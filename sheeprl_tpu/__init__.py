@@ -1,0 +1,11 @@
+"""sheeprl_tpu — a TPU-native distributed deep-RL framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capability surface of
+SheepRL (reference at /root/reference): self-contained algorithm tasks
+(PPO coupled/decoupled/recurrent, SAC, SAC-AE, DroQ, DreamerV1/2/3,
+Plan2Explore), dict-observation env pipelines, four replay-buffer semantics,
+data-parallel and player/trainer topologies over device meshes, TensorBoard
+metrics, and checkpoint/resume.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,27 @@
+from .core import Activation, Module, activation, field, static
+from .layers import Conv2d, ConvTranspose2d, LayerNorm, Linear, dropout
+from .blocks import CNN, DeCNN, MLP, MultiDecoder, MultiEncoder, NatureCNN
+from .recurrent import GRUCell, LayerNormGRUCell, LSTMCell, scan_cell
+
+__all__ = [
+    "Activation",
+    "Module",
+    "activation",
+    "field",
+    "static",
+    "Linear",
+    "Conv2d",
+    "ConvTranspose2d",
+    "LayerNorm",
+    "dropout",
+    "MLP",
+    "CNN",
+    "DeCNN",
+    "NatureCNN",
+    "MultiEncoder",
+    "MultiDecoder",
+    "GRUCell",
+    "LayerNormGRUCell",
+    "LSTMCell",
+    "scan_cell",
+]

@@ -1,0 +1,113 @@
+"""Pytree module system for the TPU-native framework.
+
+Modules are frozen dataclasses registered as JAX pytrees: parameter arrays are
+pytree leaves, configuration (sizes, activation names, ...) is static metadata.
+A module therefore *is* its parameters — it can be passed straight through
+`jax.jit`, `jax.grad`, `jax.lax.scan`, optax, and orbax without a separate
+params dict. This replaces the reference's `torch.nn.Module` layer
+(/root/reference/sheeprl/models/models.py) with a functional design that XLA
+can trace once and compile.
+
+Conventions:
+  - construction happens in classmethod `init(key, ...)` factories so the
+    dataclass `__init__` stays a plain field constructor (pytree unflatten
+    needs that);
+  - forward passes are `__call__(self, x, ...)` and must be pure;
+  - images are NHWC (channels-last) — the native TPU conv layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Module", "static", "field", "activation", "Activation"]
+
+
+def static(default: Any = dataclasses.MISSING, **kwargs: Any) -> Any:
+    """Declare a dataclass field as static pytree metadata (not a leaf)."""
+    metadata = dict(kwargs.pop("metadata", {}) or {})
+    metadata["static"] = True
+    if default is dataclasses.MISSING:
+        return dataclasses.field(metadata=metadata, **kwargs)
+    return dataclasses.field(default=default, metadata=metadata, **kwargs)
+
+
+def field(default: Any = dataclasses.MISSING, **kwargs: Any) -> Any:
+    """Declare a regular (leaf) dataclass field."""
+    if default is dataclasses.MISSING:
+        return dataclasses.field(**kwargs)
+    return dataclasses.field(default=default, **kwargs)
+
+
+class Module:
+    """Base class: subclassing turns the class into a frozen dataclass pytree."""
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        dataclasses.dataclass(frozen=True)(cls)
+        fields = dataclasses.fields(cls)
+        data = tuple(f.name for f in fields if not f.metadata.get("static"))
+        meta = tuple(f.name for f in fields if f.metadata.get("static"))
+        jax.tree_util.register_dataclass(cls, data_fields=data, meta_fields=meta)
+
+    def replace(self, **changes: Any) -> "Module":
+        return dataclasses.replace(self, **changes)
+
+    # -- convenience ---------------------------------------------------------
+    @property
+    def n_params(self) -> int:
+        return sum(
+            x.size for x in jax.tree_util.tree_leaves(self) if hasattr(x, "size")
+        )
+
+    def astype(self, dtype: jnp.dtype) -> "Module":
+        """Cast all floating-point leaves (e.g. to bfloat16 for inference)."""
+
+        def cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dtype)
+            return x
+
+        return jax.tree_util.tree_map(cast, self)
+
+
+# ---------------------------------------------------------------------------
+# Activations are referenced by name so they can live in static metadata
+# (callables in static fields would break pytree hashing across jit calls).
+# ---------------------------------------------------------------------------
+
+Activation = str | None
+
+_ACTIVATIONS: dict[str, Callable[[jax.Array], jax.Array]] = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "elu": jax.nn.elu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "softplus": jax.nn.softplus,
+    "identity": lambda x: x,
+}
+
+
+def activation(name: Activation) -> Callable[[jax.Array], jax.Array]:
+    """Resolve an activation name to its function (None -> identity)."""
+    if name is None:
+        return _ACTIVATIONS["identity"]
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError as e:
+        raise ValueError(
+            f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}"
+        ) from e
+
+
+def register_activation(name: str, fn: Callable[[jax.Array], jax.Array]) -> None:
+    _ACTIVATIONS[name] = fn

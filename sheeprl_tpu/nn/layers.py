@@ -1,0 +1,198 @@
+"""Primitive layers: Linear, Conv2d, ConvTranspose2d, LayerNorm, Dropout.
+
+TPU-first choices:
+  - convolutions run in NHWC with HWIO kernels — the layout the MXU tiles
+    natively (no transposes inserted by XLA);
+  - LayerNorm normalizes the trailing (channel) axis, so the reference's
+    `LayerNormChannelLast` NCHW<->NLC shuffle
+    (/root/reference/sheeprl/utils/model.py:225-235) disappears entirely;
+  - params are float32 by default; forward math can be bf16 via Module.astype.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .core import Module, static
+
+__all__ = ["Linear", "Conv2d", "ConvTranspose2d", "LayerNorm", "dropout"]
+
+
+def _kaiming_uniform(key, shape, fan_in, dtype=jnp.float32):
+    # matches torch's default Linear/Conv init (kaiming_uniform, a=sqrt(5))
+    bound = math.sqrt(1.0 / fan_in) * math.sqrt(3.0)
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+class Linear(Module):
+    weight: jax.Array  # [in_features, out_features]
+    bias: jax.Array | None
+
+    @classmethod
+    def init(cls, key, in_features: int, out_features: int, *, use_bias: bool = True):
+        wkey, bkey = jax.random.split(key)
+        weight = _kaiming_uniform(wkey, (in_features, out_features), in_features)
+        bias = None
+        if use_bias:
+            bound = 1.0 / math.sqrt(in_features)
+            bias = jax.random.uniform(
+                bkey, (out_features,), jnp.float32, minval=-bound, maxval=bound
+            )
+        return cls(weight=weight, bias=bias)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = x @ self.weight.astype(x.dtype)
+        if self.bias is not None:
+            y = y + self.bias.astype(x.dtype)
+        return y
+
+    @property
+    def in_features(self) -> int:
+        return self.weight.shape[0]
+
+    @property
+    def out_features(self) -> int:
+        return self.weight.shape[1]
+
+
+class Conv2d(Module):
+    """NHWC convolution with HWIO kernel."""
+
+    kernel: jax.Array  # [kh, kw, in_ch, out_ch]
+    bias: jax.Array | None
+    stride: tuple[int, int] = static(default=(1, 1))
+    padding: str | tuple[tuple[int, int], tuple[int, int]] = static(default="SAME")
+
+    @classmethod
+    def init(
+        cls,
+        key,
+        in_channels: int,
+        out_channels: int,
+        kernel_size: int | tuple[int, int],
+        *,
+        stride: int | tuple[int, int] = 1,
+        padding: str | int | tuple[int, int] = "SAME",
+        use_bias: bool = True,
+    ):
+        kh, kw = (kernel_size,) * 2 if isinstance(kernel_size, int) else kernel_size
+        stride = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+        if isinstance(padding, int):
+            padding = ((padding, padding), (padding, padding))
+        elif isinstance(padding, tuple) and isinstance(padding[0], int):
+            padding = ((padding[0], padding[0]), (padding[1], padding[1]))
+        wkey, bkey = jax.random.split(key)
+        fan_in = in_channels * kh * kw
+        kernel = _kaiming_uniform(wkey, (kh, kw, in_channels, out_channels), fan_in)
+        bias = None
+        if use_bias:
+            bound = 1.0 / math.sqrt(fan_in)
+            bias = jax.random.uniform(
+                bkey, (out_channels,), jnp.float32, minval=-bound, maxval=bound
+            )
+        return cls(kernel=kernel, bias=bias, stride=stride, padding=padding)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = jax.lax.conv_general_dilated(
+            x,
+            self.kernel.astype(x.dtype),
+            window_strides=self.stride,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.bias is not None:
+            y = y + self.bias.astype(x.dtype)
+        return y
+
+    @property
+    def in_channels(self) -> int:
+        return self.kernel.shape[2]
+
+    @property
+    def out_channels(self) -> int:
+        return self.kernel.shape[3]
+
+
+class ConvTranspose2d(Module):
+    """NHWC transposed convolution (fractionally-strided)."""
+
+    kernel: jax.Array  # [kh, kw, in_ch, out_ch]
+    bias: jax.Array | None
+    stride: tuple[int, int] = static(default=(1, 1))
+    padding: str | tuple[tuple[int, int], tuple[int, int]] = static(default="SAME")
+
+    @classmethod
+    def init(
+        cls,
+        key,
+        in_channels: int,
+        out_channels: int,
+        kernel_size: int | tuple[int, int],
+        *,
+        stride: int | tuple[int, int] = 1,
+        padding: str | int | tuple[int, int] = "SAME",
+        use_bias: bool = True,
+    ):
+        kh, kw = (kernel_size,) * 2 if isinstance(kernel_size, int) else kernel_size
+        stride = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+        if isinstance(padding, int):
+            padding = ((padding, padding), (padding, padding))
+        elif isinstance(padding, tuple) and isinstance(padding[0], int):
+            padding = ((padding[0], padding[0]), (padding[1], padding[1]))
+        wkey, bkey = jax.random.split(key)
+        fan_in = in_channels * kh * kw
+        kernel = _kaiming_uniform(wkey, (kh, kw, in_channels, out_channels), fan_in)
+        bias = None
+        if use_bias:
+            bound = 1.0 / math.sqrt(fan_in)
+            bias = jax.random.uniform(
+                bkey, (out_channels,), jnp.float32, minval=-bound, maxval=bound
+            )
+        return cls(kernel=kernel, bias=bias, stride=stride, padding=padding)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = jax.lax.conv_transpose(
+            x,
+            self.kernel.astype(x.dtype),
+            strides=self.stride,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.bias is not None:
+            y = y + self.bias.astype(x.dtype)
+        return y
+
+
+class LayerNorm(Module):
+    """LayerNorm over the trailing axis (channels in NHWC / features)."""
+
+    scale: jax.Array | None
+    offset: jax.Array | None
+    eps: float = static(default=1e-5)
+
+    @classmethod
+    def init(cls, dim: int, *, eps: float = 1e-5, elementwise_affine: bool = True):
+        if elementwise_affine:
+            return cls(scale=jnp.ones((dim,)), offset=jnp.zeros((dim,)), eps=eps)
+        return cls(scale=None, offset=None, eps=eps)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.scale is not None:
+            y = y * self.scale + self.offset
+        return y.astype(x.dtype)
+
+
+def dropout(key, x: jax.Array, rate: float, *, deterministic: bool = False):
+    """Functional inverted dropout (pure — caller threads the key)."""
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

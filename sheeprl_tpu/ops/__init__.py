@@ -1,0 +1,25 @@
+from .math import (
+    gae,
+    lambda_values,
+    lambda_values_dv3,
+    normalize,
+    polynomial_decay,
+    symexp,
+    symlog,
+    two_hot,
+)
+from .moments import Moments
+from . import distributions
+
+__all__ = [
+    "gae",
+    "lambda_values",
+    "lambda_values_dv3",
+    "normalize",
+    "polynomial_decay",
+    "symexp",
+    "symlog",
+    "two_hot",
+    "Moments",
+    "distributions",
+]

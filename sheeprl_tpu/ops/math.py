@@ -1,0 +1,172 @@
+"""Core RL math as pure, jittable functions (`lax.scan` for all recurrences).
+
+Semantics mirror the reference (/root/reference/sheeprl/utils/utils.py:8-133,
+algos/dreamer_v3/utils.py:45-56) but every reverse-time recursion is a single
+`lax.scan` — traced once, fused by XLA — instead of a Python loop over T.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "symlog",
+    "symexp",
+    "gae",
+    "lambda_values",
+    "lambda_values_dv3",
+    "two_hot",
+    "normalize",
+    "polynomial_decay",
+]
+
+
+def symlog(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    next_done: jax.Array,
+    gamma: float,
+    gae_lambda: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation (arXiv:1506.02438).
+
+    All of rewards/values/dones are time-major `[T, ...]`; `next_value` /
+    `next_done` bootstrap the step after the rollout. Returns
+    (returns, advantages), both `[T, ...]`. Matches the reference recursion
+    (/root/reference/sheeprl/utils/utils.py:8-48).
+    """
+    dones = dones.astype(jnp.float32)
+    next_nonterminal = jnp.concatenate(
+        [1.0 - dones[1:], (1.0 - next_done.astype(jnp.float32))[None]], axis=0
+    )
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+    deltas = rewards + gamma * next_values * next_nonterminal - values
+
+    def step(carry, inp):
+        delta, nonterm = inp
+        adv = delta + gamma * gae_lambda * nonterm * carry
+        return adv, adv
+
+    _, advantages = jax.lax.scan(
+        step, jnp.zeros_like(next_value), (deltas, next_nonterminal), reverse=True
+    )
+    returns = advantages + values
+    return returns, advantages
+
+
+def lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    done_mask: jax.Array,
+    last_values: jax.Array,
+    horizon: int,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(lambda) targets for DreamerV1/V2 imagination
+    (/root/reference/sheeprl/utils/utils.py:51-86). Output is `[horizon-1, ...]`;
+    gradients flow through values/rewards. `done_mask` is the (already
+    gamma-scaled) continuation mask the callers pass."""
+    next_vals = jnp.concatenate(
+        [values[1 : horizon - 1] * (1.0 - lmbda), last_values[None]], axis=0
+    )
+    deltas = rewards[: horizon - 1] + next_vals * done_mask[: horizon - 1]
+
+    def step(carry, inp):
+        delta, mask = inp
+        lv = delta + lmbda * mask * carry
+        return lv, lv
+
+    _, out = jax.lax.scan(
+        step,
+        jnp.zeros_like(last_values),
+        (deltas, done_mask[: horizon - 1]),
+        reverse=True,
+    )
+    return out
+
+
+def lambda_values_dv3(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DreamerV3 lambda-return variant
+    (/root/reference/sheeprl/algos/dreamer_v3/utils.py:45-56): inputs are the
+    1-step-shifted imagination tensors `[T, ...]`; recursion bootstraps from
+    values[-1]."""
+    interm = rewards + continues * values * (1.0 - lmbda)
+
+    def step(carry, inp):
+        i_t, c_t = inp
+        v = i_t + c_t * lmbda * carry
+        return v, v
+
+    _, out = jax.lax.scan(step, values[-1], (interm, continues), reverse=True)
+    return out
+
+
+def two_hot(
+    x: jax.Array, bins: jax.Array
+) -> jax.Array:
+    """Two-hot encoding of scalar targets over a monotonic bin support
+    (DreamerV3, /root/reference/sheeprl/utils/distribution.py:220-266).
+
+    x: [...] scalars; bins: [K]. Returns [..., K] with mass split between the
+    two neighboring bins, all weight on an edge bin when out of range.
+    """
+    k = bins.shape[0]
+    below = jnp.sum((bins <= x[..., None]).astype(jnp.int32), axis=-1) - 1
+    above = k - jnp.sum((bins > x[..., None]).astype(jnp.int32), axis=-1)
+    below = jnp.clip(below, 0, k - 1)
+    above = jnp.clip(above, 0, k - 1)
+    equal = below == above
+    dist_to_below = jnp.where(equal, 1.0, jnp.abs(bins[below] - x))
+    dist_to_above = jnp.where(equal, 1.0, jnp.abs(bins[above] - x))
+    total = dist_to_below + dist_to_above
+    w_below = dist_to_above / total
+    w_above = dist_to_below / total
+    target = (
+        jax.nn.one_hot(below, k) * w_below[..., None]
+        + jax.nn.one_hot(above, k) * w_above[..., None]
+    )
+    return target
+
+
+def normalize(x: jax.Array, eps: float = 1e-8, mask: jax.Array | None = None):
+    """(x - mean) / (std + eps), statistics over masked entries
+    (/root/reference/sheeprl/utils/utils.py:106-112)."""
+    if mask is None:
+        mean, std = x.mean(), x.std()
+    else:
+        mask = mask.astype(jnp.float32)
+        n = jnp.maximum(mask.sum(), 1.0)
+        mean = (x * mask).sum() / n
+        var = (jnp.square(x - mean) * mask).sum() / n
+        std = jnp.sqrt(var)
+    return (x - mean) / (std + eps)
+
+
+def polynomial_decay(
+    current_step: int,
+    *,
+    initial: float = 1.0,
+    final: float = 0.0,
+    max_decay_steps: int = 100,
+    power: float = 1.0,
+) -> float:
+    """Host-side schedule helper (/root/reference/sheeprl/utils/utils.py:114-125)."""
+    if current_step > max_decay_steps or initial == final:
+        return final
+    return (initial - final) * ((1 - current_step / max_decay_steps) ** power) + final

@@ -1,0 +1,60 @@
+"""DreamerV3 return normalizer: EMA of cross-device return percentiles.
+
+Functional port of the reference `Moments`
+(/root/reference/sheeprl/algos/dreamer_v3/utils.py:17-42), whose forward pass
+contains a collective (`fabric.all_gather`). Here the state is a tiny pytree
+and the update is a pure function that can run inside a jitted, sharded train
+step: pass `axis_name` when running under `shard_map` so the percentiles are
+computed over the *global* batch via `lax.all_gather` riding ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.core import Module, static
+
+__all__ = ["Moments"]
+
+
+class Moments(Module):
+    low: jax.Array
+    high: jax.Array
+    decay: float = static(default=0.99)
+    maximum: float = static(default=1e8)
+    percentile_low: float = static(default=0.05)
+    percentile_high: float = static(default=0.95)
+
+    @classmethod
+    def init(
+        cls,
+        decay: float = 0.99,
+        maximum: float = 1e8,
+        percentile_low: float = 0.05,
+        percentile_high: float = 0.95,
+    ) -> "Moments":
+        return cls(
+            low=jnp.zeros(()),
+            high=jnp.zeros(()),
+            decay=decay,
+            maximum=maximum,
+            percentile_low=percentile_low,
+            percentile_high=percentile_high,
+        )
+
+    def update(
+        self, x: jax.Array, axis_name: str | None = None
+    ) -> tuple["Moments", tuple[jax.Array, jax.Array]]:
+        """Returns (new_state, (offset, invscale)) for return normalization."""
+        x = jax.lax.stop_gradient(x)
+        if axis_name is not None:
+            x = jax.lax.all_gather(x, axis_name)
+        flat = x.reshape(-1)
+        low = jnp.quantile(flat, self.percentile_low)
+        high = jnp.quantile(flat, self.percentile_high)
+        new_low = self.decay * self.low + (1.0 - self.decay) * low
+        new_high = self.decay * self.high + (1.0 - self.decay) * high
+        invscale = jnp.maximum(1.0 / self.maximum, new_high - new_low)
+        new = self.replace(low=new_low, high=new_high)
+        return new, (new_low, invscale)

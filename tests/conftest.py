@@ -1,0 +1,41 @@
+"""Test harness: run everything on the CPU backend with 8 virtual devices so
+multi-device mesh semantics are exercised without TPU hardware — the JAX
+equivalent of the reference's Gloo-on-CPU distributed tests
+(/root/reference/tests/test_algos/test_algos.py:16-38).
+
+NOTE on the axon TPU tunnel: this image's sitecustomize registers an `axon`
+PJRT plugin and force-sets `jax_platforms="axon,cpu"` at interpreter start,
+overriding the JAX_PLATFORMS env var. Tests must run on local CPU (fast,
+deterministic, and immune to tunnel flakiness), so we update the jax config
+directly — config updates win over the sitecustomize write — and blank the
+pool-IPs var so subprocesses spawned by tests skip axon registration.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # children: skip axon registration
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("SHEEPRL_TPU_TEST", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _assert_cpu_backend() -> None:
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", devices
+    assert len(devices) == 8, devices
+
+
+_assert_cpu_backend()

@@ -1,0 +1,148 @@
+"""Shape/gradient contracts for the nn layer (mirrors the reference's
+tests/test_models/{test_mlp,test_cnn}.py strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu import nn
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_linear_shapes():
+    lin = nn.Linear.init(KEY, 5, 3)
+    x = jnp.ones((7, 5))
+    assert lin(x).shape == (7, 3)
+    lin_nb = nn.Linear.init(KEY, 5, 3, use_bias=False)
+    assert lin_nb.bias is None
+    assert lin_nb(x).shape == (7, 3)
+
+
+def test_mlp_shapes_and_head():
+    mlp = nn.MLP.init(KEY, 4, [8, 8], 2, act="relu", layer_norm=True)
+    x = jnp.ones((3, 4))
+    assert mlp(x).shape == (3, 2)
+    assert mlp.output_dim == 2
+    no_head = nn.MLP.init(KEY, 4, [8, 16])
+    assert no_head(x).shape == (3, 16)
+    assert no_head.output_dim == 16
+
+
+def test_mlp_is_pytree_and_jits():
+    mlp = nn.MLP.init(KEY, 4, [8], 2)
+    leaves = jax.tree_util.tree_leaves(mlp)
+    assert all(isinstance(leaf, jax.Array) for leaf in leaves)
+
+    @jax.jit
+    def f(m, x):
+        return m(x).sum()
+
+    g = jax.grad(f)(mlp, jnp.ones((3, 4)))
+    assert isinstance(g, nn.MLP)
+    assert g.layers[0].weight.shape == mlp.layers[0].weight.shape
+
+
+def test_mlp_dropout_deterministic_vs_train():
+    mlp = nn.MLP.init(KEY, 4, [32, 32], dropout_rate=0.5)
+    x = jnp.ones((2, 4))
+    eval_out = mlp(x)
+    train_out = mlp(x, key=jax.random.PRNGKey(1), training=True)
+    assert not np.allclose(eval_out, train_out)
+
+
+def test_cnn_nhwc():
+    cnn = nn.CNN.init(KEY, 3, [16, 32], [3, 3], [2, 2], layer_norm=True)
+    x = jnp.ones((2, 16, 16, 3))
+    y = cnn(x)
+    assert y.shape == (2, 4, 4, 32)
+    # leading batch dims folded
+    y2 = cnn(jnp.ones((5, 2, 16, 16, 3)))
+    assert y2.shape == (5, 2, 4, 4, 32)
+
+
+def test_decnn_upsamples():
+    de = nn.DeCNN.init(KEY, 8, [16, 3], [4, 4], [2, 2])
+    x = jnp.ones((2, 4, 4, 8))
+    y = de(x)
+    assert y.shape == (2, 16, 16, 3)
+
+
+def test_nature_cnn_output_dim():
+    enc = nn.NatureCNN.init(KEY, 4, 512, screen_size=64)
+    x = jnp.ones((2, 64, 64, 4))
+    assert enc(x).shape == (2, 512)
+    assert enc.output_dim == 512
+
+
+def test_gru_cells():
+    for cls in (nn.GRUCell, nn.LayerNormGRUCell):
+        cell = cls.init(KEY, 6, 12)
+        x = jnp.ones((3, 6))
+        h = jnp.zeros((3, 12))
+        h2 = cell(x, h)
+        assert h2.shape == (3, 12)
+        assert not np.allclose(h2, h)
+
+
+def test_lstm_cell_and_scan():
+    cell = nn.LSTMCell.init(KEY, 6, 12)
+    xs = jnp.ones((5, 3, 6))
+    h0 = cell.initial_state((3,))
+    (hT, cT), ys = nn.scan_cell(cell, xs, h0)
+    assert ys.shape == (5, 3, 12)
+    assert hT.shape == (3, 12) and cT.shape == (3, 12)
+
+
+def test_scan_cell_reset_mask():
+    cell = nn.GRUCell.init(KEY, 4, 8)
+    xs = jax.random.normal(KEY, (6, 2, 4))
+    h0 = jnp.ones((2, 8))
+    # resetting at t=0 must equal starting from zeros
+    mask = jnp.zeros((6, 2)).at[0].set(1.0)
+    _, ys_reset = nn.scan_cell(cell, xs, h0, reset_mask=mask)
+    _, ys_zero = nn.scan_cell(cell, xs, jnp.zeros((2, 8)))
+    np.testing.assert_allclose(ys_reset, ys_zero, rtol=1e-5)
+
+
+def test_multi_encoder_decoder():
+    k1, k2, k3 = jax.random.split(KEY, 3)
+    cnn_enc = nn.NatureCNN.init(k1, 6, 32, screen_size=64)
+    mlp_enc = nn.MLP.init(k2, 5, [16])
+    enc = nn.MultiEncoder(
+        cnn_encoder=cnn_enc,
+        mlp_encoder=mlp_enc,
+        cnn_keys=("rgb", "depth"),
+        mlp_keys=("state",),
+    )
+    obs = {
+        "rgb": jnp.ones((2, 64, 64, 3)),
+        "depth": jnp.ones((2, 64, 64, 3)),
+        "state": jnp.ones((2, 5)),
+    }
+    feat = enc(obs)
+    assert feat.shape == (2, 32 + 16)
+
+    mlp_dec = nn.MLP.init(k3, 48, [16])
+    heads = {"state": nn.Linear.init(k3, 16, 5)}
+    dec = nn.MultiDecoder(
+        cnn_decoder=None,
+        mlp_decoder=mlp_dec,
+        mlp_heads=heads,
+        mlp_keys=("state",),
+    )
+    out = dec(feat)
+    assert out["state"].shape == (2, 5)
+
+
+def test_astype_bf16():
+    mlp = nn.MLP.init(KEY, 4, [8], 2)
+    bf = mlp.astype(jnp.bfloat16)
+    assert bf.layers[0].weight.dtype == jnp.bfloat16
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError):
+        nn.activation("nope")

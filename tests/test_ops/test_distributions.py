@@ -1,0 +1,154 @@
+"""Statistical and gradient contracts for the distribution library."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.ops import distributions as D
+from sheeprl_tpu.ops import symlog
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_normal_log_prob_matches_formula():
+    d = D.Normal(loc=jnp.array(1.0), scale=jnp.array(2.0))
+    x = jnp.array(0.5)
+    expected = -0.5 * ((0.5 - 1.0) / 2.0) ** 2 - math.log(2.0) - 0.5 * math.log(2 * math.pi)
+    np.testing.assert_allclose(d.log_prob(x), expected, rtol=1e-6)
+    np.testing.assert_allclose(d.entropy(), 0.5 * math.log(2 * math.pi * math.e) + math.log(2.0))
+
+
+def test_independent_sums_event_dims():
+    d = D.Independent(base=D.Normal(loc=jnp.zeros((3, 4)), scale=jnp.ones((3, 4))), event_ndims=1)
+    lp = d.log_prob(jnp.zeros((3, 4)))
+    assert lp.shape == (3,)
+    np.testing.assert_allclose(lp, 4 * (-0.5 * math.log(2 * math.pi)) * np.ones(3), rtol=1e-6)
+
+
+def test_tanh_normal_log_prob_consistency():
+    d = D.TanhNormal(loc=jnp.zeros((5, 3)), scale=jnp.ones((5, 3)) * 0.5)
+    a, lp = d.sample_and_log_prob(KEY)
+    assert a.shape == (5, 3) and lp.shape == (5,)
+    assert np.all(np.abs(a) < 1.0)
+    # compare against naive formula
+    u = np.arctanh(np.asarray(a))
+    base = -0.5 * (u / 0.5) ** 2 - math.log(0.5) - 0.5 * math.log(2 * math.pi)
+    corr = np.log(1 - np.tanh(u) ** 2 + 1e-12)
+    np.testing.assert_allclose(lp, (base - corr).sum(-1), rtol=1e-3, atol=1e-3)
+
+
+def test_truncated_normal_bounds_and_moments():
+    d = D.TruncatedNormal(
+        loc=jnp.zeros(()), scale=jnp.ones(()), low=jnp.array(-1.0), high=jnp.array(1.0)
+    )
+    s = d.sample(KEY, (20000,))
+    assert np.all(np.asarray(s) >= -1.0) and np.all(np.asarray(s) <= 1.0)
+    np.testing.assert_allclose(np.mean(np.asarray(s)), 0.0, atol=0.02)
+    # known variance of standard normal truncated to [-1, 1] ~ 0.29112
+    np.testing.assert_allclose(np.var(np.asarray(s)), 0.29112, atol=0.01)
+    # entropy of truncated standard normal on [-1,1]:
+    # log sqrt(2*pi*e) + log Z - (b*phi(b) - a*phi(a))/(2Z) = 0.68283
+    np.testing.assert_allclose(float(d.entropy()), 0.68283, atol=1e-3)
+
+
+def test_categorical_sample_and_entropy():
+    logits = jnp.log(jnp.array([0.7, 0.2, 0.1]))
+    d = D.Categorical.from_logits(jnp.broadcast_to(logits, (5000, 3)))
+    s = d.sample(KEY)
+    freq = np.bincount(np.asarray(s), minlength=3) / 5000
+    np.testing.assert_allclose(freq, [0.7, 0.2, 0.1], atol=0.03)
+    expected_h = -(0.7 * math.log(0.7) + 0.2 * math.log(0.2) + 0.1 * math.log(0.1))
+    np.testing.assert_allclose(d.entropy()[0], expected_h, rtol=1e-4)
+    np.testing.assert_allclose(d.log_prob(jnp.zeros(5000, jnp.int32))[0], math.log(0.7), rtol=1e-4)
+
+
+def test_one_hot_straight_through_gradients():
+    logits = jnp.array([[1.0, 0.0, -1.0]])
+
+    def f(lg):
+        d = D.OneHotCategorical.from_logits(lg)
+        s = d.rsample(KEY)
+        return (s * jnp.arange(3.0)).sum()
+
+    g = jax.grad(f)(logits)
+    assert np.any(np.asarray(g) != 0.0)  # gradients flow through probs
+
+
+def test_unimix_logits():
+    logits = jnp.array([100.0, 0.0, 0.0])  # near-deterministic
+    mixed = D.unimix_logits(logits, 0.01)
+    probs = np.asarray(jax.nn.softmax(mixed))
+    assert probs[1] > 0.003  # uniform mass injected (0.01/3)
+    np.testing.assert_allclose(probs.sum(), 1.0, rtol=1e-6)
+
+
+def test_bernoulli_log_prob_and_mode():
+    d = D.Bernoulli(logits=jnp.array([2.0, -2.0]))
+    np.testing.assert_allclose(np.asarray(d.mode), [1.0, 0.0])
+    lp = d.log_prob(jnp.array([1.0, 1.0]))
+    p = 1 / (1 + math.exp(-2.0))
+    np.testing.assert_allclose(lp, [math.log(p), math.log(1 - p)], rtol=1e-4)
+
+
+def test_symlog_distribution():
+    mode = symlog(jnp.array([[3.0, -5.0]]))
+    d = D.SymlogDistribution(_mode=mode, dims=1)
+    np.testing.assert_allclose(d.mode, [[3.0, -5.0]], rtol=1e-4)
+    np.testing.assert_allclose(d.log_prob(jnp.array([[3.0, -5.0]])), [0.0], atol=1e-5)
+    assert float(d.log_prob(jnp.array([[10.0, -5.0]]))[0]) < 0.0
+
+
+def test_mse_distribution():
+    d = D.MSEDistribution(_mode=jnp.array([[1.0, 2.0]]), dims=1)
+    np.testing.assert_allclose(d.log_prob(jnp.array([[0.0, 0.0]])), [-(1.0 + 4.0)], rtol=1e-6)
+
+
+def test_two_hot_distribution_roundtrip():
+    # logits that put all mass on the bin closest to symlog(7.0)
+    bins = np.linspace(-20, 20, 255)
+    target_bin = np.argmin(np.abs(bins - float(symlog(jnp.array(7.0)))))
+    logits = jnp.full((1, 255), -1e9).at[0, target_bin].set(0.0)
+    d = D.TwoHotEncodingDistribution(logits=logits, dims=1)
+    assert abs(float(d.mean[0, 0]) - 7.0) < 1.0
+    lp_near = float(d.log_prob(jnp.array([[float(d.mean[0, 0])]]))[0])
+    lp_far = float(d.log_prob(jnp.array([[-15.0]]))[0])
+    assert lp_near > lp_far
+
+
+def test_two_hot_log_prob_is_cross_entropy():
+    key = jax.random.PRNGKey(3)
+    logits = jax.random.normal(key, (4, 255))
+    d = D.TwoHotEncodingDistribution(logits=logits, dims=1)
+    lp = d.log_prob(jnp.ones((4, 1)) * 2.5)
+    assert lp.shape == (4,)
+    assert np.all(np.asarray(lp) <= 0.0)
+
+
+def test_kl_categorical():
+    p = jnp.log(jnp.array([[0.5, 0.5]]))
+    q = jnp.log(jnp.array([[0.9, 0.1]]))
+    kl = D.kl_categorical(p, q, event_ndims=0)
+    expected = 0.5 * math.log(0.5 / 0.9) + 0.5 * math.log(0.5 / 0.1)
+    np.testing.assert_allclose(kl[0], expected, rtol=1e-4)
+    assert float(D.kl_categorical(p, p, event_ndims=0)[0]) == 0.0
+
+
+def test_kl_normal():
+    p = D.Normal(loc=jnp.zeros((1, 2)), scale=jnp.ones((1, 2)))
+    q = D.Normal(loc=jnp.ones((1, 2)), scale=jnp.ones((1, 2)) * 2.0)
+    kl = D.kl_normal(p, q)
+    per_dim = 0.5 * (0.25 + 0.25 - 1 - math.log(0.25))
+    np.testing.assert_allclose(kl[0], 2 * per_dim, rtol=1e-4)
+
+
+def test_distributions_work_under_jit():
+    @jax.jit
+    def f(key, loc):
+        d = D.TanhNormal(loc=loc, scale=jnp.ones_like(loc))
+        a, lp = d.sample_and_log_prob(key)
+        return a.sum() + lp.sum()
+
+    out = f(KEY, jnp.zeros((2, 3)))
+    assert np.isfinite(float(out))

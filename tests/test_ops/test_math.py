@@ -1,0 +1,130 @@
+"""Numerical contracts for RL math against straightforward NumPy recursions
+written from the definitions (GAE: arXiv:1506.02438; lambda-returns: Dreamer)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu import ops
+
+
+def _np_gae(rewards, values, dones, next_value, next_done, gamma, lam):
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    lastgaelam = 0.0
+    for t in reversed(range(T)):
+        if t == T - 1:
+            nonterm = 1.0 - next_done
+            nxt = next_value
+        else:
+            nonterm = 1.0 - dones[t + 1]
+            nxt = values[t + 1]
+        delta = rewards[t] + gamma * nxt * nonterm - values[t]
+        lastgaelam = delta + gamma * lam * nonterm * lastgaelam
+        adv[t] = lastgaelam
+    return adv + values, adv
+
+
+def test_gae_matches_reference_recursion():
+    rng = np.random.default_rng(0)
+    T, B = 16, 4
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    dones = (rng.random((T, B)) < 0.2).astype(np.float32)
+    next_value = rng.normal(size=(B,)).astype(np.float32)
+    next_done = (rng.random((B,)) < 0.2).astype(np.float32)
+    ret, adv = ops.gae(
+        jnp.array(rewards), jnp.array(values), jnp.array(dones),
+        jnp.array(next_value), jnp.array(next_done), 0.99, 0.95,
+    )
+    ret_np, adv_np = _np_gae(rewards, values, dones, next_value, next_done, 0.99, 0.95)
+    np.testing.assert_allclose(adv, adv_np, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ret, ret_np, rtol=1e-4, atol=1e-5)
+
+
+def test_lambda_values_dv12_recursion():
+    rng = np.random.default_rng(1)
+    H, B = 15, 3
+    rewards = rng.normal(size=(H, B)).astype(np.float32)
+    values = rng.normal(size=(H, B)).astype(np.float32)
+    mask = np.full((H, B), 0.99, dtype=np.float32)
+    last = values[-1]
+    lmbda = 0.95
+    out = ops.lambda_values(
+        jnp.array(rewards), jnp.array(values), jnp.array(mask), jnp.array(last), H, lmbda
+    )
+    # reference-style recursion (/root/reference/sheeprl/utils/utils.py:51-86)
+    lam_vals = np.zeros((H - 1, B), dtype=np.float32)
+    carry = np.zeros(B, dtype=np.float32)
+    for step in reversed(range(H - 1)):
+        nxt = last if step == H - 2 else values[step + 1] * (1 - lmbda)
+        delta = rewards[step] + nxt * mask[step]
+        carry = delta + lmbda * mask[step] * carry
+        lam_vals[step] = carry
+    np.testing.assert_allclose(out, lam_vals, rtol=1e-4, atol=1e-5)
+
+
+def test_lambda_values_dv3_recursion():
+    rng = np.random.default_rng(2)
+    T, B = 14, 3
+    rewards = rng.normal(size=(T, B, 1)).astype(np.float32)
+    values = rng.normal(size=(T, B, 1)).astype(np.float32)
+    conts = np.full((T, B, 1), 0.997, dtype=np.float32)
+    out = ops.lambda_values_dv3(jnp.array(rewards), jnp.array(values), jnp.array(conts), 0.95)
+    interm = rewards + conts * values * (1 - 0.95)
+    carry = values[-1]
+    expected = np.zeros_like(rewards)
+    for t in reversed(range(T)):
+        carry = interm[t] + conts[t] * 0.95 * carry
+        expected[t] = carry
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_symlog_symexp_inverse():
+    x = jnp.array([-100.0, -1.0, 0.0, 0.5, 10.0, 1e4])
+    np.testing.assert_allclose(ops.symexp(ops.symlog(x)), x, rtol=1e-3)
+
+
+def test_two_hot_partitions_mass():
+    bins = jnp.linspace(-20.0, 20.0, 255)
+    x = jnp.array([0.0, 3.3, -7.77, 25.0, -25.0])  # incl. out-of-range
+    t = ops.two_hot(x, bins)
+    assert t.shape == (5, 255)
+    np.testing.assert_allclose(t.sum(-1), np.ones(5), rtol=1e-5)
+    # expectation reconstructs in-range values
+    recon = (t * bins).sum(-1)
+    np.testing.assert_allclose(recon[:3], np.array([0.0, 3.3, -7.77]), atol=1e-3)
+    # out-of-range snaps to edge bins
+    np.testing.assert_allclose(recon[3:], np.array([20.0, -20.0]), atol=1e-5)
+
+
+def test_two_hot_exact_bin_is_one_hot():
+    bins = jnp.linspace(-2.0, 2.0, 5)  # bins at -2,-1,0,1,2
+    t = ops.two_hot(jnp.array([1.0]), bins)
+    np.testing.assert_allclose(t[0], np.array([0, 0, 0, 1, 0]), atol=1e-6)
+
+
+def test_normalize_masked():
+    x = jnp.array([1.0, 2.0, 3.0, 100.0])
+    mask = jnp.array([True, True, True, False])
+    out = ops.normalize(x, mask=mask)
+    np.testing.assert_allclose(out[:3].mean(), 0.0, atol=1e-6)
+
+
+def test_polynomial_decay():
+    assert ops.polynomial_decay(0, initial=1.0, final=0.0, max_decay_steps=10) == 1.0
+    assert ops.polynomial_decay(10, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    assert ops.polynomial_decay(11, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    mid = ops.polynomial_decay(5, initial=1.0, final=0.0, max_decay_steps=10)
+    assert 0.0 < mid < 1.0
+
+
+def test_moments_update():
+    m = ops.Moments.init(decay=0.5)
+    x = jnp.linspace(0.0, 1.0, 101)
+    m2, (offset, invscale) = m.update(x)
+    assert m2.low > m.low and m2.high > m.high
+    assert invscale > 0
+    # jits cleanly with the state as a pytree
+    m3, _ = jax.jit(lambda s, v: s.update(v))(m2, x)
+    assert float(m3.high) > float(m2.high)

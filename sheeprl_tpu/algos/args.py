@@ -1,0 +1,75 @@
+"""Base config dataclass shared by every algorithm task.
+
+Capability parity with the reference StandardArgs
+(/root/reference/sheeprl/algos/args.py:9-46), with TPU-flavored additions:
+`platform` (jax platform pin), `mesh_shape` / `data_axis` (device-mesh
+parallelism instead of DDP world size), and `precision` (bf16 compute).
+Setting `log_dir` dumps `args.json` into the run directory, matching the
+reference's side effect (algos/args.py:41-46).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+from ..utils.parser import Arg
+
+
+@dataclasses.dataclass
+class StandardArgs:
+    exp_name: str = Arg(default="default", help="name of this experiment")
+    seed: int = Arg(default=42, help="experiment PRNG seed")
+    dry_run: bool = Arg(default=False, help="run one tiny iteration of everything and exit")
+    deterministic: bool = Arg(
+        default=False, help="force deterministic XLA ops (jax_default_matmul_precision, no autotune)"
+    )
+    env_id: str = Arg(default="CartPole-v1", help="environment id")
+    num_envs: int = Arg(default=4, help="number of parallel environments")
+    sync_env: bool = Arg(default=False, help="use the synchronous vector env runner")
+    root_dir: Optional[str] = Arg(default=None, help="root folder for logs of this experiment")
+    run_name: Optional[str] = Arg(default=None, help="folder name of this run")
+    action_repeat: int = Arg(default=1, help="number of action repeats")
+    memmap_buffer: bool = Arg(
+        default=False,
+        help="keep replay storage on host (numpy memmap) instead of device HBM; "
+        "for pixel off-policy runs with >=1e6 capacity",
+    )
+    checkpoint_every: int = Arg(default=100, help="checkpoint period in policy steps; -1 disables")
+    checkpoint_path: Optional[str] = Arg(default=None, help="checkpoint to resume from")
+    screen_size: int = Arg(default=64, help="side of pixel observations")
+    frame_stack: int = Arg(default=-1, help="frames to stack for pixel observations")
+    frame_stack_dilation: int = Arg(default=1, help="dilation between stacked frames")
+    max_episode_steps: int = Arg(
+        default=-1,
+        help="max episode length in env steps (divided by action_repeat); -1 disables",
+    )
+    # --- TPU-native execution knobs (no reference equivalent) ---
+    platform: Optional[str] = Arg(
+        default=None, help="jax platform to run on (tpu|cpu|None=jax default)"
+    )
+    num_devices: int = Arg(
+        default=-1, help="number of devices in the data mesh axis; -1 = all local devices"
+    )
+    precision: str = Arg(default="float32", help="compute dtype for the train step (float32|bfloat16)")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        super().__setattr__(name, value)
+        if name == "log_dir" and value:
+            os.makedirs(value, exist_ok=True)
+            with open(os.path.join(value, "args.json"), "w") as fh:
+                json.dump(self.as_dict(), fh)
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if f.init
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]):
+        keys = {f.name for f in dataclasses.fields(cls) if f.init}
+        return cls(**{k: v for k, v in d.items() if k in keys})

@@ -1,0 +1,38 @@
+"""CLI launcher: `python -m sheeprl_tpu <task> [--flags]`.
+
+The reference's click-group + torchrun self-spawn machinery
+(/root/reference/sheeprl/cli.py:19-90) collapses here: JAX is SPMD —
+one process drives all local devices, so decoupled (player/trainer)
+topologies run as sub-meshes of a single program instead of torchrun
+process groups. Multi-host pods launch one process per host externally and
+call `jax.distributed.initialize` (see sheeprl_tpu/parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .utils.registry import decoupled_tasks, tasks
+
+
+def _print_usage() -> None:
+    print("usage: sheeprl_tpu <task> [--flags] | sheeprl_tpu --help")
+    print("\navailable tasks:")
+    for name in sorted(tasks):
+        kind = " (decoupled)" if name in decoupled_tasks else ""
+        print(f"  {name}{kind}")
+
+
+def run(argv: list[str] | None = None) -> None:
+    from . import algos  # noqa: F401 -- imports fire @register_algorithm
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        _print_usage()
+        return
+    task = argv[0]
+    if task not in tasks:
+        print(f"unknown task {task!r}", file=sys.stderr)
+        _print_usage()
+        raise SystemExit(2)
+    tasks[task](argv[1:])

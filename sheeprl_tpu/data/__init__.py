@@ -1,0 +1,3 @@
+from .buffers import AsyncReplayBuffer, EpisodeBuffer, ReplayBuffer, SequentialReplayBuffer
+
+__all__ = ["ReplayBuffer", "SequentialReplayBuffer", "EpisodeBuffer", "AsyncReplayBuffer"]

@@ -1,0 +1,683 @@
+"""Replay / rollout buffers, TPU-native.
+
+Re-designs the reference's four TensorDict buffer semantics
+(/root/reference/sheeprl/data/buffers.py) around two storage backends:
+
+  - **device** (default): every key is a `jax.Array` ring `[capacity, n_envs,
+    *item]` resident in HBM. `add` is a jitted, donated scatter
+    (`.at[idx].set`) so the ring is updated in place without host round
+    trips; `sample` is a jitted gather whose random indices are drawn with
+    `jax.random` *on device*. Under a mesh the ring can be sharded on the
+    env axis, making sampling a local gather + no collective.
+  - **host**: numpy (optionally `np.memmap`) ring with identical index
+    semantics, for capacities that exceed HBM (the reference's
+    `memmap_buffer=True` pixel-Dreamer case); samples are assembled on host
+    and handed to jit as one batch per train step.
+
+Batches are plain `dict[str, array]` (a pytree) instead of TensorDicts.
+Data layout is `[T, n_envs, *item]` on `add` and the reference's sampling
+contracts are preserved:
+  - `ReplayBuffer.sample` -> `[batch, *item]` uniform over valid entries,
+    excluding the write head (buffers.py:153-194), with optional
+    `next_{key}` synthesis from `idx+1 % capacity` (buffers.py:196-204);
+  - `SequentialReplayBuffer.sample` -> `[n_samples, seq_len, batch, *item]`
+    contiguous windows whose start indices avoid `[pos-seq_len, pos)` when
+    full (buffers.py:287-316), each window drawn from a single env;
+  - `EpisodeBuffer` stores whole episodes, evicts oldest first, and samples
+    windows with optional `prioritize_ends` (buffers.py:351-534);
+  - `AsyncReplayBuffer` keeps one independent buffer per env with per-env
+    `add(data, indices)` (buffers.py:537-699).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from functools import partial
+from pathlib import Path
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "EpisodeBuffer",
+    "AsyncReplayBuffer",
+]
+
+Batch = dict[str, np.ndarray]
+
+
+def _as_time_env(data: Mapping[str, np.ndarray]) -> Batch:
+    d = dict(data)
+    shapes = {k: v.shape[:2] for k, v in d.items()}
+    first = next(iter(shapes.values()))
+    if any(s != first for s in shapes.values()):
+        raise ValueError(f"inconsistent [T, n_envs] leading dims: {shapes}")
+    return d
+
+
+class ReplayBuffer:
+    """Circular buffer `[capacity, n_envs]`; uniform sampling."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        storage: str = "device",
+        memmap_dir: str | os.PathLike | None = None,
+        obs_keys: Sequence[str] = ("observations",),
+        seed: int = 0,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"buffer size must be > 0, got {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"n_envs must be > 0, got {n_envs}")
+        if storage not in ("device", "host"):
+            raise ValueError(f"storage must be 'device' or 'host', got {storage!r}")
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._storage_kind = storage
+        self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
+        if self._memmap_dir is not None:
+            self._memmap_dir.mkdir(parents=True, exist_ok=True)
+        self.obs_keys = tuple(obs_keys)
+        self._buf: dict[str, np.ndarray] | dict[str, jax.Array] | None = None
+        self._pos = 0
+        self._full = False
+        self._np_rng = np.random.default_rng(seed)
+        self._key = jax.random.PRNGKey(seed)
+
+    # -- properties mirroring the reference API ------------------------------
+    @property
+    def buffer(self):
+        return self._buf
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def full(self) -> bool:
+        return self._full
+
+    @property
+    def is_device_backed(self) -> bool:
+        return self._storage_kind == "device"
+
+    @property
+    def shape(self):
+        if self._buf is None:
+            return None
+        return (self._buffer_size, self._n_envs)
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def __getitem__(self, key: str):
+        if self._buf is None:
+            raise RuntimeError("buffer not initialized; add data first")
+        return self._buf[key]
+
+    def __setitem__(self, key: str, value) -> None:
+        if self._buf is None:
+            raise RuntimeError("buffer not initialized; add data first")
+        expected = (self._buffer_size, self._n_envs)
+        if tuple(value.shape[:2]) != expected:
+            raise ValueError(f"value must have leading shape {expected}")
+        if self._storage_kind == "device":
+            self._buf[key] = jnp.asarray(value)
+        else:
+            self._buf[key][:] = np.asarray(value)
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- allocation ----------------------------------------------------------
+    def _allocate(self, data: Batch) -> None:
+        buf: dict = {}
+        for k, v in data.items():
+            item_shape = v.shape[2:]
+            full_shape = (self._buffer_size, self._n_envs, *item_shape)
+            if self._storage_kind == "device":
+                buf[k] = jnp.zeros(full_shape, dtype=v.dtype)
+            elif self._memmap_dir is not None:
+                buf[k] = np.lib.format.open_memmap(
+                    self._memmap_dir / f"{k}.npy",
+                    mode="w+",
+                    dtype=v.dtype,
+                    shape=full_shape,
+                )
+            else:
+                buf[k] = np.zeros(full_shape, dtype=v.dtype)
+        self._buf = buf
+
+    # -- add -----------------------------------------------------------------
+    @staticmethod
+    @partial(jax.jit, donate_argnums=0)
+    def _device_add(buf, data, pos):
+        data_len = next(iter(data.values())).shape[0]
+        capacity = next(iter(buf.values())).shape[0]
+        idxes = (pos + jnp.arange(data_len)) % capacity
+        return {k: buf[k].at[idxes].set(data[k].astype(buf[k].dtype)) for k in buf}
+
+    def add(self, data: Mapping[str, np.ndarray] | "ReplayBuffer") -> None:
+        """Append `[T, n_envs]`-shaped rows at the write head, wrapping around
+        (reference add semantics, buffers.py:99-151)."""
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if data is None:
+            raise RuntimeError("data must not be None")
+        data = _as_time_env(data)
+        data_len, n_envs = next(iter(data.values())).shape[:2]
+        if n_envs != self._n_envs:
+            raise ValueError(f"expected n_envs={self._n_envs}, got {n_envs}")
+        if data_len == 0:
+            return
+        if data_len > self._buffer_size:
+            # only the last `capacity` rows survive a wrap anyway
+            data = {k: v[-self._buffer_size :] for k, v in data.items()}
+            data_len = self._buffer_size
+        if self._buf is None:
+            self._allocate(data)
+        if self._storage_kind == "device":
+            self._buf = self._device_add(
+                self._buf, {k: jnp.asarray(v) for k, v in data.items()}, self._pos
+            )
+        else:
+            idxes = (self._pos + np.arange(data_len)) % self._buffer_size
+            for k, v in data.items():
+                self._buf[k][idxes] = v
+        if self._pos + data_len >= self._buffer_size:
+            self._full = True
+        self._pos = (self._pos + data_len) % self._buffer_size
+
+    # -- sampling ------------------------------------------------------------
+    def _valid_ranges(self, exclude: int) -> tuple[int, int]:
+        """Uniform sampling domain as (first_range_end, n_valid): indices
+        `r < first_range_end` map to themselves, the rest shift past the
+        write head (reference window rules, buffers.py:166-186)."""
+        if self._full:
+            first = self._pos - exclude
+            second_end = (
+                self._buffer_size if first >= 0 else self._buffer_size + first
+            )
+            first = max(first, 0)
+            n_valid = first + (second_end - self._pos)
+        else:
+            first = self._pos - exclude
+            n_valid = first
+        if n_valid <= 0:
+            raise RuntimeError(
+                "not enough valid entries to sample; add more data first"
+            )
+        return first, n_valid
+
+    @staticmethod
+    @partial(jax.jit, static_argnames=("batch_size", "n_envs", "sample_next_obs", "obs_keys"))
+    def _device_sample(
+        buf, key, batch_size, n_envs, first, n_valid, pos, sample_next_obs, obs_keys
+    ):
+        capacity = next(iter(buf.values())).shape[0]
+        k1, k2 = jax.random.split(key)
+        r = jax.random.randint(k1, (batch_size,), 0, n_valid)
+        idx = jnp.where(r < first, r, r - first + pos)
+        env_idx = jax.random.randint(k2, (batch_size,), 0, n_envs)
+        out = {k: buf[k][idx, env_idx] for k in buf}
+        if sample_next_obs:
+            nxt = (idx + 1) % capacity
+            for k in obs_keys:
+                out[f"next_{k}"] = buf[k][nxt, env_idx]
+        return out
+
+    def sample(
+        self, batch_size: int, sample_next_obs: bool = False, **_: object
+    ) -> Batch:
+        """Uniform batch `[batch_size, *item]`, excluding the write head; with
+        `sample_next_obs`, also exclude `pos-1` and synthesize `next_*` keys
+        (buffers.py:153-204)."""
+        if batch_size <= 0:
+            raise ValueError("batch_size must be > 0")
+        if self._buf is None or (not self._full and self._pos == 0):
+            raise RuntimeError("no samples in buffer; call add() first")
+        first, n_valid = self._valid_ranges(1 if sample_next_obs else 0)
+        if self._storage_kind == "device":
+            return self._device_sample(
+                self._buf,
+                self._next_key(),
+                batch_size,
+                self._n_envs,
+                first,
+                n_valid,
+                self._pos,
+                sample_next_obs,
+                self.obs_keys if sample_next_obs else (),
+            )
+        r = self._np_rng.integers(0, n_valid, size=batch_size)
+        idx = np.where(r < first, r, r - first + self._pos)
+        env_idx = self._np_rng.integers(0, self._n_envs, size=batch_size)
+        out = {k: v[idx, env_idx] for k, v in self._buf.items()}
+        if sample_next_obs:
+            nxt = (idx + 1) % self._buffer_size
+            for k in self.obs_keys:
+                out[f"next_{k}"] = self._buf[k][nxt, env_idx]
+        return out
+
+    def to_state_dict(self) -> dict:
+        """Serializable state for checkpointing (host numpy copies)."""
+        buf = None
+        if self._buf is not None:
+            buf = {k: np.asarray(v) for k, v in self._buf.items()}
+        return {
+            "buf": buf,
+            "pos": self._pos,
+            "full": self._full,
+            "buffer_size": self._buffer_size,
+            "n_envs": self._n_envs,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if state["buffer_size"] != self._buffer_size or state["n_envs"] != self._n_envs:
+            raise ValueError("checkpointed buffer shape mismatch")
+        if state["buf"] is not None:
+            self._allocate({k: v[:1] for k, v in state["buf"].items()})
+            if self._storage_kind == "device":
+                self._buf = {k: jnp.asarray(v) for k, v in state["buf"].items()}
+            else:
+                for k, v in state["buf"].items():
+                    self._buf[k][:] = v
+        self._pos = int(state["pos"])
+        self._full = bool(state["full"])
+
+
+class SequentialReplayBuffer(ReplayBuffer):
+    """Samples contiguous `[n_samples, seq_len, batch]` windows, each from a
+    single env (buffers.py:219-348)."""
+
+    def _seq_valid_ranges(self, sequence_length: int) -> tuple[int, int]:
+        # a window of length L occupies L-1 successors of its start index, so
+        # the start-validity window is exactly the base rule with exclude=L-1
+        try:
+            return self._valid_ranges(sequence_length - 1)
+        except RuntimeError as e:
+            raise ValueError(
+                f"too long sequence_length ({sequence_length}) for buffer with "
+                f"pos={self._pos}, full={self._full}"
+            ) from e
+
+    @staticmethod
+    @partial(
+        jax.jit,
+        static_argnames=("batch_size", "n_samples", "seq_len", "n_envs", "sample_next_obs", "obs_keys"),
+    )
+    def _device_sample_seq(
+        buf, key, batch_size, n_samples, seq_len, n_envs, first, n_valid, pos,
+        sample_next_obs, obs_keys,
+    ):
+        capacity = next(iter(buf.values())).shape[0]
+        batch_dim = batch_size * n_samples
+        k1, k2 = jax.random.split(key)
+        r = jax.random.randint(k1, (batch_dim,), 0, n_valid)
+        start = jnp.where(r < first, r, r - first + pos)
+        idx = (start[:, None] + jnp.arange(seq_len)[None, :]) % capacity  # [BD, T]
+        env_idx = jax.random.randint(k2, (batch_dim,), 0, n_envs)[:, None]
+        out = {}
+        for k in buf:
+            v = buf[k][idx, env_idx]  # [BD, T, *item]
+            item = v.shape[2:]
+            v = v.reshape(n_samples, batch_size, seq_len, *item)
+            out[k] = jnp.swapaxes(v, 1, 2)  # [n_samples, T, B, *item]
+        if sample_next_obs:
+            nxt = (idx + 1) % capacity
+            for k in obs_keys:
+                v = buf[k][nxt, env_idx]
+                item = v.shape[2:]
+                v = v.reshape(n_samples, batch_size, seq_len, *item)
+                out[f"next_{k}"] = jnp.swapaxes(v, 1, 2)
+        return out
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        sequence_length: int = 1,
+        n_samples: int = 1,
+        **_: object,
+    ) -> Batch:
+        batch_dim = batch_size * n_samples
+        if batch_dim <= 0:
+            raise ValueError("batch_size * n_samples must be > 0")
+        if self._buf is None or (not self._full and self._pos == 0):
+            raise RuntimeError("no samples in buffer; call add() first")
+        if sequence_length > self._buffer_size:
+            raise ValueError(f"too long sequence_length ({sequence_length})")
+        first, n_valid = self._seq_valid_ranges(sequence_length)
+        if self._storage_kind == "device":
+            return self._device_sample_seq(
+                self._buf,
+                self._next_key(),
+                batch_size,
+                n_samples,
+                sequence_length,
+                self._n_envs,
+                first,
+                n_valid,
+                self._pos,
+                sample_next_obs,
+                self.obs_keys if sample_next_obs else (),
+            )
+        r = self._np_rng.integers(0, n_valid, size=batch_dim)
+        start = np.where(r < first, r, r - first + self._pos)
+        idx = (start[:, None] + np.arange(sequence_length)[None, :]) % self._buffer_size
+        env_idx = self._np_rng.integers(0, self._n_envs, size=batch_dim)[:, None]
+        out = {}
+        for k, v in self._buf.items():
+            s = v[idx, env_idx]  # [BD, T, *item]
+            s = s.reshape(n_samples, batch_size, sequence_length, *s.shape[2:])
+            out[k] = np.swapaxes(s, 1, 2)
+        if sample_next_obs:
+            nxt = (idx + 1) % self._buffer_size
+            for k in self.obs_keys:
+                s = self._buf[k][nxt, env_idx]
+                s = s.reshape(n_samples, batch_size, sequence_length, *s.shape[2:])
+                out[f"next_{k}"] = np.swapaxes(s, 1, 2)
+        return out
+
+
+class EpisodeBuffer:
+    """Stores whole episodes (host-side, variable length); samples fixed
+    windows `[n_samples, seq_len, batch]` (buffers.py:351-534). Episode data
+    arrives from the host env loop and leaves as one batch per train step, so
+    host storage is the right residency; window gathers are numpy, the batch
+    crosses to HBM once."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        sequence_length: int,
+        memmap_dir: str | os.PathLike | None = None,
+        seed: int = 0,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"buffer size must be > 0, got {buffer_size}")
+        if sequence_length <= 0:
+            raise ValueError(f"sequence length must be > 0, got {sequence_length}")
+        if buffer_size < sequence_length:
+            raise ValueError(
+                f"sequence length ({sequence_length}) must not exceed buffer size ({buffer_size})"
+            )
+        self._buffer_size = buffer_size
+        self._sequence_length = sequence_length
+        self._buf: list[Batch] = []
+        self._episode_dirs: list[Path | None] = []
+        self._cum_lengths: list[int] = []
+        self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
+        if self._memmap_dir is not None:
+            self._memmap_dir.mkdir(parents=True, exist_ok=True)
+        self._np_rng = np.random.default_rng(seed)
+
+    @property
+    def buffer(self) -> list[Batch]:
+        return self._buf
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def sequence_length(self) -> int:
+        return self._sequence_length
+
+    @property
+    def full(self) -> bool:
+        if not self._buf:
+            return False
+        return self._cum_lengths[-1] + self._sequence_length > self._buffer_size
+
+    def __len__(self) -> int:
+        return self._cum_lengths[-1] if self._buf else 0
+
+    def __getitem__(self, i: int) -> Batch:
+        return self._buf[i]
+
+    def add(self, episode: Mapping[str, np.ndarray]) -> None:
+        """Validates exactly-one-done-at-end, evicts oldest episodes (incl.
+        their memmap files) to fit (buffers.py:433-489)."""
+        episode = dict(episode)
+        dones = np.asarray(episode["dones"]).reshape(-1)
+        if int((dones != 0).sum()) != 1:
+            raise RuntimeError(
+                f"episode must contain exactly one done, got {int((dones != 0).sum())}"
+            )
+        if dones[-1] == 0:
+            raise RuntimeError("the last step of an episode must be done")
+        ep_len = dones.shape[0]
+        if ep_len < self._sequence_length:
+            raise RuntimeError(
+                f"episode too short: {ep_len} < sequence_length {self._sequence_length}"
+            )
+        if ep_len > self._buffer_size:
+            raise RuntimeError(
+                f"episode too long: {ep_len} > buffer_size {self._buffer_size}"
+            )
+        if self.full or len(self) + ep_len > self._buffer_size:
+            cum = np.array(self._cum_lengths)
+            keep_from = int(((len(self) - cum + ep_len) <= self._buffer_size).argmax()) + 1
+            for d in self._episode_dirs[:keep_from]:
+                if d is not None and d.exists():
+                    shutil.rmtree(d)
+            self._buf = self._buf[keep_from:]
+            self._episode_dirs = self._episode_dirs[keep_from:]
+            cum = cum[keep_from:] - cum[keep_from - 1]
+            self._cum_lengths = cum.tolist()
+        self._cum_lengths.append(len(self) + ep_len)
+        ep_dir: Path | None = None
+        if self._memmap_dir is not None:
+            ep_dir = self._memmap_dir / f"episode_{uuid.uuid4()}"
+            ep_dir.mkdir(parents=True, exist_ok=True)
+            stored = {}
+            for k, v in episode.items():
+                v = np.asarray(v)
+                mm = np.lib.format.open_memmap(
+                    ep_dir / f"{k}.npy", mode="w+", dtype=v.dtype, shape=v.shape
+                )
+                mm[:] = v
+                stored[k] = mm
+            episode = stored
+        else:
+            episode = {k: np.asarray(v) for k, v in episode.items()}
+        self._buf.append(episode)
+        self._episode_dirs.append(ep_dir)
+
+    def sample(
+        self,
+        batch_size: int,
+        n_samples: int = 1,
+        prioritize_ends: bool = False,
+        **_: object,
+    ) -> Batch:
+        """`[n_samples, seq_len, batch]` windows; `prioritize_ends` biases
+        start indices toward episode tails (buffers.py:491-534)."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError("batch_size and n_samples must be > 0")
+        if not self._buf:
+            raise RuntimeError("no episodes in buffer; call add() first")
+        batch_dim = batch_size * n_samples
+        counts = np.bincount(
+            self._np_rng.integers(0, len(self._buf), size=batch_dim),
+            minlength=len(self._buf),
+        )
+        chunks: dict[str, list[np.ndarray]] = {k: [] for k in self._buf[0]}
+        for i, n in enumerate(counts):
+            if n == 0:
+                continue
+            ep = self._buf[i]
+            ep_len = next(iter(ep.values())).shape[0]
+            upper = ep_len - self._sequence_length + 1
+            if prioritize_ends:
+                upper += self._sequence_length
+            starts = np.minimum(
+                self._np_rng.integers(0, upper, size=(int(n), 1)),
+                ep_len - self._sequence_length,
+            )
+            idx = starts + np.arange(self._sequence_length)[None, :]
+            for k in chunks:
+                chunks[k].append(np.asarray(ep[k])[idx])
+        out = {}
+        for k, parts in chunks.items():
+            cat = np.concatenate(parts, axis=0)  # [BD, T, *item]
+            cat = cat.reshape(n_samples, batch_size, self._sequence_length, *cat.shape[2:])
+            out[k] = np.swapaxes(cat, 1, 2)  # [n_samples, T, B, *item]
+        return out
+
+    def to_state_dict(self) -> dict:
+        return {
+            "episodes": [{k: np.asarray(v) for k, v in ep.items()} for ep in self._buf],
+            "buffer_size": self._buffer_size,
+            "sequence_length": self._sequence_length,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if (
+            state["buffer_size"] != self._buffer_size
+            or state["sequence_length"] != self._sequence_length
+        ):
+            raise ValueError("checkpointed episode buffer shape mismatch")
+        self._buf = []
+        self._episode_dirs = []
+        self._cum_lengths = []
+        for ep in state["episodes"]:
+            self.add(ep)
+
+
+class AsyncReplayBuffer:
+    """One independent (Sequential)ReplayBuffer per env; `add(data, indices)`
+    writes only the given env columns — envs that reset mid-step can append
+    their reset records without touching the others (buffers.py:537-699)."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        storage: str = "device",
+        memmap_dir: str | os.PathLike | None = None,
+        sequential: bool = False,
+        obs_keys: Sequence[str] = ("observations",),
+        seed: int = 0,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"buffer size must be > 0, got {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"n_envs must be > 0, got {n_envs}")
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._storage_kind = storage
+        self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
+        self._sequential = sequential
+        self._obs_keys = tuple(obs_keys)
+        self._seed = seed
+        self._buf: list[ReplayBuffer] | None = None
+        self._np_rng = np.random.default_rng(seed)
+
+    @property
+    def buffer(self):
+        return tuple(self._buf) if self._buf is not None else None
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def full(self):
+        if self._buf is None:
+            return None
+        return tuple(b.full for b in self._buf)
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def _ensure_buffers(self) -> None:
+        if self._buf is not None:
+            return
+        cls = SequentialReplayBuffer if self._sequential else ReplayBuffer
+        self._buf = [
+            cls(
+                self._buffer_size,
+                n_envs=1,
+                storage=self._storage_kind,
+                memmap_dir=(
+                    self._memmap_dir / f"env_{i}" if self._memmap_dir is not None else None
+                ),
+                obs_keys=self._obs_keys,
+                seed=self._seed + i,
+            )
+            for i in range(self._n_envs)
+        ]
+
+    def add(self, data: Mapping[str, np.ndarray], indices: Sequence[int] | None = None) -> None:
+        data = _as_time_env(dict(data))
+        self._ensure_buffers()
+        if indices is None:
+            indices = range(self._n_envs)
+        for col, env_idx in enumerate(indices):
+            self._buf[env_idx].add({k: v[:, col : col + 1] for k, v in data.items()})
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        sequence_length: int = 1,
+        n_samples: int = 1,
+        **_: object,
+    ) -> Batch:
+        """Partitions the batch across env-buffers via bincount and
+        concatenates on the batch axis (buffers.py:687-699)."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError("batch_size and n_samples must be > 0")
+        if self._buf is None:
+            raise RuntimeError("no samples in buffer; call add() first")
+        counts = np.bincount(
+            self._np_rng.integers(0, self._n_envs, size=batch_size),
+            minlength=self._n_envs,
+        )
+        parts = []
+        for b, n in zip(self._buf, counts):
+            if n == 0:
+                continue
+            if self._sequential:
+                parts.append(
+                    b.sample(
+                        int(n),
+                        sample_next_obs=sample_next_obs,
+                        sequence_length=sequence_length,
+                        n_samples=n_samples,
+                    )
+                )
+            else:
+                parts.append(b.sample(int(n), sample_next_obs=sample_next_obs))
+        axis = 2 if self._sequential else 0
+        keys = parts[0].keys()
+        xp = jnp if self._storage_kind == "device" else np
+        return {k: xp.concatenate([p[k] for p in parts], axis=axis) for k in keys}
+
+    def to_state_dict(self) -> dict:
+        self._ensure_buffers()
+        return {"buffers": [b.to_state_dict() for b in self._buf]}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._ensure_buffers()
+        for b, s in zip(self._buf, state["buffers"]):
+            b.load_state_dict(s)

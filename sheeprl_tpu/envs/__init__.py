@@ -1,0 +1,23 @@
+from .dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from .vector import AsyncVectorEnv, SyncVectorEnv, make_vector_env
+from .wrappers import (
+    ActionRepeat,
+    DictObservation,
+    FrameStack,
+    MaskVelocityWrapper,
+    RestartOnException,
+)
+
+__all__ = [
+    "ContinuousDummyEnv",
+    "DiscreteDummyEnv",
+    "MultiDiscreteDummyEnv",
+    "SyncVectorEnv",
+    "AsyncVectorEnv",
+    "make_vector_env",
+    "ActionRepeat",
+    "DictObservation",
+    "FrameStack",
+    "MaskVelocityWrapper",
+    "RestartOnException",
+]

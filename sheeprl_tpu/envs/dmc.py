@@ -1,0 +1,177 @@
+"""dm_control -> gymnasium bridge (pixels or flat states).
+
+Capability parity with /root/reference/sheeprl/envs/dmc.py: dm_env spec ->
+Box conversion, [-1, 1] normalized actions rescaled to the true action
+bounds, frame-skip with early stop, physics-state info. Pixels are emitted
+channel-LAST `[H, W, 3]` (the framework's NHWC convention; the reference
+defaults to channel-first for torch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+try:
+    from dm_control import suite
+    from dm_env import specs
+
+    _DMC_AVAILABLE = True
+except ImportError:  # pragma: no cover - exercised only without dm_control
+    _DMC_AVAILABLE = False
+
+import gymnasium as gym
+from gymnasium import spaces
+
+__all__ = ["DMCWrapper"]
+
+
+def _spec_to_box(spec_list, dtype) -> spaces.Box:
+    mins, maxs = [], []
+    for s in spec_list:
+        dim = int(np.prod(s.shape))
+        if isinstance(s, specs.BoundedArray):
+            mins.append(np.broadcast_to(s.minimum, (dim,)).astype(np.float32))
+            maxs.append(np.broadcast_to(s.maximum, (dim,)).astype(np.float32))
+        elif isinstance(s, specs.Array):
+            maxs.append(np.full(dim, np.inf, dtype=np.float32))
+            mins.append(np.full(dim, -np.inf, dtype=np.float32))
+        else:
+            raise ValueError(f"unrecognized spec: {type(s)}")
+    low = np.concatenate(mins).astype(dtype)
+    high = np.concatenate(maxs).astype(dtype)
+    return spaces.Box(low, high, dtype=dtype)
+
+
+def _flatten_obs(obs: dict) -> np.ndarray:
+    pieces = [
+        np.array([v]) if np.isscalar(v) else np.asarray(v).ravel()
+        for v in obs.values()
+    ]
+    return np.concatenate(pieces, axis=0)
+
+
+class DMCWrapper(gym.Env):
+    def __init__(
+        self,
+        domain_name: str,
+        task_name: str,
+        from_pixels: bool = False,
+        height: int = 84,
+        width: int = 84,
+        camera_id: int = 0,
+        frame_skip: int = 1,
+        task_kwargs: Optional[dict] = None,
+        environment_kwargs: Optional[dict] = None,
+        visualize_reward: bool = False,
+        seed: Optional[int] = None,
+    ):
+        if not _DMC_AVAILABLE:
+            raise ModuleNotFoundError(
+                "dm_control is required for DMC environments"
+            )
+        self._from_pixels = from_pixels
+        self._height = height
+        self._width = width
+        self._camera_id = camera_id
+        self._frame_skip = frame_skip
+        task_kwargs = dict(task_kwargs or {})
+        if seed is not None:
+            task_kwargs.setdefault("random", seed)
+        self._env = suite.load(
+            domain_name=domain_name,
+            task_name=task_name,
+            task_kwargs=task_kwargs,
+            visualize_reward=visualize_reward,
+            environment_kwargs=environment_kwargs,
+        )
+        self._true_action_space = _spec_to_box([self._env.action_spec()], np.float32)
+        self._norm_action_space = spaces.Box(
+            -1.0, 1.0, shape=self._true_action_space.shape, dtype=np.float32
+        )
+        if from_pixels:
+            self._observation_space = spaces.Box(
+                0, 255, shape=(height, width, 3), dtype=np.uint8
+            )
+        else:
+            self._observation_space = _spec_to_box(
+                self._env.observation_spec().values(), np.float64
+            )
+        self._state_space = _spec_to_box(
+            self._env.observation_spec().values(), np.float64
+        )
+        self.current_state: np.ndarray | None = None
+        self._render_mode = "rgb_array"
+        self.seed(seed)
+
+    # -- spaces --------------------------------------------------------------
+    @property
+    def observation_space(self):
+        return self._observation_space
+
+    @property
+    def state_space(self):
+        return self._state_space
+
+    @property
+    def action_space(self):
+        return self._norm_action_space
+
+    @property
+    def reward_range(self):
+        return 0, self._frame_skip
+
+    @property
+    def render_mode(self) -> str:
+        return self._render_mode
+
+    def seed(self, seed: Optional[int] = None):
+        self._true_action_space.seed(seed)
+        self._norm_action_space.seed(seed)
+        self._observation_space.seed(seed)
+
+    # -- helpers -------------------------------------------------------------
+    def _get_obs(self, time_step) -> np.ndarray:
+        if self._from_pixels:
+            return self.render()
+        return _flatten_obs(time_step.observation)
+
+    def _denormalize_action(self, action: np.ndarray) -> np.ndarray:
+        action = action.astype(np.float64)
+        true_delta = self._true_action_space.high - self._true_action_space.low
+        norm_delta = self._norm_action_space.high - self._norm_action_space.low
+        action = (action - self._norm_action_space.low) / norm_delta
+        return (action * true_delta + self._true_action_space.low).astype(np.float32)
+
+    # -- gym API -------------------------------------------------------------
+    def step(self, action):
+        assert self._norm_action_space.contains(action)
+        action = self._denormalize_action(action)
+        reward, done = 0.0, False
+        info: dict[str, Any] = {"internal_state": self._env.physics.get_state().copy()}
+        time_step = None
+        for _ in range(self._frame_skip):
+            time_step = self._env.step(action)
+            reward += time_step.reward or 0.0
+            done = time_step.last()
+            if done:
+                break
+        obs = self._get_obs(time_step)
+        self.current_state = _flatten_obs(time_step.observation)
+        info["discount"] = time_step.discount
+        return obs, reward, done, False, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
+        time_step = self._env.reset()
+        self.current_state = _flatten_obs(time_step.observation)
+        return self._get_obs(time_step), {}
+
+    def render(self):
+        return self._env.physics.render(
+            height=self._height, width=self._width, camera_id=self._camera_id
+        )
+
+    def close(self):
+        self._env.close()
+        return super().close()

@@ -1,0 +1,212 @@
+"""Vectorized environment runners (sync + async subprocess).
+
+The framework's own replacement for `gym.vector.{Sync,Async}VectorEnv`
+(used by every reference algorithm, /root/reference/sheeprl/algos/ppo/ppo.py:137-152)
+with the semantics the training loops want, independent of gymnasium's
+version-to-version autoreset changes:
+
+  - **same-step autoreset**: when an env finishes, its final observation is
+    surfaced as `infos[i]["final_observation"]` and the returned observation
+    is already the reset one — the policy never sees a stale terminal obs;
+  - **dict-obs batching**: observations arrive as `{key: [N, ...]}` numpy
+    stacks, the exact host-side layout `jax.device_put` ships to HBM in one
+    transfer per key;
+  - **per-env info dicts**: `infos` is a list of length `num_envs` (episode
+    stats from RecordEpisodeStatistics pass through untouched).
+
+The async runner keeps one OS process per env (envs are CPU/GIL-bound
+Python; stepping them in subprocesses overlaps with device compute exactly
+like the reference's AsyncVectorEnv subprocesses did).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from typing import Any, Callable, Sequence
+
+import gymnasium as gym
+import numpy as np
+
+__all__ = ["SyncVectorEnv", "AsyncVectorEnv", "make_vector_env"]
+
+
+def _batch_obs(space: gym.Space, obs_list: Sequence[Any]):
+    if isinstance(space, gym.spaces.Dict):
+        return {k: np.stack([o[k] for o in obs_list]) for k in space.spaces}
+    return np.stack(obs_list)
+
+
+class _VectorEnvBase:
+    single_observation_space: gym.Space
+    single_action_space: gym.Space
+    num_envs: int
+
+    @property
+    def observation_space(self):
+        return self.single_observation_space
+
+    @property
+    def action_space(self):
+        return self.single_action_space
+
+
+class SyncVectorEnv(_VectorEnvBase):
+    def __init__(self, env_fns: Sequence[Callable[[], gym.Env]]):
+        self.envs = [fn() for fn in env_fns]
+        self.num_envs = len(self.envs)
+        self.single_observation_space = self.envs[0].observation_space
+        self.single_action_space = self.envs[0].action_space
+
+    def reset(self, seed: int | Sequence[int] | None = None):
+        seeds = self._expand_seed(seed)
+        obs_list, infos = [], []
+        for env, s in zip(self.envs, seeds):
+            obs, info = env.reset(seed=s)
+            obs_list.append(obs)
+            infos.append(info)
+        return _batch_obs(self.single_observation_space, obs_list), infos
+
+    def step(self, actions: Sequence[Any]):
+        obs_list, rewards, terms, truncs, infos = [], [], [], [], []
+        for env, act in zip(self.envs, actions):
+            obs, reward, term, trunc, info = env.step(act)
+            if term or trunc:
+                info = dict(info)
+                info["final_observation"] = obs
+                obs, _ = env.reset()
+            obs_list.append(obs)
+            rewards.append(reward)
+            terms.append(term)
+            truncs.append(trunc)
+            infos.append(info)
+        return (
+            _batch_obs(self.single_observation_space, obs_list),
+            np.asarray(rewards, dtype=np.float32),
+            np.asarray(terms, dtype=bool),
+            np.asarray(truncs, dtype=bool),
+            infos,
+        )
+
+    def close(self):
+        for env in self.envs:
+            env.close()
+
+    def call(self, name: str, *args, **kwargs):
+        return [getattr(env, name)(*args, **kwargs) for env in self.envs]
+
+    def _expand_seed(self, seed):
+        if seed is None or isinstance(seed, int):
+            return [seed if seed is None else seed + i for i in range(self.num_envs)]
+        return list(seed)
+
+
+def _worker(remote, parent_remote, env_fn) -> None:
+    parent_remote.close()
+    if isinstance(env_fn, bytes):  # cloudpickled closure (spawn/forkserver path)
+        import cloudpickle
+
+        env_fn = cloudpickle.loads(env_fn)
+    env = env_fn()
+    try:
+        while True:
+            cmd, payload = remote.recv()
+            if cmd == "reset":
+                remote.send(env.reset(seed=payload))
+            elif cmd == "step":
+                obs, reward, term, trunc, info = env.step(payload)
+                if term or trunc:
+                    info = dict(info)
+                    info["final_observation"] = obs
+                    obs, _ = env.reset()
+                remote.send((obs, reward, term, trunc, info))
+            elif cmd == "spaces":
+                remote.send((env.observation_space, env.action_space))
+            elif cmd == "call":
+                name, args, kwargs = payload
+                remote.send(getattr(env, name)(*args, **kwargs))
+            elif cmd == "close":
+                env.close()
+                remote.send(None)
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        remote.close()
+
+
+class AsyncVectorEnv(_VectorEnvBase):
+    """Subprocess vector env. Defaults to the `spawn` start method: the
+    parent is a multithreaded JAX process, and `fork`ing it can deadlock the
+    child mid-step. Env thunks (closures) are shipped to spawned workers via
+    cloudpickle. NOTE: as with any `spawn` usage, driver *scripts* must guard
+    their entry point with `if __name__ == "__main__":`."""
+
+    def __init__(self, env_fns: Sequence[Callable[[], gym.Env]], context: str = "spawn"):
+        ctx = mp.get_context(context)
+        self.num_envs = len(env_fns)
+        if context in ("spawn", "forkserver"):
+            import cloudpickle
+
+            env_fns = [cloudpickle.dumps(fn) for fn in env_fns]
+        self._remotes, self._work_remotes = zip(
+            *[ctx.Pipe(duplex=True) for _ in range(self.num_envs)]
+        )
+        self._procs = []
+        for work_remote, remote, fn in zip(self._work_remotes, self._remotes, env_fns):
+            proc = ctx.Process(
+                target=_worker, args=(work_remote, remote, fn), daemon=True
+            )
+            proc.start()
+            work_remote.close()
+            self._procs.append(proc)
+        self._remotes[0].send(("spaces", None))
+        self.single_observation_space, self.single_action_space = self._remotes[0].recv()
+        self._closed = False
+
+    def reset(self, seed: int | Sequence[int] | None = None):
+        if seed is None or isinstance(seed, int):
+            seeds = [seed if seed is None else seed + i for i in range(self.num_envs)]
+        else:
+            seeds = list(seed)
+        for remote, s in zip(self._remotes, seeds):
+            remote.send(("reset", s))
+        results = [remote.recv() for remote in self._remotes]
+        obs_list, infos = zip(*results)
+        return _batch_obs(self.single_observation_space, obs_list), list(infos)
+
+    def step(self, actions: Sequence[Any]):
+        for remote, act in zip(self._remotes, actions):
+            remote.send(("step", act))
+        results = [remote.recv() for remote in self._remotes]
+        obs_list, rewards, terms, truncs, infos = zip(*results)
+        return (
+            _batch_obs(self.single_observation_space, obs_list),
+            np.asarray(rewards, dtype=np.float32),
+            np.asarray(terms, dtype=bool),
+            np.asarray(truncs, dtype=bool),
+            list(infos),
+        )
+
+    def call(self, name: str, *args, **kwargs):
+        for remote in self._remotes:
+            remote.send(("call", (name, args, kwargs)))
+        return [remote.recv() for remote in self._remotes]
+
+    def close(self):
+        if self._closed:
+            return
+        for remote in self._remotes:
+            try:
+                remote.send(("close", None))
+                remote.recv()
+            except (BrokenPipeError, EOFError):
+                pass
+        for proc in self._procs:
+            proc.join(timeout=5)
+        self._closed = True
+
+
+def make_vector_env(
+    env_fns: Sequence[Callable[[], gym.Env]], sync: bool = True
+) -> _VectorEnvBase:
+    return SyncVectorEnv(env_fns) if sync else AsyncVectorEnv(env_fns)

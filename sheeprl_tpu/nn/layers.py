@@ -58,6 +58,35 @@ class Linear(Module):
         return self.weight.shape[1]
 
 
+def _conv_init_params(
+    key,
+    in_channels: int,
+    out_channels: int,
+    kernel_size: int | tuple[int, int],
+    stride: int | tuple[int, int],
+    padding: str | int | tuple[int, int],
+    use_bias: bool,
+):
+    """Shared (transposed-)conv parameter construction: kernel/stride/padding
+    normalization + torch-style kaiming-uniform init."""
+    kh, kw = (kernel_size,) * 2 if isinstance(kernel_size, int) else kernel_size
+    stride = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    elif isinstance(padding, tuple) and isinstance(padding[0], int):
+        padding = ((padding[0], padding[0]), (padding[1], padding[1]))
+    wkey, bkey = jax.random.split(key)
+    fan_in = in_channels * kh * kw
+    kernel = _kaiming_uniform(wkey, (kh, kw, in_channels, out_channels), fan_in)
+    bias = None
+    if use_bias:
+        bound = 1.0 / math.sqrt(fan_in)
+        bias = jax.random.uniform(
+            bkey, (out_channels,), jnp.float32, minval=-bound, maxval=bound
+        )
+    return kernel, bias, stride, padding
+
+
 class Conv2d(Module):
     """NHWC convolution with HWIO kernel."""
 
@@ -78,21 +107,9 @@ class Conv2d(Module):
         padding: str | int | tuple[int, int] = "SAME",
         use_bias: bool = True,
     ):
-        kh, kw = (kernel_size,) * 2 if isinstance(kernel_size, int) else kernel_size
-        stride = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
-        if isinstance(padding, int):
-            padding = ((padding, padding), (padding, padding))
-        elif isinstance(padding, tuple) and isinstance(padding[0], int):
-            padding = ((padding[0], padding[0]), (padding[1], padding[1]))
-        wkey, bkey = jax.random.split(key)
-        fan_in = in_channels * kh * kw
-        kernel = _kaiming_uniform(wkey, (kh, kw, in_channels, out_channels), fan_in)
-        bias = None
-        if use_bias:
-            bound = 1.0 / math.sqrt(fan_in)
-            bias = jax.random.uniform(
-                bkey, (out_channels,), jnp.float32, minval=-bound, maxval=bound
-            )
+        kernel, bias, stride, padding = _conv_init_params(
+            key, in_channels, out_channels, kernel_size, stride, padding, use_bias
+        )
         return cls(kernel=kernel, bias=bias, stride=stride, padding=padding)
 
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -136,21 +153,9 @@ class ConvTranspose2d(Module):
         padding: str | int | tuple[int, int] = "SAME",
         use_bias: bool = True,
     ):
-        kh, kw = (kernel_size,) * 2 if isinstance(kernel_size, int) else kernel_size
-        stride = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
-        if isinstance(padding, int):
-            padding = ((padding, padding), (padding, padding))
-        elif isinstance(padding, tuple) and isinstance(padding[0], int):
-            padding = ((padding[0], padding[0]), (padding[1], padding[1]))
-        wkey, bkey = jax.random.split(key)
-        fan_in = in_channels * kh * kw
-        kernel = _kaiming_uniform(wkey, (kh, kw, in_channels, out_channels), fan_in)
-        bias = None
-        if use_bias:
-            bound = 1.0 / math.sqrt(fan_in)
-            bias = jax.random.uniform(
-                bkey, (out_channels,), jnp.float32, minval=-bound, maxval=bound
-            )
+        kernel, bias, stride, padding = _conv_init_params(
+            key, in_channels, out_channels, kernel_size, stride, padding, use_bias
+        )
         return cls(kernel=kernel, bias=bias, stride=stride, padding=padding)
 
     def __call__(self, x: jax.Array) -> jax.Array:

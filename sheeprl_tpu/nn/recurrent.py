@@ -20,23 +20,28 @@ __all__ = ["GRUCell", "LayerNormGRUCell", "LSTMCell", "scan_cell"]
 
 
 class GRUCell(Module):
-    """Standard GRU cell over concatenated [x, h]."""
+    """Standard (textbook / torch.nn.GRUCell) GRU: the reset gate scales only
+    the hidden-state contribution of the candidate,
+    `n = tanh(W_in x + r * (W_hn h))`."""
 
-    proj: Linear  # [in+hidden, 3*hidden]
+    input_proj: Linear  # [in, 3*hidden]
+    hidden_proj: Linear  # [hidden, 3*hidden]
     hidden_size: int = static()
 
     @classmethod
     def init(cls, key, input_size: int, hidden_size: int, *, use_bias: bool = True):
-        proj = Linear.init(key, input_size + hidden_size, 3 * hidden_size, use_bias=use_bias)
-        return cls(proj=proj, hidden_size=hidden_size)
+        k1, k2 = jax.random.split(key)
+        input_proj = Linear.init(k1, input_size, 3 * hidden_size, use_bias=use_bias)
+        hidden_proj = Linear.init(k2, hidden_size, 3 * hidden_size, use_bias=use_bias)
+        return cls(input_proj=input_proj, hidden_proj=hidden_proj, hidden_size=hidden_size)
 
     def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
-        parts = self.proj(jnp.concatenate([x, h], axis=-1))
-        r, c, u = jnp.split(parts, 3, axis=-1)
-        reset = jax.nn.sigmoid(r)
-        cand = jnp.tanh(reset * c)
-        update = jax.nn.sigmoid(u)
-        return update * cand + (1.0 - update) * h
+        xi_r, xi_z, xi_n = jnp.split(self.input_proj(x), 3, axis=-1)
+        hh_r, hh_z, hh_n = jnp.split(self.hidden_proj(h), 3, axis=-1)
+        r = jax.nn.sigmoid(xi_r + hh_r)
+        z = jax.nn.sigmoid(xi_z + hh_z)
+        n = jnp.tanh(xi_n + r * hh_n)
+        return (1.0 - z) * n + z * h
 
 
 class LayerNormGRUCell(Module):

@@ -209,13 +209,19 @@ class TruncatedNormal(Distribution):
 
 
 class Categorical(Distribution):
-    """Categorical over the trailing axis, parameterized by (normalized) logits."""
+    """Categorical over the trailing axis. Accepts unnormalized logits:
+    log_prob/entropy normalize internally (log_softmax is idempotent, so
+    pre-normalized logits are fine too)."""
 
     logits: jax.Array
 
     @classmethod
     def from_logits(cls, logits):
-        return cls(logits=jax.nn.log_softmax(logits, axis=-1))
+        return cls(logits=logits)
+
+    @property
+    def log_probs(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
 
     @property
     def probs(self):
@@ -227,11 +233,12 @@ class Categorical(Distribution):
 
     def log_prob(self, x):
         return jnp.take_along_axis(
-            self.logits, x[..., None].astype(jnp.int32), axis=-1
+            self.log_probs, x[..., None].astype(jnp.int32), axis=-1
         )[..., 0]
 
     def entropy(self):
-        return -jnp.sum(self.probs * self.logits, axis=-1)
+        lp = self.log_probs
+        return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
 
     @property
     def mode(self):
@@ -241,13 +248,18 @@ class Categorical(Distribution):
 class OneHotCategorical(Distribution):
     """One-hot categorical; `StraightThrough` sampling passes gradients to the
     probabilities (Dreamer stochastic state,
-    /root/reference/sheeprl/algos/dreamer_v2/utils.py:21-38)."""
+    /root/reference/sheeprl/algos/dreamer_v2/utils.py:21-38). Accepts
+    unnormalized logits (normalized internally where it matters)."""
 
     logits: jax.Array
 
     @classmethod
     def from_logits(cls, logits):
-        return cls(logits=jax.nn.log_softmax(logits, axis=-1))
+        return cls(logits=logits)
+
+    @property
+    def log_probs(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
 
     @property
     def probs(self):
@@ -267,10 +279,11 @@ class OneHotCategorical(Distribution):
         return sample + probs - jax.lax.stop_gradient(probs)
 
     def log_prob(self, x):
-        return jnp.sum(self.logits * x, axis=-1)
+        return jnp.sum(self.log_probs * x, axis=-1)
 
     def entropy(self):
-        return -jnp.sum(self.probs * self.logits, axis=-1)
+        lp = self.log_probs
+        return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
 
     @property
     def mode(self):

@@ -1,0 +1,10 @@
+from .parser import Arg, DataclassArgumentParser
+from .registry import decoupled_tasks, register_algorithm, tasks
+
+__all__ = [
+    "Arg",
+    "DataclassArgumentParser",
+    "register_algorithm",
+    "tasks",
+    "decoupled_tasks",
+]

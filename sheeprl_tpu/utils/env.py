@@ -1,0 +1,311 @@
+"""Environment construction pipeline.
+
+Capability parity with /root/reference/sheeprl/utils/env.py: `make_env` (plain
+vector-obs envs) and `make_dict_env` (the full dict-observation pipeline with
+backend dispatch on the env-id prefix `dummy|dmc|minedojo|minerl|diambra|gym`).
+
+TPU-first deviation: every image observation leaves this pipeline as
+channel-LAST `[H, W, C]` uint8 (NHWC — what TPU convs tile natively), and
+frame stacking concatenates channels. The reference emits `[C, H, W]` for
+PyTorch (utils/env.py:231-267).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Optional
+
+import cv2
+import gymnasium as gym
+import numpy as np
+
+from ..envs.wrappers import (
+    ActionRepeat,
+    DictObservation,
+    FrameStack,
+    MaskVelocityWrapper,
+)
+
+__all__ = ["make_env", "make_dict_env", "get_dummy_env"]
+
+
+def make_env(
+    env_id: str,
+    seed: Optional[int],
+    idx: int,
+    capture_video: bool = False,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    mask_velocities: bool = False,
+    vector_env_idx: int = 0,
+    action_repeat: int = 1,
+) -> Callable[[], gym.Env]:
+    """Simple thunk for vector-obs algorithms (SAC/DroQ/recurrent PPO), as in
+    /root/reference/sheeprl/utils/env.py:13-41."""
+
+    def thunk() -> gym.Env:
+        env = gym.make(env_id, render_mode="rgb_array")
+        if mask_velocities:
+            env = MaskVelocityWrapper(env)
+        env = ActionRepeat(env, action_repeat)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if capture_video and vector_env_idx == 0 and idx == 0 and run_name is not None:
+            env = gym.wrappers.RecordVideo(
+                env,
+                os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                disable_logger=True,
+            )
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        return env
+
+    return thunk
+
+
+class _ImageTransform(gym.ObservationWrapper):
+    """Resize / grayscale the image keys via cv2, always emitting
+    `[H, W, C]` uint8 (reference transform at utils/env.py:231-267, minus the
+    final channel-first transpose)."""
+
+    def __init__(self, env: gym.Env, cnn_keys, screen_size: int, grayscale: bool):
+        super().__init__(env)
+        self._cnn_keys = tuple(cnn_keys)
+        self._screen = screen_size
+        self._gray = grayscale
+        spaces = dict(env.observation_space.spaces)
+        for k in self._cnn_keys:
+            channels = 1 if grayscale else 3
+            spaces[k] = gym.spaces.Box(
+                0, 255, (screen_size, screen_size, channels), np.uint8
+            )
+        self.observation_space = gym.spaces.Dict(spaces)
+
+    def observation(self, obs):
+        obs = dict(obs)
+        for k in self._cnn_keys:
+            img = np.asarray(obs[k])
+            if img.ndim == 2:
+                img = img[..., None]
+            # channel-first input (e.g. an env emitting [C, H, W]) -> HWC
+            if img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[-1] not in (1, 3):
+                img = img.transpose(1, 2, 0)
+            if img.shape[:2] != (self._screen, self._screen):
+                img = cv2.resize(
+                    img, (self._screen, self._screen), interpolation=cv2.INTER_AREA
+                )
+                if img.ndim == 2:
+                    img = img[..., None]
+            if self._gray and img.shape[-1] == 3:
+                img = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)[..., None]
+            elif not self._gray and img.shape[-1] == 1:
+                img = np.repeat(img, 3, axis=-1)
+            obs[k] = img.astype(np.uint8)
+        return obs
+
+
+def get_dummy_env(env_id: str) -> gym.Env:
+    from ..envs.dummy import (
+        ContinuousDummyEnv,
+        DiscreteDummyEnv,
+        MultiDiscreteDummyEnv,
+    )
+
+    lid = env_id.lower()
+    if "continuous" in lid:
+        return ContinuousDummyEnv()
+    if "multidiscrete" in lid:
+        return MultiDiscreteDummyEnv()
+    if "discrete" in lid:
+        return DiscreteDummyEnv()
+    raise ValueError(f"unrecognized dummy environment: {env_id}")
+
+
+def make_dict_env(
+    env_id: str,
+    seed: int,
+    rank: int,
+    args: Any,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    mask_velocities: bool = False,
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Full dict-observation pipeline
+    (/root/reference/sheeprl/utils/env.py:44-292). `args` carries the
+    standard fields plus the per-algo obs config (`cnn_keys`, `mlp_keys`,
+    `grayscale_obs`, `capture_video`, ...)."""
+
+    def thunk() -> gym.Env:
+        lid = env_id.lower()
+        env_spec = ""
+        cnn_keys = list(getattr(args, "cnn_keys", None) or [])
+        mlp_keys = list(getattr(args, "mlp_keys", None) or [])
+        grayscale = bool(getattr(args, "grayscale_obs", False))
+        screen_size = getattr(args, "screen_size", 64)
+        action_repeat = getattr(args, "action_repeat", 1)
+
+        if "dummy" in lid:
+            env = get_dummy_env(lid)
+        elif lid.startswith("dmc"):
+            from ..envs.dmc import DMCWrapper
+
+            _, domain, task = lid.split("_")
+            env = DMCWrapper(
+                domain,
+                task,
+                from_pixels=True,
+                height=screen_size,
+                width=screen_size,
+                frame_skip=action_repeat,
+                seed=seed,
+            )
+        elif "minedojo" in lid:
+            from ..envs.minedojo import MineDojoWrapper
+
+            task_id = "_".join(env_id.split("_")[1:])
+            pos = getattr(args, "mine_start_position", None)
+            start_position = (
+                dict(
+                    x=float(pos[0]), y=float(pos[1]), z=float(pos[2]),
+                    pitch=float(pos[3]), yaw=float(pos[4]),
+                )
+                if pos is not None
+                else None
+            )
+            env = MineDojoWrapper(
+                task_id,
+                height=screen_size,
+                width=screen_size,
+                pitch_limits=(
+                    getattr(args, "mine_min_pitch", -60),
+                    getattr(args, "mine_max_pitch", 60),
+                ),
+                seed=args.seed,
+                start_position=start_position,
+            )
+            args.action_repeat = 1
+            action_repeat = 1
+        elif "minerl" in lid:
+            from ..envs.minerl import MineRLWrapper
+
+            task_id = "_".join(env_id.split("_")[1:])
+            env = MineRLWrapper(
+                task_id,
+                height=screen_size,
+                width=screen_size,
+                pitch_limits=(
+                    getattr(args, "mine_min_pitch", -60),
+                    getattr(args, "mine_max_pitch", 60),
+                ),
+                seed=args.seed,
+                break_speed_multiplier=getattr(args, "mine_break_speed", 100),
+                sticky_attack=getattr(args, "mine_sticky_attack", 30),
+                sticky_jump=getattr(args, "mine_sticky_jump", 10),
+                dense=getattr(args, "minerl_dense", False),
+                extreme=getattr(args, "minerl_extreme", False),
+            )
+            args.action_repeat = 1
+            action_repeat = 1
+        elif "diambra" in lid:
+            from ..envs.diambra_wrapper import DiambraWrapper
+
+            if not args.sync_env:
+                raise ValueError("DIAMBRA envs require sync_env=True")
+            task_id = "_".join(env_id.split("_")[1:])
+            env = DiambraWrapper(
+                env_id=task_id,
+                action_space=getattr(args, "diambra_action_space", "discrete"),
+                screen_size=screen_size,
+                grayscale=grayscale,
+                attack_but_combination=getattr(args, "diambra_attack_but_combination", True),
+                actions_stack=getattr(args, "diambra_actions_stack", 1),
+                noop_max=getattr(args, "diambra_noop_max", 0),
+                sticky_actions=action_repeat,
+                seed=args.seed,
+                rank=rank + vector_env_idx,
+            )
+        else:
+            env_spec = str(gym.spec(env_id).entry_point)
+            env = gym.make(env_id, render_mode="rgb_array")
+            if "mujoco" in env_spec:
+                env.frame_skip = 0
+            elif "atari" in env_spec:
+                noop_max = getattr(args, "atari_noop_max", 30)
+                if noop_max < 0:
+                    raise ValueError(
+                        f"atari_noop_max must be >= 0, got {noop_max}"
+                    )
+                env = gym.wrappers.AtariPreprocessing(
+                    env,
+                    noop_max=noop_max,
+                    frame_skip=action_repeat,
+                    screen_size=screen_size,
+                    grayscale_obs=grayscale,
+                    scale_obs=False,
+                    terminal_on_life_loss=False,
+                    grayscale_newaxis=True,
+                )
+        if mask_velocities:
+            env = MaskVelocityWrapper(env)
+        if "atari" not in env_spec and not lid.startswith("dmc") and "diambra" not in lid:
+            env = ActionRepeat(env, action_repeat)
+
+        # --- Box obs -> dict obs -------------------------------------------
+        if isinstance(env.observation_space, gym.spaces.Box):
+            shape = env.observation_space.shape
+            if len(shape) < 2:  # vector obs
+                if cnn_keys:
+                    warnings.warn(
+                        f"{env_id} emits a vector observation; cnn_keys {cnn_keys} "
+                        "cannot be rendered from it — exposing it as an mlp key"
+                    )
+                key = mlp_keys[0] if mlp_keys else "state"
+                if not mlp_keys:
+                    args.mlp_keys = [key]
+                env = DictObservation(env, key)
+            else:  # image obs
+                key = cnn_keys[0] if cnn_keys else "rgb"
+                if not cnn_keys:
+                    args.cnn_keys = [key]
+                    cnn_keys = [key]
+                env = DictObservation(env, key)
+
+        env_cnn_keys = {
+            k
+            for k, sp in env.observation_space.spaces.items()
+            if len(sp.shape) in (2, 3)
+        }
+        active_cnn_keys = sorted(env_cnn_keys.intersection(cnn_keys))
+        if active_cnn_keys:
+            env = _ImageTransform(env, active_cnn_keys, screen_size, grayscale)
+            frame_stack = getattr(args, "frame_stack", -1)
+            if frame_stack > 0:
+                dilation = getattr(args, "frame_stack_dilation", 1)
+                if dilation <= 0:
+                    raise ValueError(
+                        f"frame_stack_dilation must be > 0, got {dilation}"
+                    )
+                env = FrameStack(env, frame_stack, active_cnn_keys, dilation)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if args.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(
+                env, max_episode_steps=args.max_episode_steps // action_repeat
+            )
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if (
+            getattr(args, "capture_video", False)
+            and rank == 0
+            and vector_env_idx == 0
+            and run_name is not None
+        ):
+            env = gym.wrappers.RecordVideo(
+                env,
+                os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                disable_logger=True,
+            )
+        return env
+
+    return thunk

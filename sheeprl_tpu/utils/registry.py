@@ -1,0 +1,33 @@
+"""Algorithm registry: maps task names to their `main()` entry points.
+
+Mirrors the reference's decorator-driven registry
+(/root/reference/sheeprl/utils/registry.py:7-44): importing
+`sheeprl_tpu.algos` fires every `@register_algorithm()` decorator, the CLI
+then builds one subcommand per registered task.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+# task name -> entry point callable (the algorithm's `main`)
+tasks: dict[str, Callable[..., Any]] = {}
+# task names whose topology is decoupled player/trainer (run over sub-meshes)
+decoupled_tasks: list[str] = []
+
+
+def register_algorithm(decoupled: bool = False, name: str | None = None):
+    """Decorator registering an algorithm `main()` as a CLI task. The task
+    name defaults to the defining module's last path component
+    (`sheeprl_tpu/algos/ppo/ppo.py` -> `ppo`)."""
+
+    def inner(fn: Callable[..., Any]) -> Callable[..., Any]:
+        task = name or fn.__module__.rsplit(".", 1)[-1]
+        if task in tasks:
+            raise ValueError(f"algorithm {task!r} already registered")
+        tasks[task] = fn
+        if decoupled or "decoupled" in task:
+            decoupled_tasks.append(task)
+        return fn
+
+    return inner

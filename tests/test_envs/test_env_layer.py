@@ -1,0 +1,183 @@
+"""Env pipeline contracts: dict obs, NHWC images, frame stacking, vector
+runners, wrappers."""
+
+import dataclasses
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs import (
+    ActionRepeat,
+    ContinuousDummyEnv,
+    DiscreteDummyEnv,
+    FrameStack,
+    MaskVelocityWrapper,
+    MultiDiscreteDummyEnv,
+    RestartOnException,
+    SyncVectorEnv,
+    AsyncVectorEnv,
+)
+from sheeprl_tpu.utils.env import make_dict_env, make_env
+
+
+@dataclasses.dataclass
+class EnvArgs:
+    seed: int = 0
+    sync_env: bool = True
+    screen_size: int = 64
+    action_repeat: int = 1
+    frame_stack: int = -1
+    frame_stack_dilation: int = 1
+    max_episode_steps: int = -1
+    capture_video: bool = False
+    cnn_keys: list = None
+    mlp_keys: list = None
+    grayscale_obs: bool = False
+
+
+def test_dummy_envs_channel_last():
+    for env in (ContinuousDummyEnv(), DiscreteDummyEnv(), MultiDiscreteDummyEnv()):
+        obs, _ = env.reset()
+        assert obs.shape == (64, 64, 3) and obs.dtype == np.uint8
+
+
+def test_make_dict_env_vector_obs():
+    args = EnvArgs(mlp_keys=["state"])
+    env = make_dict_env("CartPole-v1", seed=0, rank=0, args=args)()
+    obs, _ = env.reset(seed=0)
+    assert isinstance(obs, dict) and "state" in obs
+    assert obs["state"].shape == (4,)
+
+
+def test_make_dict_env_pixel_obs_nhwc():
+    args = EnvArgs(cnn_keys=["rgb"])
+    env = make_dict_env("discrete_dummy", seed=0, rank=0, args=args)()
+    obs, _ = env.reset(seed=0)
+    assert obs["rgb"].shape == (64, 64, 3)
+    assert obs["rgb"].dtype == np.uint8
+    assert env.observation_space["rgb"].shape == (64, 64, 3)
+
+
+def test_make_dict_env_grayscale_resize():
+    args = EnvArgs(cnn_keys=["rgb"], grayscale_obs=True, screen_size=32)
+    env = make_dict_env("discrete_dummy", seed=0, rank=0, args=args)()
+    obs, _ = env.reset(seed=0)
+    assert obs["rgb"].shape == (32, 32, 1)
+
+
+def test_make_dict_env_frame_stack_channels():
+    args = EnvArgs(cnn_keys=["rgb"], frame_stack=4)
+    env = make_dict_env("discrete_dummy", seed=0, rank=0, args=args)()
+    obs, _ = env.reset(seed=0)
+    assert obs["rgb"].shape == (64, 64, 12)  # 3 channels x 4 frames
+    obs, *_ = env.step(env.action_space.sample())
+    assert obs["rgb"].shape == (64, 64, 12)
+
+
+def test_make_dict_env_time_limit():
+    args = EnvArgs(mlp_keys=["state"], max_episode_steps=6, action_repeat=2)
+    env = make_dict_env("CartPole-v1", seed=0, rank=0, args=args)()
+    env.reset(seed=0)
+    truncated = False
+    for _ in range(4):
+        *_, truncated, info = env.step(env.action_space.sample())
+        if truncated:
+            break
+    assert truncated  # 6 // 2 = 3 steps
+
+
+def test_action_repeat_accumulates_reward():
+    env = make_env("CartPole-v1", seed=0, idx=0, action_repeat=3)()
+    env.reset(seed=0)
+    _, reward, *_ = env.step(env.action_space.sample())
+    assert reward >= 1.0  # cartpole gives 1/step; 3 repeats unless early done
+
+
+def test_mask_velocity():
+    env = MaskVelocityWrapper(gym.make("CartPole-v1"))
+    obs, _ = env.reset(seed=0)
+    assert obs[1] == 0.0 and obs[3] == 0.0
+
+
+def test_restart_on_exception():
+    calls = {"n": 0}
+
+    class Crashy(DiscreteDummyEnv):
+        def step(self, action):
+            if calls["n"] == 1:
+                calls["n"] += 1
+                raise RuntimeError("boom")
+            calls["n"] += 1
+            return super().step(action)
+
+    env = RestartOnException(lambda: Crashy(), wait=0.01)
+    env.reset()
+    env.step(env.action_space.sample())
+    obs, reward, term, trunc, info = env.step(env.action_space.sample())  # crashes -> restart
+    assert info.get("restart_on_exception") is True
+    assert not term and not trunc
+
+
+def test_restart_on_exception_gives_up():
+    class AlwaysCrash(DiscreteDummyEnv):
+        def step(self, action):
+            raise RuntimeError("boom")
+
+    env = RestartOnException(lambda: AlwaysCrash(), maxfails=1, wait=0.01, window=1000)
+    env.reset()
+    with pytest.raises(RuntimeError, match="too many"):
+        for _ in range(3):
+            env.step(env.action_space.sample())
+
+
+@pytest.mark.parametrize("cls", [SyncVectorEnv, AsyncVectorEnv])
+def test_vector_env_dict_obs_and_autoreset(cls):
+    args = EnvArgs(cnn_keys=["rgb"])
+    fns = [make_dict_env("discrete_dummy", seed=i, rank=0, args=args) for i in range(2)]
+    envs = cls(fns)
+    try:
+        obs, _ = envs.reset(seed=0)
+        assert obs["rgb"].shape == (2, 64, 64, 3)
+        saw_final = False
+        for _ in range(8):  # dummy env has 4-step episodes
+            actions = [envs.single_action_space.sample() for _ in range(2)]
+            obs, rewards, terms, truncs, infos = envs.step(actions)
+            assert obs["rgb"].shape == (2, 64, 64, 3)
+            for i, info in enumerate(infos):
+                if terms[i] or truncs[i]:
+                    assert "final_observation" in info
+                    saw_final = True
+        assert saw_final
+    finally:
+        envs.close()
+
+
+def test_vector_env_box_obs():
+    fns = [make_env("CartPole-v1", seed=i, idx=i) for i in range(3)]
+    envs = SyncVectorEnv(fns)
+    obs, _ = envs.reset(seed=0)
+    assert obs.shape == (3, 4)
+    envs.close()
+
+
+def test_frame_stack_dilation():
+    class Counter(DiscreteDummyEnv):
+        def __init__(self):
+            super().__init__(size=(2, 2, 1), n_steps=100)
+            self.t = 0
+
+        def _obs(self):
+            self.t += 1
+            return np.full((2, 2, 1), self.t % 256, np.uint8)
+
+    from sheeprl_tpu.envs.wrappers import DictObservation
+
+    env = DictObservation(Counter(), "rgb")
+    env = FrameStack(env, num_stack=2, cnn_keys=["rgb"], dilation=2)
+    obs, _ = env.reset()
+    for _ in range(4):
+        obs, *_ = env.step(0)
+    # after 4 steps: frames deque [1,2,3,4]; dilation 2 -> picks frames 2,4
+    assert obs["rgb"].shape == (2, 2, 2)
+    np.testing.assert_array_equal(obs["rgb"][0, 0], [2, 4])

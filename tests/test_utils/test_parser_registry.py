@@ -1,0 +1,91 @@
+"""Config parser and registry contracts
+(mirrors the reference parser behaviors, utils/parser.py:69-431)."""
+
+import dataclasses
+from typing import List, Literal, Optional
+
+import pytest
+
+from sheeprl_tpu.algos.args import StandardArgs
+from sheeprl_tpu.utils.parser import Arg, DataclassArgumentParser
+from sheeprl_tpu.utils.registry import register_algorithm, tasks
+
+
+@dataclasses.dataclass
+class DemoArgs(StandardArgs):
+    lr: float = Arg(default=1e-3, help="learning rate")
+    flag: bool = Arg(default=True)
+    mode: Literal["a", "b"] = Arg(default="a")
+    sizes: List[int] = Arg(default=[1, 2])
+    note: Optional[str] = Arg(default=None)
+
+
+def parse(argv):
+    return DataclassArgumentParser(DemoArgs).parse_args_into_dataclasses(argv)[0]
+
+
+def test_defaults():
+    args = parse([])
+    assert args.lr == 1e-3 and args.flag is True and args.sizes == [1, 2]
+    assert args.env_id == "CartPole-v1"  # inherited
+
+
+def test_bool_pair():
+    assert parse(["--no_flag"]).flag is False
+    assert parse(["--flag"]).flag is True
+
+
+def test_literal_choices():
+    assert parse(["--mode", "b"]).mode == "b"
+    with pytest.raises(SystemExit):
+        parse(["--mode", "c"])
+
+
+def test_list_nargs():
+    assert parse(["--sizes", "3", "4", "5"]).sizes == [3, 4, 5]
+
+
+def test_unknown_arg_raises():
+    with pytest.raises(ValueError):
+        parse(["--nope", "1"])
+
+
+def test_inheritance_overrides():
+    args = parse(["--env_id", "dmc_walker_walk", "--lr", "0.01"])
+    assert args.env_id == "dmc_walker_walk" and args.lr == 0.01
+
+
+def test_parse_dict_roundtrip():
+    args = parse(["--seed", "7"])
+    parser = DataclassArgumentParser(DemoArgs)
+    (restored,) = parser.parse_dict(args.as_dict())
+    assert restored.seed == 7
+    # extra keys tolerated by default (checkpoint resume path)
+    (restored2,) = parser.parse_dict({**args.as_dict(), "bogus": 1})
+    assert restored2.seed == 7
+    with pytest.raises(ValueError):
+        parser.parse_dict({"bogus": 1}, allow_extra_keys=False)
+
+
+def test_log_dir_side_effect(tmp_path):
+    args = parse([])
+    args.log_dir = str(tmp_path / "run")
+    assert (tmp_path / "run" / "args.json").exists()
+
+
+def test_default_list_not_shared():
+    a, b = parse([]), parse([])
+    a.sizes.append(99)
+    assert b.sizes == [1, 2]
+
+
+def test_registry_decorator():
+    @register_algorithm(name="_test_algo")
+    def main(argv):
+        return "ran"
+
+    assert "_test_algo" in tasks
+    assert tasks["_test_algo"]([]) == "ran"
+    with pytest.raises(ValueError):
+        register_algorithm(name="_test_algo")(lambda argv: None)
+    del tasks["_test_algo"]

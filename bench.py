@@ -1,0 +1,125 @@
+"""Benchmark entry: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Current benchmark: PPO coupled on CartPole-v1 (BASELINE.md config 1) —
+end-to-end env-steps/sec including rollout, GAE, and the single-jit update
+phase, measured after one warm-up update (compile excluded).
+
+Baseline denominator: the reference (SheepRL, torch) is not runnable in this
+image (no lightning/tensordict), and it publishes no numbers (BASELINE.md),
+so vs_baseline is measured against this framework's first-round CPU
+measurement (610 env-steps/sec on the round-1 host) until a reference run
+is available.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+CPU_REFERENCE_SPS = 610.0  # round-1 CPU measurement, see docstring
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.ppo.agent import one_hot_to_env_actions
+    from sheeprl_tpu.algos.ppo.args import PPOArgs
+    from sheeprl_tpu.algos.ppo.ppo import (
+        TrainState,
+        compute_gae_returns,
+        make_optimizer,
+        make_train_step,
+        policy_step,
+        validate_obs_keys,
+        actions_dim_of,
+    )
+    from sheeprl_tpu.algos.ppo.agent import PPOAgent
+    from sheeprl_tpu.envs import make_vector_env
+    from sheeprl_tpu.utils.env import make_dict_env
+
+    args = PPOArgs(
+        env_id="CartPole-v1", num_envs=8, rollout_steps=128,
+        per_rank_batch_size=64, update_epochs=10, sync_env=True,
+    )
+    envs = make_vector_env(
+        [make_dict_env(args.env_id, i, rank=0, args=args) for i in range(args.num_envs)],
+        sync=True,
+    )
+    cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
+    obs_keys = [*cnn_keys, *mlp_keys]
+    actions_dim, is_continuous = actions_dim_of(envs.single_action_space)
+    key = jax.random.PRNGKey(0)
+    agent = PPOAgent.init(
+        jax.random.PRNGKey(1), actions_dim, envs.single_observation_space.spaces,
+        cnn_keys, mlp_keys, is_continuous=is_continuous,
+    )
+    optimizer = make_optimizer(args)
+    state = TrainState(agent=agent, opt_state=optimizer.init(agent))
+    num_minibatches = args.rollout_steps * args.num_envs // args.per_rank_batch_size
+    train_step = make_train_step(args, optimizer, num_minibatches)
+
+    obs, _ = envs.reset(seed=0)
+    next_done = np.zeros(args.num_envs, np.float32)
+
+    def one_update(state, obs, next_done, key):
+        rows = {k: [] for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
+        for _ in range(args.rollout_steps):
+            key, sk = jax.random.split(key)
+            dobs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+            actions, logprob, value = policy_step(state.agent, dobs, sk)
+            env_actions = one_hot_to_env_actions(actions, actions_dim, is_continuous)
+            nobs, rewards, terms, truncs, _ = envs.step(list(env_actions))
+            for k in obs_keys:
+                rows[k].append(np.asarray(obs[k]))
+            rows["actions"].append(np.asarray(actions))
+            rows["logprobs"].append(np.asarray(logprob))
+            rows["values"].append(np.asarray(value))
+            rows["rewards"].append(rewards[:, None])
+            rows["dones"].append(next_done[:, None])
+            next_done = (terms | truncs).astype(np.float32)
+            obs = nobs
+        data = {k: jnp.asarray(np.stack(v)) for k, v in rows.items()}
+        dnext = {k: jnp.asarray(obs[k]) for k in obs_keys}
+        returns, advantages = compute_gae_returns(
+            state.agent, data, dnext, jnp.asarray(next_done)[:, None],
+            args.gamma, args.gae_lambda,
+        )
+        data["returns"], data["advantages"] = returns, advantages
+        flat = {
+            k: v.reshape((-1,) + v.shape[2:])
+            for k, v in data.items() if k not in ("rewards", "dones")
+        }
+        key, tk = jax.random.split(key)
+        state, metrics = train_step(
+            state, flat, tk, jnp.float32(args.lr), jnp.float32(args.clip_coef),
+            jnp.float32(args.ent_coef),
+        )
+        jax.block_until_ready(metrics)
+        return state, obs, next_done, key
+
+    # warm-up (compile)
+    state, obs, next_done, key = one_update(state, obs, next_done, key)
+    n_updates = 8
+    t0 = time.perf_counter()
+    for _ in range(n_updates):
+        state, obs, next_done, key = one_update(state, obs, next_done, key)
+    dt = time.perf_counter() - t0
+    envs.close()
+    sps = n_updates * args.rollout_steps * args.num_envs / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_env_steps_per_sec",
+                "value": round(sps, 1),
+                "unit": "env-steps/sec/chip",
+                "vs_baseline": round(sps / CPU_REFERENCE_SPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

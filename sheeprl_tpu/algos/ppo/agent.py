@@ -1,0 +1,220 @@
+"""PPO agent: dict-obs multi-encoder + multi-head actor + critic.
+
+Capability parity with /root/reference/sheeprl/algos/ppo/agent.py:60-174 —
+continuous (Gaussian), Discrete and MultiDiscrete (independent one-hot heads)
+action spaces over fused CNN+MLP features — as a single pytree Module whose
+forward is pure (sampling takes an explicit key), so rollout policy steps and
+train-time re-evaluation are two jits of the same object.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...ops import distributions as D
+
+
+class CNNEncoder(nn.Module):
+    """NatureCNN over channel-concatenated image keys (agent.py:13-28);
+    uint8 NHWC input is normalized to [0,1] on device."""
+
+    model: nn.NatureCNN
+    keys: tuple[str, ...] = nn.static()
+
+    @classmethod
+    def init(cls, key, in_channels: int, features_dim: int, screen_size: int, keys: Sequence[str]):
+        model = nn.NatureCNN.init(key, in_channels, features_dim, screen_size=screen_size)
+        return cls(model=model, keys=tuple(keys))
+
+    def __call__(self, obs: dict) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return self.model(x.astype(jnp.float32) / 255.0)
+
+    @property
+    def output_dim(self) -> int:
+        return self.model.output_dim
+
+
+class MLPEncoder(nn.Module):
+    """MLP over feature-concatenated vector keys (agent.py:31-57)."""
+
+    model: nn.MLP
+    keys: tuple[str, ...] = nn.static()
+
+    @classmethod
+    def init(
+        cls, key, input_dim: int, features_dim: int, keys: Sequence[str],
+        dense_units: int, mlp_layers: int, dense_act: str, layer_norm: bool,
+    ):
+        model = nn.MLP.init(
+            key, input_dim, [dense_units] * mlp_layers, features_dim,
+            act=dense_act, layer_norm=layer_norm,
+        )
+        return cls(model=model, keys=tuple(keys))
+
+    def __call__(self, obs: dict) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return self.model(x)
+
+    @property
+    def output_dim(self) -> int:
+        return self.model.output_dim
+
+
+class PPOAgent(nn.Module):
+    cnn_encoder: CNNEncoder | None
+    mlp_encoder: MLPEncoder | None
+    actor_backbone: nn.MLP
+    actor_heads: tuple[nn.Linear, ...]
+    critic: nn.MLP
+    actions_dim: tuple[int, ...] = nn.static()
+    is_continuous: bool = nn.static(default=False)
+
+    @classmethod
+    def init(
+        cls,
+        key,
+        actions_dim: Sequence[int],
+        obs_space: dict,
+        cnn_keys: Sequence[str],
+        mlp_keys: Sequence[str],
+        *,
+        cnn_features_dim: int = 512,
+        mlp_features_dim: int = 64,
+        screen_size: int = 64,
+        mlp_layers: int = 2,
+        dense_units: int = 64,
+        dense_act: str = "tanh",
+        layer_norm: bool = False,
+        is_continuous: bool = False,
+    ):
+        k_cnn, k_mlp, k_bb, k_cr, k_heads = jax.random.split(key, 5)
+        cnn_encoder = None
+        features_dim = 0
+        if cnn_keys:
+            in_channels = sum(obs_space[k].shape[-1] for k in cnn_keys)
+            cnn_encoder = CNNEncoder.init(
+                k_cnn, in_channels, cnn_features_dim, screen_size, cnn_keys
+            )
+            features_dim += cnn_features_dim
+        mlp_encoder = None
+        if mlp_keys:
+            input_dim = sum(obs_space[k].shape[0] for k in mlp_keys)
+            mlp_encoder = MLPEncoder.init(
+                k_mlp, input_dim, mlp_features_dim, mlp_keys,
+                dense_units, mlp_layers, dense_act, layer_norm,
+            )
+            features_dim += mlp_features_dim
+        actor_backbone = nn.MLP.init(
+            k_bb, features_dim, [dense_units] * mlp_layers,
+            act=dense_act, layer_norm=layer_norm,
+        )
+        if is_continuous:
+            heads = (nn.Linear.init(k_heads, dense_units, sum(actions_dim) * 2),)
+        else:
+            head_keys = jax.random.split(k_heads, len(actions_dim))
+            heads = tuple(
+                nn.Linear.init(hk, dense_units, int(dim))
+                for hk, dim in zip(head_keys, actions_dim)
+            )
+        critic = nn.MLP.init(
+            k_cr, features_dim, [dense_units] * mlp_layers, 1, act=dense_act
+        )
+        return cls(
+            cnn_encoder=cnn_encoder,
+            mlp_encoder=mlp_encoder,
+            actor_backbone=actor_backbone,
+            actor_heads=heads,
+            critic=critic,
+            actions_dim=tuple(int(d) for d in actions_dim),
+            is_continuous=is_continuous,
+        )
+
+    # -- internals -----------------------------------------------------------
+    def features(self, obs: dict) -> jax.Array:
+        feats = []
+        if self.cnn_encoder is not None:
+            feats.append(self.cnn_encoder(obs))
+        if self.mlp_encoder is not None:
+            feats.append(self.mlp_encoder(obs))
+        return jnp.concatenate(feats, axis=-1)
+
+    def _pre_dist(self, feat: jax.Array) -> list[jax.Array]:
+        out = self.actor_backbone(feat)
+        return [head(out) for head in self.actor_heads]
+
+    # -- public API ----------------------------------------------------------
+    def __call__(self, obs: dict, actions: jax.Array | None = None, *, key=None):
+        """Returns (actions, logprob[...,1], entropy[...,1], values[...,1]).
+
+        Discrete/multi-discrete actions are a single concatenated one-hot
+        array `[..., sum(actions_dim)]`; continuous actions are raw values
+        `[..., sum(actions_dim)]` (reference forward, agent.py:122-160).
+        When `actions` is None they are sampled with `key`.
+        """
+        feat = self.features(obs)
+        pre_dist = self._pre_dist(feat)
+        values = self.critic(feat)
+        if self.is_continuous:
+            mean, log_std = jnp.split(pre_dist[0], 2, axis=-1)
+            normal = D.Independent(
+                base=D.Normal(loc=mean, scale=jnp.exp(log_std)), event_ndims=1
+            )
+            if actions is None:
+                actions = normal.sample(key)
+            log_prob = normal.log_prob(actions)
+            entropy = normal.entropy()
+            return actions, log_prob[..., None], entropy[..., None], values
+        import numpy as np
+
+        splits = np.cumsum(self.actions_dim)[:-1].tolist()  # static split points
+        given = None if actions is None else jnp.split(actions, splits, axis=-1)
+        sampled, log_probs, entropies = [], [], []
+        keys = jax.random.split(key, len(pre_dist)) if key is not None else [None] * len(pre_dist)
+        for i, logits in enumerate(pre_dist):
+            dist = D.OneHotCategorical.from_logits(logits)
+            act = dist.sample(keys[i]) if given is None else given[i]
+            sampled.append(act)
+            log_probs.append(dist.log_prob(act))
+            entropies.append(dist.entropy())
+        return (
+            jnp.concatenate(sampled, axis=-1),
+            sum(log_probs)[..., None],
+            sum(entropies)[..., None],
+            values,
+        )
+
+    def get_value(self, obs: dict) -> jax.Array:
+        return self.critic(self.features(obs))
+
+    def get_greedy_actions(self, obs: dict) -> jax.Array:
+        feat = self.features(obs)
+        pre_dist = self._pre_dist(feat)
+        if self.is_continuous:
+            return jnp.split(pre_dist[0], 2, axis=-1)[0]
+        return jnp.concatenate(
+            [D.OneHotCategorical.from_logits(lg).mode for lg in pre_dist], axis=-1
+        )
+
+
+def one_hot_to_env_actions(actions: jax.Array, actions_dim: Sequence[int], is_continuous: bool):
+    """Convert the agent's action representation to what env.step expects:
+    argmax indices per head for (multi-)discrete (squeezed to scalars for a
+    single Discrete head), raw values for continuous."""
+    import numpy as np
+
+    actions = np.asarray(actions)
+    if is_continuous:
+        return actions
+    out, start = [], 0
+    for dim in actions_dim:
+        out.append(actions[..., start : start + dim].argmax(-1))
+        start += dim
+    stacked = np.stack(out, axis=-1)
+    if len(actions_dim) == 1:  # plain Discrete: env wants a scalar per env
+        return stacked[..., 0]
+    return stacked

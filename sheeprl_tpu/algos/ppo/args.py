@@ -1,0 +1,53 @@
+"""PPO config (field parity with /root/reference/sheeprl/algos/ppo/args.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ...utils.parser import Arg
+from ..args import StandardArgs
+
+
+@dataclasses.dataclass
+class PPOArgs(StandardArgs):
+    share_data: bool = Arg(
+        default=False,
+        help="gather the full rollout across the mesh before sharding minibatches "
+        "(under a global jit the batch is already global; kept for parity)",
+    )
+    per_rank_batch_size: int = Arg(default=64, help="minibatch size per device")
+    total_steps: int = Arg(default=2**16, help="total env steps of the experiment")
+    rollout_steps: int = Arg(default=128, help="env steps per policy rollout")
+    capture_video: bool = Arg(default=False, help="record videos of the agent")
+    mask_vel: bool = Arg(default=False, help="mask velocity entries (POMDP)")
+    lr: float = Arg(default=1e-3, help="optimizer learning rate")
+    anneal_lr: bool = Arg(default=False, help="linearly anneal lr to zero")
+    gamma: float = Arg(default=0.99, help="discount factor")
+    gae_lambda: float = Arg(default=0.95, help="GAE lambda")
+    update_epochs: int = Arg(default=10, help="epochs over the rollout per update")
+    loss_reduction: str = Arg(default="mean", help="loss reduction: mean|sum")
+    normalize_advantages: bool = Arg(default=False, help="normalize advantages per minibatch")
+    clip_coef: float = Arg(default=0.2, help="surrogate clipping coefficient")
+    anneal_clip_coef: bool = Arg(default=False, help="anneal clip coefficient to zero")
+    clip_vloss: bool = Arg(default=False, help="clip the value loss")
+    ent_coef: float = Arg(default=0.0, help="entropy bonus coefficient")
+    anneal_ent_coef: bool = Arg(default=False, help="anneal entropy coefficient to zero")
+    vf_coef: float = Arg(default=1.0, help="value loss coefficient")
+    max_grad_norm: float = Arg(default=0.0, help="global grad-norm clip; 0 disables")
+    dense_units: int = Arg(default=64, help="units per dense layer")
+    mlp_layers: int = Arg(default=2, help="MLP depth for actor/critic/backbone")
+    dense_act: str = Arg(default="tanh", help="dense activation name")
+    cnn_act: str = Arg(default="tanh", help="conv activation name")
+    layer_norm: bool = Arg(default=False, help="LayerNorm after every dense/conv layer")
+    grayscale_obs: bool = Arg(default=False, help="grayscale image observations")
+    cnn_keys: Optional[List[str]] = Arg(default=None, help="obs keys for the CNN encoder")
+    mlp_keys: Optional[List[str]] = Arg(default=None, help="obs keys for the MLP encoder")
+    eps: float = Arg(default=1e-4, help="adam epsilon")
+    cnn_features_dim: int = Arg(default=512, help="CNN encoder output features")
+    mlp_features_dim: int = Arg(default=64, help="MLP encoder output features")
+    atari_noop_max: int = Arg(default=30, help="max no-ops on Atari reset")
+    diambra_action_space: str = Arg(default="discrete", help="discrete|multi_discrete")
+    diambra_attack_but_combination: bool = Arg(default=True)
+    diambra_noop_max: int = Arg(default=0)
+    diambra_actions_stack: int = Arg(default=1)

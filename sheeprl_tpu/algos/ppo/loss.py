@@ -1,0 +1,52 @@
+"""PPO losses (equation parity with /root/reference/sheeprl/algos/ppo/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    if reduction == "none":
+        return x
+    raise ValueError(f"unrecognized reduction: {reduction}")
+
+
+def policy_loss(
+    new_logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    clip_coef: jax.Array,
+    reduction: str = "mean",
+) -> jax.Array:
+    """Clipped surrogate objective, eq. (7) of arXiv:1707.06347
+    (loss.py:6-47)."""
+    ratio = jnp.exp(new_logprobs - old_logprobs)
+    pg1 = advantages * ratio
+    pg2 = advantages * jnp.clip(ratio, 1.0 - clip_coef, 1.0 + clip_coef)
+    return _reduce(-jnp.minimum(pg1, pg2), reduction)
+
+
+def value_loss(
+    new_values: jax.Array,
+    old_values: jax.Array,
+    returns: jax.Array,
+    clip_coef: jax.Array,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> jax.Array:
+    """(Optionally clipped) value MSE (loss.py:50-62). Note the reference's
+    unclipped branch is plain MSE *without* the 0.5 factor; kept identical."""
+    if clip_vloss:
+        values_pred = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    else:
+        values_pred = new_values
+    return _reduce(jnp.square(values_pred - returns), reduction)
+
+
+def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:
+    return _reduce(-entropy, reduction)

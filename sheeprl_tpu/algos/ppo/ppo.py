@@ -1,0 +1,341 @@
+"""PPO, coupled (capability parity with
+/root/reference/sheeprl/algos/ppo/ppo.py).
+
+TPU-first structure:
+  - the rollout hot loop is a single jitted `policy_step` (device) feeding a
+    host vector-env; transitions accumulate in an HBM-resident ReplayBuffer
+    used as the rollout store (reference uses ReplayBuffer the same way,
+    ppo.py:228-235);
+  - GAE and the FULL update phase (update_epochs x minibatches) run as ONE
+    jitted call — `lax.scan` over epochs and minibatches — so a whole PPO
+    update is a single XLA program with zero host round-trips
+    (the reference's Python minibatch loop, ppo.py:34-100, becomes a scan);
+  - annealed lr / clip / entropy coefficients enter the jit as traced
+    scalars, so annealing never recompiles;
+  - data parallelism: params replicated over the mesh, rollout sharded on the
+    env axis; XLA inserts the gradient all-reduce (the DDP equivalent) from
+    the sharding annotations. `share_data` is implicit — under a global jit
+    every device contributes to every global minibatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from functools import partial
+from typing import Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ... import nn, ops
+from ...data import ReplayBuffer
+from ...envs import make_vector_env
+from ...parallel import make_mesh, replicate, shard_batch
+from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.env import make_dict_env
+from ...utils.logger import create_logger
+from ...utils.metric import MetricAggregator
+from ...utils.registry import register_algorithm
+from ...utils.parser import DataclassArgumentParser
+from .agent import PPOAgent, one_hot_to_env_actions
+from .args import PPOArgs
+from .loss import entropy_loss, policy_loss, value_loss
+
+
+class TrainState(nn.Module):
+    agent: PPOAgent
+    opt_state: object
+
+
+def validate_obs_keys(observation_space: gym.spaces.Dict, args) -> tuple[list, list]:
+    """cnn/mlp key validation, as every reference main does
+    (ppo.py:154-183)."""
+    if args.cnn_keys is None and args.mlp_keys is None:
+        # default: every 3D key is a cnn key, every 1D key an mlp key
+        args.cnn_keys = [k for k, s in observation_space.spaces.items() if len(s.shape) == 3]
+        args.mlp_keys = [k for k, s in observation_space.spaces.items() if len(s.shape) == 1]
+    cnn_keys = [k for k in (args.cnn_keys or []) if k in observation_space.spaces]
+    mlp_keys = [k for k in (args.mlp_keys or []) if k in observation_space.spaces]
+    if not cnn_keys and not mlp_keys:
+        raise RuntimeError(
+            f"no valid observation keys among cnn={args.cnn_keys} mlp={args.mlp_keys}; "
+            f"env provides {sorted(observation_space.spaces)}"
+        )
+    args.cnn_keys, args.mlp_keys = cnn_keys, mlp_keys
+    return cnn_keys, mlp_keys
+
+
+def actions_dim_of(action_space: gym.Space) -> tuple[list[int], bool]:
+    if isinstance(action_space, gym.spaces.Box):
+        return [int(np.prod(action_space.shape))], True
+    if isinstance(action_space, gym.spaces.Discrete):
+        return [int(action_space.n)], False
+    if isinstance(action_space, gym.spaces.MultiDiscrete):
+        return [int(n) for n in action_space.nvec], False
+    raise ValueError(f"unsupported action space {type(action_space)}")
+
+
+def make_optimizer(args: PPOArgs) -> optax.GradientTransformation:
+    """adam with optional global-norm clip; lr is applied inside the train
+    step as a traced scalar so annealing doesn't recompile."""
+    steps = [optax.scale_by_adam(eps=args.eps)]
+    if args.max_grad_norm > 0:
+        steps.insert(0, optax.clip_by_global_norm(args.max_grad_norm))
+    return optax.chain(*steps)
+
+
+@partial(jax.jit, static_argnames=("use_key",))
+def policy_step(agent: PPOAgent, obs: dict, key, use_key: bool = True):
+    actions, logprob, _, value = agent(obs, key=key if use_key else None)
+    return actions, logprob, value
+
+
+def make_train_step(args: PPOArgs, optimizer, num_minibatches: int):
+    """Build the single-jit PPO update: GAE outside (already in `data`);
+    scan(epochs) x scan(minibatches) inside."""
+
+    def loss_fn(agent, batch, clip_coef, ent_coef):
+        obs = {k: batch[k] for k in (*args.cnn_keys, *args.mlp_keys)}
+        _, new_logprob, entropy, new_value = agent(obs, actions=batch["actions"])
+        adv = batch["advantages"]
+        if args.normalize_advantages:
+            adv = ops.normalize(adv)
+        pg = policy_loss(new_logprob, batch["logprobs"], adv, clip_coef, args.loss_reduction)
+        vf = value_loss(
+            new_value, batch["values"], batch["returns"], clip_coef,
+            args.clip_vloss, args.loss_reduction,
+        )
+        ent = entropy_loss(entropy, args.loss_reduction)
+        total = pg + args.vf_coef * vf + ent_coef * ent
+        return total, (pg, vf, ent)
+
+    def train_step(state: TrainState, data: dict, key, lr, clip_coef, ent_coef):
+        n = data["logprobs"].shape[0]
+        mb_size = n // num_minibatches
+
+        def minibatch_body(carry, idx):
+            agent, opt_state = carry
+            batch = jax.tree_util.tree_map(lambda x: x[idx], data)
+            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                agent, batch, clip_coef, ent_coef
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, agent)
+            updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
+            agent = optax.apply_updates(agent, updates)
+            return (agent, opt_state), aux
+
+        def epoch_body(carry, ep_key):
+            perm = jax.random.permutation(ep_key, n)
+            idxes = perm[: num_minibatches * mb_size].reshape(num_minibatches, mb_size)
+            return jax.lax.scan(minibatch_body, carry, idxes)
+
+        epoch_keys = jax.random.split(key, args.update_epochs)
+        (agent, opt_state), aux = jax.lax.scan(
+            epoch_body, (state.agent, state.opt_state), epoch_keys
+        )
+        pg, vf, ent = jax.tree_util.tree_map(jnp.mean, aux)
+        return TrainState(agent=agent, opt_state=opt_state), {
+            "Loss/policy_loss": pg,
+            "Loss/value_loss": vf,
+            "Loss/entropy_loss": ent,
+        }
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+@jax.jit
+def compute_gae_returns(agent, data, next_obs, next_done, gamma, gae_lambda):
+    next_value = agent.get_value(next_obs)
+    returns, advantages = ops.gae(
+        data["rewards"], data["values"], data["dones"],
+        next_value, next_done, gamma, gae_lambda,
+    )
+    return returns, advantages
+
+
+def test(agent: PPOAgent, env: gym.Env, logger, args: PPOArgs) -> float:
+    """Greedy final evaluation (reference test(), algos/ppo/utils.py)."""
+    obs, _ = env.reset(seed=args.seed)
+    done, cumulative_reward = False, 0.0
+    greedy = jax.jit(agent.get_greedy_actions)
+    while not done:
+        batched = {k: jnp.asarray(v)[None] for k, v in obs.items()}
+        actions = greedy(batched)
+        env_actions = one_hot_to_env_actions(
+            actions[0], agent.actions_dim, agent.is_continuous
+        )
+        if isinstance(env.action_space, gym.spaces.Discrete):
+            env_actions = env_actions.item()
+        obs, reward, terminated, truncated, _ = env.step(env_actions)
+        done = terminated or truncated
+        cumulative_reward += float(reward)
+    logger.log("Test/cumulative_reward", cumulative_reward, 0)
+    env.close()
+    return cumulative_reward
+
+
+@register_algorithm()
+def main(argv: Sequence[str] | None = None) -> None:
+    parser = DataclassArgumentParser(PPOArgs)
+    (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.checkpoint_path:
+        saved = load_checkpoint_args(args.checkpoint_path)
+        if saved:
+            saved.update(
+                checkpoint_path=args.checkpoint_path  # keep resume pointer
+            )
+            (args,) = parser.parse_dict(saved)
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    np.random.seed(args.seed)
+    key = jax.random.PRNGKey(args.seed)
+    mesh = make_mesh(args.num_devices)
+    n_dev = mesh.devices.size
+
+    logger, log_dir, run_name = create_logger(args, "ppo")
+    logger.log_hyperparams(args.as_dict())
+
+    envs = make_vector_env(
+        [
+            make_dict_env(
+                args.env_id, args.seed + i, rank=0, args=args,
+                run_name=log_dir, vector_env_idx=i, mask_velocities=args.mask_vel,
+            )
+            for i in range(args.num_envs)
+        ],
+        sync=args.sync_env or args.num_envs == 1,
+    )
+    cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
+    obs_keys = [*cnn_keys, *mlp_keys]
+    actions_dim, is_continuous = actions_dim_of(envs.single_action_space)
+
+    key, agent_key = jax.random.split(key)
+    agent = PPOAgent.init(
+        agent_key, actions_dim, envs.single_observation_space.spaces,
+        cnn_keys, mlp_keys,
+        cnn_features_dim=args.cnn_features_dim, mlp_features_dim=args.mlp_features_dim,
+        screen_size=args.screen_size, mlp_layers=args.mlp_layers,
+        dense_units=args.dense_units, dense_act=args.dense_act,
+        layer_norm=args.layer_norm, is_continuous=is_continuous,
+    )
+    optimizer = make_optimizer(args)
+    state = TrainState(agent=agent, opt_state=optimizer.init(agent))
+    start_update = 1
+    if args.checkpoint_path:
+        ckpt = load_checkpoint(
+            args.checkpoint_path,
+            {"agent": agent, "optimizer": state.opt_state, "update_step": 0},
+        )
+        state = TrainState(agent=ckpt["agent"], opt_state=ckpt["optimizer"])
+        start_update = int(ckpt["update_step"]) + 1
+    state = replicate(state, mesh)
+
+    rollout_and_train_size = args.rollout_steps * args.num_envs
+    num_updates = (
+        args.total_steps // rollout_and_train_size
+        if not args.dry_run
+        else start_update  # dry run: exactly one update (also after resume)
+    )
+    global_batch_size = args.per_rank_batch_size * n_dev
+    num_minibatches = max(rollout_and_train_size // global_batch_size, 1)
+    train_step = make_train_step(args, optimizer, num_minibatches)
+
+    rb = ReplayBuffer(
+        args.rollout_steps, args.num_envs,
+        storage="host" if args.memmap_buffer else "device",
+        obs_keys=tuple(obs_keys), seed=args.seed,
+    )
+
+    aggregator = MetricAggregator()
+    obs, _ = envs.reset(seed=args.seed)
+    next_done = np.zeros(args.num_envs, dtype=np.float32)
+    global_step = 0
+    start_time = time.perf_counter()
+
+    for update in range(start_update, num_updates + 1):
+        # anneal schedules (host-side; traced scalars below)
+        lr = ops.polynomial_decay(
+            update, initial=args.lr, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_lr else args.lr
+        clip_coef = ops.polynomial_decay(
+            update, initial=args.clip_coef, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_clip_coef else args.clip_coef
+        ent_coef = ops.polynomial_decay(
+            update, initial=args.ent_coef, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_ent_coef else args.ent_coef
+
+        # ---- rollout hot loop ------------------------------------------------
+        for _ in range(args.rollout_steps):
+            key, step_key = jax.random.split(key)
+            device_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+            actions, logprob, value = policy_step(state.agent, device_obs, step_key)
+            env_actions = one_hot_to_env_actions(actions, actions_dim, is_continuous)
+            next_obs, rewards, terms, truncs, infos = envs.step(list(env_actions))
+            dones = (terms | truncs).astype(np.float32)
+            row = {k: np.asarray(obs[k])[None] for k in obs_keys}
+            row.update(
+                actions=np.asarray(actions)[None],
+                logprobs=np.asarray(logprob)[None],
+                values=np.asarray(value)[None],
+                rewards=rewards[None, :, None],
+                dones=next_done[None, :, None],
+            )
+            rb.add(row)
+            global_step += args.num_envs
+            next_done = dones
+            obs = next_obs
+            for info in infos:
+                if "episode" in info:
+                    aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
+                    aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
+
+        # ---- GAE + one-jit update -------------------------------------------
+        data = {k: jnp.asarray(rb[k]) for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
+        device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+        returns, advantages = compute_gae_returns(
+            state.agent, data, device_next_obs, jnp.asarray(next_done)[:, None],
+            args.gamma, args.gae_lambda,
+        )
+        data["returns"], data["advantages"] = returns, advantages
+        flat = {
+            k: v.reshape((-1,) + v.shape[2:])
+            for k, v in data.items()
+            if k not in ("rewards", "dones")
+        }
+        if n_dev > 1:
+            flat = shard_batch(flat, mesh)
+        key, train_key = jax.random.split(key)
+        state, metrics = train_step(
+            state, flat, train_key,
+            jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
+        )
+        for name, val in metrics.items():
+            aggregator.update(name, val)
+
+        # ---- logging + checkpoint -------------------------------------------
+        sps = global_step / (time.perf_counter() - start_time)
+        logger.log_dict(aggregator.compute(), global_step)
+        logger.log("Time/step_per_second", sps, global_step)
+        logger.log("Info/learning_rate", lr, global_step)
+        aggregator.reset()
+        if (
+            args.checkpoint_every > 0 and update % args.checkpoint_every == 0
+        ) or args.dry_run or update == num_updates:
+            save_checkpoint(
+                os.path.join(log_dir, "checkpoints", f"ckpt_{update}"),
+                {"agent": state.agent, "optimizer": state.opt_state, "update_step": update},
+                args=args,
+            )
+
+    envs.close()
+    test_env = make_dict_env(
+        args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
+    )()
+    test(state.agent, test_env, logger, args)
+    logger.close()

@@ -1,0 +1,28 @@
+"""DIAMBRA arcade wrapper (capability target:
+/root/reference/sheeprl/envs/diambra_wrapper.py — discrete/multidiscrete
+action spaces, per-rank port offsetting). The `diambra` packages are not
+present in this image; the wrapper raises an actionable error until the
+backend is installed."""
+
+from __future__ import annotations
+
+try:
+    import diambra.arena  # noqa: F401
+
+    _DIAMBRA_AVAILABLE = True
+except ImportError:
+    _DIAMBRA_AVAILABLE = False
+
+
+class DiambraWrapper:
+    def __init__(self, *args, **kwargs):
+        if not _DIAMBRA_AVAILABLE:
+            raise ModuleNotFoundError(
+                "diambra is not installed: `pip install diambra diambra-arena` "
+                "(requires the DIAMBRA docker engine); env ids look like "
+                "`diambra_doapp`"
+            )
+        raise NotImplementedError(
+            "DIAMBRA wrapper pending implementation against an installed "
+            "diambra backend (reference: sheeprl/envs/diambra_wrapper.py)"
+        )

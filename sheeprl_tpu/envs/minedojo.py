@@ -1,0 +1,27 @@
+"""MineDojo wrapper (capability target:
+/root/reference/sheeprl/envs/minedojo.py — 19-action functional map +
+3-head MultiDiscrete, `mask_*` action-mask obs keys, pitch/yaw limits,
+sticky attack/jump). The `minedojo` package is not present in this image;
+the wrapper raises an actionable error until the backend is installed."""
+
+from __future__ import annotations
+
+try:
+    import minedojo  # noqa: F401
+
+    _MINEDOJO_AVAILABLE = True
+except ImportError:
+    _MINEDOJO_AVAILABLE = False
+
+
+class MineDojoWrapper:
+    def __init__(self, *args, **kwargs):
+        if not _MINEDOJO_AVAILABLE:
+            raise ModuleNotFoundError(
+                "minedojo is not installed: `pip install minedojo` (requires "
+                "JDK 8); env ids look like `minedojo_open-ended`"
+            )
+        raise NotImplementedError(
+            "MineDojo wrapper pending implementation against an installed "
+            "minedojo backend (reference: sheeprl/envs/minedojo.py)"
+        )

@@ -1,0 +1,27 @@
+"""MineRL 0.4.4 wrapper (capability target:
+/root/reference/sheeprl/envs/minerl.py + envs/minerl_envs/ — custom
+navigate/obtain task backends, sticky attack/jump, pitch limits). The
+`minerl` package is not present in this image; the wrapper raises an
+actionable error until the backend is installed."""
+
+from __future__ import annotations
+
+try:
+    import minerl  # noqa: F401
+
+    _MINERL_AVAILABLE = True
+except ImportError:
+    _MINERL_AVAILABLE = False
+
+
+class MineRLWrapper:
+    def __init__(self, *args, **kwargs):
+        if not _MINERL_AVAILABLE:
+            raise ModuleNotFoundError(
+                "minerl is not installed: `pip install minerl==0.4.4` "
+                "(requires JDK 8); env ids look like `minerl_custom_navigate`"
+            )
+        raise NotImplementedError(
+            "MineRL wrapper pending implementation against an installed "
+            "minerl backend (reference: sheeprl/envs/minerl.py)"
+        )

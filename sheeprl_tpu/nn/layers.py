@@ -22,8 +22,9 @@ __all__ = ["Linear", "Conv2d", "ConvTranspose2d", "LayerNorm", "dropout"]
 
 
 def _kaiming_uniform(key, shape, fan_in, dtype=jnp.float32):
-    # matches torch's default Linear/Conv init (kaiming_uniform, a=sqrt(5))
-    bound = math.sqrt(1.0 / fan_in) * math.sqrt(3.0)
+    # torch's default Linear/Conv init — kaiming_uniform(a=sqrt(5)):
+    # gain = sqrt(1/3), bound = gain * sqrt(3/fan_in) = 1/sqrt(fan_in)
+    bound = 1.0 / math.sqrt(fan_in)
     return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
 
 

@@ -1,0 +1,21 @@
+from .mesh import (
+    data_sharding,
+    distributed_setup,
+    local_mesh_devices,
+    make_mesh,
+    process_index,
+    replicate,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "data_sharding",
+    "distributed_setup",
+    "local_mesh_devices",
+    "make_mesh",
+    "process_index",
+    "replicate",
+    "replicated_sharding",
+    "shard_batch",
+]

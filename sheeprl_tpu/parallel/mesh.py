@@ -1,0 +1,109 @@
+"""Device mesh + sharding utilities — the framework's distributed runtime.
+
+Replaces the reference's Lightning-Fabric/torch.distributed layer (DDP wrap,
+process groups, NCCL/Gloo collectives — SURVEY.md §2.7) with the JAX SPMD
+model: one process per host drives all its local devices; parallelism is a
+`jax.sharding.Mesh` with named axes; gradient all-reduce, data sharding and
+cross-device statistics are XLA collectives inserted by the compiler from
+sharding annotations, riding ICI within a slice and DCN across slices.
+
+Axes:
+  - "data": batch/env-parallelism (the reference's DDP world) — params
+    replicated, batch sharded, grad psum implicit in the sharded jit.
+  - decoupled player/trainer topologies use *sub-meshes* of the same device
+    set (see sheeprl_tpu/parallel/decoupled.py) instead of torch process
+    groups.
+
+Multi-host: call `distributed_setup()` (jax.distributed.initialize) once per
+host before building the mesh; `jax.devices()` then spans the pod and the
+same annotations scale out with zero code change.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "distributed_setup",
+    "make_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "replicate",
+    "local_mesh_devices",
+    "process_index",
+]
+
+
+def distributed_setup(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize multi-host JAX (one call per host process). No-ops when
+    single-host or when the TPU pod runtime auto-configures itself."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def local_mesh_devices(num_devices: int = -1, platform: Optional[str] = None):
+    devices = jax.devices(platform) if platform else jax.devices()
+    if num_devices > 0:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    return devices
+
+
+def make_mesh(
+    num_devices: int = -1,
+    platform: Optional[str] = None,
+    axis_name: str = "data",
+    devices: Any = None,
+) -> Mesh:
+    """1-D data mesh over (a prefix of) the visible devices."""
+    if devices is None:
+        devices = local_mesh_devices(num_devices, platform)
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def data_sharding(mesh: Mesh, axis: int = 0, axis_name: str = "data") -> NamedSharding:
+    """Shard the given array axis across the mesh's data axis."""
+    spec = [None] * (axis + 1)
+    spec[axis] = axis_name
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(tree: Any, mesh: Mesh, axis: int = 0, axis_name: str = "data") -> Any:
+    """device_put a host batch with its `axis` sharded over the mesh — one
+    transfer per leaf, landing already distributed (no broadcast+slice)."""
+    sharding = data_sharding(mesh, axis, axis_name)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Replicate params across the mesh (the DDP 'same weights everywhere'
+    invariant, enforced by sharding instead of broadcast)."""
+    sharding = replicated_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

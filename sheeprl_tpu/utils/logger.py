@@ -1,0 +1,68 @@
+"""TensorBoard logging (capability parity with
+/root/reference/sheeprl/utils/logger.py): run-dir layout
+`{root_dir}/{run_name}` with `root_dir` defaulting to
+`logs/{algo}/{env_id}` and `run_name` to a timestamp; resuming from a
+checkpoint reuses the checkpoint's run directory (logger.py:36-39).
+
+In SPMD JAX one process drives all local devices, so the reference's
+"broadcast log_dir to other ranks" collective is only needed multi-host:
+process 0 creates the dir, other processes log nothing (rank-0-only logging,
+logger.py:21-34)."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Optional
+
+
+class TensorBoardLogger:
+    """Thin SummaryWriter wrapper; a no-op on non-zero processes."""
+
+    def __init__(self, log_dir: str, enabled: bool = True):
+        self.log_dir = log_dir
+        self._writer = None
+        if enabled:
+            from torch.utils.tensorboard import SummaryWriter
+
+            os.makedirs(log_dir, exist_ok=True)
+            self._writer = SummaryWriter(log_dir)
+
+    def log(self, name: str, value: Any, step: int) -> None:
+        if self._writer is not None:
+            self._writer.add_scalar(name, float(value), step)
+
+    def log_dict(self, metrics: dict[str, Any], step: int) -> None:
+        for k, v in metrics.items():
+            self.log(k, v, step)
+
+    def log_hyperparams(self, params: dict[str, Any]) -> None:
+        if self._writer is not None:
+            self._writer.add_text(
+                "hyperparams",
+                "\n".join(f"    {k}: {v}" for k, v in sorted(params.items())),
+            )
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()
+            self._writer.close()
+
+
+def create_logger(args: Any, algo_name: str, process_index: int = 0):
+    """Build (logger, log_dir, run_name); sets `args.log_dir` (which dumps
+    args.json as a side effect, algos/args.py contract)."""
+    if args.checkpoint_path and os.path.exists(args.checkpoint_path):
+        # resume into the checkpoint's run directory
+        log_dir = os.path.dirname(os.path.dirname(os.path.abspath(args.checkpoint_path)))
+        root_dir = os.path.dirname(log_dir)
+        run_name = os.path.basename(log_dir)
+    else:
+        root_dir = args.root_dir or os.path.join("logs", algo_name, args.env_id)
+        run_name = args.run_name or time.strftime("%Y-%m-%d_%H-%M-%S")
+        log_dir = os.path.join(root_dir, run_name)
+    logger = TensorBoardLogger(log_dir, enabled=process_index == 0)
+    args.root_dir = root_dir
+    args.run_name = run_name
+    args.log_dir = log_dir
+    return logger, log_dir, run_name

@@ -1,0 +1,91 @@
+"""Metric aggregation (capability parity with
+/root/reference/sheeprl/utils/metric.py): a named dict of running means
+updated every step and computed/reset once per logging interval, plus a
+windowed moving-average metric. Values may be jax scalars — they are pulled
+to host lazily at compute() time, so updating inside the hot loop never
+forces a device sync."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+__all__ = ["MetricAggregator", "MovingAverageMetric"]
+
+
+class MeanMetric:
+    def __init__(self) -> None:
+        self._values: list[Any] = []
+
+    def update(self, value: Any) -> None:
+        self._values.append(value)
+
+    def compute(self) -> float | None:
+        if not self._values:
+            return None
+        return float(np.mean([float(v) for v in self._values]))
+
+    def reset(self) -> None:
+        self._values.clear()
+
+
+class MovingAverageMetric:
+    """Windowed statistics over the last `window` values
+    (reference MovingAverageMetric, metric.py:70-137)."""
+
+    def __init__(self, window: int = 100) -> None:
+        self._window = deque(maxlen=window)
+
+    def update(self, value: Any) -> None:
+        self._window.append(float(value))
+
+    def compute(self) -> dict[str, float] | None:
+        if not self._window:
+            return None
+        arr = np.asarray(self._window)
+        return {
+            "mean": float(arr.mean()),
+            "std": float(arr.std()),
+            "min": float(arr.min()),
+            "max": float(arr.max()),
+        }
+
+    def reset(self) -> None:
+        self._window.clear()
+
+
+class MetricAggregator:
+    def __init__(self, metrics: dict[str, Any] | None = None) -> None:
+        self.metrics: dict[str, Any] = metrics if metrics is not None else {}
+
+    def add(self, name: str, metric: Any | None = None) -> None:
+        if name in self.metrics:
+            raise ValueError(f"metric {name!r} already exists")
+        self.metrics[name] = metric if metric is not None else MeanMetric()
+
+    def update(self, name: str, value: Any) -> None:
+        if name not in self.metrics:
+            self.add(name)
+        self.metrics[name].update(value)
+
+    def pop(self, name: str) -> None:
+        self.metrics.pop(name, None)
+
+    def compute(self) -> dict[str, float]:
+        out = {}
+        for name, metric in self.metrics.items():
+            val = metric.compute()
+            if val is None:
+                continue
+            if isinstance(val, dict):
+                for k, v in val.items():
+                    out[f"{name}/{k}"] = v
+            else:
+                out[name] = val
+        return out
+
+    def reset(self) -> None:
+        for metric in self.metrics.values():
+            metric.reset()

@@ -5,6 +5,7 @@ ImportError-tolerant so an optional env extra never breaks the CLI
 
 _ALGO_MODULES = [
     "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.sac.sac",
 ]
 
 import importlib

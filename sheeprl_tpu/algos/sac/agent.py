@@ -1,0 +1,249 @@
+"""SAC agent: tanh-Gaussian actor + vmapped critic ensemble + EMA targets.
+
+Capability parity with /root/reference/sheeprl/algos/sac/agent.py:16-249.
+TPU-first deviations:
+  - the reference keeps `num_critics` *separate* critic modules in a
+    ModuleList; here the ensemble is ONE critic pytree with a leading
+    ensemble axis on every leaf, evaluated with `jax.vmap` — the N critic
+    MLPs become a single batched matmul chain that tiles onto the MXU
+    instead of N small sequential kernels;
+  - target networks and `log_alpha` are plain pytree leaves on the agent, so
+    the EMA update and the whole soft-update/training step stay inside one
+    jit (the reference mutates `.data` under `torch.no_grad`, agent.py:246-249);
+  - sampling is pure: the reparameterized draw takes an explicit PRNG key.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+
+LOG_STD_MIN = -5.0
+LOG_STD_MAX = 2.0
+
+__all__ = ["SACActor", "SACCritic", "CriticEnsemble", "SACAgent"]
+
+
+class SACActor(nn.Module):
+    """Squashed-Gaussian policy (reference agent.py:53-148): 2-layer ReLU
+    trunk, mean/log_std heads, tanh squash rescaled to the env action bounds,
+    log-prob with the tanh change-of-variable correction (Eq. 26 of the SAC
+    paper)."""
+
+    model: nn.MLP
+    fc_mean: nn.Linear
+    fc_logstd: nn.Linear
+    action_scale: jax.Array
+    action_bias: jax.Array
+
+    @classmethod
+    def init(
+        cls,
+        key,
+        observation_dim: int,
+        action_dim: int,
+        *,
+        hidden_size: int = 256,
+        action_low=-1.0,
+        action_high=1.0,
+    ):
+        k_m, k_mu, k_std = jax.random.split(key, 3)
+        model = nn.MLP.init(
+            k_m, observation_dim, [hidden_size, hidden_size], act="relu"
+        )
+        return cls(
+            model=model,
+            fc_mean=nn.Linear.init(k_mu, hidden_size, action_dim),
+            fc_logstd=nn.Linear.init(k_std, hidden_size, action_dim),
+            action_scale=jnp.asarray(
+                (np.asarray(action_high) - np.asarray(action_low)) / 2.0,
+                dtype=jnp.float32,
+            ),
+            action_bias=jnp.asarray(
+                (np.asarray(action_high) + np.asarray(action_low)) / 2.0,
+                dtype=jnp.float32,
+            ),
+        )
+
+    def dist_params(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
+        x = self.model(obs)
+        mean = self.fc_mean(x)
+        log_std = jnp.clip(self.fc_logstd(x), LOG_STD_MIN, LOG_STD_MAX)
+        return mean, jnp.exp(log_std)
+
+    @property
+    def _bounds(self) -> tuple[jax.Array, jax.Array]:
+        # action bounds are env constants, not weights (the reference keeps
+        # them as non-trainable buffers, agent.py:81-82) — stop_gradient so
+        # the actor optimizer never drifts them
+        return (
+            jax.lax.stop_gradient(self.action_scale),
+            jax.lax.stop_gradient(self.action_bias),
+        )
+
+    def __call__(self, obs: jax.Array, key) -> tuple[jax.Array, jax.Array]:
+        """Reparameterized tanh-squashed sample and its log-prob
+        (reference agent.py:102-134). Returns (action, logprob[..., 1])."""
+        mean, std = self.dist_params(obs)
+        scale, bias = self._bounds
+        x_t = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+        y_t = jnp.tanh(x_t)
+        action = y_t * scale + bias
+        # Normal log-prob minus the tanh-squash jacobian term
+        log_prob = (
+            -0.5 * jnp.square((x_t - mean) / std)
+            - jnp.log(std)
+            - 0.5 * jnp.log(2.0 * jnp.pi)
+        )
+        log_prob = log_prob - jnp.log(scale * (1.0 - jnp.square(y_t)) + 1e-6)
+        return action, jnp.sum(log_prob, axis=-1, keepdims=True)
+
+    def get_greedy_actions(self, obs: jax.Array) -> jax.Array:
+        mean, _ = self.dist_params(obs)
+        scale, bias = self._bounds
+        return jnp.tanh(mean) * scale + bias
+
+
+class SACCritic(nn.Module):
+    """Q(s, a): MLP over the concatenated observation and action
+    (reference agent.py:16-50)."""
+
+    model: nn.MLP
+
+    @classmethod
+    def init(cls, key, input_dim: int, *, hidden_size: int = 256, num_outputs: int = 1):
+        return cls(
+            model=nn.MLP.init(
+                key, input_dim, [hidden_size, hidden_size], num_outputs, act="relu"
+            )
+        )
+
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        return self.model(jnp.concatenate([obs, action], axis=-1))
+
+
+class CriticEnsemble(nn.Module):
+    """N critics as one pytree with a stacked leading axis — `__call__`
+    vmaps the member forward so the ensemble runs as batched matmuls."""
+
+    members: SACCritic  # every leaf has a leading [n] ensemble axis
+    n: int = nn.static()
+
+    @classmethod
+    def init(cls, key, n: int, input_dim: int, *, hidden_size: int = 256):
+        members = jax.vmap(
+            lambda k: SACCritic.init(k, input_dim, hidden_size=hidden_size)
+        )(jax.random.split(key, n))
+        return cls(members=members, n=n)
+
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        """[..., n] Q-values (reference get_q_values, agent.py:230-231)."""
+        q = jax.vmap(lambda c: c(obs, action))(self.members)  # [n, ..., 1]
+        return jnp.moveaxis(q[..., 0], 0, -1)
+
+
+class SACAgent(nn.Module):
+    """Actor + critic ensemble + EMA targets + learnable temperature, as one
+    pytree (reference SACAgent, agent.py:151-249)."""
+
+    actor: SACActor
+    critics: CriticEnsemble
+    target_critics: CriticEnsemble
+    log_alpha: jax.Array
+    target_entropy: float = nn.static()
+    tau: float = nn.static(default=0.005)
+
+    @classmethod
+    def init(
+        cls,
+        key,
+        observation_dim: int,
+        action_dim: int,
+        *,
+        num_critics: int = 2,
+        actor_hidden_size: int = 256,
+        critic_hidden_size: int = 256,
+        action_low=-1.0,
+        action_high=1.0,
+        alpha: float = 1.0,
+        tau: float = 0.005,
+        target_entropy: float | None = None,
+    ):
+        k_actor, k_critic = jax.random.split(key)
+        actor = SACActor.init(
+            k_actor,
+            observation_dim,
+            action_dim,
+            hidden_size=actor_hidden_size,
+            action_low=action_low,
+            action_high=action_high,
+        )
+        critics = CriticEnsemble.init(
+            k_critic,
+            num_critics,
+            observation_dim + action_dim,
+            hidden_size=critic_hidden_size,
+        )
+        return cls(
+            actor=actor,
+            critics=critics,
+            # target starts as a distinct copy (agent.py:181) — distinct
+            # buffers, or jit donation would see the same buffer twice
+            target_critics=jax.tree_util.tree_map(jnp.copy, critics),
+            log_alpha=jnp.log(jnp.asarray([alpha], dtype=jnp.float32)),
+            target_entropy=(
+                float(-action_dim) if target_entropy is None else float(target_entropy)
+            ),
+            tau=float(tau),
+        )
+
+    @property
+    def alpha(self) -> jax.Array:
+        return jnp.exp(self.log_alpha)
+
+    @property
+    def num_critics(self) -> int:
+        return self.critics.n
+
+    def get_actions_and_log_probs(self, obs: jax.Array, key):
+        return self.actor(obs, key)
+
+    def get_greedy_actions(self, obs: jax.Array) -> jax.Array:
+        return self.actor.get_greedy_actions(obs)
+
+    def get_q_values(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        return self.critics(obs, action)
+
+    def get_target_q_values(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        return jax.lax.stop_gradient(self.target_critics(obs, action))
+
+    def get_next_target_q_values(
+        self,
+        next_obs: jax.Array,
+        rewards: jax.Array,
+        dones: jax.Array,
+        gamma: float,
+        key,
+    ) -> jax.Array:
+        """TD target: r + (1-d) * gamma * (min_i Q_target_i(s', a') - alpha
+        log pi(a'|s')) (reference agent.py:238-244)."""
+        next_actions, next_log_pi = self.actor(next_obs, key)
+        q_next = self.get_target_q_values(next_obs, next_actions)
+        min_q_next = jnp.min(q_next, axis=-1, keepdims=True)
+        min_q_next = min_q_next - jax.lax.stop_gradient(self.alpha) * next_log_pi
+        return jax.lax.stop_gradient(rewards + (1.0 - dones) * gamma * min_q_next)
+
+    def qfs_target_ema(self, do_update: jax.Array | bool = True) -> "SACAgent":
+        """Soft target update; `do_update` may be a traced bool so the EMA
+        schedule stays inside jit (reference agent.py:246-249)."""
+        new_target = jax.tree_util.tree_map(
+            lambda p, t: jnp.where(do_update, self.tau * p + (1.0 - self.tau) * t, t),
+            self.critics,
+            self.target_critics,
+        )
+        return self.replace(target_critics=new_target)

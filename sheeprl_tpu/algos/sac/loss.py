@@ -1,0 +1,28 @@
+"""SAC losses (pure jnp), per "Soft Actor-Critic Algorithms and
+Applications" (https://arxiv.org/abs/1812.05905), matching
+/root/reference/sheeprl/algos/sac/loss.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["policy_loss", "critic_loss", "entropy_loss"]
+
+
+def policy_loss(alpha, logprobs: jax.Array, qf_values: jax.Array) -> jax.Array:
+    """Eq. 7: E[alpha * log pi(a|s) - Q(s, a)]."""
+    return jnp.mean(alpha * logprobs - qf_values)
+
+
+def critic_loss(qf_values: jax.Array, next_qf_value: jax.Array) -> jax.Array:
+    """Eq. 5 summed over the ensemble: sum_i MSE(Q_i(s,a), y). `qf_values` is
+    [..., n]; the target broadcasts over the ensemble axis."""
+    return jnp.sum(
+        jnp.mean(jnp.square(qf_values - next_qf_value), axis=tuple(range(qf_values.ndim - 1)))
+    )
+
+
+def entropy_loss(log_alpha: jax.Array, logprobs: jax.Array, target_entropy) -> jax.Array:
+    """Eq. 17: E[-log_alpha * (log pi(a|s) + target_entropy)]."""
+    return jnp.mean(-log_alpha * (jax.lax.stop_gradient(logprobs) + target_entropy))

@@ -1,0 +1,26 @@
+"""SAC eval helper (parity with /root/reference/sheeprl/algos/sac/utils.py)."""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+
+from .agent import SACActor
+
+
+def test(actor: SACActor, env: gym.Env, logger, args) -> float:
+    """Greedy (mean-action) evaluation episode."""
+    obs, _ = env.reset(seed=args.seed)
+    greedy = jax.jit(actor.get_greedy_actions)
+    done, cumulative_reward = False, 0.0
+    while not done:
+        action = greedy(jnp.asarray(obs, dtype=jnp.float32)[None])
+        obs, reward, terminated, truncated, _ = env.step(
+            jax.device_get(action[0])
+        )
+        done = terminated or truncated
+        cumulative_reward += float(reward)
+    logger.log("Test/cumulative_reward", cumulative_reward, 0)
+    env.close()
+    return cumulative_reward

@@ -239,6 +239,18 @@ class ReplayBuffer:
                 out[f"next_{k}"] = buf[k][nxt, env_idx]
         return out
 
+    def can_sample(self, sample_next_obs: bool = False) -> bool:
+        """Whether at least one index is currently in the valid sampling
+        window (loops use this to gate the first updates, e.g. dry runs
+        where the buffer holds a single row)."""
+        if self._buf is None or (not self._full and self._pos == 0):
+            return False
+        try:
+            self._valid_ranges(1 if sample_next_obs else 0)
+        except RuntimeError:
+            return False
+        return True
+
     def sample(
         self, batch_size: int, sample_next_obs: bool = False, **_: object
     ) -> Batch:
@@ -297,6 +309,33 @@ class ReplayBuffer:
                     self._buf[k][:] = v
         self._pos = int(state["pos"])
         self._full = bool(state["full"])
+
+    def save(self, path: str) -> None:
+        """Serialize the ring + head state to one `.npz` (the off-policy
+        `checkpoint_buffer` path, reference callback.py:23-64)."""
+        st = self.to_state_dict()
+        np.savez(
+            path,
+            pos=st["pos"],
+            full=st["full"],
+            buffer_size=st["buffer_size"],
+            n_envs=st["n_envs"],
+            **{f"buf_{k}": v for k, v in (st["buf"] or {}).items()},
+        )
+
+    def load(self, path: str) -> None:
+        """Restore a ring saved with `save` into this (same-shape) buffer."""
+        data = np.load(path)
+        bufs = {k[4:]: data[k] for k in data.files if k.startswith("buf_")}
+        self.load_state_dict(
+            {
+                "buf": bufs or None,
+                "pos": int(data["pos"]),
+                "full": bool(data["full"]),
+                "buffer_size": int(data["buffer_size"]),
+                "n_envs": int(data["n_envs"]),
+            }
+        )
 
 
 class SequentialReplayBuffer(ReplayBuffer):

@@ -1,0 +1,96 @@
+"""End-to-end smoke tests for SAC (reference backbone:
+/root/reference/tests/test_algos/test_algos.py:93-123): run main() in-process
+on a tiny config, assert the checkpoint contract."""
+
+import os
+
+import numpy as np
+import pytest
+
+import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+from sheeprl_tpu.utils.checkpoint import load_checkpoint, load_checkpoint_args
+from sheeprl_tpu.utils.registry import tasks
+
+CKPT_KEYS = {
+    "agent", "qf_optimizer", "actor_optimizer", "alpha_optimizer", "global_step"
+}
+
+
+def tiny_argv(tmp_path, run_name, extra=()):
+    return [
+        "--env_id", "Pendulum-v1",
+        "--dry_run",
+        "--num_envs", "1",
+        "--per_rank_batch_size", "2",
+        "--buffer_size", "4",
+        "--learning_starts", "0",
+        "--gradient_steps", "1",
+        "--actor_hidden_size", "8",
+        "--critic_hidden_size", "8",
+        "--root_dir", str(tmp_path),
+        "--run_name", run_name,
+        *extra,
+    ]
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("checkpoint_buffer", [True, False])
+def test_sac_dry_run(tmp_path, checkpoint_buffer):
+    run = f"buf_{checkpoint_buffer}"
+    extra = ["--checkpoint_buffer"] if checkpoint_buffer else []
+    tasks["sac"](tiny_argv(tmp_path, run, extra))
+    ckpt_dir = tmp_path / run / "checkpoints"
+    ckpt = str(ckpt_dir / "ckpt_1")
+    assert os.path.exists(ckpt)
+    state = load_checkpoint(ckpt)
+    assert set(state.keys()) == CKPT_KEYS
+    assert load_checkpoint_args(ckpt)["env_id"] == "Pendulum-v1"
+    assert os.path.exists(ckpt + ".buffer.npz") == checkpoint_buffer
+
+
+@pytest.mark.timeout(300)
+def test_sac_resume(tmp_path):
+    tasks["sac"](tiny_argv(tmp_path, "first", ["--checkpoint_buffer"]))
+    ckpt = str(tmp_path / "first" / "checkpoints" / "ckpt_1")
+    tasks["sac"](["--checkpoint_path", ckpt])
+    assert (tmp_path / "first" / "checkpoints" / "ckpt_2").exists()
+
+
+@pytest.mark.timeout(300)
+def test_sac_rejects_discrete(tmp_path):
+    with pytest.raises(ValueError, match="continuous"):
+        tasks["sac"](
+            ["--env_id", "CartPole-v1", "--dry_run", "--num_envs", "1",
+             "--root_dir", str(tmp_path), "--run_name", "bad"]
+        )
+
+
+@pytest.mark.timeout(300)
+def test_sac_dry_run_sample_next_obs(tmp_path):
+    # one dry-run step can't produce a valid next-obs sample; the update
+    # phase must be skipped gracefully, not crash
+    tasks["sac"](tiny_argv(tmp_path, "dry_next", ["--sample_next_obs"]))
+    assert (tmp_path / "dry_next" / "checkpoints" / "ckpt_1").exists()
+
+
+@pytest.mark.timeout(300)
+def test_sac_sample_next_obs(tmp_path):
+    # needs >1 valid entries: skip dry_run's 1-slot buffer by running 2 steps
+    tasks["sac"](
+        [
+            "--env_id", "Pendulum-v1",
+            "--num_envs", "1",
+            "--total_steps", "8",
+            "--per_rank_batch_size", "2",
+            "--buffer_size", "16",
+            "--learning_starts", "4",
+            "--gradient_steps", "1",
+            "--actor_hidden_size", "8",
+            "--critic_hidden_size", "8",
+            "--checkpoint_every", "-1",
+            "--sample_next_obs",
+            "--root_dir", str(tmp_path),
+            "--run_name", "next_obs",
+        ]
+    )
+    assert (tmp_path / "next_obs" / "checkpoints" / "ckpt_8").exists()

@@ -6,6 +6,8 @@ ImportError-tolerant so an optional env extra never breaks the CLI
 _ALGO_MODULES = [
     "sheeprl_tpu.algos.ppo.ppo",
     "sheeprl_tpu.algos.sac.sac",
+    "sheeprl_tpu.algos.droq.droq",
+    "sheeprl_tpu.algos.sac_ae.sac_ae",
 ]
 
 import importlib

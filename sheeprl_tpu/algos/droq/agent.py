@@ -1,0 +1,144 @@
+"""DroQ agent (https://arxiv.org/abs/2110.02034): SAC with Dropout+LayerNorm
+critics, capability parity with /root/reference/sheeprl/algos/droq/agent.py.
+
+As with SAC, the N critics are ONE pytree with a stacked leading axis —
+vmapped into a single batched matmul chain. Dropout is pure: every stochastic
+forward takes an explicit PRNG key (split per ensemble member), and —
+matching the reference, whose torch modules stay in train mode everywhere —
+dropout is also active in the *target* critic forward."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ..sac.agent import SACActor
+
+__all__ = ["DROQCritic", "DROQCriticEnsemble", "DROQAgent"]
+
+
+class DROQCritic(nn.Module):
+    """Q(s, a) with LayerNorm + dropout on every hidden layer
+    (reference agent.py:16-56)."""
+
+    model: nn.MLP
+
+    @classmethod
+    def init(
+        cls, key, input_dim: int, *, hidden_size: int = 256,
+        num_outputs: int = 1, dropout: float = 0.0,
+    ):
+        return cls(
+            model=nn.MLP.init(
+                key, input_dim, [hidden_size, hidden_size], num_outputs,
+                act="relu", layer_norm=True, dropout_rate=dropout,
+            )
+        )
+
+    def __call__(self, obs, action, *, key=None, training: bool = False):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return self.model(x, key=key, training=training)
+
+
+class DROQCriticEnsemble(nn.Module):
+    """N dropout critics, one stacked pytree, one vmapped forward."""
+
+    members: DROQCritic
+    n: int = nn.static()
+
+    @classmethod
+    def init(cls, key, n: int, input_dim: int, *, hidden_size: int = 256, dropout: float = 0.0):
+        members = jax.vmap(
+            lambda k: DROQCritic.init(
+                k, input_dim, hidden_size=hidden_size, dropout=dropout
+            )
+        )(jax.random.split(key, n))
+        return cls(members=members, n=n)
+
+    def __call__(self, obs, action, *, key=None, training: bool = False):
+        """[..., n] Q-values; each member gets its own dropout key."""
+        if key is not None and training:
+            keys = jax.random.split(key, self.n)
+            q = jax.vmap(
+                lambda c, k: c(obs, action, key=k, training=True)
+            )(self.members, keys)
+        else:
+            q = jax.vmap(lambda c: c(obs, action))(self.members)
+        return jnp.moveaxis(q[..., 0], 0, -1)
+
+
+class DROQAgent(nn.Module):
+    """Actor + dropout-critic ensemble + EMA targets + temperature
+    (reference DROQAgent, agent.py:59-182)."""
+
+    actor: SACActor
+    critics: DROQCriticEnsemble
+    target_critics: DROQCriticEnsemble
+    log_alpha: jax.Array
+    target_entropy: float = nn.static()
+    tau: float = nn.static(default=0.005)
+
+    @classmethod
+    def init(
+        cls,
+        key,
+        observation_dim: int,
+        action_dim: int,
+        *,
+        num_critics: int = 2,
+        actor_hidden_size: int = 256,
+        critic_hidden_size: int = 256,
+        dropout: float = 0.01,
+        action_low=-1.0,
+        action_high=1.0,
+        alpha: float = 1.0,
+        tau: float = 0.005,
+        target_entropy: float | None = None,
+    ):
+        k_actor, k_critic = jax.random.split(key)
+        actor = SACActor.init(
+            k_actor, observation_dim, action_dim,
+            hidden_size=actor_hidden_size,
+            action_low=action_low, action_high=action_high,
+        )
+        critics = DROQCriticEnsemble.init(
+            k_critic, num_critics, observation_dim + action_dim,
+            hidden_size=critic_hidden_size, dropout=dropout,
+        )
+        return cls(
+            actor=actor,
+            critics=critics,
+            target_critics=jax.tree_util.tree_map(jnp.copy, critics),
+            log_alpha=jnp.log(jnp.asarray([alpha], dtype=jnp.float32)),
+            target_entropy=(
+                float(-action_dim) if target_entropy is None else float(target_entropy)
+            ),
+            tau=float(tau),
+        )
+
+    @property
+    def alpha(self) -> jax.Array:
+        return jnp.exp(self.log_alpha)
+
+    @property
+    def num_critics(self) -> int:
+        return self.critics.n
+
+    def get_next_target_q_values(self, next_obs, rewards, dones, gamma, key):
+        """TD target with min over the (dropout-active) target ensemble
+        (reference agent.py:167-174)."""
+        k_pi, k_drop = jax.random.split(key)
+        next_actions, next_log_pi = self.actor(next_obs, k_pi)
+        q_next = self.target_critics(next_obs, next_actions, key=k_drop, training=True)
+        min_q_next = jnp.min(q_next, axis=-1, keepdims=True)
+        min_q_next = min_q_next - jax.lax.stop_gradient(self.alpha) * next_log_pi
+        return jax.lax.stop_gradient(rewards + (1.0 - dones) * gamma * min_q_next)
+
+    def qfs_target_ema(self) -> "DROQAgent":
+        new_target = jax.tree_util.tree_map(
+            lambda p, t: self.tau * p + (1.0 - self.tau) * t,
+            self.critics,
+            self.target_critics,
+        )
+        return self.replace(target_critics=new_target)

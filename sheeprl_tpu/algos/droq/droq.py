@@ -1,0 +1,320 @@
+"""DroQ (capability parity with /root/reference/sheeprl/algos/droq/droq.py):
+SAC at high update-to-data ratio with Dropout+LayerNorm critics.
+
+TPU-first structure: the whole per-env-step update phase is ONE jitted call —
+`lax.scan` over the `gradient_steps` critic batches (each: TD target from the
+dropout-active target ensemble -> joint vmapped critic update -> EMA), then a
+single actor+alpha update on a fresh batch using the MEAN over critics
+(reference droq.py:97-111). The reference's per-critic Python inner loop
+(droq.py:60-80) is equivalent to the joint vmapped update because each
+critic's MSE only touches its own parameters and its own EMA target."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ... import nn
+from ...data import ReplayBuffer
+from ...envs import make_vector_env
+from ...parallel import make_mesh, replicate, shard_batch
+from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.env import make_env
+from ...utils.logger import create_logger
+from ...utils.metric import MetricAggregator
+from ...utils.parser import DataclassArgumentParser
+from ...utils.registry import register_algorithm
+from ..sac.loss import critic_loss, entropy_loss, policy_loss
+from ..sac.sac import make_optimizers, policy_step
+from ..sac.utils import test
+from .agent import DROQAgent
+from .args import DROQArgs
+
+
+class TrainState(nn.Module):
+    agent: DROQAgent
+    qf_opt: object
+    actor_opt: object
+    alpha_opt: object
+
+
+def make_train_step(args: DROQArgs, qf_optim, actor_optim, alpha_optim):
+    def critic_step(carry, inp):
+        """One DroQ critic round (reference droq.py:60-80), all critics at
+        once via the vmapped ensemble."""
+        state = carry
+        batch, key = inp
+        k_target, k_drop = jax.random.split(key)
+        agent = state.agent
+        next_q = agent.get_next_target_q_values(
+            batch["next_observations"], batch["rewards"], batch["dones"],
+            args.gamma, k_target,
+        )
+
+        def qf_loss_fn(critics):
+            q = critics(
+                batch["observations"], batch["actions"], key=k_drop, training=True
+            )
+            return critic_loss(q, next_q)
+
+        qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(agent.critics)
+        qf_updates, qf_opt = qf_optim.update(qf_grads, state.qf_opt, agent.critics)
+        agent = agent.replace(critics=optax.apply_updates(agent.critics, qf_updates))
+        # EMA after every critic update (the DroQ schedule, droq.py:78-80)
+        agent = agent.qfs_target_ema()
+        return state.replace(agent=agent, qf_opt=qf_opt), qf_l
+
+    def train_step(state: TrainState, data: dict, actor_batch: dict, key):
+        """`data` leaves are [gradient_steps, batch, ...]; `actor_batch` is a
+        fresh [batch, ...] sample for the policy/alpha update."""
+        g = next(iter(data.values())).shape[0]
+        key, k_scan, k_pi, k_drop = jax.random.split(key, 4)
+        state, qf_losses = jax.lax.scan(
+            critic_step, state, (data, jax.random.split(k_scan, g))
+        )
+        agent = state.agent
+
+        # ---- actor update on a fresh batch, MEAN over critics (droq.py:97-105)
+        def actor_loss_fn(actor):
+            actions, logprobs = actor(actor_batch["observations"], k_pi)
+            q = agent.critics(
+                actor_batch["observations"], actions, key=k_drop, training=True
+            )
+            mean_q = jnp.mean(q, axis=-1, keepdims=True)
+            return (
+                policy_loss(jax.lax.stop_gradient(agent.alpha), logprobs, mean_q),
+                logprobs,
+            )
+
+        (actor_l, logprobs), actor_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(agent.actor)
+        actor_updates, actor_opt = actor_optim.update(
+            actor_grads, state.actor_opt, agent.actor
+        )
+        agent = agent.replace(actor=optax.apply_updates(agent.actor, actor_updates))
+
+        # ---- temperature update (droq.py:107-111)
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, logprobs, agent.target_entropy)
+
+        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(agent.log_alpha)
+        alpha_updates, alpha_opt = alpha_optim.update(
+            alpha_grads, state.alpha_opt, agent.log_alpha
+        )
+        agent = agent.replace(
+            log_alpha=optax.apply_updates(agent.log_alpha, alpha_updates)
+        )
+
+        state = TrainState(
+            agent=agent, qf_opt=state.qf_opt,
+            actor_opt=actor_opt, alpha_opt=alpha_opt,
+        )
+        return state, {
+            "Loss/value_loss": jnp.mean(qf_losses),
+            "Loss/policy_loss": actor_l,
+            "Loss/alpha_loss": alpha_l,
+        }
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+@register_algorithm()
+def main(argv: Sequence[str] | None = None) -> None:
+    parser = DataclassArgumentParser(DROQArgs)
+    (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.checkpoint_path:
+        saved = load_checkpoint_args(args.checkpoint_path)
+        if saved:
+            saved.update(checkpoint_path=args.checkpoint_path)
+            (args,) = parser.parse_dict(saved)
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    np.random.seed(args.seed)
+    key = jax.random.PRNGKey(args.seed)
+    mesh = make_mesh(args.num_devices)
+    n_dev = mesh.devices.size
+
+    logger, log_dir, run_name = create_logger(args, "droq")
+    logger.log_hyperparams(args.as_dict())
+
+    envs = make_vector_env(
+        [
+            make_env(
+                args.env_id, args.seed + i, 0, args.capture_video,
+                run_name=log_dir, prefix="train", vector_env_idx=i,
+                action_repeat=args.action_repeat,
+            )
+            for i in range(args.num_envs)
+        ],
+        sync=args.sync_env or args.num_envs == 1,
+    )
+    if not isinstance(envs.single_action_space, gym.spaces.Box):
+        raise ValueError("only continuous action spaces are supported by DroQ")
+    if len(envs.single_observation_space.shape) > 1:
+        raise ValueError("only vector observations are supported by DroQ")
+    obs_dim = int(np.prod(envs.single_observation_space.shape))
+    act_dim = int(np.prod(envs.single_action_space.shape))
+
+    key, agent_key = jax.random.split(key)
+    agent = DROQAgent.init(
+        agent_key, obs_dim, act_dim,
+        num_critics=args.num_critics,
+        actor_hidden_size=args.actor_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        dropout=args.dropout,
+        action_low=envs.single_action_space.low,
+        action_high=envs.single_action_space.high,
+        alpha=args.alpha, tau=args.tau,
+    )
+    qf_optim, actor_optim, alpha_optim = make_optimizers(args)
+    state = TrainState(
+        agent=agent,
+        qf_opt=qf_optim.init(agent.critics),
+        actor_opt=actor_optim.init(agent.actor),
+        alpha_opt=alpha_optim.init(agent.log_alpha),
+    )
+    train_step = make_train_step(args, qf_optim, actor_optim, alpha_optim)
+
+    min_size = 2 if args.sample_next_obs else 1
+    buffer_size = (
+        max(args.buffer_size // args.num_envs, min_size) if not args.dry_run else min_size
+    )
+    rb = ReplayBuffer(
+        buffer_size, args.num_envs,
+        storage="host" if args.memmap_buffer else "device",
+        memmap_dir=os.path.join(log_dir, "memmap_buffer") if args.memmap_buffer else None,
+        obs_keys=("observations",), seed=args.seed,
+    )
+
+    start_step = 1
+    if args.checkpoint_path:
+        ckpt = load_checkpoint(
+            args.checkpoint_path,
+            {
+                "agent": state.agent, "qf_optimizer": state.qf_opt,
+                "actor_optimizer": state.actor_opt, "alpha_optimizer": state.alpha_opt,
+                "global_step": 0,
+            },
+        )
+        state = TrainState(
+            agent=ckpt["agent"], qf_opt=ckpt["qf_optimizer"],
+            actor_opt=ckpt["actor_optimizer"], alpha_opt=ckpt["alpha_optimizer"],
+        )
+        start_step = int(ckpt["global_step"]) + 1
+        rb_state_path = args.checkpoint_path + ".buffer.npz"
+        if args.checkpoint_buffer and os.path.exists(rb_state_path):
+            rb.load(rb_state_path)
+    state = replicate(state, mesh)
+
+    aggregator = MetricAggregator()
+    num_updates = (
+        int(args.total_steps // args.num_envs) if not args.dry_run else start_step
+    )
+    learning_starts = (
+        args.learning_starts // args.num_envs if not args.dry_run else 0
+    )
+
+    obs, _ = envs.reset(seed=args.seed)
+    obs = np.asarray(obs, dtype=np.float32)
+    start_time = time.perf_counter()
+
+    for global_step in range(start_step, num_updates + 1):
+        if global_step < learning_starts:
+            actions = np.stack(
+                [envs.single_action_space.sample() for _ in range(args.num_envs)]
+            )
+        else:
+            key, step_key = jax.random.split(key)
+            actions = np.asarray(
+                policy_step(state.agent.actor, jnp.asarray(obs), step_key)
+            )
+        next_obs, rewards, terms, truncs, infos = envs.step(list(actions))
+        dones = np.logical_or(terms, truncs).astype(np.float32)
+
+        real_next_obs = np.asarray(next_obs, dtype=np.float32).copy()
+        for i, info in enumerate(infos):
+            if "final_observation" in info:
+                real_next_obs[i] = info["final_observation"]
+            if "episode" in info:
+                aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
+                aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
+
+        row = {
+            "observations": obs[None],
+            "actions": actions.reshape(args.num_envs, -1)[None].astype(np.float32),
+            "rewards": rewards.reshape(args.num_envs, 1)[None],
+            "dones": dones.reshape(args.num_envs, 1)[None],
+        }
+        if not args.sample_next_obs:
+            row["next_observations"] = real_next_obs[None]
+        rb.add(row)
+        obs = np.asarray(next_obs, dtype=np.float32)
+
+        if global_step >= learning_starts - 1 and rb.can_sample(args.sample_next_obs):
+            training_steps = (
+                learning_starts
+                if global_step == learning_starts - 1 and learning_starts > 1
+                else 1
+            )
+            global_batch = args.per_rank_batch_size * n_dev
+            for _ in range(training_steps):
+                sample = rb.sample(
+                    args.gradient_steps * global_batch,
+                    sample_next_obs=args.sample_next_obs,
+                )
+                data = {
+                    k: jnp.asarray(v).reshape(
+                        (args.gradient_steps, global_batch) + v.shape[1:]
+                    )
+                    for k, v in sample.items()
+                }
+                # fresh sample for the actor/alpha update (droq.py:84)
+                actor_batch = {
+                    k: jnp.asarray(v)
+                    for k, v in rb.sample(global_batch).items()
+                }
+                if n_dev > 1:
+                    data = shard_batch(data, mesh, axis=1)
+                    actor_batch = shard_batch(actor_batch, mesh, axis=0)
+                key, train_key = jax.random.split(key)
+                state, metrics = train_step(state, data, actor_batch, train_key)
+            for name, val in metrics.items():
+                aggregator.update(name, val)
+
+        sps = global_step / (time.perf_counter() - start_time)
+        logger.log_dict(aggregator.compute(), global_step)
+        logger.log("Time/step_per_second", sps, global_step)
+        aggregator.reset()
+        if (
+            (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
+            or args.dry_run
+            or global_step == num_updates
+        ):
+            ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
+            save_checkpoint(
+                ckpt_path,
+                {
+                    "agent": state.agent, "qf_optimizer": state.qf_opt,
+                    "actor_optimizer": state.actor_opt, "alpha_optimizer": state.alpha_opt,
+                    "global_step": global_step,
+                },
+                args=args,
+            )
+            if args.checkpoint_buffer:
+                rb.save(ckpt_path + ".buffer.npz")
+
+    envs.close()
+    test_env = make_env(
+        args.env_id, args.seed, 0, args.capture_video, run_name=log_dir, prefix="test"
+    )()
+    test(state.agent.actor, test_env, logger, args)
+    logger.close()

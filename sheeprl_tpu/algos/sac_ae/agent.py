@@ -1,0 +1,467 @@
+"""SAC-AE agent (https://arxiv.org/abs/1910.01741): pixel SAC with a shared
+convolutional encoder and a reconstruction autoencoder. Capability parity
+with /root/reference/sheeprl/algos/sac_ae/agent.py.
+
+Weight-tying, TPU-first: the reference ties the actor's conv/mlp encoder
+modules to the critic's by aliasing torch submodules (agent.py:332-336).
+Pytrees can't alias leaves, so the sharing is explicit in the dataflow: the
+shared encoder lives ONCE on the critic; the actor owns only its private
+CNN projection head and takes the shared encoder as a call argument. The
+reference's `detach_encoder_features` flags become `stop_gradient` at the
+same points — and because updates differentiate w.r.t. one subtree at a
+time, encoder gradients flow exactly where the reference lets them (critic
+loss and reconstruction loss only).
+
+Observations are NHWC uint8 images normalized to [0,1] by callers, plus
+flat vectors (dict obs, cnn_keys/mlp_keys)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+
+LOG_STD_MIN = -10.0
+LOG_STD_MAX = 2.0
+
+__all__ = [
+    "SACAECNNEncoder",
+    "SACAEMLPEncoder",
+    "SACAEEncoder",
+    "SACAECNNDecoder",
+    "SACAEMLPDecoder",
+    "SACAEDecoder",
+    "SACAEQEnsemble",
+    "SACAECritic",
+    "SACAEContinuousActor",
+    "SACAEAgent",
+    "sanitize_action_bounds",
+]
+
+
+def sanitize_action_bounds(low, high):
+    """Replace non-finite env action bounds with [-1, 1] so tanh rescaling
+    stays finite (dummy envs advertise +-inf bounds)."""
+    low = np.asarray(low, dtype=np.float32)
+    high = np.asarray(high, dtype=np.float32)
+    finite = np.isfinite(low) & np.isfinite(high)
+    return np.where(finite, low, -1.0), np.where(finite, high, 1.0)
+
+
+class SACAECNNEncoder(nn.Module):
+    """4-conv trunk (k3, strides 2/1/1/1, VALID) + Linear->LayerNorm->tanh
+    projection (reference agent.py:19-76). `trunk` exposes the flattened
+    conv features so the actor can attach its private head."""
+
+    conv: nn.CNN
+    fc: nn.Linear
+    ln: nn.LayerNorm
+    keys: tuple[str, ...] = nn.static()
+    conv_output_shape: tuple[int, int, int] = nn.static()
+
+    @classmethod
+    def init(
+        cls, key, in_channels: int, features_dim: int, keys: Sequence[str],
+        *, screen_size: int = 64, cnn_channels_multiplier: int = 1,
+    ):
+        k_conv, k_fc = jax.random.split(key)
+        ch = 32 * cnn_channels_multiplier
+        conv = nn.CNN.init(
+            k_conv, in_channels, [ch] * 4, kernel_sizes=[3] * 4,
+            strides=[2, 1, 1, 1], paddings=["VALID"] * 4, act="relu",
+        )
+        probe = jax.eval_shape(
+            conv,
+            jax.ShapeDtypeStruct((1, screen_size, screen_size, in_channels), jnp.float32),
+        )
+        conv_shape = tuple(probe.shape[1:])
+        flat = int(np.prod(conv_shape))
+        return cls(
+            conv=conv,
+            fc=nn.Linear.init(k_fc, flat, features_dim),
+            ln=nn.LayerNorm.init(features_dim),
+            keys=tuple(keys),
+            conv_output_shape=conv_shape,
+        )
+
+    def trunk(self, obs: dict) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        y = self.conv(x)
+        return y.reshape(y.shape[:-3] + (-1,))
+
+    def head(self, flat: jax.Array) -> jax.Array:
+        return jnp.tanh(self.ln(self.fc(flat)))
+
+    def __call__(self, obs: dict, detach: bool = False) -> jax.Array:
+        flat = self.trunk(obs)
+        if detach:
+            flat = jax.lax.stop_gradient(flat)
+        return self.head(flat)
+
+    @property
+    def output_dim(self) -> int:
+        return self.fc.out_features
+
+
+class SACAEMLPEncoder(nn.Module):
+    """Vector-obs encoder; fully shared between actor and critic — with
+    `detach` the whole output is cut (reference agent.py:79-106)."""
+
+    model: nn.MLP
+    keys: tuple[str, ...] = nn.static()
+
+    @classmethod
+    def init(
+        cls, key, input_dim: int, keys: Sequence[str], *,
+        dense_units: int = 1024, mlp_layers: int = 3,
+        dense_act: str = "relu", layer_norm: bool = False,
+    ):
+        model = nn.MLP.init(
+            key, input_dim, [dense_units] * mlp_layers,
+            act=dense_act, layer_norm=layer_norm,
+        )
+        return cls(model=model, keys=tuple(keys))
+
+    def __call__(self, obs: dict, detach: bool = False) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        y = self.model(x)
+        if detach:
+            y = jax.lax.stop_gradient(y)
+        return y
+
+    @property
+    def output_dim(self) -> int:
+        return self.model.output_dim
+
+
+class SACAEEncoder(nn.Module):
+    """Fused dict-obs encoder (either branch optional)."""
+
+    cnn_encoder: SACAECNNEncoder | None
+    mlp_encoder: SACAEMLPEncoder | None
+
+    def __call__(self, obs: dict, detach: bool = False) -> jax.Array:
+        feats = []
+        if self.cnn_encoder is not None:
+            feats.append(self.cnn_encoder(obs, detach))
+        if self.mlp_encoder is not None:
+            feats.append(self.mlp_encoder(obs, detach))
+        return jnp.concatenate(feats, axis=-1)
+
+    @property
+    def output_dim(self) -> int:
+        dim = 0
+        if self.cnn_encoder is not None:
+            dim += self.cnn_encoder.output_dim
+        if self.mlp_encoder is not None:
+            dim += self.mlp_encoder.output_dim
+        return dim
+
+
+class SACAECNNDecoder(nn.Module):
+    """features -> conv grid -> 3 deconvs (k3 s1, relu) -> output deconv
+    (k3 s2, torch output_padding=1 == explicit (2,3) dilated-input padding)
+    -> per-key channel split (reference agent.py:140-188)."""
+
+    fc: nn.Linear
+    deconv: nn.DeCNN
+    to_obs: nn.ConvTranspose2d
+    conv_input_shape: tuple[int, int, int] = nn.static()
+    keys: tuple[str, ...] = nn.static()
+    channels: tuple[int, ...] = nn.static()
+
+    @classmethod
+    def init(
+        cls, key, conv_input_shape: tuple[int, int, int], features_dim: int,
+        keys: Sequence[str], channels: Sequence[int],
+        *, cnn_channels_multiplier: int = 1,
+    ):
+        k_fc, k_de, k_out = jax.random.split(key, 3)
+        ch = 32 * cnn_channels_multiplier
+        flat = int(np.prod(conv_input_shape))
+        deconv = nn.DeCNN.init(
+            k_de, ch, [ch] * 3, kernel_sizes=[3] * 3, strides=[1] * 3,
+            paddings=["VALID"] * 3, act="relu", act_last=True,
+        )
+        to_obs = nn.ConvTranspose2d.init(
+            k_out, ch, sum(channels), 3, stride=2, padding=((2, 3), (2, 3))
+        )
+        return cls(
+            fc=nn.Linear.init(k_fc, features_dim, flat),
+            deconv=deconv,
+            to_obs=to_obs,
+            conv_input_shape=tuple(conv_input_shape),
+            keys=tuple(keys),
+            channels=tuple(channels),
+        )
+
+    def __call__(self, x: jax.Array) -> dict:
+        y = jax.nn.relu(self.fc(x))
+        y = y.reshape(y.shape[:-1] + self.conv_input_shape)
+        y = self.to_obs(self.deconv(y))
+        splits = np.cumsum(self.channels)[:-1].tolist()
+        return dict(zip(self.keys, jnp.split(y, splits, axis=-1)))
+
+
+class SACAEMLPDecoder(nn.Module):
+    """features -> MLP trunk -> per-key linear heads
+    (reference agent.py:109-137)."""
+
+    model: nn.MLP
+    heads: tuple[nn.Linear, ...]
+    keys: tuple[str, ...] = nn.static()
+
+    @classmethod
+    def init(
+        cls, key, input_dim: int, output_dims: Sequence[int], keys: Sequence[str],
+        *, dense_units: int = 1024, mlp_layers: int = 3,
+        dense_act: str = "relu", layer_norm: bool = False,
+    ):
+        k_m, k_h = jax.random.split(key)
+        model = nn.MLP.init(
+            k_m, input_dim, [dense_units] * mlp_layers,
+            act=dense_act, layer_norm=layer_norm,
+        )
+        head_keys = jax.random.split(k_h, len(output_dims))
+        heads = tuple(
+            nn.Linear.init(hk, dense_units, int(d))
+            for hk, d in zip(head_keys, output_dims)
+        )
+        return cls(model=model, heads=heads, keys=tuple(keys))
+
+    def __call__(self, x: jax.Array) -> dict:
+        y = self.model(x)
+        return {k: h(y) for k, h in zip(self.keys, self.heads)}
+
+
+class SACAEDecoder(nn.Module):
+    cnn_decoder: SACAECNNDecoder | None
+    mlp_decoder: SACAEMLPDecoder | None
+
+    def __call__(self, x: jax.Array) -> dict:
+        out: dict = {}
+        if self.cnn_decoder is not None:
+            out.update(self.cnn_decoder(x))
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(x))
+        return out
+
+
+class SACAEQFunction(nn.Module):
+    """Q(features, a) MLP (reference agent.py:191-210)."""
+
+    model: nn.MLP
+
+    @classmethod
+    def init(cls, key, input_dim: int, action_dim: int, *, hidden_size: int = 1024):
+        return cls(
+            model=nn.MLP.init(
+                key, input_dim + action_dim, [hidden_size, hidden_size], 1, act="relu"
+            )
+        )
+
+    def __call__(self, features: jax.Array, action: jax.Array) -> jax.Array:
+        return self.model(jnp.concatenate([features, action], axis=-1))
+
+
+class SACAEQEnsemble(nn.Module):
+    members: SACAEQFunction
+    n: int = nn.static()
+
+    @classmethod
+    def init(cls, key, n: int, input_dim: int, action_dim: int, *, hidden_size: int = 1024):
+        def member(k):
+            k_init, k_ortho = jax.random.split(k)
+            qf = SACAEQFunction.init(
+                k_init, input_dim, action_dim, hidden_size=hidden_size
+            )
+            return nn.init_orthogonal(qf, k_ortho)
+
+        return cls(members=jax.vmap(member)(jax.random.split(key, n)), n=n)
+
+    def __call__(self, features: jax.Array, action: jax.Array) -> jax.Array:
+        q = jax.vmap(lambda c: c(features, action))(self.members)
+        return jnp.moveaxis(q[..., 0], 0, -1)
+
+
+class SACAECritic(nn.Module):
+    """Shared encoder + Q ensemble (reference agent.py:213-224)."""
+
+    encoder: SACAEEncoder
+    qfs: SACAEQEnsemble
+
+    def __call__(self, obs: dict, action: jax.Array, detach_encoder: bool = False):
+        features = self.encoder(obs, detach_encoder)
+        return self.qfs(features, action)
+
+
+class SACAEContinuousActor(nn.Module):
+    """Squashed-Gaussian policy over shared-encoder features. Owns only its
+    private CNN projection head (the conv trunk + mlp encoder are the
+    critic's, passed per call); log_std is tanh-rescaled into
+    [LOG_STD_MIN, LOG_STD_MAX] (reference agent.py:227-317)."""
+
+    cnn_fc: nn.Linear | None
+    cnn_ln: nn.LayerNorm | None
+    model: nn.MLP
+    fc_mean: nn.Linear
+    fc_logstd: nn.Linear
+    action_scale: jax.Array
+    action_bias: jax.Array
+
+    @classmethod
+    def init(
+        cls, key, encoder: SACAEEncoder, action_dim: int,
+        *, hidden_size: int = 1024, action_low=-1.0, action_high=1.0,
+    ):
+        k_fc, k_m, k_mu, k_std, k_ortho = jax.random.split(key, 5)
+        cnn_fc = cnn_ln = None
+        if encoder.cnn_encoder is not None:
+            cnn_fc = nn.Linear.init(
+                k_fc, encoder.cnn_encoder.fc.in_features,
+                encoder.cnn_encoder.output_dim,
+            )
+            cnn_ln = nn.LayerNorm.init(encoder.cnn_encoder.output_dim)
+        model = nn.MLP.init(
+            k_m, encoder.output_dim, [hidden_size, hidden_size], act="relu"
+        )
+        low, high = sanitize_action_bounds(action_low, action_high)
+        actor = cls(
+            cnn_fc=cnn_fc,
+            cnn_ln=cnn_ln,
+            model=model,
+            fc_mean=nn.Linear.init(k_mu, hidden_size, action_dim),
+            fc_logstd=nn.Linear.init(k_std, hidden_size, action_dim),
+            action_scale=jnp.asarray((high - low) / 2.0),
+            action_bias=jnp.asarray((high + low) / 2.0),
+        )
+        return nn.init_orthogonal(actor, k_ortho)
+
+    def features(self, encoder: SACAEEncoder, obs: dict, detach: bool = False):
+        feats = []
+        if encoder.cnn_encoder is not None:
+            flat = encoder.cnn_encoder.trunk(obs)
+            if detach:
+                flat = jax.lax.stop_gradient(flat)
+            feats.append(jnp.tanh(self.cnn_ln(self.cnn_fc(flat))))
+        if encoder.mlp_encoder is not None:
+            feats.append(encoder.mlp_encoder(obs, detach))
+        return jnp.concatenate(feats, axis=-1)
+
+    def dist_params(self, encoder, obs: dict, detach: bool = False):
+        x = self.model(self.features(encoder, obs, detach))
+        mean = self.fc_mean(x)
+        log_std = jnp.tanh(self.fc_logstd(x))
+        log_std = LOG_STD_MIN + 0.5 * (LOG_STD_MAX - LOG_STD_MIN) * (log_std + 1.0)
+        return mean, jnp.exp(log_std)
+
+    @property
+    def _bounds(self):
+        return (
+            jax.lax.stop_gradient(self.action_scale),
+            jax.lax.stop_gradient(self.action_bias),
+        )
+
+    def __call__(self, encoder, obs: dict, key, detach: bool = False):
+        mean, std = self.dist_params(encoder, obs, detach)
+        scale, bias = self._bounds
+        x_t = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+        y_t = jnp.tanh(x_t)
+        action = y_t * scale + bias
+        log_prob = (
+            -0.5 * jnp.square((x_t - mean) / std)
+            - jnp.log(std)
+            - 0.5 * jnp.log(2.0 * jnp.pi)
+        )
+        log_prob = log_prob - jnp.log(scale * (1.0 - jnp.square(y_t)) + 1e-6)
+        return action, jnp.sum(log_prob, axis=-1, keepdims=True)
+
+    def get_greedy_actions(self, encoder, obs: dict) -> jax.Array:
+        mean, _ = self.dist_params(encoder, obs)
+        scale, bias = self._bounds
+        return jnp.tanh(mean) * scale + bias
+
+
+class SACAEAgent(nn.Module):
+    """Actor + critic (with shared encoder) + EMA target critic + temperature
+    (reference SACAEAgent, agent.py:320-429). The target critic EMAs its Q
+    heads with `tau` and its encoder with `encoder_tau`."""
+
+    actor: SACAEContinuousActor
+    critic: SACAECritic
+    critic_target: SACAECritic
+    log_alpha: jax.Array
+    target_entropy: float = nn.static()
+    tau: float = nn.static(default=0.01)
+    encoder_tau: float = nn.static(default=0.05)
+
+    @classmethod
+    def init(
+        cls, key, encoder: SACAEEncoder, action_dim: int,
+        *, num_critics: int = 2, actor_hidden_size: int = 1024,
+        critic_hidden_size: int = 1024, action_low=-1.0, action_high=1.0,
+        alpha: float = 0.1, tau: float = 0.01, encoder_tau: float = 0.05,
+        target_entropy: float | None = None,
+    ):
+        k_actor, k_q, k_ortho = jax.random.split(key, 3)
+        actor = SACAEContinuousActor.init(
+            k_actor, encoder, action_dim,
+            hidden_size=actor_hidden_size,
+            action_low=action_low, action_high=action_high,
+        )
+        qfs = SACAEQEnsemble.init(
+            k_q, num_critics, encoder.output_dim, action_dim,
+            hidden_size=critic_hidden_size,
+        )
+        critic = SACAECritic(
+            encoder=nn.init_orthogonal(encoder, k_ortho), qfs=qfs
+        )
+        return cls(
+            actor=actor,
+            critic=critic,
+            critic_target=jax.tree_util.tree_map(jnp.copy, critic),
+            log_alpha=jnp.log(jnp.asarray([alpha], dtype=jnp.float32)),
+            target_entropy=(
+                float(-action_dim) if target_entropy is None else float(target_entropy)
+            ),
+            tau=float(tau),
+            encoder_tau=float(encoder_tau),
+        )
+
+    @property
+    def alpha(self) -> jax.Array:
+        return jnp.exp(self.log_alpha)
+
+    @property
+    def num_critics(self) -> int:
+        return self.critic.qfs.n
+
+    def get_next_target_q_values(self, next_obs, rewards, dones, gamma, key):
+        """TD target via the online actor + target critic
+        (reference agent.py:410-417)."""
+        next_actions, next_log_pi = self.actor(self.critic.encoder, next_obs, key)
+        q_next = jax.lax.stop_gradient(self.critic_target(next_obs, next_actions))
+        min_q_next = jnp.min(q_next, axis=-1, keepdims=True)
+        min_q_next = min_q_next - jax.lax.stop_gradient(self.alpha) * next_log_pi
+        return jax.lax.stop_gradient(rewards + (1.0 - dones) * gamma * min_q_next)
+
+    def critic_target_ema(self, do_update: jax.Array | bool = True) -> "SACAEAgent":
+        """Q heads with `tau`, encoder with `encoder_tau`
+        (reference agent.py:419-429)."""
+
+        def ema(tau):
+            return lambda p, t: jnp.where(do_update, tau * p + (1.0 - tau) * t, t)
+
+        new_qfs = jax.tree_util.tree_map(
+            ema(self.tau), self.critic.qfs, self.critic_target.qfs
+        )
+        new_enc = jax.tree_util.tree_map(
+            ema(self.encoder_tau), self.critic.encoder, self.critic_target.encoder
+        )
+        return self.replace(
+            critic_target=SACAECritic(encoder=new_enc, qfs=new_qfs)
+        )

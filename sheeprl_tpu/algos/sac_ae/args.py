@@ -1,0 +1,50 @@
+"""SAC-AE config (field parity with
+/root/reference/sheeprl/algos/sac_ae/args.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ...utils.parser import Arg
+from ..sac.args import SACArgs
+
+
+@dataclasses.dataclass
+class SACAEArgs(SACArgs):
+    env_id: str = Arg(default="CarRacing-v2", help="environment id")
+    num_envs: int = Arg(default=1, help="number of parallel environments")
+    action_repeat: int = Arg(default=1, help="number of action repeats")
+    frame_stack: int = Arg(default=3, help="frames to stack; 0 disables")
+    screen_size: int = Arg(default=64, help="pixel observation side")
+    learning_starts: int = Arg(default=1000, help="env steps before learning starts")
+    features_dim: int = Arg(default=64, help="encoder feature dimension after the conv stack")
+    hidden_dim: int = Arg(default=1024, help="actor/critic MLP width")
+    per_rank_batch_size: int = Arg(default=128, help="replay batch size per device")
+    alpha: float = Arg(default=0.1, help="initial entropy temperature")
+    q_lr: float = Arg(default=1e-3, help="critic learning rate")
+    alpha_lr: float = Arg(default=1e-4, help="temperature learning rate")
+    policy_lr: float = Arg(default=1e-3, help="actor learning rate")
+    encoder_lr: float = Arg(default=1e-3, help="encoder learning rate (reconstruction)")
+    decoder_lr: float = Arg(default=1e-3, help="decoder learning rate")
+    decoder_wd: float = Arg(default=1e-7, help="decoder weight decay")
+    decoder_l2_lambda: float = Arg(default=1e-6, help="L2 penalty on the latent in the recon loss")
+    decoder_update_freq: int = Arg(default=1, help="decoder update period in env steps")
+    actor_network_frequency: int = Arg(default=2, help="actor update period in env steps")
+    target_network_frequency: int = Arg(default=2, help="target EMA period in env steps")
+    tau: float = Arg(default=0.01, help="critic target EMA coefficient")
+    encoder_tau: float = Arg(default=0.05, help="encoder target EMA coefficient")
+    actor_hidden_size: int = Arg(default=1024, help="actor MLP hidden width")
+    critic_hidden_size: int = Arg(default=1024, help="critic MLP hidden width")
+    cnn_channels_multiplier: int = Arg(default=16, help="conv width multiplier (> 0)")
+    dense_units: int = Arg(default=64, help="units per dense layer (mlp encoder/decoder)")
+    mlp_layers: int = Arg(default=2, help="MLP depth for encoder/decoder")
+    dense_act: str = Arg(default="relu", help="dense activation name")
+    layer_norm: bool = Arg(default=False, help="LayerNorm after every dense layer")
+    grayscale_obs: bool = Arg(default=False, help="grayscale image observations")
+    cnn_keys: Optional[List[str]] = Arg(default=None, help="obs keys for the CNN encoder")
+    mlp_keys: Optional[List[str]] = Arg(default=None, help="obs keys for the MLP encoder")
+    diambra_action_space: str = Arg(default="discrete", help="discrete|multi_discrete")
+    diambra_attack_but_combination: bool = Arg(default=True)
+    diambra_noop_max: int = Arg(default=0)
+    diambra_actions_stack: int = Arg(default=1)

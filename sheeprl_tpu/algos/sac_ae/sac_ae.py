@@ -1,0 +1,480 @@
+"""SAC-AE, coupled (capability parity with
+/root/reference/sheeprl/algos/sac_ae/sac_ae.py): pixel SAC with a shared
+conv encoder trained by both the critic loss and a reconstruction
+autoencoder (5-bit dithered targets + L2 latent penalty).
+
+TPU-first structure: one jitted update per env step scanning the
+`gradient_steps` batches; each scan step runs critic -> (EMA targets) ->
+(actor+alpha) -> (encoder/decoder reconstruction), with the periodic
+schedules (`target_network_frequency`, `actor_network_frequency`,
+`decoder_update_freq`) entering as traced booleans so nothing recompiles.
+Gradients are taken per-subtree (critic incl. shared encoder; actor private
+head; log_alpha; encoder+decoder), which reproduces the reference's
+detach-and-five-optimizers dance (sac_ae.py:50-130) without parameter
+aliasing. The replay ring keeps uint8 pixels in HBM; normalization happens
+on device inside the jit."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ... import nn
+from ...data import ReplayBuffer
+from ...envs import make_vector_env
+from ...parallel import make_mesh, replicate, shard_batch
+from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.env import make_dict_env
+from ...utils.logger import create_logger
+from ...utils.metric import MetricAggregator
+from ...utils.parser import DataclassArgumentParser
+from ...utils.registry import register_algorithm
+from ..ppo.ppo import validate_obs_keys
+from ..sac.loss import critic_loss, entropy_loss, policy_loss
+from .agent import (
+    SACAEAgent,
+    SACAECNNDecoder,
+    SACAECNNEncoder,
+    SACAEDecoder,
+    SACAEEncoder,
+    SACAEMLPDecoder,
+    SACAEMLPEncoder,
+)
+from .args import SACAEArgs
+from .utils import preprocess_obs, test_sac_ae
+
+
+class TrainState(nn.Module):
+    agent: SACAEAgent
+    decoder: SACAEDecoder
+    qf_opt: object
+    actor_opt: object
+    alpha_opt: object
+    encoder_opt: object
+    decoder_opt: object
+
+
+def make_optimizers(args: SACAEArgs):
+    return (
+        optax.adam(args.q_lr),
+        optax.adam(args.policy_lr),
+        optax.adam(args.alpha_lr, b1=0.5),
+        optax.adam(args.encoder_lr),
+        # coupled L2 (decay folded into the gradient before the moments),
+        # matching torch Adam(weight_decay=...) (reference sac_ae.py:338)
+        optax.chain(
+            optax.add_decayed_weights(args.decoder_wd), optax.adam(args.decoder_lr)
+        ),
+    )
+
+
+def _select(flag, new_tree, old_tree):
+    """Pick `new_tree` where `flag` else `old_tree` — the periodic-update
+    gate. Masking *gradients* instead would still move params through Adam
+    momentum on skipped steps; the whole (params, opt_state) pair must be
+    held back."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(flag, n, o), new_tree, old_tree
+    )
+
+
+def make_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
+    qf_optim, actor_optim, alpha_optim, encoder_optim, decoder_optim = optimizers
+    obs_keys = (*cnn_keys, *mlp_keys)
+
+    def normalize(batch, prefix=""):
+        return {
+            k: (
+                batch[prefix + k].astype(jnp.float32) / 255.0
+                if k in cnn_keys
+                else batch[prefix + k].astype(jnp.float32)
+            )
+            for k in obs_keys
+        }
+
+    def gradient_step(carry, inp):
+        state, do_ema, do_actor, do_decoder = carry
+        batch, key = inp
+        k_target, k_actor, k_dither = jax.random.split(key, 3)
+        agent, decoder = state.agent, state.decoder
+        obs = normalize(batch)
+        next_obs = normalize(batch, "next_")
+
+        # ---- critic update (reference sac_ae.py:79-88): grads flow through
+        # the shared encoder
+        next_q = agent.get_next_target_q_values(
+            next_obs, batch["rewards"], batch["dones"], args.gamma, k_target
+        )
+
+        def qf_loss_fn(critic):
+            return critic_loss(critic(obs, batch["actions"]), next_q)
+
+        qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(agent.critic)
+        qf_updates, qf_opt = qf_optim.update(qf_grads, state.qf_opt, agent.critic)
+        agent = agent.replace(critic=optax.apply_updates(agent.critic, qf_updates))
+
+        # ---- EMA targets (sac_ae.py:90-93)
+        agent = agent.critic_target_ema(do_ema)
+
+        # ---- actor + temperature, every actor_network_frequency steps
+        # (sac_ae.py:95-112); gradients masked out on skipped steps
+        def actor_loss_fn(actor):
+            actions, logprobs = actor(agent.critic.encoder, obs, k_actor, detach=True)
+            q = agent.critic(obs, actions, detach_encoder=True)
+            min_q = jnp.min(q, axis=-1, keepdims=True)
+            return (
+                policy_loss(jax.lax.stop_gradient(agent.alpha), logprobs, min_q),
+                logprobs,
+            )
+
+        (actor_l, logprobs), actor_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(agent.actor)
+        actor_updates, actor_opt = actor_optim.update(
+            actor_grads, state.actor_opt, agent.actor
+        )
+        new_actor = optax.apply_updates(agent.actor, actor_updates)
+        agent = agent.replace(actor=_select(do_actor, new_actor, agent.actor))
+        actor_opt = _select(do_actor, actor_opt, state.actor_opt)
+
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, logprobs, agent.target_entropy)
+
+        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(agent.log_alpha)
+        alpha_updates, alpha_opt = alpha_optim.update(
+            alpha_grads, state.alpha_opt, agent.log_alpha
+        )
+        new_log_alpha = optax.apply_updates(agent.log_alpha, alpha_updates)
+        agent = agent.replace(
+            log_alpha=_select(do_actor, new_log_alpha, agent.log_alpha)
+        )
+        alpha_opt = _select(do_actor, alpha_opt, state.alpha_opt)
+
+        # ---- reconstruction update (sac_ae.py:114-130): 5-bit dithered image
+        # targets, raw vector targets, L2 latent penalty; trains encoder+decoder
+        def recon_loss_fn(enc_dec):
+            enc, dec = enc_dec
+            hidden = enc(obs)
+            recon = dec(hidden)
+            l2 = jnp.mean(0.5 * jnp.sum(jnp.square(hidden), axis=-1))
+            loss = 0.0
+            for k in obs_keys:
+                if k in cnn_keys:
+                    target = preprocess_obs(batch[k], k_dither, bits=5)
+                else:
+                    target = batch[k].astype(jnp.float32)
+                loss += jnp.mean(jnp.square(target - recon[k]))
+                loss += args.decoder_l2_lambda * l2
+            return loss
+
+        recon_l, (enc_grads, dec_grads) = jax.value_and_grad(recon_loss_fn)(
+            (agent.critic.encoder, decoder)
+        )
+        enc_updates, encoder_opt = encoder_optim.update(
+            enc_grads, state.encoder_opt, agent.critic.encoder
+        )
+        new_encoder = optax.apply_updates(agent.critic.encoder, enc_updates)
+        agent = agent.replace(
+            critic=agent.critic.replace(
+                encoder=_select(do_decoder, new_encoder, agent.critic.encoder)
+            )
+        )
+        encoder_opt = _select(do_decoder, encoder_opt, state.encoder_opt)
+        dec_updates, decoder_opt = decoder_optim.update(
+            dec_grads, state.decoder_opt, decoder
+        )
+        decoder = _select(
+            do_decoder, optax.apply_updates(decoder, dec_updates), decoder
+        )
+        decoder_opt = _select(do_decoder, decoder_opt, state.decoder_opt)
+
+        new_state = TrainState(
+            agent=agent, decoder=decoder, qf_opt=qf_opt, actor_opt=actor_opt,
+            alpha_opt=alpha_opt, encoder_opt=encoder_opt, decoder_opt=decoder_opt,
+        )
+        return (new_state, do_ema, do_actor, do_decoder), (qf_l, actor_l, alpha_l, recon_l)
+
+    def train_step(state: TrainState, data: dict, key, do_ema, do_actor, do_decoder):
+        g = next(iter(data.values())).shape[0]
+        keys = jax.random.split(key, g)
+        (state, *_), (qf_l, actor_l, alpha_l, recon_l) = jax.lax.scan(
+            gradient_step, (state, do_ema, do_actor, do_decoder), (data, keys)
+        )
+        return state, {
+            "Loss/value_loss": jnp.mean(qf_l),
+            "Loss/policy_loss": jnp.mean(actor_l),
+            "Loss/alpha_loss": jnp.mean(alpha_l),
+            "Loss/reconstruction_loss": jnp.mean(recon_l),
+        }
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def _policy_step_fn(cnn_keys):
+    @jax.jit
+    def policy_step(actor, encoder, obs, key):
+        normalized = {
+            k: v.astype(jnp.float32) / 255.0 if k in cnn_keys else v.astype(jnp.float32)
+            for k, v in obs.items()
+        }
+        actions, _ = actor(encoder, normalized, key)
+        return actions
+
+    return policy_step
+
+
+@register_algorithm()
+def main(argv: Sequence[str] | None = None) -> None:
+    parser = DataclassArgumentParser(SACAEArgs)
+    (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.checkpoint_path:
+        saved = load_checkpoint_args(args.checkpoint_path)
+        if saved:
+            saved.update(checkpoint_path=args.checkpoint_path)
+            (args,) = parser.parse_dict(saved)
+    if "minedojo" in args.env_id:
+        raise ValueError(
+            "MineDojo is not supported by SAC-AE (no action-mask handling); "
+            "use a Dreamer agent instead"
+        )
+    args.screen_size = 64  # fixed by the conv geometry (reference sac_ae.py:147)
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    np.random.seed(args.seed)
+    key = jax.random.PRNGKey(args.seed)
+    mesh = make_mesh(args.num_devices)
+    n_dev = mesh.devices.size
+
+    logger, log_dir, run_name = create_logger(args, "sac_ae")
+    logger.log_hyperparams(args.as_dict())
+
+    envs = make_vector_env(
+        [
+            make_dict_env(
+                args.env_id, args.seed + i, rank=0, args=args,
+                run_name=log_dir, vector_env_idx=i,
+            )
+            for i in range(args.num_envs)
+        ],
+        sync=args.sync_env or args.num_envs == 1,
+    )
+    if not isinstance(envs.single_action_space, gym.spaces.Box):
+        raise ValueError("only continuous action spaces are supported by SAC-AE")
+    cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
+    obs_keys = (*cnn_keys, *mlp_keys)
+    act_dim = int(np.prod(envs.single_action_space.shape))
+
+    key, k_cnn, k_mlp, k_agent, k_dec = jax.random.split(key, 5)
+    cnn_encoder = None
+    if cnn_keys:
+        in_channels = sum(
+            envs.single_observation_space[k].shape[-1] for k in cnn_keys
+        )
+        cnn_encoder = SACAECNNEncoder.init(
+            k_cnn, in_channels, args.features_dim, cnn_keys,
+            screen_size=args.screen_size,
+            cnn_channels_multiplier=args.cnn_channels_multiplier,
+        )
+    mlp_encoder = None
+    if mlp_keys:
+        input_dim = sum(envs.single_observation_space[k].shape[0] for k in mlp_keys)
+        mlp_encoder = SACAEMLPEncoder.init(
+            k_mlp, input_dim, mlp_keys,
+            dense_units=args.dense_units, mlp_layers=args.mlp_layers,
+            dense_act=args.dense_act, layer_norm=args.layer_norm,
+        )
+    encoder = SACAEEncoder(cnn_encoder=cnn_encoder, mlp_encoder=mlp_encoder)
+
+    cnn_decoder = None
+    if cnn_keys:
+        cnn_channels = [
+            envs.single_observation_space[k].shape[-1] for k in cnn_keys
+        ]
+        cnn_decoder = SACAECNNDecoder.init(
+            k_dec, cnn_encoder.conv_output_shape, encoder.output_dim,
+            cnn_keys, cnn_channels,
+            cnn_channels_multiplier=args.cnn_channels_multiplier,
+        )
+    mlp_decoder = None
+    if mlp_keys:
+        mlp_dims = [envs.single_observation_space[k].shape[0] for k in mlp_keys]
+        mlp_decoder = SACAEMLPDecoder.init(
+            jax.random.fold_in(k_dec, 1), encoder.output_dim, mlp_dims, mlp_keys,
+            dense_units=args.dense_units, mlp_layers=args.mlp_layers,
+            dense_act=args.dense_act, layer_norm=args.layer_norm,
+        )
+    decoder = SACAEDecoder(cnn_decoder=cnn_decoder, mlp_decoder=mlp_decoder)
+
+    agent = SACAEAgent.init(
+        k_agent, encoder, act_dim,
+        num_critics=args.num_critics,
+        actor_hidden_size=args.actor_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        action_low=envs.single_action_space.low,
+        action_high=envs.single_action_space.high,
+        alpha=args.alpha, tau=args.tau, encoder_tau=args.encoder_tau,
+    )
+
+    optimizers = make_optimizers(args)
+    qf_optim, actor_optim, alpha_optim, encoder_optim, decoder_optim = optimizers
+    state = TrainState(
+        agent=agent,
+        decoder=decoder,
+        qf_opt=qf_optim.init(agent.critic),
+        actor_opt=actor_optim.init(agent.actor),
+        alpha_opt=alpha_optim.init(agent.log_alpha),
+        encoder_opt=encoder_optim.init(agent.critic.encoder),
+        decoder_opt=decoder_optim.init(decoder),
+    )
+    train_step = make_train_step(args, optimizers, tuple(cnn_keys), tuple(mlp_keys))
+    policy_step = _policy_step_fn(tuple(cnn_keys))
+
+    min_size = 2 if args.sample_next_obs else 1
+    buffer_size = (
+        max(args.buffer_size // args.num_envs, min_size) if not args.dry_run else min_size
+    )
+    rb = ReplayBuffer(
+        buffer_size, args.num_envs,
+        storage="host" if args.memmap_buffer else "device",
+        memmap_dir=os.path.join(log_dir, "memmap_buffer") if args.memmap_buffer else None,
+        obs_keys=tuple(obs_keys), seed=args.seed,
+    )
+
+    ckpt_template_keys = {
+        "agent": state.agent, "decoder": state.decoder,
+        "qf_optimizer": state.qf_opt, "actor_optimizer": state.actor_opt,
+        "alpha_optimizer": state.alpha_opt, "encoder_optimizer": state.encoder_opt,
+        "decoder_optimizer": state.decoder_opt, "global_step": 0,
+    }
+    start_step = 1
+    if args.checkpoint_path:
+        ckpt = load_checkpoint(args.checkpoint_path, ckpt_template_keys)
+        state = TrainState(
+            agent=ckpt["agent"], decoder=ckpt["decoder"],
+            qf_opt=ckpt["qf_optimizer"], actor_opt=ckpt["actor_optimizer"],
+            alpha_opt=ckpt["alpha_optimizer"], encoder_opt=ckpt["encoder_optimizer"],
+            decoder_opt=ckpt["decoder_optimizer"],
+        )
+        start_step = int(ckpt["global_step"]) + 1
+        rb_state_path = args.checkpoint_path + ".buffer.npz"
+        if args.checkpoint_buffer and os.path.exists(rb_state_path):
+            rb.load(rb_state_path)
+    state = replicate(state, mesh)
+
+    aggregator = MetricAggregator()
+    num_updates = (
+        int(args.total_steps // args.num_envs) if not args.dry_run else start_step
+    )
+    learning_starts = (
+        args.learning_starts // args.num_envs if not args.dry_run else 0
+    )
+
+    obs, _ = envs.reset(seed=args.seed)
+    obs = {k: np.asarray(obs[k]) for k in obs_keys}
+    start_time = time.perf_counter()
+
+    for global_step in range(start_step, num_updates + 1):
+        if global_step < learning_starts:
+            actions = np.stack(
+                [envs.single_action_space.sample() for _ in range(args.num_envs)]
+            )
+        else:
+            key, step_key = jax.random.split(key)
+            device_obs = {k: jnp.asarray(v) for k, v in obs.items()}
+            actions = np.asarray(
+                policy_step(
+                    state.agent.actor, state.agent.critic.encoder, device_obs, step_key
+                )
+            )
+        next_obs, rewards, terms, truncs, infos = envs.step(list(actions))
+        dones = np.logical_or(terms, truncs).astype(np.float32)
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+        for i, info in enumerate(infos):
+            if "final_observation" in info:
+                for k in obs_keys:
+                    real_next_obs[k][i] = info["final_observation"][k]
+            if "episode" in info:
+                aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
+                aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
+
+        row = {k: obs[k][None] for k in obs_keys}
+        if not args.sample_next_obs:
+            row.update({f"next_{k}": real_next_obs[k][None] for k in obs_keys})
+        row.update(
+            actions=actions.reshape(args.num_envs, -1)[None].astype(np.float32),
+            rewards=rewards.reshape(args.num_envs, 1)[None],
+            dones=dones.reshape(args.num_envs, 1)[None],
+        )
+        rb.add(row)
+        obs = {k: np.asarray(next_obs[k]) for k in obs_keys}
+
+        if global_step >= learning_starts - 1 and rb.can_sample(args.sample_next_obs):
+            training_steps = (
+                learning_starts
+                if global_step == learning_starts - 1 and learning_starts > 1
+                else 1
+            )
+            global_batch = args.per_rank_batch_size * n_dev
+            for _ in range(training_steps):
+                sample = rb.sample(
+                    args.gradient_steps * global_batch,
+                    sample_next_obs=args.sample_next_obs,
+                )
+                data = {
+                    k: jnp.asarray(v).reshape(
+                        (args.gradient_steps, global_batch) + v.shape[1:]
+                    )
+                    for k, v in sample.items()
+                }
+                if n_dev > 1:
+                    data = shard_batch(data, mesh, axis=1)
+                key, train_key = jax.random.split(key)
+                state, metrics = train_step(
+                    state, data, train_key,
+                    jnp.asarray(global_step % args.target_network_frequency == 0),
+                    jnp.asarray(global_step % args.actor_network_frequency == 0),
+                    jnp.asarray(global_step % args.decoder_update_freq == 0),
+                )
+            for name, val in metrics.items():
+                aggregator.update(name, val)
+
+        sps = global_step / (time.perf_counter() - start_time)
+        logger.log_dict(aggregator.compute(), global_step)
+        logger.log("Time/step_per_second", sps, global_step)
+        aggregator.reset()
+        if (
+            (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
+            or args.dry_run
+            or global_step == num_updates
+        ):
+            ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
+            save_checkpoint(
+                ckpt_path,
+                {
+                    "agent": state.agent, "decoder": state.decoder,
+                    "qf_optimizer": state.qf_opt, "actor_optimizer": state.actor_opt,
+                    "alpha_optimizer": state.alpha_opt,
+                    "encoder_optimizer": state.encoder_opt,
+                    "decoder_optimizer": state.decoder_opt,
+                    "global_step": global_step,
+                },
+                args=args,
+            )
+            if args.checkpoint_buffer:
+                rb.save(ckpt_path + ".buffer.npz")
+
+    envs.close()
+    test_env = make_dict_env(
+        args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
+    )()
+    test_sac_ae(state.agent, test_env, logger, args, cnn_keys, mlp_keys)
+    logger.close()

@@ -1,0 +1,47 @@
+"""SAC-AE helpers (parity with /root/reference/sheeprl/algos/sac_ae/utils.py)."""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+
+
+def preprocess_obs(obs: jax.Array, key, bits: int = 8) -> jax.Array:
+    """Bit-reduced, dithered, centered image target for the reconstruction
+    loss (https://arxiv.org/abs/1807.03039; reference utils.py:64-72)."""
+    bins = 2.0**bits
+    obs = obs.astype(jnp.float32)
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    obs = obs + jax.random.uniform(key, obs.shape) / bins
+    return obs - 0.5
+
+
+def test_sac_ae(agent, env: gym.Env, logger, args, cnn_keys, mlp_keys) -> float:
+    """Greedy evaluation episode on normalized dict obs
+    (reference test_sac_pixel, utils.py:15-61)."""
+
+    def prep(o):
+        out = {}
+        for k in (*cnn_keys, *mlp_keys):
+            v = jnp.asarray(o[k])[None]
+            out[k] = v.astype(jnp.float32) / 255.0 if k in cnn_keys else v.astype(jnp.float32)
+        return out
+
+    greedy = jax.jit(
+        lambda actor, encoder, obs: actor.get_greedy_actions(encoder, obs)
+    )
+    obs, _ = env.reset(seed=args.seed)
+    done, cumulative_reward = False, 0.0
+    while not done:
+        action = greedy(agent.actor, agent.critic.encoder, prep(obs))
+        obs, reward, terminated, truncated, _ = env.step(
+            jax.device_get(action[0]).reshape(env.action_space.shape)
+        )
+        done = terminated or truncated or args.dry_run
+        cumulative_reward += float(reward)
+    logger.log("Test/cumulative_reward", cumulative_reward, 0)
+    env.close()
+    return cumulative_reward

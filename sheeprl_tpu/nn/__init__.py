@@ -2,6 +2,7 @@ from .core import Activation, Module, activation, field, static
 from .layers import Conv2d, ConvTranspose2d, LayerNorm, Linear, dropout
 from .blocks import CNN, DeCNN, MLP, MultiDecoder, MultiEncoder, NatureCNN
 from .recurrent import GRUCell, LayerNormGRUCell, LSTMCell, scan_cell
+from .inits import init_kaiming_normal, init_orthogonal, map_layers
 
 __all__ = [
     "Activation",
@@ -24,4 +25,7 @@ __all__ = [
     "LayerNormGRUCell",
     "LSTMCell",
     "scan_cell",
+    "init_orthogonal",
+    "init_kaiming_normal",
+    "map_layers",
 ]

@@ -29,8 +29,9 @@ class MLP(Module):
 
     Mirrors the capability of the reference MLP
     (/root/reference/sheeprl/models/models.py:15-118): hidden miniblocks are
-    Linear -> [LayerNorm] -> act -> [dropout]; the optional output head is a
-    bare Linear. `flatten_leading` folds leading batch dims before the stack.
+    Linear -> [dropout] -> [LayerNorm] -> act (the reference miniblock order,
+    utils/model.py:70-87 — the DroQ-paper critic layout); the optional output
+    head is a bare Linear.
     """
 
     layers: tuple[Linear, ...]
@@ -72,12 +73,12 @@ class MLP(Module):
         act = activation(self.act)
         for i, layer in enumerate(self.layers):
             x = layer(x)
-            if self.norms[i] is not None:
-                x = self.norms[i](x)
-            x = act(x)
             if self.dropout_rate > 0.0 and training and key is not None:
                 key, sub = jax.random.split(key)
                 x = dropout(sub, x, self.dropout_rate)
+            if self.norms[i] is not None:
+                x = self.norms[i](x)
+            x = act(x)
         if self.head is not None:
             x = self.head(x)
         return x
@@ -144,11 +145,15 @@ class CNN(Module):
 
 
 class DeCNN(Module):
-    """ConvTranspose2d stack (NHWC); last layer has no norm/activation."""
+    """ConvTranspose2d stack (NHWC). By default the last layer has no
+    norm/activation (decoder-output convention); `act_last=True` activates
+    every layer like the reference DeCNN (models.py:204-287), for use as an
+    inner trunk (e.g. the SAC-AE decoder)."""
 
     layers: tuple[ConvTranspose2d, ...]
     norms: tuple[LayerNorm | None, ...]
     act: Activation = static(default="relu")
+    act_last: bool = static(default=False)
 
     @classmethod
     def init(
@@ -163,6 +168,7 @@ class DeCNN(Module):
         act: Activation = "relu",
         layer_norm: bool = False,
         use_bias: bool = True,
+        act_last: bool = False,
     ):
         n = len(channels)
         if paddings is None:
@@ -181,12 +187,12 @@ class DeCNN(Module):
             )
             for i in range(n)
         )
-        # no norm/act after the final (output) deconv
+        # norm/act after the final deconv only when act_last
         norms = tuple(
-            LayerNorm.init(c) if (layer_norm and i < n - 1) else None
+            LayerNorm.init(c) if (layer_norm and (act_last or i < n - 1)) else None
             for i, c in enumerate(channels)
         )
-        return cls(layers=layers, norms=norms, act=act)
+        return cls(layers=layers, norms=norms, act=act, act_last=act_last)
 
     def __call__(self, x: jax.Array) -> jax.Array:
         """x: [..., H, W, C] latent grid -> [..., H', W', C'] image."""
@@ -198,7 +204,7 @@ class DeCNN(Module):
             x = layer(x)
             if self.norms[i] is not None:
                 x = self.norms[i](x)
-            if i != last:
+            if i != last or self.act_last:
                 x = act(x)
         return x.reshape(lead + x.shape[1:])
 

@@ -1,0 +1,102 @@
+"""Weight-init transforms applied to already-built Module pytrees.
+
+Functional equivalents of the reference's `.apply(init_fn)` passes:
+  - `init_orthogonal`: orthogonal Linear weights + delta-orthogonal conv
+    kernels, zero biases — SAC-AE's `weight_init`
+    (/root/reference/sheeprl/algos/sac_ae/utils.py:75-87);
+  - `init_kaiming_normal`: kaiming-normal Linear weights — PPO/SAC-family
+    `init_weights` (/root/reference/sheeprl/utils/utils.py:89-103).
+
+Each transform recursively rewrites every Linear / Conv2d / ConvTranspose2d
+inside an arbitrary Module tree and returns a new tree (modules are frozen).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .core import Module
+from .layers import Conv2d, ConvTranspose2d, Linear
+
+__all__ = ["init_orthogonal", "init_kaiming_normal", "map_layers"]
+
+
+def map_layers(
+    module,
+    key,
+    fn: Callable[[Linear | Conv2d | ConvTranspose2d, jax.Array], Module],
+):
+    """Depth-first rewrite of every primitive layer in a Module tree. `fn`
+    receives (layer, key) and returns the replacement layer; keys are
+    fold_in-derived along the traversal so the pass is deterministic."""
+    counter = [0]
+
+    def next_key():
+        counter[0] += 1
+        return jax.random.fold_in(key, counter[0])
+
+    def rec(obj):
+        if isinstance(obj, (Linear, Conv2d, ConvTranspose2d)):
+            return fn(obj, next_key())
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            changes = {
+                f.name: rec(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+                if not f.metadata.get("static")
+            }
+            return dataclasses.replace(obj, **changes)
+        if isinstance(obj, tuple):
+            return tuple(rec(v) for v in obj)
+        if isinstance(obj, list):
+            return [rec(v) for v in obj]
+        if isinstance(obj, dict):
+            return {k: rec(v) for k, v in obj.items()}
+        return obj
+
+    return rec(module)
+
+
+def _orthogonal(key, rows: int, cols: int, gain: float = 1.0) -> jax.Array:
+    return jax.nn.initializers.orthogonal(scale=gain)(key, (rows, cols), jnp.float32)
+
+
+def init_orthogonal(module, key):
+    """Orthogonal Linear weights (+ zero bias) and delta-orthogonal conv
+    kernels (https://arxiv.org/pdf/1806.05393.pdf): the kernel is zero except
+    the center tap, which is an orthogonal matrix scaled by the relu gain —
+    the reference `weight_init` (sac_ae/utils.py:75-87), which likewise has
+    fixed gains (1 for Linear, sqrt(2) for convs)."""
+
+    def rewrite(layer, k):
+        if isinstance(layer, Linear):
+            w = _orthogonal(k, layer.in_features, layer.out_features)
+            b = None if layer.bias is None else jnp.zeros_like(layer.bias)
+            return layer.replace(weight=w, bias=b)
+        # conv kernels are HWIO
+        kh, kw, cin, cout = layer.kernel.shape
+        center = _orthogonal(k, cin, cout, gain=math.sqrt(2.0))
+        kernel = jnp.zeros_like(layer.kernel).at[kh // 2, kw // 2].set(center)
+        b = None if layer.bias is None else jnp.zeros_like(layer.bias)
+        return layer.replace(kernel=kernel, bias=b)
+
+    return map_layers(module, key, rewrite)
+
+
+def init_kaiming_normal(module, key):
+    """Kaiming-normal (fan-in, relu gain) Linear weights, zero bias — the
+    reference `init_weights` (utils/utils.py:89-103). Convs untouched."""
+
+    def rewrite(layer, k):
+        if not isinstance(layer, Linear):
+            return layer
+        std = math.sqrt(2.0 / layer.in_features)
+        w = std * jax.random.normal(k, layer.weight.shape, jnp.float32)
+        b = None if layer.bias is None else jnp.zeros_like(layer.bias)
+        return layer.replace(weight=w, bias=b)
+
+    return map_layers(module, key, rewrite)

@@ -1,0 +1,59 @@
+"""End-to-end smoke tests for DroQ (reference backbone:
+/root/reference/tests/test_algos/test_algos.py)."""
+
+import os
+
+import pytest
+
+import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+from sheeprl_tpu.utils.registry import tasks
+
+CKPT_KEYS = {
+    "agent", "qf_optimizer", "actor_optimizer", "alpha_optimizer", "global_step"
+}
+
+
+@pytest.mark.timeout(300)
+def test_droq_dry_run(tmp_path):
+    tasks["droq"](
+        [
+            "--env_id", "Pendulum-v1",
+            "--dry_run",
+            "--num_envs", "1",
+            "--per_rank_batch_size", "2",
+            "--buffer_size", "4",
+            "--learning_starts", "0",
+            "--gradient_steps", "2",
+            "--actor_hidden_size", "8",
+            "--critic_hidden_size", "8",
+            "--root_dir", str(tmp_path),
+            "--run_name", "dry",
+        ]
+    )
+    ckpt = str(tmp_path / "dry" / "checkpoints" / "ckpt_1")
+    assert os.path.exists(ckpt)
+    assert set(load_checkpoint(ckpt).keys()) == CKPT_KEYS
+
+
+@pytest.mark.timeout(300)
+def test_droq_high_utd_run(tmp_path):
+    # several real steps at UTD=4 exercising the scan + fresh actor batch
+    tasks["droq"](
+        [
+            "--env_id", "Pendulum-v1",
+            "--num_envs", "2",
+            "--total_steps", "12",
+            "--per_rank_batch_size", "2",
+            "--buffer_size", "32",
+            "--learning_starts", "4",
+            "--gradient_steps", "4",
+            "--actor_hidden_size", "8",
+            "--critic_hidden_size", "8",
+            "--checkpoint_every", "-1",
+            "--sync_env",
+            "--root_dir", str(tmp_path),
+            "--run_name", "utd",
+        ]
+    )
+    assert (tmp_path / "utd" / "checkpoints" / "ckpt_6").exists()

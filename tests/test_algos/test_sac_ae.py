@@ -1,0 +1,58 @@
+"""End-to-end smoke tests for SAC-AE (reference backbone:
+/root/reference/tests/test_algos/test_algos.py:125-171)."""
+
+import os
+
+import pytest
+
+import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+from sheeprl_tpu.utils.registry import tasks
+
+CKPT_KEYS = {
+    "agent", "decoder", "qf_optimizer", "actor_optimizer", "alpha_optimizer",
+    "encoder_optimizer", "decoder_optimizer", "global_step",
+}
+
+
+def tiny_argv(tmp_path, run_name, extra=()):
+    return [
+        "--env_id", "continuous_dummy",
+        "--dry_run",
+        "--num_envs", "1",
+        "--per_rank_batch_size", "2",
+        "--buffer_size", "4",
+        "--learning_starts", "0",
+        "--gradient_steps", "1",
+        "--actor_hidden_size", "16",
+        "--critic_hidden_size", "16",
+        "--features_dim", "16",
+        "--dense_units", "8",
+        "--mlp_layers", "1",
+        "--cnn_channels_multiplier", "1",
+        "--root_dir", str(tmp_path),
+        "--run_name", run_name,
+        *extra,
+    ]
+
+
+@pytest.mark.timeout(300)
+def test_sac_ae_dry_run_pixels(tmp_path):
+    tasks["sac_ae"](tiny_argv(tmp_path, "dry"))
+    ckpt = str(tmp_path / "dry" / "checkpoints" / "ckpt_1")
+    assert os.path.exists(ckpt)
+    assert set(load_checkpoint(ckpt).keys()) == CKPT_KEYS
+
+
+@pytest.mark.timeout(300)
+def test_sac_ae_resume(tmp_path):
+    tasks["sac_ae"](tiny_argv(tmp_path, "first"))
+    ckpt = str(tmp_path / "first" / "checkpoints" / "ckpt_1")
+    tasks["sac_ae"](["--checkpoint_path", ckpt])
+    assert (tmp_path / "first" / "checkpoints" / "ckpt_2").exists()
+
+
+@pytest.mark.timeout(300)
+def test_sac_ae_rejects_minedojo():
+    with pytest.raises(ValueError, match="MineDojo"):
+        tasks["sac_ae"](["--env_id", "minedojo_open-ended", "--dry_run"])

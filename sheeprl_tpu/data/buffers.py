@@ -138,6 +138,21 @@ class ReplayBuffer:
         else:
             self._buf[key][:] = np.asarray(value)
 
+    @property
+    def pos(self) -> int:
+        return self._pos
+
+    def set_at(self, key: str, time_idx: int, value) -> None:
+        """Point row surgery: overwrite `[time_idx]` of one key — the env
+        fault-tolerance rewrite of the last inserted row (reference
+        dreamer_v3.py:565-573 patching dones/is_first after a restart)."""
+        if self._buf is None:
+            raise RuntimeError("buffer not initialized; add data first")
+        if self._storage_kind == "device":
+            self._buf[key] = self._buf[key].at[time_idx].set(value)
+        else:
+            self._buf[key][time_idx] = value
+
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
         return sub
@@ -720,3 +735,38 @@ class AsyncReplayBuffer:
         self._ensure_buffers()
         for b, s in zip(self._buf, state["buffers"]):
             b.load_state_dict(s)
+
+    def save(self, path: str) -> None:
+        """Serialize all per-env rings into one `.npz` (the Dreamer
+        `checkpoint_buffer` path, reference callback.py:23-64)."""
+        st = self.to_state_dict()
+        flat: dict[str, np.ndarray] = {
+            "n_envs": np.int64(self._n_envs),
+            "buffer_size": np.int64(self._buffer_size),
+        }
+        for i, s in enumerate(st["buffers"]):
+            flat[f"b{i}_pos"] = np.int64(s["pos"])
+            flat[f"b{i}_full"] = np.bool_(s["full"])
+            for k, v in (s["buf"] or {}).items():
+                flat[f"b{i}_buf_{k}"] = v
+        np.savez(path, **flat)
+
+    def load(self, path: str) -> None:
+        data = np.load(path)
+        if int(data["n_envs"]) != self._n_envs:
+            raise ValueError("checkpointed buffer n_envs mismatch")
+        if int(data["buffer_size"]) != self._buffer_size:
+            raise ValueError("checkpointed buffer shape mismatch")
+        self._ensure_buffers()
+        for i, b in enumerate(self._buf):
+            prefix = f"b{i}_buf_"
+            bufs = {k[len(prefix):]: data[k] for k in data.files if k.startswith(prefix)}
+            b.load_state_dict(
+                {
+                    "buf": bufs or None,
+                    "pos": int(data[f"b{i}_pos"]),
+                    "full": bool(data[f"b{i}_full"]),
+                    "buffer_size": self._buffer_size,
+                    "n_envs": 1,
+                }
+            )

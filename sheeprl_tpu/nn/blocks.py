@@ -52,6 +52,7 @@ class MLP(Module):
         layer_norm: bool = False,
         dropout_rate: float = 0.0,
         use_bias: bool = True,
+        norm_eps: float = 1e-5,
     ):
         sizes = [input_dim, *hidden_sizes]
         keys = _split(key, len(hidden_sizes) + 1)
@@ -60,7 +61,7 @@ class MLP(Module):
             for i, k in enumerate(keys[: len(hidden_sizes)])
         )
         norms = tuple(
-            LayerNorm.init(s) if layer_norm else None for s in sizes[1:]
+            LayerNorm.init(s, eps=norm_eps) if layer_norm else None for s in sizes[1:]
         )
         head = None
         if output_dim is not None:
@@ -110,6 +111,7 @@ class CNN(Module):
         act: Activation = "relu",
         layer_norm: bool = False,
         use_bias: bool = True,
+        norm_eps: float = 1e-5,
     ):
         n = len(channels)
         if paddings is None:
@@ -128,7 +130,9 @@ class CNN(Module):
             )
             for i in range(n)
         )
-        norms = tuple(LayerNorm.init(c) if layer_norm else None for c in channels)
+        norms = tuple(
+            LayerNorm.init(c, eps=norm_eps) if layer_norm else None for c in channels
+        )
         return cls(layers=layers, norms=norms, act=act)
 
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -169,6 +173,7 @@ class DeCNN(Module):
         layer_norm: bool = False,
         use_bias: bool = True,
         act_last: bool = False,
+        norm_eps: float = 1e-5,
     ):
         n = len(channels)
         if paddings is None:
@@ -189,7 +194,9 @@ class DeCNN(Module):
         )
         # norm/act after the final deconv only when act_last
         norms = tuple(
-            LayerNorm.init(c) if (layer_norm and (act_last or i < n - 1)) else None
+            LayerNorm.init(c, eps=norm_eps)
+            if (layer_norm and (act_last or i < n - 1))
+            else None
             for i, c in enumerate(channels)
         )
         return cls(layers=layers, norms=norms, act=act, act_last=act_last)

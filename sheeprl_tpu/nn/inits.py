@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from .core import Module
 from .layers import Conv2d, ConvTranspose2d, Linear
 
-__all__ = ["init_orthogonal", "init_kaiming_normal", "map_layers"]
+__all__ = ["init_orthogonal", "init_kaiming_normal", "init_xavier", "map_layers"]
 
 
 def map_layers(
@@ -83,6 +83,42 @@ def init_orthogonal(module, key):
         kernel = jnp.zeros_like(layer.kernel).at[kh // 2, kw // 2].set(center)
         b = None if layer.bias is None else jnp.zeros_like(layer.bias)
         return layer.replace(kernel=kernel, bias=b)
+
+    return map_layers(module, key, rewrite)
+
+
+def init_xavier(module, key, mode: str = "normal"):
+    """Xavier (Glorot) init of every Linear / Conv / ConvTranspose weight with
+    zero biases — the Dreamer-family `init_weights`
+    (/root/reference/sheeprl/algos/dreamer_v2/utils.py:41-60).
+    `mode`: 'normal' | 'uniform' | 'zero' (the Hafner-initialization modes,
+    /root/reference/sheeprl/algos/dreamer_v3/agent.py:1023-1033)."""
+    if mode not in ("normal", "uniform", "zero"):
+        raise ValueError(f"unknown xavier init mode {mode!r}")
+
+    def rewrite(layer, k):
+        if isinstance(layer, Linear):
+            shape, fan_in, fan_out = (
+                layer.weight.shape,
+                layer.in_features,
+                layer.out_features,
+            )
+            attr = "weight"
+        else:
+            # conv kernels are HWIO: fan counts include the receptive field
+            kh, kw, cin, cout = layer.kernel.shape
+            shape, fan_in, fan_out = layer.kernel.shape, cin * kh * kw, cout * kh * kw
+            attr = "kernel"
+        if mode == "zero":
+            w = jnp.zeros(shape, jnp.float32)
+        elif mode == "uniform":
+            bound = math.sqrt(6.0 / (fan_in + fan_out))
+            w = jax.random.uniform(k, shape, jnp.float32, minval=-bound, maxval=bound)
+        else:
+            std = math.sqrt(2.0 / (fan_in + fan_out))
+            w = std * jax.random.normal(k, shape, jnp.float32)
+        b = None if layer.bias is None else jnp.zeros_like(layer.bias)
+        return layer.replace(**{attr: w, "bias": b})
 
     return map_layers(module, key, rewrite)
 

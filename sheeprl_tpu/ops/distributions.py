@@ -113,6 +113,17 @@ class TanhNormal(Distribution):
     def sample(self, key, sample_shape: tuple[int, ...] = ()):
         return self.sample_and_log_prob(key, sample_shape)[0]
 
+    def log_prob(self, value):
+        eps = 1e-6
+        u = jnp.arctanh(jnp.clip(value, -1.0 + eps, 1.0 - eps))
+        base_lp = (
+            -0.5 * jnp.square((u - self.loc) / self.scale)
+            - jnp.log(self.scale)
+            - _LOG_SQRT_2PI
+        )
+        correction = 2.0 * (math.log(2.0) - u - jax.nn.softplus(-2.0 * u))
+        return (base_lp - correction).sum(axis=-1)
+
     @property
     def mode(self):
         return jnp.tanh(self.loc)

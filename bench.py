@@ -1,31 +1,160 @@
 """Benchmark entry: prints ONE JSON line
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
-Current benchmark: PPO coupled on CartPole-v1 (BASELINE.md config 1) —
-end-to-end env-steps/sec including rollout, GAE, and the single-jit update
-phase, measured after one warm-up update (compile excluded).
+Flagship benchmark (default): **DreamerV3** at its published model scale
+(dense 512, cnn multiplier 32, recurrent 512, 32x32 discrete latent,
+T=64 x B=16 sequences) on a 64x64 pixel workload — the BASELINE.md
+north-star shape (config 4/5) with the host env-step cost removed, so the
+number isolates the device pipeline this framework owns: the jitted policy
+step + the single-jit world-model/actor/critic update at the canonical
+train_every=5 duty cycle. Metric is env-steps/sec/chip, the reference's
+`Time/step_per_second`
+(/root/reference/sheeprl/algos/dreamer_v3/dreamer_v3.py:675).
 
-Baseline denominator: the reference (SheepRL, torch) is not runnable in this
-image (no lightning/tensordict), and it publishes no numbers (BASELINE.md),
-so vs_baseline is measured against this framework's first-round CPU
-measurement (610 env-steps/sec on the round-1 host) until a reference run
-is available.
+`python bench.py --algo ppo` runs the PPO/CartPole end-to-end bench
+(BASELINE.md config 1) instead; `--tiny` shrinks the DreamerV3 model for
+CPU smoke runs.
+
+Baseline denominator: the reference (torch) is not runnable in this image
+(no lightning/tensordict) and publishes no numbers (BASELINE.md), so
+vs_baseline is the ratio against this framework's round-1 measurement,
+recorded below.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
-CPU_REFERENCE_SPS = 610.0  # round-1 CPU measurement, see docstring
+# round-1 reference points for vs_baseline (see module docstring)
+DV3_REFERENCE_SPS = 139.1  # round-1 measurement on the round-1 chip
+PPO_CPU_REFERENCE_SPS = 610.0  # round-1 CPU measurement
 
 
-def main() -> None:
+def bench_dreamer_v3(tiny: bool = False) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from sheeprl_tpu.algos.ppo.agent import one_hot_to_env_actions
+    from sheeprl_tpu import ops
+    from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3, build_models
+    from sheeprl_tpu.algos.dreamer_v3.args import DreamerV3Args
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+        DV3TrainState,
+        make_optimizers,
+        make_train_step,
+    )
+
+    args = DreamerV3Args(num_envs=4, env_id="dummy")
+    args.cnn_keys, args.mlp_keys = ["rgb"], []
+    if tiny:  # smoke-test mode for CPU runs
+        args.dense_units = 16
+        args.hidden_size = 16
+        args.recurrent_state_size = 16
+        args.cnn_channels_multiplier = 4
+        args.stochastic_size = 4
+        args.discrete_size = 4
+        args.per_rank_batch_size = 2
+        args.per_rank_sequence_length = 8
+        args.horizon = 4
+        args.mlp_layers = 1
+
+    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
+    actions_dim, is_continuous = [6], False
+    obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+
+    key = jax.random.PRNGKey(0)
+    world_model, actor, critic, target_critic = build_models(
+        key, actions_dim, is_continuous, args, obs_space, ["rgb"], []
+    )
+    world_opt, actor_opt, critic_opt = make_optimizers(args)
+    state = DV3TrainState(
+        world_model=world_model,
+        actor=actor,
+        critic=critic,
+        target_critic=target_critic,
+        world_opt=world_opt.init(world_model),
+        actor_opt=actor_opt.init(actor),
+        critic_opt=critic_opt.init(critic),
+        moments=ops.Moments.init(args.moments_decay, args.moment_max),
+    )
+    train_step = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim, is_continuous
+    )
+
+    def make_player(st: DV3TrainState) -> PlayerDV3:
+        return PlayerDV3(
+            encoder=st.world_model.encoder,
+            rssm=st.world_model.rssm,
+            actor=st.actor,
+            actions_dim=tuple(actions_dim),
+            stochastic_size=args.stochastic_size,
+            discrete_size=args.discrete_size,
+            recurrent_state_size=args.recurrent_state_size,
+            is_continuous=is_continuous,
+        )
+
+    player_step = jax.jit(lambda p, s, o, k: p.step(s, o, k, jnp.float32(0.0)))
+    player_state = make_player(state).init_states(args.num_envs)
+
+    rng = np.random.default_rng(0)
+    sample_batch = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 64, 64, 3), dtype=np.uint8)),
+        "actions": jnp.asarray(
+            np.eye(6, dtype=np.float32)[rng.integers(0, 6, (T, B))]
+        ),
+        "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+        "dones": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+    obs = {
+        "rgb": jnp.asarray(
+            rng.integers(0, 255, (args.num_envs, 64, 64, 3), dtype=np.uint8)
+        ).astype(jnp.float32)
+        / 255.0
+    }
+
+    def one_cycle(state, player_state, key):
+        # train_every env interactions + one gradient step (the canonical
+        # DreamerV3 duty cycle, reference dreamer_v3.py:633-665); the player
+        # is rebuilt from the post-update state exactly like the train loop
+        player = make_player(state)
+        for _ in range(args.train_every):
+            key, sk = jax.random.split(key)
+            player_state, _ = player_step(player, player_state, obs, sk)
+        key, tk = jax.random.split(key)
+        state, metrics = train_step(state, dict(sample_batch), tk, jnp.float32(0.02))
+        jax.block_until_ready(metrics)
+        return state, player_state, key
+
+    # warm-up (compile both programs)
+    state, player_state, key = one_cycle(state, player_state, key)
+    n_cycles = 3 if tiny else 10
+    t0 = time.perf_counter()
+    for _ in range(n_cycles):
+        state, player_state, key = one_cycle(state, player_state, key)
+    dt = time.perf_counter() - t0
+    env_steps = n_cycles * args.train_every * args.num_envs
+    sps = env_steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "dreamer_v3_pixel_env_steps_per_sec",
+                "value": round(sps, 1),
+                "unit": "env-steps/sec/chip",
+                "vs_baseline": round(sps / DV3_REFERENCE_SPS, 3),
+            }
+        )
+    )
+
+
+def bench_ppo() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.ppo.agent import PPOAgent, one_hot_to_env_actions
     from sheeprl_tpu.algos.ppo.args import PPOArgs
     from sheeprl_tpu.algos.ppo.ppo import (
         TrainState,
@@ -36,7 +165,6 @@ def main() -> None:
         validate_obs_keys,
         actions_dim_of,
     )
-    from sheeprl_tpu.algos.ppo.agent import PPOAgent
     from sheeprl_tpu.envs import make_vector_env
     from sheeprl_tpu.utils.env import make_dict_env
 
@@ -100,7 +228,6 @@ def main() -> None:
         jax.block_until_ready(metrics)
         return state, obs, next_done, key
 
-    # warm-up (compile)
     state, obs, next_done, key = one_update(state, obs, next_done, key)
     n_updates = 8
     t0 = time.perf_counter()
@@ -115,10 +242,17 @@ def main() -> None:
                 "metric": "ppo_cartpole_env_steps_per_sec",
                 "value": round(sps, 1),
                 "unit": "env-steps/sec/chip",
-                "vs_baseline": round(sps / CPU_REFERENCE_SPS, 3),
+                "vs_baseline": round(sps / PPO_CPU_REFERENCE_SPS, 3),
             }
         )
     )
+
+
+def main() -> None:
+    if "--algo" in sys.argv and sys.argv[sys.argv.index("--algo") + 1] == "ppo":
+        bench_ppo()
+    else:
+        bench_dreamer_v3(tiny="--tiny" in sys.argv)
 
 
 if __name__ == "__main__":

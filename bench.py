@@ -249,10 +249,16 @@ def bench_ppo() -> None:
 
 
 def main() -> None:
-    if "--algo" in sys.argv and sys.argv[sys.argv.index("--algo") + 1] == "ppo":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--algo", choices=["dreamer_v3", "ppo"], default="dreamer_v3")
+    parser.add_argument("--tiny", action="store_true")
+    opts = parser.parse_args()
+    if opts.algo == "ppo":
         bench_ppo()
     else:
-        bench_dreamer_v3(tiny="--tiny" in sys.argv)
+        bench_dreamer_v3(tiny=opts.tiny)
 
 
 if __name__ == "__main__":

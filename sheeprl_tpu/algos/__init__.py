@@ -8,6 +8,8 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.sac.sac",
     "sheeprl_tpu.algos.droq.droq",
     "sheeprl_tpu.algos.sac_ae.sac_ae",
+    "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",
+    "sheeprl_tpu.algos.dreamer_v2.dreamer_v2",
     "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
 ]
 

@@ -1,0 +1,309 @@
+"""DreamerV1 agent: Gaussian-RSSM world model, tanh-normal actor, critic and
+the environment-interaction player.
+
+Capability parity with /root/reference/sheeprl/algos/dreamer_v1/agent.py.
+Reuses the DreamerV2 conv/MLP encoders and decoders (the reference does the
+same, agent.py:12) and the shared pytree machinery; V1-specific semantics:
+  - the stochastic state is a diagonal Gaussian `Normal(mean,
+    softplus(std) + min_std)` with reparameterized sampling
+    (reference dreamer_v1/utils.py:9-38);
+  - no `is_first` handling anywhere — the recurrence just runs
+    (reference agent.py:81-118);
+  - the recurrent model is Linear+ELU into a plain GRU (no LayerNorm,
+    reference agent.py:17-47);
+  - the actor distribution is fixed to tanh-normal (reference
+    agent.py:475-500); init is kaiming (reference utils.py:89-103).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn.inits import init_kaiming_normal
+from ..dreamer_v3.agent import (
+    Actor,
+    Decoder,
+    Encoder,
+    MinedojoActor,
+    PlayerDV3,
+    PlayerState,
+    WorldModel,
+    exploration_actions,
+)
+from ..dreamer_v2.agent import CNNDecoder, CNNEncoder, MLPDecoder, MLPEncoder
+
+__all__ = [
+    "compute_stochastic_state",
+    "RecurrentModel",
+    "RSSMV1",
+    "PlayerDV1",
+    "build_models",
+]
+
+
+def compute_stochastic_state(
+    state_information: jax.Array, min_std: float = 0.1, key=None
+) -> tuple[tuple[jax.Array, jax.Array], jax.Array]:
+    """Split `[..., 2*S]` into (mean, std=softplus+min_std) and draw a
+    reparameterized Gaussian sample (mean when `key` is None)
+    (reference dreamer_v1/utils.py:9-38)."""
+    mean, std = jnp.split(state_information, 2, axis=-1)
+    std = jax.nn.softplus(std) + min_std
+    if key is None:
+        return (mean, std), mean
+    eps = jax.random.normal(key, mean.shape, mean.dtype)
+    return (mean, std), mean + std * eps
+
+
+class RecurrentModel(nn.Module):
+    """Linear + ELU pre-projection into a plain GRU
+    (reference agent.py:17-47)."""
+
+    proj: nn.Linear
+    rnn: nn.GRUCell
+
+    @classmethod
+    def init(cls, key, input_size: int, recurrent_state_size: int):
+        k_proj, k_rnn = jax.random.split(key)
+        proj = nn.Linear.init(k_proj, input_size, recurrent_state_size)
+        rnn = nn.GRUCell.init(k_rnn, recurrent_state_size, recurrent_state_size)
+        return cls(proj=proj, rnn=rnn)
+
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        return self.rnn(jax.nn.elu(self.proj(x)), recurrent_state)
+
+
+class RSSMV1(nn.Module):
+    """Gaussian RSSM (reference agent.py:50-173): the representation and
+    transition models emit `2*S` (mean, raw std) vectors."""
+
+    recurrent_model: RecurrentModel
+    representation_model: nn.MLP
+    transition_model: nn.MLP
+    min_std: float = nn.static(default=0.1)
+
+    def _representation(self, recurrent_state, embedded_obs, key=None):
+        return compute_stochastic_state(
+            self.representation_model(
+                jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
+            ),
+            min_std=self.min_std,
+            key=key,
+        )
+
+    def _transition(self, recurrent_out, key=None):
+        return compute_stochastic_state(
+            self.transition_model(recurrent_out), min_std=self.min_std, key=key
+        )
+
+    def dynamic(self, posterior, recurrent_state, action, embedded_obs, key):
+        """One dynamic-learning step (reference agent.py:81-118). Returns
+        (recurrent_state, posterior, prior, (post_mean, post_std),
+        (prior_mean, prior_std))."""
+        k_prior, k_post = jax.random.split(key)
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], axis=-1), recurrent_state
+        )
+        prior_mean_std, prior = self._transition(recurrent_state, key=k_prior)
+        posterior_mean_std, posterior = self._representation(
+            recurrent_state, embedded_obs, key=k_post
+        )
+        return recurrent_state, posterior, prior, posterior_mean_std, prior_mean_std
+
+    def scan_dynamic(self, posterior0, recurrent0, actions, embedded_obs, key):
+        """The dynamic-learning sequence as one `lax.scan` over time
+        (replacing the reference's Python loop, dreamer_v1.py:151-165).
+        Returns stacked (recurrent_states, posteriors, post_means, post_stds,
+        prior_means, prior_stds), all `[T, B, ...]`."""
+        keys = jax.random.split(key, actions.shape[0])
+
+        def step(carry, inp):
+            post, rec = carry
+            a, emb, k = inp
+            rec, post, _, (pm, ps), (qm, qs) = self.dynamic(post, rec, a, emb, k)
+            return (post, rec), (rec, post, pm, ps, qm, qs)
+
+        _, outs = jax.lax.scan(
+            step, (posterior0, recurrent0), (actions, embedded_obs, keys)
+        )
+        return outs
+
+    def imagination(self, stochastic_state, recurrent_state, actions, key):
+        """One-step latent imagination (reference agent.py:153-173)."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([stochastic_state, actions], axis=-1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key=key)
+        return imagined_prior, recurrent_state
+
+
+class PlayerDV1(PlayerDV3):
+    """V1 player: flat Gaussian stochastic state, zero-initialized
+    (reference agent.py:202-315). Inherits reset_states; overrides the state
+    init and the representation step (mean/std sampling, no one-hot
+    reshape). `discrete_size` is unused (the state is continuous)."""
+
+    def init_states(self, n_envs: int) -> PlayerState:
+        return PlayerState(
+            actions=jnp.zeros((n_envs, int(sum(self.actions_dim)))),
+            recurrent_state=jnp.zeros((n_envs, self.recurrent_state_size)),
+            stochastic_state=jnp.zeros((n_envs, self.stochastic_size)),
+        )
+
+    def step(
+        self,
+        state: PlayerState,
+        obs: dict,
+        key,
+        expl_amount: jax.Array,
+        is_training: bool = True,
+        mask: dict | None = None,
+    ) -> tuple[PlayerState, jax.Array]:
+        """One greedy+exploration action step (reference agent.py:261-315)."""
+        k_repr, k_act, k_expl = jax.random.split(key, 3)
+        embedded = self.encoder(obs)
+        recurrent = self.rssm.recurrent_model(
+            jnp.concatenate([state.stochastic_state, state.actions], axis=-1),
+            state.recurrent_state,
+        )
+        _, stochastic = self.rssm._representation(recurrent, embedded, key=k_repr)
+        latent = jnp.concatenate([stochastic, recurrent], axis=-1)
+        actions, _ = self.actor(latent, key=k_act, is_training=is_training, mask=mask)
+        cat = exploration_actions(actions, self.is_continuous, expl_amount, k_expl)
+        return PlayerState(
+            actions=cat, recurrent_state=recurrent, stochastic_state=stochastic
+        ), cat
+
+
+def build_models(
+    key,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    args,
+    obs_space: dict,
+    cnn_keys: Sequence[str],
+    mlp_keys: Sequence[str],
+) -> tuple[WorldModel, Actor, nn.MLP]:
+    """Build (world_model, actor, critic) with the kaiming init pass
+    (reference agent.py:318-540; no layer norm anywhere, actor distribution
+    fixed to tanh-normal)."""
+    latent_state_size = args.stochastic_size + args.recurrent_state_size
+    keys = jax.random.split(key, 12)
+
+    cnn_encoder = None
+    if cnn_keys:
+        cnn_encoder = CNNEncoder.init(
+            keys[0],
+            cnn_keys,
+            input_channels=sum(obs_space[k].shape[-1] for k in cnn_keys),
+            image_size=obs_space[cnn_keys[0]].shape[:2],
+            channels_multiplier=args.cnn_channels_multiplier,
+            layer_norm=False,
+            activation=args.cnn_act,
+        )
+    mlp_encoder = None
+    if mlp_keys:
+        mlp_encoder = MLPEncoder.init(
+            keys[1],
+            mlp_keys,
+            input_dim=sum(obs_space[k].shape[0] for k in mlp_keys),
+            mlp_layers=args.mlp_layers,
+            dense_units=args.dense_units,
+            layer_norm=False,
+            activation=args.dense_act,
+        )
+    encoder = Encoder(cnn_encoder=cnn_encoder, mlp_encoder=mlp_encoder)
+
+    recurrent_model = RecurrentModel.init(
+        keys[2], int(sum(actions_dim)) + args.stochastic_size, args.recurrent_state_size
+    )
+    representation_model = nn.MLP.init(
+        keys[3],
+        args.recurrent_state_size + encoder.output_dim,
+        [args.hidden_size],
+        args.stochastic_size * 2,
+        act=args.dense_act,
+    )
+    transition_model = nn.MLP.init(
+        keys[4],
+        args.recurrent_state_size,
+        [args.hidden_size],
+        args.stochastic_size * 2,
+        act=args.dense_act,
+    )
+    rssm = RSSMV1(
+        recurrent_model=recurrent_model,
+        representation_model=representation_model,
+        transition_model=transition_model,
+        min_std=args.min_std,
+    )
+
+    cnn_decoder = None
+    if cnn_keys:
+        cnn_decoder = CNNDecoder.init(
+            keys[5],
+            cnn_keys,
+            output_channels=[obs_space[k].shape[-1] for k in cnn_keys],
+            channels_multiplier=args.cnn_channels_multiplier,
+            latent_state_size=latent_state_size,
+            cnn_encoder_output_dim=cnn_encoder.output_dim,
+            layer_norm=False,
+            activation=args.cnn_act,
+        )
+    mlp_decoder = None
+    if mlp_keys:
+        mlp_decoder = MLPDecoder.init(
+            keys[6],
+            mlp_keys,
+            output_dims=[obs_space[k].shape[0] for k in mlp_keys],
+            latent_state_size=latent_state_size,
+            mlp_layers=args.mlp_layers,
+            dense_units=args.dense_units,
+            layer_norm=False,
+            activation=args.dense_act,
+        )
+    observation_model = Decoder(cnn_decoder=cnn_decoder, mlp_decoder=mlp_decoder)
+
+    reward_model = nn.MLP.init(
+        keys[7], latent_state_size, [args.dense_units] * args.mlp_layers, 1,
+        act=args.dense_act,
+    )
+    continue_model = nn.MLP.init(
+        keys[8], latent_state_size, [args.dense_units] * args.mlp_layers, 1,
+        act=args.dense_act,
+    )
+    world_model = WorldModel(
+        encoder=encoder,
+        rssm=rssm,
+        observation_model=observation_model,
+        reward_model=reward_model,
+        continue_model=continue_model,
+    )
+    actor_cls = MinedojoActor if "minedojo" in args.env_id else Actor
+    actor = actor_cls.init(
+        keys[9],
+        latent_state_size,
+        actions_dim,
+        is_continuous,
+        init_std=args.actor_init_std,
+        min_std=args.actor_min_std,
+        dense_units=args.dense_units,
+        dense_act=args.dense_act,
+        mlp_layers=args.mlp_layers,
+        distribution="tanh_normal" if is_continuous else "discrete",
+        layer_norm=False,
+        unimix=0.0,
+    )
+    critic = nn.MLP.init(
+        keys[10], latent_state_size, [args.dense_units] * args.mlp_layers, 1,
+        act=args.dense_act,
+    )
+    ik = jax.random.split(keys[11], 3)
+    world_model = init_kaiming_normal(world_model, ik[0])
+    actor = init_kaiming_normal(actor, ik[1])
+    critic = init_kaiming_normal(critic, ik[2])
+    return world_model, actor, critic

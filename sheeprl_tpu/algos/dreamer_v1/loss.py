@@ -1,0 +1,56 @@
+"""DreamerV1 losses (Eq. 7/8/10 of arXiv:1912.01603) — capability parity
+with /root/reference/sheeprl/algos/dreamer_v1/loss.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.distributions import Normal, kl_normal
+
+__all__ = ["reconstruction_loss", "actor_loss", "critic_loss"]
+
+
+def actor_loss(discounted_lambda_values: jax.Array) -> jax.Array:
+    """Eq. 7: maximize the discounted lambda-returns
+    (reference loss.py:28-39)."""
+    return -jnp.mean(discounted_lambda_values)
+
+
+def critic_loss(qv, lambda_values: jax.Array, discount: jax.Array) -> jax.Array:
+    """Eq. 8 (reference loss.py:9-25)."""
+    return -jnp.mean(discount * qv.log_prob(lambda_values))
+
+
+def reconstruction_loss(
+    qo: dict,
+    observations: dict,
+    qr,
+    rewards: jax.Array,
+    posterior_mean_std: tuple[jax.Array, jax.Array],
+    prior_mean_std: tuple[jax.Array, jax.Array],
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc=None,
+    continue_targets: jax.Array | None = None,
+    continue_scale_factor: float = 10.0,
+):
+    """Eq. 10: Gaussian KL(posterior || prior) with free nats on the mean,
+    plus Normal(x, 1) observation/reward likelihoods (reference
+    loss.py:42-101; the continue term is the negative log-likelihood — the
+    reference adds `+log_prob` at loss.py:97, dormant since V1 defaults to
+    use_continues=False).
+
+    Returns (loss, kl, state_loss, reward_loss, observation_loss,
+    continue_loss), all scalars."""
+    observation_loss = -sum(qo[k].log_prob(observations[k]).mean() for k in qo)
+    reward_loss = -qr.log_prob(rewards).mean()
+    p = Normal(loc=posterior_mean_std[0], scale=posterior_mean_std[1])
+    q = Normal(loc=prior_mean_std[0], scale=prior_mean_std[1])
+    kl = kl_normal(p, q, event_ndims=1).mean()
+    state_loss = jnp.maximum(jnp.float32(kl_free_nats), kl)
+    continue_loss = jnp.float32(0.0)
+    if qc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -qc.log_prob(continue_targets).mean()
+    loss = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return loss, kl, state_loss, reward_loss, observation_loss, continue_loss

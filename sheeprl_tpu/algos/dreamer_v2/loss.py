@@ -1,0 +1,56 @@
+"""DreamerV2 world-model loss (Eq. 2 of arXiv:2010.02193) with
+alpha-KL-balancing — capability parity with
+/root/reference/sheeprl/algos/dreamer_v2/loss.py:9-87."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.distributions import kl_categorical
+
+__all__ = ["reconstruction_loss"]
+
+
+def reconstruction_loss(
+    po: dict,
+    observations: dict,
+    pr,
+    rewards: jax.Array,
+    priors_logits: jax.Array,  # [T, B, S, D]
+    posteriors_logits: jax.Array,  # [T, B, S, D]
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    pc=None,
+    continue_targets: jax.Array | None = None,
+    continue_scale_factor: float = 1.0,
+):
+    """alpha * KL(sg(post) || prior) + (1-alpha) * KL(post || sg(prior)),
+    free-nats clipped (on the mean when `kl_free_avg`), plus Normal(x, 1)
+    observation/reward log-likelihoods and the continue Bernoulli.
+
+    Returns (loss, kl, kl_loss, reward_loss, observation_loss,
+    continue_loss) — scalars (kl is [T, B])."""
+    observation_loss = -sum(po[k].log_prob(observations[k]).mean() for k in po)
+    reward_loss = -pr.log_prob(rewards).mean()
+    lhs = kl = kl_categorical(
+        jax.lax.stop_gradient(posteriors_logits), priors_logits, event_ndims=1
+    )
+    rhs = kl_categorical(
+        posteriors_logits, jax.lax.stop_gradient(priors_logits), event_ndims=1
+    )
+    free_nats = jnp.float32(kl_free_nats)
+    if kl_free_avg:
+        loss_lhs = jnp.maximum(lhs.mean(), free_nats)
+        loss_rhs = jnp.maximum(rhs.mean(), free_nats)
+    else:
+        loss_lhs = jnp.maximum(lhs, free_nats).mean()
+        loss_rhs = jnp.maximum(rhs, free_nats).mean()
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+    continue_loss = jnp.float32(0.0)
+    if pc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -pc.log_prob(continue_targets).mean()
+    loss = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    return loss, kl, kl_loss, reward_loss, observation_loss, continue_loss

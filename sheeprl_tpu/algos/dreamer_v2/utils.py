@@ -1,0 +1,80 @@
+"""DreamerV2 helpers: observation preprocessing and the final evaluation
+rollout (capability parity with
+/root/reference/sheeprl/algos/dreamer_v2/utils.py:83-140; the lambda-return
+helper lives in sheeprl_tpu/ops/math.py:lambda_values_dv2)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ...utils.env import make_dict_env
+from ..ppo.agent import one_hot_to_env_actions
+
+__all__ = ["preprocess_obs", "test"]
+
+
+def preprocess_obs(obs: dict, cnn_keys, mlp_keys) -> dict:
+    """Host batch -> device-ready dict: images scaled to [-0.5, 0.5] float
+    (the V2 convention, reference dreamer_v2.py:623), vectors float32."""
+    out = {}
+    for k in cnn_keys:
+        out[k] = np.asarray(obs[k], dtype=np.float32) / 255.0 - 0.5
+    for k in mlp_keys:
+        out[k] = np.asarray(obs[k], dtype=np.float32)
+    return out
+
+
+def test(
+    player,
+    logger,
+    args,
+    cnn_keys,
+    mlp_keys,
+    log_dir: str,
+    test_name: str = "",
+    sample_actions: bool = False,
+) -> float:
+    """Play one greedy episode in a fresh env and log the cumulative reward
+    (reference dreamer_v2/utils.py:83-140)."""
+    import gymnasium as gym
+    import jax.numpy as jnp
+
+    env: gym.Env = make_dict_env(
+        args.env_id,
+        args.seed,
+        rank=0,
+        args=args,
+        run_name=log_dir,
+        prefix="test" + (f"_{test_name}" if test_name else ""),
+    )()
+    step = jax.jit(
+        lambda p, s, o, k, m: p.step(
+            s, o, k, jnp.float32(0.0), is_training=sample_actions, mask=m
+        )
+    )
+    obs, _ = env.reset(seed=args.seed)
+    state = player.init_states(1)
+    key = jax.random.PRNGKey(args.seed)
+    done, cumulative_reward = False, 0.0
+    while not done:
+        batched = {k: np.asarray(v)[None] for k, v in obs.items()}
+        device_obs = {
+            k: jnp.asarray(v)
+            for k, v in preprocess_obs(batched, cnn_keys, mlp_keys).items()
+        }
+        mask = {k: v for k, v in device_obs.items() if k.startswith("mask")} or None
+        key, sub = jax.random.split(key)
+        state, actions = step(player, state, device_obs, sub, mask)
+        env_actions = one_hot_to_env_actions(
+            actions, player.actions_dim, player.is_continuous
+        )
+        act = env_actions[0]
+        if isinstance(env.action_space, gym.spaces.Discrete):
+            act = act.item()
+        obs, reward, terminated, truncated, _ = env.step(act)
+        done = terminated or truncated or args.dry_run
+        cumulative_reward += float(reward)
+    logger.log("Test/cumulative_reward", cumulative_reward, 0)
+    env.close()
+    return cumulative_reward

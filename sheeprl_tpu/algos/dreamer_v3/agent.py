@@ -633,6 +633,30 @@ class PlayerState(nn.Module):
     stochastic_state: jax.Array  # [N, S*D]
 
 
+def exploration_actions(
+    actions: tuple[jax.Array, ...],
+    is_continuous: bool,
+    expl_amount: jax.Array,
+    key,
+) -> jax.Array:
+    """Add exploration noise and concatenate the per-head actions: clipped
+    Gaussian noise for continuous control, epsilon-uniform one-hot swaps per
+    discrete head (reference agent.py:524-554; shared by every Dreamer
+    player)."""
+    if is_continuous:
+        cat = jnp.concatenate(actions, axis=-1)
+        noise = expl_amount * jax.random.normal(key, cat.shape)
+        return jnp.clip(cat + noise, -1.0, 1.0)
+    expl_actions = []
+    for act in actions:
+        key, k_u, k_s = jax.random.split(key, 3)
+        rand_idx = jax.random.randint(k_u, act.shape[:-1], 0, act.shape[-1])
+        rand_one_hot = jax.nn.one_hot(rand_idx, act.shape[-1], dtype=act.dtype)
+        take_rand = (jax.random.uniform(k_s, act.shape[:-1]) < expl_amount)[..., None]
+        expl_actions.append(jnp.where(take_rand, rand_one_hot, act))
+    return jnp.concatenate(expl_actions, axis=-1)
+
+
 class PlayerDV3(nn.Module):
     """Environment-interaction model sharing parameters with the training
     graph (reference agent.py:448-583). `step` is pure and jittable; the
@@ -690,23 +714,7 @@ class PlayerDV3(nn.Module):
         stochastic = stochastic.reshape(*stochastic.shape[:-2], -1)
         latent = jnp.concatenate([stochastic, recurrent], axis=-1)
         actions, _ = self.actor(latent, key=k_act, is_training=is_training, mask=mask)
-        if self.is_continuous:
-            cat = jnp.concatenate(actions, axis=-1)
-            noise = expl_amount * jax.random.normal(k_expl, cat.shape)
-            cat = jnp.clip(cat + noise, -1.0, 1.0)
-        else:
-            expl_actions = []
-            for act in actions:
-                k_expl, k_u, k_s = jax.random.split(k_expl, 3)
-                rand_idx = jax.random.randint(
-                    k_u, act.shape[:-1], 0, act.shape[-1]
-                )
-                rand_one_hot = jax.nn.one_hot(rand_idx, act.shape[-1], dtype=act.dtype)
-                take_rand = (
-                    jax.random.uniform(k_s, act.shape[:-1]) < expl_amount
-                )[..., None]
-                expl_actions.append(jnp.where(take_rand, rand_one_hot, act))
-            cat = jnp.concatenate(expl_actions, axis=-1)
+        cat = exploration_actions(actions, self.is_continuous, expl_amount, k_expl)
         new_state = PlayerState(
             actions=cat, recurrent_state=recurrent, stochastic_state=stochastic
         )

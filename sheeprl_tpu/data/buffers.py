@@ -612,6 +612,41 @@ class EpisodeBuffer:
         for ep in state["episodes"]:
             self.add(ep)
 
+    def save(self, path: str) -> None:
+        """Serialize all episodes into one `.npz` (the Dreamer
+        `checkpoint_buffer` path for `buffer_type=episode`)."""
+        st = self.to_state_dict()
+        flat: dict[str, np.ndarray] = {
+            "n_episodes": np.int64(len(st["episodes"])),
+            "buffer_size": np.int64(self._buffer_size),
+            "sequence_length": np.int64(self._sequence_length),
+        }
+        for i, ep in enumerate(st["episodes"]):
+            for k, v in ep.items():
+                flat[f"ep{i}_{k}"] = v
+        np.savez(path, **flat)
+
+    def load(self, path: str) -> None:
+        data = np.load(path)
+        if (
+            int(data["buffer_size"]) != self._buffer_size
+            or int(data["sequence_length"]) != self._sequence_length
+        ):
+            raise ValueError("checkpointed episode buffer shape mismatch")
+        episodes: list[dict] = [{} for _ in range(int(data["n_episodes"]))]
+        for name in data.files:
+            if not name.startswith("ep"):
+                continue
+            idx, key = name[2:].split("_", 1)
+            episodes[int(idx)][key] = data[name]
+        self.load_state_dict(
+            {
+                "episodes": episodes,
+                "buffer_size": self._buffer_size,
+                "sequence_length": self._sequence_length,
+            }
+        )
+
 
 class AsyncReplayBuffer:
     """One independent (Sequential)ReplayBuffer per env; `add(data, indices)`

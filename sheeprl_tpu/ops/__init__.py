@@ -1,6 +1,7 @@
 from .math import (
     gae,
     lambda_values,
+    lambda_values_dv2,
     lambda_values_dv3,
     normalize,
     polynomial_decay,
@@ -14,6 +15,7 @@ from . import distributions
 __all__ = [
     "gae",
     "lambda_values",
+    "lambda_values_dv2",
     "lambda_values_dv3",
     "normalize",
     "polynomial_decay",

@@ -15,6 +15,7 @@ __all__ = [
     "symexp",
     "gae",
     "lambda_values",
+    "lambda_values_dv2",
     "lambda_values_dv3",
     "two_hot",
     "normalize",
@@ -93,6 +94,31 @@ def lambda_values(
         (deltas, done_mask[: horizon - 1]),
         reverse=True,
     )
+    return out
+
+
+def lambda_values_dv2(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    bootstrap: jax.Array | None = None,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DreamerV2 lambda-return variant with explicit bootstrap
+    (/root/reference/sheeprl/algos/dreamer_v2/utils.py:63-80): inputs are
+    `[H, ...]`, `bootstrap` is `[1, ...]` (zeros when None); `continues`
+    already folds in gamma."""
+    if bootstrap is None:
+        bootstrap = jnp.zeros_like(values[-1:])
+    next_vals = jnp.concatenate([values[1:], bootstrap], axis=0)
+    inputs = rewards + continues * next_vals * (1.0 - lmbda)
+
+    def step(carry, inp):
+        i_t, c_t = inp
+        agg = i_t + c_t * lmbda * carry
+        return agg, agg
+
+    _, out = jax.lax.scan(step, bootstrap[0], (inputs, continues), reverse=True)
     return out
 
 

@@ -1,0 +1,88 @@
+"""End-to-end smoke tests for DreamerV2 (mirrors the reference e2e strategy,
+/root/reference/tests/test_algos/test_algos.py:466-518: tiny config, dummy
+env, dry run, sequential and episode buffers, checkpoint key contract)."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import main
+
+TINY = [
+    "--dry_run",
+    "--num_devices=1",
+    "--num_envs=1",
+    "--sync_env",
+    "--per_rank_batch_size=1",
+    "--per_rank_sequence_length=2",
+    "--buffer_size=10",
+    "--learning_starts=0",
+    "--pretrain_steps=1",
+    "--gradient_steps=1",
+    "--horizon=4",
+    "--dense_units=8",
+    "--cnn_channels_multiplier=2",
+    "--recurrent_state_size=8",
+    "--hidden_size=8",
+    "--stochastic_size=4",
+    "--discrete_size=4",
+    "--mlp_layers=1",
+    "--train_every=1",
+    "--checkpoint_every=1",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize("buffer_type", ["sequential", "episode"])
+def test_dreamer_v2_dry_run(tmp_path, env_id, buffer_type):
+    main(
+        TINY
+        + [
+            f"--env_id={env_id}",
+            f"--buffer_type={buffer_type}",
+            f"--root_dir={tmp_path}",
+            "--run_name=test",
+            "--cnn_keys", "rgb",
+        ]
+    )
+    ckpt_dir = os.path.join(tmp_path, "test", "checkpoints")
+    assert os.path.isdir(ckpt_dir)
+    assert any(e.startswith("ckpt_") for e in sorted(os.listdir(ckpt_dir)))
+
+
+def test_dreamer_v2_checkpoint_contract_and_resume(tmp_path):
+    main(
+        TINY
+        + [
+            "--env_id=discrete_dummy",
+            f"--root_dir={tmp_path}",
+            "--run_name=test",
+            "--cnn_keys", "rgb",
+            "--checkpoint_buffer",
+        ]
+    )
+    ckpt_dir = os.path.join(tmp_path, "test", "checkpoints")
+    ckpts = [
+        e
+        for e in sorted(os.listdir(ckpt_dir))
+        if not e.endswith(".json") and not e.endswith(".npz")
+    ]
+    ckpt = os.path.join(ckpt_dir, ckpts[-1])
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+    raw = load_checkpoint(ckpt)
+    for k in (
+        "world_model",
+        "actor",
+        "critic",
+        "target_critic",
+        "world_optimizer",
+        "actor_optimizer",
+        "critic_optimizer",
+        "expl_decay_steps",
+        "global_step",
+        "batch_size",
+    ):
+        assert k in raw, f"missing checkpoint key {k}"
+    assert os.path.exists(ckpt + "_buffer.npz")
+    main([f"--checkpoint_path={ckpt}"])

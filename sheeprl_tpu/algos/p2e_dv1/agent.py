@@ -1,0 +1,98 @@
+"""Plan2Explore-on-DreamerV1 models (capability parity with
+/root/reference/sheeprl/algos/p2e_dv1/agent.py): the DreamerV1 world model
+plus a DUAL actor-critic (exploration + task, learned zero-shot) and an
+ensemble of next-embedding predictors whose disagreement is the intrinsic
+reward (arXiv:2005.05960).
+
+TPU-first deviation: the reference keeps `num_ensembles` separate MLPs in a
+ModuleList and loops over them (p2e_dv1.py:219-231); here the ensemble is
+ONE MLP pytree with a leading ensemble axis on every leaf, evaluated with
+`jax.vmap` — N member forwards become one batched matmul chain on the MXU
+(same design as the SAC critic ensemble)."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn.inits import init_kaiming_normal
+from ..dreamer_v1.agent import build_models as dv1_build_models
+from ..dreamer_v3.agent import Actor, MinedojoActor, WorldModel
+
+__all__ = ["build_ensembles", "ensemble_apply", "build_models"]
+
+
+def build_ensembles(
+    key,
+    num_ensembles: int,
+    make_one: Callable[[jax.Array], nn.Module],
+) -> nn.Module:
+    """Stack `num_ensembles` independently-initialized members into one
+    pytree with a leading ensemble axis (the reference seeds each member
+    differently, p2e_dv1.py:466-478)."""
+    members = [make_one(k) for k in jax.random.split(key, num_ensembles)]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *members)
+
+
+def ensemble_apply(ensembles: nn.Module, x: jax.Array) -> jax.Array:
+    """Evaluate every member on the same input: `[N_ens, ..., out]`."""
+    return jax.vmap(lambda e: e(x))(ensembles)
+
+
+def build_models(
+    key,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    args,
+    obs_space: dict,
+    cnn_keys: Sequence[str],
+    mlp_keys: Sequence[str],
+) -> tuple[WorldModel, Actor, nn.MLP, Actor, nn.MLP, nn.Module]:
+    """-> (world_model, actor_task, critic_task, actor_exploration,
+    critic_exploration, ensembles) — reference agent.py:16-133 +
+    p2e_dv1.py:466-478."""
+    k_dv1, k_task_a, k_task_c, k_ens, k_init = jax.random.split(key, 5)
+    world_model, actor_exploration, critic_exploration = dv1_build_models(
+        k_dv1, actions_dim, is_continuous, args, obs_space, cnn_keys, mlp_keys
+    )
+    latent_state_size = args.stochastic_size + args.recurrent_state_size
+    actor_cls = MinedojoActor if "minedojo" in args.env_id else Actor
+    actor_task = actor_cls.init(
+        k_task_a,
+        latent_state_size,
+        actions_dim,
+        is_continuous,
+        init_std=args.actor_init_std,
+        min_std=args.actor_min_std,
+        dense_units=args.dense_units,
+        dense_act=args.dense_act,
+        mlp_layers=args.mlp_layers,
+        distribution="tanh_normal" if is_continuous else "discrete",
+        layer_norm=False,
+        unimix=0.0,
+    )
+    critic_task = nn.MLP.init(
+        k_task_c, latent_state_size, [args.dense_units] * args.mlp_layers, 1,
+        act=args.dense_act,
+    )
+    ik = jax.random.split(k_init, 2)
+    actor_task = init_kaiming_normal(actor_task, ik[0])
+    critic_task = init_kaiming_normal(critic_task, ik[1])
+
+    embedding_dim = world_model.encoder.output_dim
+
+    def make_member(k):
+        member = nn.MLP.init(
+            k,
+            int(sum(actions_dim)) + args.recurrent_state_size + args.stochastic_size,
+            [args.dense_units] * args.mlp_layers,
+            embedding_dim,
+            act="relu",
+        )
+        return init_kaiming_normal(member, jax.random.fold_in(k, 1))
+
+    ensembles = build_ensembles(k_ens, args.num_ensembles, make_member)
+    return world_model, actor_task, critic_task, actor_exploration, critic_exploration, ensembles

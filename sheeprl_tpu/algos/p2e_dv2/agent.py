@@ -1,0 +1,89 @@
+"""Plan2Explore-on-DreamerV2 models (capability parity with
+/root/reference/sheeprl/algos/p2e_dv2/agent.py): the DreamerV2 world model
+plus a dual actor-critic (exploration + task, each with an EMA-free hard
+target critic) and a vmapped ensemble predicting the NEXT POSTERIOR from
+(posterior, recurrent, action) — its disagreement is the intrinsic reward
+(reference p2e_dv2.py:216-288)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn.inits import init_xavier
+from ..dreamer_v2.agent import build_models as dv2_build_models
+from ..dreamer_v3.agent import Actor, MinedojoActor, WorldModel
+from ..p2e_dv1.agent import build_ensembles, ensemble_apply  # noqa: F401 - re-exported
+
+__all__ = ["build_models", "build_ensembles", "ensemble_apply"]
+
+
+def build_models(
+    key,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    args,
+    obs_space: dict,
+    cnn_keys: Sequence[str],
+    mlp_keys: Sequence[str],
+):
+    """-> (world_model, actor_task, critic_task, target_critic_task,
+    actor_exploration, critic_exploration, target_critic_exploration,
+    ensembles) — reference agent.py:16-151 + p2e_dv2.py:581-605."""
+    k_dv2, k_task_a, k_task_c, k_ens, k_init = jax.random.split(key, 5)
+    world_model, actor_exploration, critic_exploration, target_critic_exploration = (
+        dv2_build_models(
+            k_dv2, actions_dim, is_continuous, args, obs_space, cnn_keys, mlp_keys
+        )
+    )
+    stochastic_size = args.stochastic_size * args.discrete_size
+    latent_state_size = stochastic_size + args.recurrent_state_size
+    actor_cls = MinedojoActor if "minedojo" in args.env_id else Actor
+    actor_task = actor_cls.init(
+        k_task_a,
+        latent_state_size,
+        actions_dim,
+        is_continuous,
+        init_std=args.actor_init_std,
+        min_std=args.actor_min_std,
+        dense_units=args.dense_units,
+        dense_act=args.dense_act,
+        mlp_layers=args.mlp_layers,
+        distribution=args.actor_distribution,
+        layer_norm=args.layer_norm,
+        unimix=0.0,
+    )
+    critic_task = nn.MLP.init(
+        k_task_c, latent_state_size, [args.dense_units] * args.mlp_layers, 1,
+        act=args.dense_act, layer_norm=args.layer_norm,
+    )
+    ik = jax.random.split(k_init, 2)
+    actor_task = init_xavier(actor_task, ik[0], "normal")
+    critic_task = init_xavier(critic_task, ik[1], "normal")
+    target_critic_task = jax.tree_util.tree_map(jnp.copy, critic_task)
+
+    def make_member(k):
+        member = nn.MLP.init(
+            k,
+            int(sum(actions_dim)) + args.recurrent_state_size + stochastic_size,
+            [args.dense_units] * args.mlp_layers,
+            stochastic_size,
+            act=args.dense_act,
+            layer_norm=args.layer_norm,
+        )
+        return init_xavier(member, jax.random.fold_in(k, 1), "normal")
+
+    ensembles = build_ensembles(k_ens, args.num_ensembles, make_member)
+    return (
+        world_model,
+        actor_task,
+        critic_task,
+        target_critic_task,
+        actor_exploration,
+        critic_exploration,
+        target_critic_exploration,
+        ensembles,
+    )

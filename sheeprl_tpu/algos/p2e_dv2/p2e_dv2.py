@@ -1,0 +1,838 @@
+"""Plan2Explore on DreamerV2 — capability parity with
+/root/reference/sheeprl/algos/p2e_dv2/p2e_dv2.py.
+
+Same single-jit structure as the DreamerV2 task, extended with:
+  - a vmapped ensemble predicting the next posterior from
+    (posterior, recurrent, action); its member variance is the intrinsic
+    reward (reference p2e_dv2.py:216-288);
+  - dual actor-critic (exploration on intrinsic reward, task zero-shot on
+    the extrinsic reward model), each with a hard-copied target critic
+    gated by the same traced tau (reference p2e_dv2.py:893-897);
+  - the world model's reward/continue heads fit on detached latents
+    (reference p2e_dv2.py:163-168);
+  - `exploring` is a compile-time flag switched once at
+    `exploration_steps`.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ... import nn, ops
+from ...data import AsyncReplayBuffer, EpisodeBuffer
+from ...envs import make_vector_env
+from ...ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
+from ...parallel import make_mesh, replicate, shard_batch
+from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.env import make_dict_env
+from ...utils.logger import create_logger
+from ...utils.metric import MetricAggregator
+from ...utils.parser import DataclassArgumentParser
+from ...utils.registry import register_algorithm
+from ..ppo.agent import one_hot_to_env_actions
+from ..ppo.ppo import actions_dim_of, validate_obs_keys
+from ..dreamer_v2.agent import PlayerDV2
+from ..dreamer_v2.loss import reconstruction_loss
+from ..dreamer_v2.utils import preprocess_obs, test
+from ..dreamer_v2.dreamer_v2 import _policy_entropy
+from ..dreamer_v3.agent import WorldModel
+from ..dreamer_v3.dreamer_v3 import _random_actions
+from .agent import build_models, ensemble_apply
+from .args import P2EDV2Args
+
+
+class P2EDV2TrainState(nn.Module):
+    world_model: WorldModel
+    actor_task: object
+    critic_task: nn.MLP
+    target_critic_task: nn.MLP
+    actor_exploration: object
+    critic_exploration: nn.MLP
+    target_critic_exploration: nn.MLP
+    ensembles: nn.Module
+    world_opt: object
+    actor_task_opt: object
+    critic_task_opt: object
+    actor_exploration_opt: object
+    critic_exploration_opt: object
+    ensemble_opt: object
+
+
+def make_optimizers(args: P2EDV2Args):
+    """Adam(eps=1e-5, weight_decay=1e-6) with shared clipping + the ensemble
+    chain (reference p2e_dv2.py:620-625)."""
+
+    def chain(lr, eps=1e-5, clip=None):
+        clip = args.clip_gradients if clip is None else clip
+        steps = []
+        if clip is not None and clip > 0:
+            steps.append(optax.clip_by_global_norm(clip))
+        steps.append(optax.add_decayed_weights(1e-6))
+        steps.append(optax.adam(lr, eps=eps))
+        return optax.chain(*steps)
+
+    return (
+        chain(args.world_lr),
+        chain(args.actor_lr),
+        chain(args.critic_lr),
+        chain(args.actor_lr),
+        chain(args.critic_lr),
+        chain(args.ensemble_lr, eps=args.ensemble_eps, clip=args.ensemble_clip_gradients),
+    )
+
+
+def make_train_step(
+    args: P2EDV2Args,
+    optimizers,
+    cnn_keys: Sequence[str],
+    mlp_keys: Sequence[str],
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    exploring: bool,
+):
+    """Build the single-jit P2E-DV2 update (reference train(),
+    p2e_dv2.py:44-500)."""
+    (world_optimizer, actor_task_optimizer, critic_task_optimizer,
+     actor_expl_optimizer, critic_expl_optimizer, ensemble_optimizer) = optimizers
+    stoch_size = args.stochastic_size * args.discrete_size
+    horizon = args.horizon
+    action_splits = np.cumsum(actions_dim)[:-1]
+
+    def behaviour_update(
+        actor, critic, target_critic, actor_opt, critic_opt,
+        actor_optimizer_, critic_optimizer_,
+        world_model, imagined_prior0, recurrent0, true_continue0, reward_fn, key,
+    ):
+        """DV2-style behaviour learning: imagination, target-critic
+        lambda-returns, reinforce (discrete) or dynamics (continuous)
+        objective (reference p2e_dv2.py:250-360)."""
+        img_keys = jax.random.split(key, horizon)
+
+        def actor_loss_fn(actor):
+            latent0 = jnp.concatenate([imagined_prior0, recurrent0], axis=-1)
+
+            def img_step(carry, k):
+                prior, recurrent = carry
+                latent = jnp.concatenate([prior, recurrent], axis=-1)
+                k_act, k_trans = jax.random.split(k)
+                acts, _ = actor(jax.lax.stop_gradient(latent), key=k_act)
+                action = jnp.concatenate(acts, axis=-1)
+                new_prior, new_recurrent = world_model.rssm.imagination(
+                    prior, recurrent, action, k_trans
+                )
+                new_latent = jnp.concatenate([new_prior, new_recurrent], axis=-1)
+                return (new_prior, new_recurrent), (new_latent, action)
+
+            _, (new_latents, actions_h) = jax.lax.scan(
+                img_step, (imagined_prior0, recurrent0), img_keys
+            )
+            imagined_trajectories = jnp.concatenate([latent0[None], new_latents], axis=0)
+            imagined_actions = jnp.concatenate(
+                [jnp.zeros_like(actions_h[:1]), actions_h], axis=0
+            )  # [H+1, T*B, A]
+
+            predicted_target_values = target_critic(imagined_trajectories)
+            rewards = reward_fn(imagined_trajectories, imagined_actions)
+            if args.use_continues:
+                continues = Independent(
+                    base=Bernoulli(
+                        logits=world_model.continue_model(imagined_trajectories)
+                    ),
+                    event_ndims=1,
+                ).mean
+                continues = jnp.concatenate(
+                    [true_continue0 * args.gamma, continues[1:]], axis=0
+                )
+            else:
+                continues = (
+                    jnp.ones_like(jax.lax.stop_gradient(rewards)) * args.gamma
+                )
+
+            lambda_values = ops.lambda_values_dv2(
+                rewards[:-1],
+                predicted_target_values[:-1],
+                continues[:-1],
+                bootstrap=predicted_target_values[-1:],
+                lmbda=args.lmbda,
+            )
+            discount = jax.lax.stop_gradient(
+                jnp.cumprod(
+                    jnp.concatenate(
+                        [jnp.ones_like(continues[:1]), continues[:-1]], axis=0
+                    ),
+                    axis=0,
+                )
+            )
+
+            policies = actor.dists(jax.lax.stop_gradient(imagined_trajectories[:-2]))
+            if is_continuous:
+                objective = lambda_values[1:]
+            else:
+                advantage = jax.lax.stop_gradient(
+                    lambda_values[1:] - predicted_target_values[:-2]
+                )
+                per_head_actions = jnp.split(
+                    jax.lax.stop_gradient(imagined_actions[1:-1]), action_splits, axis=-1
+                )
+                objective = (
+                    sum(
+                        p.log_prob(a)[..., None]
+                        for p, a in zip(policies, per_head_actions)
+                    )
+                    * advantage
+                )
+            entropies = [_policy_entropy(p) for p in policies]
+            if any(e is None for e in entropies):
+                entropy = jnp.zeros_like(objective)
+            else:
+                entropy = args.actor_ent_coef * sum(entropies)[..., None]
+            policy_loss = -jnp.mean(discount[:-2] * (objective + entropy))
+            return policy_loss, (imagined_trajectories, lambda_values, discount, rewards)
+
+        (policy_loss, (traj, lambda_values, discount, rewards)), actor_grads = (
+            jax.value_and_grad(actor_loss_fn, has_aux=True)(actor)
+        )
+        actor_updates, actor_opt = actor_optimizer_.update(actor_grads, actor_opt, actor)
+        actor = optax.apply_updates(actor, actor_updates)
+
+        traj_sg = jax.lax.stop_gradient(traj[:-1])
+        lambda_sg = jax.lax.stop_gradient(lambda_values)
+
+        def critic_loss_fn(critic):
+            qv_mean = critic(traj_sg)
+            qv = Independent(
+                base=Normal(loc=qv_mean, scale=jnp.ones_like(qv_mean)), event_ndims=1
+            )
+            return -jnp.mean(discount[:-1, :, 0] * qv.log_prob(lambda_sg))
+
+        value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(critic)
+        critic_updates, critic_opt = critic_optimizer_.update(
+            critic_grads, critic_opt, critic
+        )
+        critic = optax.apply_updates(critic, critic_updates)
+        return actor, critic, actor_opt, critic_opt, {
+            "policy_loss": policy_loss,
+            "value_loss": value_loss,
+            "actor_grads": optax.global_norm(actor_grads),
+            "critic_grads": optax.global_norm(critic_grads),
+            "rewards": rewards.mean(),
+        }
+
+    def train_step(state: P2EDV2TrainState, data: dict, key, tau):
+        T, B = data["dones"].shape[:2]
+        k_wm, k_expl, k_task = jax.random.split(key, 3)
+
+        # hard target copies for BOTH critics (reference p2e_dv2.py:893-897)
+        target_critic_task = jax.tree_util.tree_map(
+            lambda c, t: tau * c + (1.0 - tau) * t,
+            state.critic_task,
+            state.target_critic_task,
+        )
+        target_critic_exploration = jax.tree_util.tree_map(
+            lambda c, t: tau * c + (1.0 - tau) * t,
+            state.critic_exploration,
+            state.target_critic_exploration,
+        )
+
+        batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
+        batch_obs.update({k: data[k] for k in mlp_keys})
+        is_first = data["is_first"].at[0].set(1.0)
+
+        # ---- world model (reward/continue on detached latents) --------------
+        def world_loss_fn(wm: WorldModel):
+            embedded = wm.encoder(batch_obs)
+            posterior0 = jnp.zeros((B, args.stochastic_size, args.discrete_size))
+            recurrent0 = jnp.zeros((B, args.recurrent_state_size))
+            recurrent_states, priors_logits, posteriors, posteriors_logits = (
+                wm.rssm.scan_dynamic(
+                    posterior0, recurrent0, data["actions"], embedded, is_first, k_wm
+                )
+            )
+            latent_states = jnp.concatenate(
+                [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
+            )
+            latents_sg = jax.lax.stop_gradient(latent_states)
+            decoded = wm.observation_model(latent_states)
+            po = {
+                k: Independent(
+                    base=Normal(loc=decoded[k], scale=jnp.ones_like(decoded[k])),
+                    event_ndims=len(decoded[k].shape[2:]),
+                )
+                for k in decoded
+            }
+            pr_mean = wm.reward_model(latents_sg)
+            pr = Independent(
+                base=Normal(loc=pr_mean, scale=jnp.ones_like(pr_mean)), event_ndims=1
+            )
+            if args.use_continues:
+                pc = Independent(
+                    base=Bernoulli(logits=wm.continue_model(latents_sg)), event_ndims=1
+                )
+                continue_targets = (1.0 - data["dones"]) * args.gamma
+            else:
+                pc = continue_targets = None
+            shaped = (T, B, args.stochastic_size, args.discrete_size)
+            losses = reconstruction_loss(
+                po,
+                batch_obs,
+                pr,
+                data["rewards"],
+                priors_logits.reshape(shaped),
+                posteriors_logits.reshape(shaped),
+                args.kl_balancing_alpha,
+                args.kl_free_nats,
+                args.kl_free_avg,
+                args.kl_regularizer,
+                pc,
+                continue_targets,
+                args.continue_scale_factor,
+            )
+            return losses[0], (losses, recurrent_states, posteriors, priors_logits, posteriors_logits)
+
+        (_, (wm_losses, recurrent_states, posteriors, priors_logits, posteriors_logits)), wm_grads = (
+            jax.value_and_grad(world_loss_fn, has_aux=True)(state.world_model)
+        )
+        rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = wm_losses
+        wm_updates, world_opt = world_optimizer.update(
+            wm_grads, state.world_opt, state.world_model
+        )
+        world_model = optax.apply_updates(state.world_model, wm_updates)
+
+        imagined_prior0 = jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size)
+        recurrent0 = jax.lax.stop_gradient(recurrent_states).reshape(
+            T * B, args.recurrent_state_size
+        )
+        true_continue0 = (1.0 - data["dones"]).reshape(1, T * B, 1)
+
+        shaped = (T, B, args.stochastic_size, args.discrete_size)
+        metrics = {
+            "Loss/reconstruction_loss": rec_loss,
+            "Loss/observation_loss": observation_loss,
+            "Loss/reward_loss": reward_loss,
+            "Loss/state_loss": state_loss,
+            "Loss/continue_loss": continue_loss,
+            "State/kl": kl.mean(),
+            "State/post_entropy": OneHotCategorical.from_logits(
+                posteriors_logits.reshape(shaped)
+            ).entropy().sum(-1).mean(),
+            "State/prior_entropy": OneHotCategorical.from_logits(
+                priors_logits.reshape(shaped)
+            ).entropy().sum(-1).mean(),
+            "Grads/world_model": optax.global_norm(wm_grads),
+        }
+
+        ensembles, ensemble_opt = state.ensembles, state.ensemble_opt
+        actor_expl, critic_expl = state.actor_exploration, state.critic_exploration
+        actor_expl_opt, critic_expl_opt = (
+            state.actor_exploration_opt,
+            state.critic_exploration_opt,
+        )
+        if exploring:
+            # ---- ensemble learning: predict the next posterior --------------
+            posteriors_flat_sg = jax.lax.stop_gradient(posteriors).reshape(T, B, -1)
+            ens_input = jnp.concatenate(
+                [
+                    posteriors_flat_sg,
+                    jax.lax.stop_gradient(recurrent_states),
+                    jax.lax.stop_gradient(data["actions"]),
+                ],
+                axis=-1,
+            )
+
+            def ensemble_loss_fn(ens):
+                out = ensemble_apply(ens, ens_input)[:, :-1]  # [N, T-1, B, S*D]
+                log_prob = Independent(
+                    base=Normal(loc=out, scale=jnp.ones_like(out)), event_ndims=1
+                ).log_prob(posteriors_flat_sg[1:])
+                return -log_prob.mean(axis=(1, 2)).sum()
+
+            ensemble_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(ensembles)
+            ens_updates, ensemble_opt = ensemble_optimizer.update(
+                ens_grads, ensemble_opt, ensembles
+            )
+            ensembles = optax.apply_updates(ensembles, ens_updates)
+            metrics["Loss/ensemble_loss"] = ensemble_loss
+            metrics["Grads/ensemble"] = optax.global_norm(ens_grads)
+
+            def intrinsic_reward_fn(traj, actions):
+                preds = ensemble_apply(
+                    ensembles,
+                    jnp.concatenate(
+                        [jax.lax.stop_gradient(traj), jax.lax.stop_gradient(actions)],
+                        axis=-1,
+                    ),
+                )  # [N_ens, H+1, T*B, S*D]
+                return (
+                    preds.var(axis=0).mean(axis=-1, keepdims=True)
+                    * args.intrinsic_reward_multiplier
+                )
+
+            actor_expl, critic_expl, actor_expl_opt, critic_expl_opt, expl_metrics = (
+                behaviour_update(
+                    state.actor_exploration,
+                    state.critic_exploration,
+                    target_critic_exploration,
+                    state.actor_exploration_opt,
+                    state.critic_exploration_opt,
+                    actor_expl_optimizer,
+                    critic_expl_optimizer,
+                    world_model,
+                    imagined_prior0,
+                    recurrent0,
+                    true_continue0,
+                    intrinsic_reward_fn,
+                    k_expl,
+                )
+            )
+            metrics["Loss/policy_loss_exploration"] = expl_metrics["policy_loss"]
+            metrics["Loss/value_loss_exploration"] = expl_metrics["value_loss"]
+            metrics["Grads/actor_exploration"] = expl_metrics["actor_grads"]
+            metrics["Grads/critic_exploration"] = expl_metrics["critic_grads"]
+            metrics["Rewards/intrinsic"] = expl_metrics["rewards"]
+
+        # ---- task behaviour (zero-shot, extrinsic reward model) -------------
+        def extrinsic_reward_fn(traj, actions):
+            return world_model.reward_model(traj)
+
+        actor_task, critic_task, actor_task_opt, critic_task_opt, task_metrics = (
+            behaviour_update(
+                state.actor_task,
+                state.critic_task,
+                target_critic_task,
+                state.actor_task_opt,
+                state.critic_task_opt,
+                actor_task_optimizer,
+                critic_task_optimizer,
+                world_model,
+                imagined_prior0,
+                recurrent0,
+                true_continue0,
+                extrinsic_reward_fn,
+                k_task,
+            )
+        )
+        metrics["Loss/policy_loss_task"] = task_metrics["policy_loss"]
+        metrics["Loss/value_loss_task"] = task_metrics["value_loss"]
+        metrics["Grads/actor_task"] = task_metrics["actor_grads"]
+        metrics["Grads/critic_task"] = task_metrics["critic_grads"]
+
+        new_state = P2EDV2TrainState(
+            world_model=world_model,
+            actor_task=actor_task,
+            critic_task=critic_task,
+            target_critic_task=target_critic_task,
+            actor_exploration=actor_expl,
+            critic_exploration=critic_expl,
+            target_critic_exploration=target_critic_exploration,
+            ensembles=ensembles,
+            world_opt=world_opt,
+            actor_task_opt=actor_task_opt,
+            critic_task_opt=critic_task_opt,
+            actor_exploration_opt=actor_expl_opt,
+            critic_exploration_opt=critic_expl_opt,
+            ensemble_opt=ensemble_opt,
+        )
+        return new_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+@register_algorithm()
+def main(argv: Sequence[str] | None = None) -> None:
+    parser = DataclassArgumentParser(P2EDV2Args)
+    (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.checkpoint_path:
+        saved = load_checkpoint_args(args.checkpoint_path)
+        if saved:
+            saved.update(checkpoint_path=args.checkpoint_path)
+            (args,) = parser.parse_dict(saved)
+    args.screen_size = 64
+    args.frame_stack = -1
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    np.random.seed(args.seed)
+    key = jax.random.PRNGKey(args.seed)
+    mesh = make_mesh(args.num_devices)
+    n_dev = mesh.devices.size
+
+    logger, log_dir, run_name = create_logger(args, "p2e_dv2")
+    logger.log_hyperparams(args.as_dict())
+
+    envs = make_vector_env(
+        [
+            make_dict_env(
+                args.env_id, args.seed + i, rank=0, args=args,
+                run_name=log_dir, vector_env_idx=i,
+            )
+            for i in range(args.num_envs)
+        ],
+        sync=args.sync_env or args.num_envs == 1,
+    )
+    cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
+    obs_keys = [*cnn_keys, *mlp_keys]
+    actions_dim, is_continuous = actions_dim_of(envs.single_action_space)
+
+    key, model_key = jax.random.split(key)
+    (world_model, actor_task, critic_task, target_critic_task, actor_exploration,
+     critic_exploration, target_critic_exploration, ensembles) = build_models(
+        model_key, actions_dim, is_continuous, args,
+        envs.single_observation_space.spaces, cnn_keys, mlp_keys,
+    )
+    optimizers = make_optimizers(args)
+    state = P2EDV2TrainState(
+        world_model=world_model,
+        actor_task=actor_task,
+        critic_task=critic_task,
+        target_critic_task=target_critic_task,
+        actor_exploration=actor_exploration,
+        critic_exploration=critic_exploration,
+        target_critic_exploration=target_critic_exploration,
+        ensembles=ensembles,
+        world_opt=optimizers[0].init(world_model),
+        actor_task_opt=optimizers[1].init(actor_task),
+        critic_task_opt=optimizers[2].init(critic_task),
+        actor_exploration_opt=optimizers[3].init(actor_exploration),
+        critic_exploration_opt=optimizers[4].init(critic_exploration),
+        ensemble_opt=optimizers[5].init(ensembles),
+    )
+    expl_decay_steps = 0
+    start_step = 1
+    if args.checkpoint_path:
+        template = {
+            "world_model": state.world_model,
+            "actor_task": state.actor_task,
+            "critic_task": state.critic_task,
+            "target_critic_task": state.target_critic_task,
+            "ensembles": state.ensembles,
+            "world_optimizer": state.world_opt,
+            "actor_task_optimizer": state.actor_task_opt,
+            "critic_task_optimizer": state.critic_task_opt,
+            "ensemble_optimizer": state.ensemble_opt,
+            "expl_decay_steps": 0,
+            "global_step": 0,
+            "batch_size": 0,
+            "actor_exploration": state.actor_exploration,
+            "critic_exploration": state.critic_exploration,
+            "target_critic_exploration": state.target_critic_exploration,
+            "actor_exploration_optimizer": state.actor_exploration_opt,
+            "critic_exploration_optimizer": state.critic_exploration_opt,
+        }
+        ckpt = load_checkpoint(args.checkpoint_path, template)
+        state = P2EDV2TrainState(
+            world_model=ckpt["world_model"],
+            actor_task=ckpt["actor_task"],
+            critic_task=ckpt["critic_task"],
+            target_critic_task=ckpt["target_critic_task"],
+            actor_exploration=ckpt["actor_exploration"],
+            critic_exploration=ckpt["critic_exploration"],
+            target_critic_exploration=ckpt["target_critic_exploration"],
+            ensembles=ckpt["ensembles"],
+            world_opt=ckpt["world_optimizer"],
+            actor_task_opt=ckpt["actor_task_optimizer"],
+            critic_task_opt=ckpt["critic_task_optimizer"],
+            actor_exploration_opt=ckpt["actor_exploration_optimizer"],
+            critic_exploration_opt=ckpt["critic_exploration_optimizer"],
+            ensemble_opt=ckpt["ensemble_optimizer"],
+        )
+        expl_decay_steps = int(ckpt["expl_decay_steps"])
+        start_step = int(ckpt["global_step"]) + 1
+    state = replicate(state, mesh)
+
+    def make_player(st: P2EDV2TrainState, exploring: bool) -> PlayerDV2:
+        return PlayerDV2(
+            encoder=st.world_model.encoder,
+            rssm=st.world_model.rssm,
+            actor=st.actor_exploration if exploring else st.actor_task,
+            actions_dim=tuple(actions_dim),
+            stochastic_size=args.stochastic_size,
+            discrete_size=args.discrete_size,
+            recurrent_state_size=args.recurrent_state_size,
+            is_continuous=is_continuous,
+        )
+
+    player_step = jax.jit(
+        lambda p, s, o, k, expl, mask: p.step(
+            s, o, k, expl, is_training=True, mask=mask
+        )
+    )
+    train_step_exploring = make_train_step(
+        args, optimizers, cnn_keys, mlp_keys, actions_dim, is_continuous, exploring=True
+    )
+    train_step_task = make_train_step(
+        args, optimizers, cnn_keys, mlp_keys, actions_dim, is_continuous, exploring=False
+    )
+
+    buffer_size = args.buffer_size // args.num_envs if not args.dry_run else 4
+    buffer_type = args.buffer_type.lower()
+    if buffer_type == "sequential":
+        rb = AsyncReplayBuffer(
+            max(buffer_size, args.per_rank_sequence_length),
+            args.num_envs,
+            storage="host" if args.memmap_buffer else "device",
+            memmap_dir=(
+                os.path.join(log_dir, "memmap_buffer") if args.memmap_buffer else None
+            ),
+            sequential=True,
+            obs_keys=tuple(obs_keys),
+            seed=args.seed,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            max(buffer_size, args.per_rank_sequence_length),
+            sequence_length=args.per_rank_sequence_length,
+            memmap_dir=(
+                os.path.join(log_dir, "memmap_buffer") if args.memmap_buffer else None
+            ),
+            seed=args.seed,
+        )
+    else:
+        raise ValueError(
+            f"unrecognized buffer type {buffer_type!r}: must be `sequential` or `episode`"
+        )
+    buffer_ckpt = (
+        os.path.abspath(args.checkpoint_path) + "_buffer.npz"
+        if args.checkpoint_path
+        else None
+    )
+    if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt):
+        rb.load(buffer_ckpt)
+
+    aggregator = MetricAggregator()
+    single_global_step = args.num_envs * args.action_repeat
+    step_before_training = (
+        args.train_every // single_global_step if not args.dry_run else 0
+    )
+    num_updates = args.total_steps // single_global_step if not args.dry_run else 1
+    learning_starts = args.learning_starts // single_global_step if not args.dry_run else 0
+    exploration_updates = (
+        args.exploration_steps // args.action_repeat if not args.dry_run else 4
+    )
+    exploration_updates = min(num_updates, exploration_updates)
+    if args.checkpoint_path and not args.checkpoint_buffer:
+        learning_starts += start_step
+    max_step_expl_decay = args.max_step_expl_decay // args.gradient_steps
+    expl_amount = args.expl_amount
+    if args.checkpoint_path and max_step_expl_decay > 0:
+        expl_amount = ops.polynomial_decay(
+            expl_decay_steps,
+            initial=args.expl_amount,
+            final=args.expl_min,
+            max_decay_steps=max_step_expl_decay,
+        )
+
+    episode_steps: list[list[dict]] = [[] for _ in range(args.num_envs)]
+    obs, _ = envs.reset(seed=args.seed)
+    step_data = {k: np.asarray(obs[k]) for k in obs_keys}
+    step_data["dones"] = np.zeros((args.num_envs, 1), np.float32)
+    step_data["actions"] = np.zeros((args.num_envs, int(sum(actions_dim))), np.float32)
+    step_data["rewards"] = np.zeros((args.num_envs, 1), np.float32)
+    step_data["is_first"] = np.ones((args.num_envs, 1), np.float32)
+    if buffer_type == "sequential":
+        rb.add({k: v[None] for k, v in step_data.items()})
+    else:
+        for i in range(args.num_envs):
+            episode_steps[i].append({k: v[i] for k, v in step_data.items()})
+    is_exploring = True
+    player = make_player(state, exploring=True)
+    player_state = player.init_states(args.num_envs)
+
+    gradient_steps = 0
+    start_time = time.perf_counter()
+    for global_step in range(start_step, num_updates + 1):
+        if is_exploring and global_step == exploration_updates:
+            is_exploring = False
+            player = make_player(state, exploring=False)
+            test(player, logger, args, cnn_keys, mlp_keys, log_dir, "zero-shot")
+
+        if (
+            global_step <= learning_starts
+            and args.checkpoint_path is None
+            and "minedojo" not in args.env_id
+        ):
+            pairs = [
+                _random_actions(envs.single_action_space, actions_dim, is_continuous)
+                for _ in range(args.num_envs)
+            ]
+            actions = np.stack([p[0] for p in pairs])
+            env_actions = [p[1] for p in pairs]
+        else:
+            device_obs = {
+                k: jnp.asarray(v)
+                for k, v in preprocess_obs(obs, cnn_keys, mlp_keys).items()
+            }
+            mask = {k: v for k, v in device_obs.items() if k.startswith("mask")} or None
+            key, step_key = jax.random.split(key)
+            player_state, actions_dev = player_step(
+                player, player_state, device_obs, step_key,
+                jnp.float32(expl_amount), mask,
+            )
+            actions = np.asarray(actions_dev)
+            env_actions = list(
+                one_hot_to_env_actions(actions, actions_dim, is_continuous)
+            )
+
+        step_data["is_first"] = step_data["dones"].copy()
+        next_obs, rewards, terms, truncs, infos = envs.step(env_actions)
+        dones = np.logical_or(terms, truncs).astype(np.float32)
+        if args.dry_run and buffer_type == "episode":
+            dones = np.ones_like(dones)
+
+        for i, info in enumerate(infos):
+            if "episode" in info:
+                aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
+                aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+        for i, info in enumerate(infos):
+            if "final_observation" in info:
+                for k in obs_keys:
+                    real_next_obs[k][i] = info["final_observation"][k]
+
+        for k in obs_keys:
+            step_data[k] = real_next_obs[k]
+        obs = next_obs
+        step_data["dones"] = dones[:, None]
+        step_data["actions"] = actions.astype(np.float32)
+        step_data["rewards"] = (
+            np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
+        ).astype(np.float32)
+        if buffer_type == "sequential":
+            rb.add({k: v[None] for k, v in step_data.items()})
+        else:
+            for i in range(args.num_envs):
+                episode_steps[i].append({k: v[i] for k, v in step_data.items()})
+
+        dones_idxes = np.nonzero(dones)[0].tolist()
+        if dones_idxes:
+            n_reset = len(dones_idxes)
+            reset_data = {k: np.asarray(obs[k])[dones_idxes] for k in obs_keys}
+            reset_data["dones"] = np.zeros((n_reset, 1), np.float32)
+            reset_data["actions"] = np.zeros(
+                (n_reset, int(sum(actions_dim))), np.float32
+            )
+            reset_data["rewards"] = np.zeros((n_reset, 1), np.float32)
+            reset_data["is_first"] = np.ones((n_reset, 1), np.float32)
+            if buffer_type == "episode":
+                for col, d in enumerate(dones_idxes):
+                    if len(episode_steps[d]) >= args.per_rank_sequence_length:
+                        ep = {
+                            k: np.stack([s[k] for s in episode_steps[d]])
+                            for k in episode_steps[d][0]
+                        }
+                        rb.add(ep)
+                    episode_steps[d] = [{k: v[col] for k, v in reset_data.items()}]
+            else:
+                rb.add({k: v[None] for k, v in reset_data.items()}, dones_idxes)
+            step_data["dones"][dones_idxes] = 0.0
+            reset_mask = np.zeros((args.num_envs,), np.float32)
+            reset_mask[dones_idxes] = 1.0
+            player_state = player.reset_states(player_state, jnp.asarray(reset_mask))
+
+        step_before_training -= 1
+
+        can_sample = (
+            rb.buffer is not None and len(rb.buffer) > 0
+            if buffer_type == "episode"
+            else True
+        )
+        if global_step >= learning_starts and step_before_training <= 0 and can_sample:
+            n_samples = (
+                args.pretrain_steps
+                if global_step == learning_starts and not args.dry_run
+                else args.gradient_steps
+            )
+            if buffer_type == "sequential":
+                local_data = rb.sample(
+                    args.per_rank_batch_size,
+                    sequence_length=args.per_rank_sequence_length,
+                    n_samples=n_samples,
+                )
+            else:
+                local_data = rb.sample(
+                    args.per_rank_batch_size,
+                    n_samples=n_samples,
+                    prioritize_ends=args.prioritize_ends,
+                )
+            train_step = train_step_exploring if is_exploring else train_step_task
+            for i in range(n_samples):
+                tau = 1.0 if gradient_steps % args.critic_target_network_update_freq == 0 else 0.0
+                sample = {
+                    k: jnp.asarray(v[i]).astype(
+                        jnp.float32 if v.dtype != np.uint8 else jnp.uint8
+                    )
+                    for k, v in local_data.items()
+                }
+                if n_dev > 1 and args.per_rank_batch_size % n_dev == 0:
+                    sample = shard_batch(sample, mesh, axis=1)
+                key, train_key = jax.random.split(key)
+                state, metrics = train_step(state, sample, train_key, jnp.float32(tau))
+                gradient_steps += 1
+                for name, val in metrics.items():
+                    aggregator.update(name, val)
+            player = make_player(state, exploring=is_exploring)
+            step_before_training = args.train_every // single_global_step
+            if args.expl_decay:
+                expl_decay_steps += 1
+                expl_amount = ops.polynomial_decay(
+                    expl_decay_steps,
+                    initial=args.expl_amount,
+                    final=args.expl_min,
+                    max_decay_steps=max_step_expl_decay,
+                )
+            aggregator.update("Params/exploration_amount", expl_amount)
+
+        sps = (global_step - start_step + 1) * single_global_step / (
+            time.perf_counter() - start_time
+        )
+        logger.log_dict(aggregator.compute(), global_step)
+        logger.log("Time/step_per_second", sps, global_step)
+        aggregator.reset()
+
+        if (
+            (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
+            or args.dry_run
+            or global_step == num_updates
+        ):
+            ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
+            save_checkpoint(
+                ckpt_path,
+                {
+                    "world_model": state.world_model,
+                    "actor_task": state.actor_task,
+                    "critic_task": state.critic_task,
+                    "target_critic_task": state.target_critic_task,
+                    "ensembles": state.ensembles,
+                    "world_optimizer": state.world_opt,
+                    "actor_task_optimizer": state.actor_task_opt,
+                    "critic_task_optimizer": state.critic_task_opt,
+                    "ensemble_optimizer": state.ensemble_opt,
+                    "expl_decay_steps": expl_decay_steps,
+                    "global_step": global_step,
+                    "batch_size": args.per_rank_batch_size,
+                    "actor_exploration": state.actor_exploration,
+                    "critic_exploration": state.critic_exploration,
+                    "target_critic_exploration": state.target_critic_exploration,
+                    "actor_exploration_optimizer": state.actor_exploration_opt,
+                    "critic_exploration_optimizer": state.critic_exploration_opt,
+                },
+                args=args,
+            )
+            if args.checkpoint_buffer:
+                rb.save(ckpt_path + "_buffer.npz")
+
+    envs.close()
+    player = make_player(state, exploring=False)
+    test(player, logger, args, cnn_keys, mlp_keys, log_dir, "few-shot")
+    logger.close()
+
+
+if __name__ == "__main__":
+    main()

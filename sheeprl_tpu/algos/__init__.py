@@ -5,7 +5,10 @@ ImportError-tolerant so an optional env extra never breaks the CLI
 
 _ALGO_MODULES = [
     "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.ppo.ppo_decoupled",
+    "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
     "sheeprl_tpu.algos.sac.sac",
+    "sheeprl_tpu.algos.sac.sac_decoupled",
     "sheeprl_tpu.algos.droq.droq",
     "sheeprl_tpu.algos.sac_ae.sac_ae",
     "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",

@@ -1,0 +1,216 @@
+"""PPO, decoupled player/trainer — capability parity with
+/root/reference/sheeprl/algos/ppo/ppo_decoupled.py.
+
+Topology (see sheeprl_tpu/parallel/decoupled.py): the reference's rank-0
+player + DDP-trainer-subgroup processes become one SPMD program over
+disjoint sub-meshes — the player device runs env interaction and policy
+inference; the trainer mesh runs the SAME single-jit PPO update as the
+coupled task with the rollout sharded on its data axis. The pickled-object
+scatter and flattened-parameter broadcast (reference
+ppo_decoupled.py:294-307) are typed pytree `device_put`s riding ICI; the
+shutdown sentinel and `Join` uneven-input machinery disappear (one program,
+statically-sharded batches).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import ops
+from ...data import ReplayBuffer
+from ...envs import make_vector_env
+from ...parallel import make_decoupled_meshes
+from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.env import make_dict_env
+from ...utils.logger import create_logger
+from ...utils.metric import MetricAggregator
+from ...utils.parser import DataclassArgumentParser
+from ...utils.registry import register_algorithm
+from .agent import PPOAgent, one_hot_to_env_actions
+from .args import PPOArgs
+from .ppo import (
+    TrainState,
+    actions_dim_of,
+    compute_gae_returns,
+    make_optimizer,
+    make_train_step,
+    policy_step,
+    test,
+    validate_obs_keys,
+)
+
+
+@register_algorithm()
+def main(argv: Sequence[str] | None = None) -> None:
+    parser = DataclassArgumentParser(PPOArgs)
+    (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.checkpoint_path:
+        saved = load_checkpoint_args(args.checkpoint_path)
+        if saved:
+            saved.update(checkpoint_path=args.checkpoint_path)
+            (args,) = parser.parse_dict(saved)
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    np.random.seed(args.seed)
+    key = jax.random.PRNGKey(args.seed)
+    meshes = make_decoupled_meshes(args.num_devices)
+
+    logger, log_dir, run_name = create_logger(args, "ppo_decoupled")
+    logger.log_hyperparams(args.as_dict())
+
+    envs = make_vector_env(
+        [
+            make_dict_env(
+                args.env_id, args.seed + i, rank=0, args=args,
+                run_name=log_dir, vector_env_idx=i, mask_velocities=args.mask_vel,
+            )
+            for i in range(args.num_envs)
+        ],
+        sync=args.sync_env or args.num_envs == 1,
+    )
+    cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
+    obs_keys = [*cnn_keys, *mlp_keys]
+    actions_dim, is_continuous = actions_dim_of(envs.single_action_space)
+
+    key, agent_key = jax.random.split(key)
+    agent = PPOAgent.init(
+        agent_key, actions_dim, envs.single_observation_space.spaces,
+        cnn_keys, mlp_keys,
+        cnn_features_dim=args.cnn_features_dim, mlp_features_dim=args.mlp_features_dim,
+        screen_size=args.screen_size, mlp_layers=args.mlp_layers,
+        dense_units=args.dense_units, dense_act=args.dense_act,
+        layer_norm=args.layer_norm, is_continuous=is_continuous,
+    )
+    optimizer = make_optimizer(args)
+    state = TrainState(agent=agent, opt_state=optimizer.init(agent))
+    start_update = 1
+    if args.checkpoint_path:
+        ckpt = load_checkpoint(
+            args.checkpoint_path,
+            {"agent": agent, "optimizer": state.opt_state, "update_step": 0},
+        )
+        state = TrainState(agent=ckpt["agent"], opt_state=ckpt["optimizer"])
+        start_update = int(ckpt["update_step"]) + 1
+    # trainers hold the replicated train state; the player holds a policy copy
+    state = meshes.replicated_on_trainers(state)
+    player_agent = meshes.to_player(state.agent)
+
+    rollout_and_train_size = args.rollout_steps * args.num_envs
+    num_updates = (
+        args.total_steps // rollout_and_train_size if not args.dry_run else start_update
+    )
+    global_batch_size = args.per_rank_batch_size * meshes.num_trainers
+    num_minibatches = max(rollout_and_train_size // global_batch_size, 1)
+    train_step = make_train_step(args, optimizer, num_minibatches)
+
+    rb = ReplayBuffer(
+        args.rollout_steps, args.num_envs,
+        storage="host" if args.memmap_buffer else "device",
+        obs_keys=tuple(obs_keys), seed=args.seed,
+    )
+
+    aggregator = MetricAggregator()
+    obs, _ = envs.reset(seed=args.seed)
+    next_done = np.zeros(args.num_envs, dtype=np.float32)
+    global_step = 0
+    start_time = time.perf_counter()
+
+    for update in range(start_update, num_updates + 1):
+        lr = ops.polynomial_decay(
+            update, initial=args.lr, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_lr else args.lr
+        clip_coef = ops.polynomial_decay(
+            update, initial=args.clip_coef, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_clip_coef else args.clip_coef
+        ent_coef = ops.polynomial_decay(
+            update, initial=args.ent_coef, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_ent_coef else args.ent_coef
+
+        # ---- player: rollout with the latest policy copy --------------------
+        for _ in range(args.rollout_steps):
+            key, step_key = jax.random.split(key)
+            device_obs = {
+                k: jax.device_put(jnp.asarray(obs[k]), meshes.player_device)
+                for k in obs_keys
+            }
+            actions, logprob, value = policy_step(player_agent, device_obs, step_key)
+            env_actions = one_hot_to_env_actions(actions, actions_dim, is_continuous)
+            next_obs, rewards, terms, truncs, infos = envs.step(list(env_actions))
+            dones = (terms | truncs).astype(np.float32)
+            row = {k: np.asarray(obs[k])[None] for k in obs_keys}
+            row.update(
+                actions=np.asarray(actions)[None],
+                logprobs=np.asarray(logprob)[None],
+                values=np.asarray(value)[None],
+                rewards=rewards[None, :, None],
+                dones=next_done[None, :, None],
+            )
+            rb.add(row)
+            global_step += args.num_envs
+            next_done = dones
+            obs = next_obs
+            for info in infos:
+                if "episode" in info:
+                    aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
+                    aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
+
+        # ---- player: GAE, then ship the rollout to the trainer mesh ---------
+        data = {
+            k: jnp.asarray(rb[k])
+            for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")
+        }
+        device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+        returns, advantages = compute_gae_returns(
+            player_agent, data, device_next_obs, jnp.asarray(next_done)[:, None],
+            args.gamma, args.gae_lambda,
+        )
+        data["returns"], data["advantages"] = returns, advantages
+        flat = {
+            k: v.reshape((-1,) + v.shape[2:])
+            for k, v in data.items()
+            if k not in ("rewards", "dones")
+        }
+        flat = meshes.to_trainers(flat)  # the data path (ICI, typed pytree)
+
+        # ---- trainers: the coupled single-jit update over the trainer mesh --
+        key, train_key = jax.random.split(key)
+        state, metrics = train_step(
+            state, flat, train_key,
+            jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
+        )
+        # the weight path: updated params back to the player device
+        player_agent = meshes.to_player(state.agent)
+        for name, val in metrics.items():
+            aggregator.update(name, val)
+
+        sps = global_step / (time.perf_counter() - start_time)
+        logger.log_dict(aggregator.compute(), global_step)
+        logger.log("Time/step_per_second", sps, global_step)
+        logger.log("Info/learning_rate", lr, global_step)
+        aggregator.reset()
+        if (
+            args.checkpoint_every > 0 and update % args.checkpoint_every == 0
+        ) or args.dry_run or update == num_updates:
+            save_checkpoint(
+                os.path.join(log_dir, "checkpoints", f"ckpt_{update}"),
+                {"agent": state.agent, "optimizer": state.opt_state, "update_step": update},
+                args=args,
+            )
+
+    envs.close()
+    test_env = make_dict_env(
+        args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
+    )()
+    test(player_agent, test_env, logger, args)
+    logger.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Recurrent PPO agent: separate actor and critic LSTMs with optional
+pre-LSTM projections (capability parity with
+/root/reference/sheeprl/algos/ppo_recurrent/agent.py:11-151).
+
+TPU-first: sequence forwards run the LSTM cell under `jax.lax.scan`
+(`nn.scan_cell`), with optional per-step state resets expressed as a mask
+inside the scan — replacing torch's pack/pad_packed_sequence machinery
+(reference agent.py:95-122) with static-shape masked arithmetic."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+
+__all__ = ["RecurrentPPOAgent", "RecurrentState"]
+
+# ((actor_h, actor_c), (critic_h, critic_c)), each [N, H]
+RecurrentState = tuple[tuple[jax.Array, jax.Array], tuple[jax.Array, jax.Array]]
+
+
+class RecurrentPPOAgent(nn.Module):
+    actor_fc: nn.MLP | None
+    actor_rnn: nn.LSTMCell
+    actor_logits: nn.MLP
+    critic_fc: nn.MLP | None
+    critic_rnn: nn.LSTMCell
+    critic: nn.MLP
+    lstm_hidden_size: int = nn.static(default=64)
+
+    @classmethod
+    def init(
+        cls,
+        key,
+        observation_dim: int,
+        action_dim: int,
+        *,
+        lstm_hidden_size: int = 64,
+        actor_hidden_size: int = 128,
+        actor_pre_lstm_hidden_size: int | None = None,
+        critic_hidden_size: int = 128,
+        critic_pre_lstm_hidden_size: int | None = None,
+    ):
+        keys = jax.random.split(key, 6)
+        actor_fc = None
+        actor_in = observation_dim
+        if actor_pre_lstm_hidden_size is not None:
+            actor_fc = nn.MLP.init(
+                keys[0], observation_dim, [actor_pre_lstm_hidden_size],
+                lstm_hidden_size, act="relu",
+            )
+            actor_in = lstm_hidden_size
+        actor_rnn = nn.LSTMCell.init(keys[1], actor_in, lstm_hidden_size)
+        actor_logits = nn.MLP.init(
+            keys[2], lstm_hidden_size, [actor_hidden_size, actor_hidden_size],
+            action_dim, act="relu",
+        )
+        critic_fc = None
+        critic_in = observation_dim
+        if critic_pre_lstm_hidden_size is not None:
+            critic_fc = nn.MLP.init(
+                keys[3], observation_dim, [critic_pre_lstm_hidden_size],
+                lstm_hidden_size, act="relu",
+            )
+            critic_in = lstm_hidden_size
+        critic_rnn = nn.LSTMCell.init(keys[4], critic_in, lstm_hidden_size)
+        critic = nn.MLP.init(
+            keys[5], lstm_hidden_size, [critic_hidden_size, critic_hidden_size],
+            1, act="relu",
+        )
+        return cls(
+            actor_fc=actor_fc,
+            actor_rnn=actor_rnn,
+            actor_logits=actor_logits,
+            critic_fc=critic_fc,
+            critic_rnn=critic_rnn,
+            critic=critic,
+            lstm_hidden_size=lstm_hidden_size,
+        )
+
+    def initial_states(self, n_envs: int) -> RecurrentState:
+        z = jnp.zeros((n_envs, self.lstm_hidden_size))
+        return ((z, z), (z, z))
+
+    # -- sequence forwards ([L, B, D] inputs) --------------------------------
+    def get_logits(self, obs, actor_state, reset_mask=None):
+        x = self.actor_fc(obs) if self.actor_fc is not None else obs
+        actor_state, hidden = nn.scan_cell(
+            self.actor_rnn, x, actor_state, reset_mask=reset_mask
+        )
+        return self.actor_logits(hidden), actor_state
+
+    def get_values(self, obs, critic_state, reset_mask=None):
+        x = self.critic_fc(obs) if self.critic_fc is not None else obs
+        critic_state, hidden = nn.scan_cell(
+            self.critic_rnn, x, critic_state, reset_mask=reset_mask
+        )
+        return self.critic(hidden), critic_state
+
+    def __call__(self, obs, state: RecurrentState, reset_mask=None):
+        """-> (logits [L,B,A], values [L,B,1], new state)."""
+        actor_state, critic_state = state
+        logits, actor_state = self.get_logits(obs, actor_state, reset_mask)
+        values, critic_state = self.get_values(obs, critic_state, reset_mask)
+        return logits, values, (actor_state, critic_state)
+
+    # -- single interaction step ([N, D] inputs) -----------------------------
+    def step(self, obs, state: RecurrentState, key=None):
+        """-> (action [N], logprob [N,1], value [N,1], new state); greedy
+        when `key` is None (reference get_greedy_action, agent.py:86-92)."""
+        (ah, ac), (ch, cc) = state
+        x_a = self.actor_fc(obs) if self.actor_fc is not None else obs
+        _, (ah, ac) = self.actor_rnn(x_a, (ah, ac))
+        logits = self.actor_logits(ah)
+        x_c = self.critic_fc(obs) if self.critic_fc is not None else obs
+        _, (ch, cc) = self.critic_rnn(x_c, (ch, cc))
+        value = self.critic(ch)
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        if key is None:
+            action = jnp.argmax(logits, axis=-1)
+        else:
+            action = jax.random.categorical(key, logits, axis=-1)
+        logprob = jnp.take_along_axis(log_probs, action[..., None], axis=-1)
+        return action, logprob, value, ((ah, ac), (ch, cc))

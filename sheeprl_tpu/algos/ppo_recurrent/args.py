@@ -1,0 +1,33 @@
+"""Recurrent PPO config (capability parity with
+/root/reference/sheeprl/algos/ppo_recurrent/args.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ...utils.parser import Arg
+from ..ppo.args import PPOArgs
+
+
+@dataclasses.dataclass
+class RecurrentPPOArgs(PPOArgs):
+    share_data: bool = Arg(default=False, help="toggle sharing data between processes")
+    per_rank_batch_size: int = Arg(default=64, help="the training sequence length")
+    per_rank_num_batches: int = Arg(
+        default=4, help="the number of sequence minibatches per PPO epoch"
+    )
+    reset_recurrent_state_on_done: bool = Arg(
+        default=False, help="reset the recurrent state when a done is received"
+    )
+    lstm_hidden_size: int = Arg(default=64, help="the dimension of the LSTM hidden size")
+    actor_hidden_size: int = Arg(default=64, help="hidden size of the post-LSTM actor head")
+    critic_hidden_size: int = Arg(default=64, help="hidden size of the post-LSTM critic head")
+    actor_pre_lstm_hidden_size: Optional[int] = Arg(
+        default=64,
+        help="hidden size of the single-layer pre-LSTM actor network; None disables it",
+    )
+    critic_pre_lstm_hidden_size: Optional[int] = Arg(
+        default=64,
+        help="hidden size of the single-layer pre-LSTM critic network; None disables it",
+    )

@@ -1,0 +1,354 @@
+"""Recurrent PPO (LSTM actor/critic) — capability parity with
+/root/reference/sheeprl/algos/ppo_recurrent/ppo_recurrent.py.
+
+TPU-first structure:
+  - the rollout stores, per step, the observation AND the four LSTM state
+    tensors (reference ppo_recurrent.py:240-249), so training can replay
+    the exact recurrent-state trajectory;
+  - training runs on FIXED-length windows of the rollout (`seq_len =
+    per_rank_batch_size`), each initialized from its stored entry state —
+    an XLA-static reformulation of the reference's variable-length
+    episode-split + pad/pack pipeline (ppo_recurrent.py:295-319): both
+    replay identical state trajectories, but fixed windows compile once and
+    waste no padding. When `reset_recurrent_state_on_done` is set, the
+    in-window episode boundaries zero the state inside the scan
+    (`nn.scan_cell`'s reset mask), matching the rollout-side resets;
+  - the whole update (epochs x sequence minibatches) is ONE jitted call,
+    like the PPO task.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from functools import partial
+from typing import Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ... import nn, ops
+from ...data import ReplayBuffer
+from ...envs import make_vector_env
+from ...parallel import make_mesh, replicate
+from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.env import make_dict_env
+from ...utils.logger import create_logger
+from ...utils.metric import MetricAggregator
+from ...utils.parser import DataclassArgumentParser
+from ...utils.registry import register_algorithm
+from ..ppo.loss import entropy_loss, policy_loss, value_loss
+from ..ppo.ppo import make_optimizer
+from .agent import RecurrentPPOAgent
+from .args import RecurrentPPOArgs
+
+
+class TrainState(nn.Module):
+    agent: RecurrentPPOAgent
+    opt_state: object
+
+
+@jax.jit
+def policy_step(agent: RecurrentPPOAgent, obs, state, key):
+    return agent.step(obs, state, key)
+
+
+def make_train_step(args: RecurrentPPOArgs, optimizer, seq_len: int, num_minibatches: int):
+    """Build the single-jit recurrent-PPO update: window reshaping + GAE are
+    done by the caller; here scan(epochs) x scan(sequence minibatches) with
+    stored-state initialization."""
+
+    def loss_fn(agent, batch, clip_coef, ent_coef):
+        state = (
+            (batch["actor_hxs"][0], batch["actor_cxs"][0]),
+            (batch["critic_hxs"][0], batch["critic_cxs"][0]),
+        )
+        reset_mask = (
+            batch["dones"][..., 0] if args.reset_recurrent_state_on_done else None
+        )
+        logits, new_values, _ = agent(batch["observations"], state, reset_mask)
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        new_logprob = jnp.take_along_axis(
+            log_probs, batch["actions"].astype(jnp.int32), axis=-1
+        )
+        entropy = -jnp.sum(jnp.exp(log_probs) * log_probs, axis=-1)[..., None]
+        adv = batch["advantages"]
+        if args.normalize_advantages:
+            adv = ops.normalize(adv)
+        pg = policy_loss(
+            new_logprob, batch["logprobs"], adv, clip_coef, args.loss_reduction
+        )
+        vf = value_loss(
+            new_values, batch["values"], batch["returns"], clip_coef,
+            args.clip_vloss, args.loss_reduction,
+        )
+        ent = entropy_loss(entropy, args.loss_reduction)
+        total = pg + args.vf_coef * vf + ent_coef * ent
+        return total, (pg, vf, ent)
+
+    def train_step(state: TrainState, data: dict, key, lr, clip_coef, ent_coef):
+        n_seq = data["logprobs"].shape[1]
+        mb_size = max(n_seq // num_minibatches, 1)
+
+        def minibatch_body(carry, idx):
+            agent, opt_state = carry
+            batch = jax.tree_util.tree_map(lambda x: x[:, idx], data)
+            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                agent, batch, clip_coef, ent_coef
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, agent)
+            updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
+            agent = optax.apply_updates(agent, updates)
+            return (agent, opt_state), aux
+
+        def epoch_body(carry, ep_key):
+            perm = jax.random.permutation(ep_key, n_seq)
+            idxes = perm[: num_minibatches * mb_size].reshape(num_minibatches, mb_size)
+            return jax.lax.scan(minibatch_body, carry, idxes)
+
+        epoch_keys = jax.random.split(key, args.update_epochs)
+        (agent, opt_state), aux = jax.lax.scan(
+            epoch_body, (state.agent, state.opt_state), epoch_keys
+        )
+        pg, vf, ent = jax.tree_util.tree_map(jnp.mean, aux)
+        return TrainState(agent=agent, opt_state=opt_state), {
+            "Loss/policy_loss": pg,
+            "Loss/value_loss": vf,
+            "Loss/entropy_loss": ent,
+        }
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def _to_windows(data: dict, seq_len: int) -> dict:
+    """[T, N, *] rollout -> [L, W*N, *] fixed-length sequences (window w of
+    env n becomes sequence w*N + n)."""
+
+    def reshape(x):
+        T, N = x.shape[:2]
+        W = T // seq_len
+        x = x[: W * seq_len].reshape(W, seq_len, N, *x.shape[2:])
+        return jnp.concatenate(list(x), axis=1)  # [L, W*N, *]
+
+    return {k: reshape(v) for k, v in data.items()}
+
+
+def test(agent: RecurrentPPOAgent, env: gym.Env, logger, args, obs_key: str) -> float:
+    """Greedy evaluation with recurrent state threading (reference
+    ppo_recurrent/utils.py)."""
+    obs, _ = env.reset(seed=args.seed)
+    state = agent.initial_states(1)
+    step = jax.jit(lambda a, o, s: a.step(o, s, None))
+    done, cumulative_reward = False, 0.0
+    while not done:
+        device_obs = jnp.asarray(obs[obs_key], jnp.float32)[None]
+        action, _, _, state = step(agent, device_obs, state)
+        obs, reward, terminated, truncated, _ = env.step(int(action[0]))
+        done = terminated or truncated
+        cumulative_reward += float(reward)
+    logger.log("Test/cumulative_reward", cumulative_reward, 0)
+    env.close()
+    return cumulative_reward
+
+
+@register_algorithm()
+def main(argv: Sequence[str] | None = None) -> None:
+    parser = DataclassArgumentParser(RecurrentPPOArgs)
+    (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.checkpoint_path:
+        saved = load_checkpoint_args(args.checkpoint_path)
+        if saved:
+            saved.update(checkpoint_path=args.checkpoint_path)
+            (args,) = parser.parse_dict(saved)
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    np.random.seed(args.seed)
+    key = jax.random.PRNGKey(args.seed)
+    mesh = make_mesh(args.num_devices)
+
+    logger, log_dir, run_name = create_logger(args, "ppo_recurrent")
+    logger.log_hyperparams(args.as_dict())
+
+    envs = make_vector_env(
+        [
+            make_dict_env(
+                args.env_id, args.seed + i, rank=0, args=args,
+                run_name=log_dir, vector_env_idx=i, mask_velocities=args.mask_vel,
+            )
+            for i in range(args.num_envs)
+        ],
+        sync=args.sync_env or args.num_envs == 1,
+    )
+    if not isinstance(envs.single_action_space, gym.spaces.Discrete):
+        raise ValueError("only discrete action spaces are supported by recurrent PPO")
+    mlp_keys = [
+        k for k, s in envs.single_observation_space.spaces.items() if len(s.shape) == 1
+    ]
+    if not mlp_keys:
+        raise ValueError(
+            "only vector observations are supported by recurrent PPO; "
+            f"env provides {sorted(envs.single_observation_space.spaces)}"
+        )
+    obs_key = mlp_keys[0]
+    obs_dim = int(np.prod(envs.single_observation_space.spaces[obs_key].shape))
+    action_dim = int(envs.single_action_space.n)
+
+    key, agent_key = jax.random.split(key)
+    agent = RecurrentPPOAgent.init(
+        agent_key,
+        obs_dim,
+        action_dim,
+        lstm_hidden_size=args.lstm_hidden_size,
+        actor_hidden_size=args.actor_hidden_size,
+        actor_pre_lstm_hidden_size=args.actor_pre_lstm_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        critic_pre_lstm_hidden_size=args.critic_pre_lstm_hidden_size,
+    )
+    optimizer = make_optimizer(args)
+    state = TrainState(agent=agent, opt_state=optimizer.init(agent))
+    start_update = 1
+    if args.checkpoint_path:
+        ckpt = load_checkpoint(
+            args.checkpoint_path,
+            {"agent": agent, "optimizer": state.opt_state, "update_step": 0},
+        )
+        state = TrainState(agent=ckpt["agent"], opt_state=ckpt["optimizer"])
+        start_update = int(ckpt["update_step"]) + 1
+    state = replicate(state, mesh)
+
+    seq_len = min(args.per_rank_batch_size, args.rollout_steps)
+    n_windows = args.rollout_steps // seq_len
+    n_sequences = n_windows * args.num_envs
+    num_minibatches = (
+        min(args.per_rank_num_batches, n_sequences)
+        if args.per_rank_num_batches > 0
+        else 1
+    )
+    train_step = make_train_step(args, optimizer, seq_len, num_minibatches)
+
+    rb = ReplayBuffer(
+        args.rollout_steps, args.num_envs,
+        storage="host" if args.memmap_buffer else "device",
+        obs_keys=("observations",), seed=args.seed,
+    )
+
+    aggregator = MetricAggregator()
+    obs, _ = envs.reset(seed=args.seed)
+    next_obs = np.asarray(obs[obs_key], np.float32)
+    next_done = np.zeros((args.num_envs, 1), np.float32)
+    agent_state = state.agent.initial_states(args.num_envs)
+    num_updates = (
+        args.total_steps // (args.rollout_steps * args.num_envs)
+        if not args.dry_run
+        else start_update
+    )
+    global_step = 0
+    start_time = time.perf_counter()
+
+    for update in range(start_update, num_updates + 1):
+        lr = ops.polynomial_decay(
+            update, initial=args.lr, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_lr else args.lr
+        clip_coef = ops.polynomial_decay(
+            update, initial=args.clip_coef, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_clip_coef else args.clip_coef
+        ent_coef = ops.polynomial_decay(
+            update, initial=args.ent_coef, final=0.0, max_decay_steps=num_updates
+        ) if args.anneal_ent_coef else args.ent_coef
+
+        # ---- rollout hot loop ------------------------------------------------
+        for _ in range(args.rollout_steps):
+            key, step_key = jax.random.split(key)
+            row = {
+                "observations": next_obs[None],
+                "dones": next_done[None],
+                "actor_hxs": np.asarray(agent_state[0][0])[None],
+                "actor_cxs": np.asarray(agent_state[0][1])[None],
+                "critic_hxs": np.asarray(agent_state[1][0])[None],
+                "critic_cxs": np.asarray(agent_state[1][1])[None],
+            }
+            action, logprob, value, new_state = policy_step(
+                state.agent, jnp.asarray(next_obs), agent_state, step_key
+            )
+            env_actions = [int(a) for a in np.asarray(action)]
+            obs, rewards, terms, truncs, infos = envs.step(env_actions)
+            dones = np.logical_or(terms, truncs).astype(np.float32)
+            row.update(
+                actions=np.asarray(action, np.float32)[None, :, None],
+                logprobs=np.asarray(logprob)[None],
+                values=np.asarray(value)[None],
+                rewards=rewards[None, :, None],
+            )
+            rb.add(row)
+            global_step += args.num_envs
+            next_obs = np.asarray(obs[obs_key], np.float32)
+            next_done = dones[:, None]
+            if args.reset_recurrent_state_on_done:
+                d = jnp.asarray(dones)[:, None]
+                agent_state = jax.tree_util.tree_map(
+                    lambda s: (1.0 - d) * s, new_state
+                )
+            else:
+                agent_state = new_state
+            for info in infos:
+                if "episode" in info:
+                    aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
+                    aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
+
+        # ---- GAE + one-jit update -------------------------------------------
+        data = {
+            k: jnp.asarray(rb[k])
+            for k in (
+                "observations", "dones", "actions", "logprobs", "values", "rewards",
+                "actor_hxs", "actor_cxs", "critic_hxs", "critic_cxs",
+            )
+        }
+        next_value, _ = jax.jit(state.agent.get_values)(
+            jnp.asarray(next_obs)[None], agent_state[1]
+        )
+        returns, advantages = ops.gae(
+            data["rewards"], data["values"], data["dones"],
+            next_value[0], jnp.asarray(next_done), args.gamma, args.gae_lambda,
+        )
+        data["returns"], data["advantages"] = returns, advantages
+        windows = _to_windows(data, seq_len)
+        key, train_key = jax.random.split(key)
+        state, metrics = train_step(
+            state, windows, train_key,
+            jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
+        )
+        for name, val in metrics.items():
+            aggregator.update(name, val)
+
+        sps = global_step / (time.perf_counter() - start_time)
+        logger.log_dict(aggregator.compute(), global_step)
+        logger.log("Time/step_per_second", sps, global_step)
+        logger.log("Info/learning_rate", lr, global_step)
+        aggregator.reset()
+        if (
+            args.checkpoint_every > 0 and update % args.checkpoint_every == 0
+        ) or args.dry_run or update == num_updates:
+            save_checkpoint(
+                os.path.join(log_dir, "checkpoints", f"ckpt_{update}"),
+                {
+                    "agent": state.agent,
+                    "optimizer": state.opt_state,
+                    "update_step": update,
+                },
+                args=args,
+            )
+
+    envs.close()
+    test_env = make_dict_env(
+        args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
+    )()
+    test(state.agent, test_env, logger, args, obs_key)
+    logger.close()
+
+
+if __name__ == "__main__":
+    main()

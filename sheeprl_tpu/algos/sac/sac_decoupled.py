@@ -1,0 +1,229 @@
+"""SAC, decoupled player/trainer — capability parity with
+/root/reference/sheeprl/algos/sac/sac_decoupled.py.
+
+Topology (see sheeprl_tpu/parallel/decoupled.py): the player device owns
+the envs, the replay buffer and policy inference; the trainer mesh runs the
+SAME scanned update phase as the coupled SAC task with the sampled batches
+sharded on their batch axis. The player's chunked sample scatter and the
+flattened-parameter return (reference sac_decoupled.py:180-184, 367-404)
+become typed pytree `device_put`s between the sub-meshes.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data import ReplayBuffer
+from ...envs import make_vector_env
+from ...parallel import make_decoupled_meshes
+from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.env import make_env
+from ...utils.logger import create_logger
+from ...utils.metric import MetricAggregator
+from ...utils.parser import DataclassArgumentParser
+from ...utils.registry import register_algorithm
+from .agent import SACAgent
+from .args import SACArgs
+from .sac import TrainState, make_optimizers, make_train_step, policy_step
+from .utils import test
+
+
+@register_algorithm()
+def main(argv: Sequence[str] | None = None) -> None:
+    parser = DataclassArgumentParser(SACArgs)
+    (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.checkpoint_path:
+        saved = load_checkpoint_args(args.checkpoint_path)
+        if saved:
+            saved.update(checkpoint_path=args.checkpoint_path)
+            (args,) = parser.parse_dict(saved)
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    np.random.seed(args.seed)
+    key = jax.random.PRNGKey(args.seed)
+    meshes = make_decoupled_meshes(args.num_devices)
+
+    logger, log_dir, run_name = create_logger(args, "sac_decoupled")
+    logger.log_hyperparams(args.as_dict())
+
+    envs = make_vector_env(
+        [
+            make_env(
+                args.env_id, args.seed + i, 0, args.capture_video,
+                run_name=log_dir, prefix="train", vector_env_idx=i,
+                action_repeat=args.action_repeat,
+            )
+            for i in range(args.num_envs)
+        ],
+        sync=args.sync_env or args.num_envs == 1,
+    )
+    if not isinstance(envs.single_action_space, gym.spaces.Box):
+        raise ValueError("only continuous action spaces are supported by SAC")
+    if len(envs.single_observation_space.shape) > 1:
+        raise ValueError(
+            "only vector observations are supported by SAC; "
+            f"got shape {envs.single_observation_space.shape}"
+        )
+    obs_dim = int(np.prod(envs.single_observation_space.shape))
+    act_dim = int(np.prod(envs.single_action_space.shape))
+
+    key, agent_key = jax.random.split(key)
+    agent = SACAgent.init(
+        agent_key, obs_dim, act_dim,
+        num_critics=args.num_critics,
+        actor_hidden_size=args.actor_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        action_low=envs.single_action_space.low,
+        action_high=envs.single_action_space.high,
+        alpha=args.alpha, tau=args.tau,
+    )
+    qf_optim, actor_optim, alpha_optim = make_optimizers(args)
+    state = TrainState(
+        agent=agent,
+        qf_opt=qf_optim.init(agent.critics),
+        actor_opt=actor_optim.init(agent.actor),
+        alpha_opt=alpha_optim.init(agent.log_alpha),
+    )
+    train_step = make_train_step(args, qf_optim, actor_optim, alpha_optim)
+
+    min_size = 2 if args.sample_next_obs else 1
+    buffer_size = (
+        max(args.buffer_size // args.num_envs, min_size) if not args.dry_run else min_size
+    )
+    rb = ReplayBuffer(
+        buffer_size, args.num_envs,
+        storage="host" if args.memmap_buffer else "device",
+        memmap_dir=os.path.join(log_dir, "memmap_buffer") if args.memmap_buffer else None,
+        obs_keys=("observations",), seed=args.seed,
+    )
+
+    start_step = 1
+    if args.checkpoint_path:
+        ckpt = load_checkpoint(
+            args.checkpoint_path,
+            {
+                "agent": state.agent, "qf_optimizer": state.qf_opt,
+                "actor_optimizer": state.actor_opt, "alpha_optimizer": state.alpha_opt,
+                "global_step": 0,
+            },
+        )
+        state = TrainState(
+            agent=ckpt["agent"], qf_opt=ckpt["qf_optimizer"],
+            actor_opt=ckpt["actor_optimizer"], alpha_opt=ckpt["alpha_optimizer"],
+        )
+        start_step = int(ckpt["global_step"]) + 1
+        rb_state_path = args.checkpoint_path + ".buffer.npz"
+        if args.checkpoint_buffer and os.path.exists(rb_state_path):
+            rb.load(rb_state_path)
+    # trainers hold the replicated train state; the player holds an actor copy
+    state = meshes.replicated_on_trainers(state)
+    player_actor = meshes.to_player(state.agent.actor)
+
+    aggregator = MetricAggregator()
+    num_updates = (
+        int(args.total_steps // args.num_envs) if not args.dry_run else start_step
+    )
+    learning_starts = args.learning_starts // args.num_envs if not args.dry_run else 0
+
+    obs, _ = envs.reset(seed=args.seed)
+    obs = np.asarray(obs, dtype=np.float32)
+    start_time = time.perf_counter()
+
+    for global_step in range(start_step, num_updates + 1):
+        # ---- player: interaction + buffer -----------------------------------
+        if global_step < learning_starts:
+            actions = np.stack(
+                [envs.single_action_space.sample() for _ in range(args.num_envs)]
+            )
+        else:
+            key, step_key = jax.random.split(key)
+            device_obs = jax.device_put(jnp.asarray(obs), meshes.player_device)
+            actions = np.asarray(policy_step(player_actor, device_obs, step_key))
+        next_obs, rewards, terms, truncs, infos = envs.step(list(actions))
+        dones = np.logical_or(terms, truncs).astype(np.float32)
+
+        real_next_obs = np.asarray(next_obs, dtype=np.float32).copy()
+        for i, info in enumerate(infos):
+            if "final_observation" in info:
+                real_next_obs[i] = info["final_observation"]
+            if "episode" in info:
+                aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
+                aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
+
+        row = {
+            "observations": obs[None],
+            "actions": actions.reshape(args.num_envs, -1)[None].astype(np.float32),
+            "rewards": rewards.reshape(args.num_envs, 1)[None],
+            "dones": dones.reshape(args.num_envs, 1)[None],
+        }
+        if not args.sample_next_obs:
+            row["next_observations"] = real_next_obs[None]
+        rb.add(row)
+        obs = np.asarray(next_obs, dtype=np.float32)
+
+        # ---- player samples; trainers update --------------------------------
+        if global_step >= learning_starts - 1 and rb.can_sample(args.sample_next_obs):
+            training_steps = (
+                learning_starts if global_step == learning_starts - 1 and learning_starts > 1 else 1
+            )
+            global_batch = args.per_rank_batch_size * meshes.num_trainers
+            for _ in range(training_steps):
+                sample = rb.sample(
+                    args.gradient_steps * global_batch,
+                    sample_next_obs=args.sample_next_obs,
+                )
+                data = {
+                    k: jnp.asarray(v).reshape(
+                        (args.gradient_steps, global_batch) + v.shape[1:]
+                    )
+                    for k, v in sample.items()
+                }
+                data = meshes.to_trainers(data, axis=1)  # the data path (ICI)
+                key, train_key = jax.random.split(key)
+                do_ema = jnp.asarray(global_step % args.target_network_frequency == 0)
+                state, metrics = train_step(state, data, train_key, do_ema)
+            # the weight path: refreshed actor back to the player device
+            player_actor = meshes.to_player(state.agent.actor)
+            for name, val in metrics.items():
+                aggregator.update(name, val)
+
+        sps = global_step / (time.perf_counter() - start_time)
+        logger.log_dict(aggregator.compute(), global_step)
+        logger.log("Time/step_per_second", sps, global_step)
+        aggregator.reset()
+        if (
+            (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
+            or args.dry_run
+            or global_step == num_updates
+        ):
+            ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
+            save_checkpoint(
+                ckpt_path,
+                {
+                    "agent": state.agent, "qf_optimizer": state.qf_opt,
+                    "actor_optimizer": state.actor_opt, "alpha_optimizer": state.alpha_opt,
+                    "global_step": global_step,
+                },
+                args=args,
+            )
+            if args.checkpoint_buffer:
+                rb.save(ckpt_path + ".buffer.npz")
+
+    envs.close()
+    test_env = make_env(
+        args.env_id, args.seed, 0, args.capture_video, run_name=log_dir, prefix="test"
+    )()
+    test(state.agent.actor, test_env, logger, args)
+    logger.close()
+
+
+if __name__ == "__main__":
+    main()

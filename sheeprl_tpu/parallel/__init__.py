@@ -1,3 +1,4 @@
+from .decoupled import DecoupledMeshes, make_decoupled_meshes
 from .mesh import (
     data_sharding,
     distributed_setup,
@@ -10,9 +11,11 @@ from .mesh import (
 )
 
 __all__ = [
+    "DecoupledMeshes",
     "data_sharding",
     "distributed_setup",
     "local_mesh_devices",
+    "make_decoupled_meshes",
     "make_mesh",
     "process_index",
     "replicate",

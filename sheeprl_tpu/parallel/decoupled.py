@@ -1,0 +1,100 @@
+"""Decoupled player/trainer topology over device sub-meshes.
+
+The TPU-native replacement for the reference's process-based decoupling
+(/root/reference/sheeprl/algos/ppo/ppo_decoupled.py:534-581: rank-0 player,
+ranks 1..N DDP trainers, pickled-TensorDict `scatter_object_list` for data
+and a flattened-parameter broadcast for weights). Here both roles live in
+one SPMD program over DISJOINT sub-meshes of the same device set:
+
+  - the PLAYER owns the envs and runs policy inference on its own device;
+  - the TRAINERS run the jitted update with the batch sharded over the
+    trainer mesh's data axis (XLA inserts the gradient all-reduce);
+  - the data path is a typed pytree `device_put` onto the trainer sharding
+    (device-to-device over ICI — replacing the pickled object scatter);
+  - the weight path is a pytree `device_put` of the updated params back to
+    the player device (replacing `parameters_to_vector`/broadcast,
+    ppo_decoupled.py:152-160);
+  - no shutdown sentinel is needed (single program, one control flow), and
+    uneven inputs cannot arise (batches are statically sharded), replacing
+    the reference's `Join` context (ppo_decoupled.py:439).
+
+Multi-host: the same construction over `jax.devices()` spanning the pod
+puts the player on host-0's first device and shards trainers across the
+rest; the `device_put`s ride ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import local_mesh_devices
+
+__all__ = ["DecoupledMeshes", "make_decoupled_meshes"]
+
+
+class DecoupledMeshes:
+    """Player device + trainer mesh with the data/weight transfer helpers."""
+
+    def __init__(self, player_device, trainer_mesh: Mesh):
+        self.player_device = player_device
+        self.trainer_mesh = trainer_mesh
+
+    @property
+    def num_trainers(self) -> int:
+        return self.trainer_mesh.devices.size
+
+    def to_trainers(self, batch: Any, axis: int = 0) -> Any:
+        """Ship a batch pytree onto the trainer mesh, sharded on `axis` —
+        the rollout/replay-sample data path (replacing
+        `scatter_object_list`, ppo_decoupled.py:294-297). When `axis` is not
+        divisible by the trainer count it is padded by wrapping around, the
+        same semantics as the reference's DistributedSampler padding."""
+        spec = [None] * (axis + 1)
+        spec[axis] = "data"
+        sharding = NamedSharding(self.trainer_mesh, P(*spec))
+        n = self.num_trainers
+
+        def put(x):
+            size = x.shape[axis]
+            rem = size % n
+            if rem:
+                idx = [slice(None)] * x.ndim
+                idx[axis] = np.arange(size, size + n - rem) % size
+                x = jnp.concatenate([x, x[tuple(idx)]], axis=axis)
+            return jax.device_put(x, sharding)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def replicated_on_trainers(self, tree: Any) -> Any:
+        """Replicate params across the trainer mesh (the trainer DDP
+        invariant)."""
+        sharding = NamedSharding(self.trainer_mesh, P())
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+    def to_player(self, tree: Any) -> Any:
+        """Ship (updated) params to the player device — the weight path
+        (replacing the flattened-vector broadcast, ppo_decoupled.py:304-307)."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self.player_device), tree
+        )
+
+
+def make_decoupled_meshes(
+    num_devices: int = -1, platform: str | None = None
+) -> DecoupledMeshes:
+    """First device -> player, the rest -> trainer mesh. Like the reference
+    (which requires >= 2 torchrun ranks, ppo_decoupled.py:545-551), the
+    topology needs at least 2 devices."""
+    devices = local_mesh_devices(num_devices, platform)
+    if len(devices) < 2:
+        raise RuntimeError(
+            f"decoupled player/trainer topology requires at least 2 devices, "
+            f"got {len(devices)}; run the coupled task instead"
+        )
+    trainer_mesh = Mesh(np.asarray(devices[1:]), ("data",))
+    return DecoupledMeshes(player_device=devices[0], trainer_mesh=trainer_mesh)

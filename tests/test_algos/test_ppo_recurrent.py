@@ -1,0 +1,62 @@
+"""End-to-end smoke tests for recurrent PPO (reference backbone:
+/root/reference/tests/test_algos/test_algos.py:214-283)."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent import main
+
+TINY = [
+    "--dry_run",
+    "--num_devices=1",
+    "--num_envs=2",
+    "--sync_env",
+    "--rollout_steps=8",
+    "--per_rank_batch_size=4",
+    "--per_rank_num_batches=2",
+    "--update_epochs=2",
+    "--lstm_hidden_size=8",
+    "--actor_hidden_size=8",
+    "--critic_hidden_size=8",
+    "--actor_pre_lstm_hidden_size=8",
+    "--critic_pre_lstm_hidden_size=8",
+    "--checkpoint_every=1",
+]
+
+
+@pytest.mark.parametrize("reset_on_done", [False, True])
+def test_ppo_recurrent_dry_run(tmp_path, reset_on_done):
+    argv = TINY + [
+        "--env_id=CartPole-v1",
+        f"--root_dir={tmp_path}",
+        "--run_name=test",
+    ]
+    if reset_on_done:
+        argv.append("--reset_recurrent_state_on_done")
+    main(argv)
+    ckpt_dir = os.path.join(tmp_path, "test", "checkpoints")
+    assert os.path.isdir(ckpt_dir)
+    assert any(e.startswith("ckpt_") for e in sorted(os.listdir(ckpt_dir)))
+
+
+def test_ppo_recurrent_resume(tmp_path):
+    main(
+        TINY
+        + ["--env_id=CartPole-v1", f"--root_dir={tmp_path}", "--run_name=test"]
+    )
+    ckpt_dir = os.path.join(tmp_path, "test", "checkpoints")
+    ckpts = [e for e in sorted(os.listdir(ckpt_dir)) if not e.endswith(".json")]
+    main([f"--checkpoint_path={os.path.join(ckpt_dir, ckpts[-1])}"])
+
+
+def test_ppo_recurrent_rejects_continuous(tmp_path):
+    with pytest.raises(ValueError, match="discrete"):
+        main(
+            TINY
+            + [
+                "--env_id=Pendulum-v1",
+                f"--root_dir={tmp_path}",
+                "--run_name=test",
+            ]
+        )

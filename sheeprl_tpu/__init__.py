@@ -8,4 +8,30 @@ data-parallel and player/trainer topologies over device meshes, TensorBoard
 metrics, and checkpoint/resume.
 """
 
+import os as _os
+
 __version__ = "0.1.0"
+
+
+def _load_dotenv(path: str = ".env") -> None:
+    """Load KEY=VALUE lines from a .env file into the environment without
+    overriding existing variables (reference sheeprl/__init__.py:1-3 uses
+    python-dotenv; stdlib parse here — the package is not in this image)."""
+    try:
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        if line.startswith("export "):
+            line = line[len("export "):]
+        key, _, value = line.partition("=")
+        key, value = key.strip(), value.strip().strip("'\"")
+        if key:
+            _os.environ.setdefault(key, value)
+
+
+_load_dotenv()

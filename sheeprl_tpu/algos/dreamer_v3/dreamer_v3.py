@@ -45,7 +45,14 @@ from ...ops.distributions import (
     MSEDistribution,
     SymlogDistribution,
 )
-from ...parallel import make_mesh, replicate, shard_batch
+from ...parallel import (
+    assert_divisible,
+    distributed_setup,
+    make_mesh,
+    process_index,
+    replicate,
+    shard_batch,
+)
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -367,11 +374,17 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     np.random.seed(args.seed)
+    distributed_setup()
+    rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
     mesh = make_mesh(args.num_devices)
     n_dev = mesh.devices.size
+    # the global batch (per-process batch x world) shards over the global mesh
+    assert_divisible(
+        args.per_rank_batch_size * world, n_dev, "per_rank_batch_size*world"
+    )
 
-    logger, log_dir, run_name = create_logger(args, "dreamer_v3")
+    logger, log_dir, run_name = create_logger(args, "dreamer_v3", process_index=rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
@@ -380,7 +393,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 RestartOnException,
                 partial(
                     make_dict_env(
-                        args.env_id, args.seed + i, rank=0, args=args,
+                        args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
                         run_name=log_dir, vector_env_idx=i,
                     )
                 ),
@@ -484,7 +497,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
 
     buffer_size = (
-        args.buffer_size // (args.num_envs * 1) if not args.dry_run else 2
+        args.buffer_size // (args.num_envs * world) if not args.dry_run else 2
     )
     rb = AsyncReplayBuffer(
         max(buffer_size, args.per_rank_sequence_length),
@@ -638,7 +651,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     )
                     for k, v in local_data.items()
                 }
-                if n_dev > 1 and args.per_rank_batch_size % n_dev == 0:
+                if n_dev > 1:
                     sample = shard_batch(sample, mesh, axis=1)
                 key, train_key = jax.random.split(key)
                 state, metrics = train_step(state, sample, train_key, jnp.float32(tau))

@@ -24,7 +24,14 @@ import optax
 from ... import nn
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
-from ...parallel import make_mesh, replicate, shard_batch
+from ...parallel import (
+    assert_divisible,
+    distributed_setup,
+    make_mesh,
+    process_index,
+    replicate,
+    shard_batch,
+)
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_env
 from ...utils.logger import create_logger
@@ -139,17 +146,19 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     np.random.seed(args.seed)
+    distributed_setup()
+    rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
     mesh = make_mesh(args.num_devices)
     n_dev = mesh.devices.size
 
-    logger, log_dir, run_name = create_logger(args, "droq")
+    logger, log_dir, run_name = create_logger(args, "droq", process_index=rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
         [
             make_env(
-                args.env_id, args.seed + i, 0, args.capture_video,
+                args.env_id, args.seed + rank * args.num_envs + i, rank, args.capture_video,
                 run_name=log_dir, prefix="train", vector_env_idx=i,
                 action_repeat=args.action_repeat,
             )
@@ -186,7 +195,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     min_size = 2 if args.sample_next_obs else 1
     buffer_size = (
-        max(args.buffer_size // args.num_envs, min_size) if not args.dry_run else min_size
+        max(args.buffer_size // (args.num_envs * world), min_size) if not args.dry_run else min_size
     )
     rb = ReplayBuffer(
         buffer_size, args.num_envs,

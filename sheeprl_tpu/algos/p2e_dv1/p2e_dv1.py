@@ -31,7 +31,14 @@ from ... import nn, ops
 from ...data import AsyncReplayBuffer
 from ...envs import make_vector_env
 from ...ops.distributions import Bernoulli, Independent, Normal
-from ...parallel import make_mesh, replicate, shard_batch
+from ...parallel import (
+    assert_divisible,
+    distributed_setup,
+    make_mesh,
+    process_index,
+    replicate,
+    shard_batch,
+)
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -388,17 +395,23 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     np.random.seed(args.seed)
+    distributed_setup()
+    rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
     mesh = make_mesh(args.num_devices)
     n_dev = mesh.devices.size
+    # the global batch (per-process batch x world) shards over the global mesh
+    assert_divisible(
+        args.per_rank_batch_size * world, n_dev, "per_rank_batch_size*world"
+    )
 
-    logger, log_dir, run_name = create_logger(args, "p2e_dv1")
+    logger, log_dir, run_name = create_logger(args, "p2e_dv1", process_index=rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
         [
             make_dict_env(
-                args.env_id, args.seed + i, rank=0, args=args,
+                args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
                 run_name=log_dir, vector_env_idx=i,
             )
             for i in range(args.num_envs)
@@ -492,7 +505,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         args, optimizers, cnn_keys, mlp_keys, exploring=False
     )
 
-    buffer_size = args.buffer_size // args.num_envs if not args.dry_run else 4
+    buffer_size = args.buffer_size // (args.num_envs * world) if not args.dry_run else 4
     rb = AsyncReplayBuffer(
         max(buffer_size, args.per_rank_sequence_length),
         args.num_envs,
@@ -638,7 +651,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     )
                     for k, v in local_data.items()
                 }
-                if n_dev > 1 and args.per_rank_batch_size % n_dev == 0:
+                if n_dev > 1:
                     sample = shard_batch(sample, mesh, axis=1)
                 key, train_key = jax.random.split(key)
                 state, metrics = train_step(state, sample, train_key)

@@ -35,7 +35,14 @@ import optax
 from ... import nn, ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
-from ...parallel import make_mesh, replicate, shard_batch
+from ...parallel import (
+    assert_divisible,
+    distributed_setup,
+    make_mesh,
+    process_index,
+    replicate,
+    shard_batch,
+)
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -194,17 +201,22 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     np.random.seed(args.seed)
+    distributed_setup()
+    rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
     mesh = make_mesh(args.num_devices)
     n_dev = mesh.devices.size
+    assert_divisible(
+        args.rollout_steps * args.num_envs * world, n_dev, "rollout_steps*num_envs*world"
+    )
 
-    logger, log_dir, run_name = create_logger(args, "ppo")
+    logger, log_dir, run_name = create_logger(args, "ppo", process_index=rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
         [
             make_dict_env(
-                args.env_id, args.seed + i, rank=0, args=args,
+                args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
                 run_name=log_dir, vector_env_idx=i, mask_velocities=args.mask_vel,
             )
             for i in range(args.num_envs)

@@ -25,7 +25,7 @@ import numpy as np
 from ... import ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
-from ...parallel import make_decoupled_meshes
+from ...parallel import distributed_setup, make_decoupled_meshes, process_index
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -59,16 +59,18 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     np.random.seed(args.seed)
+    distributed_setup()
+    rank = process_index()
     key = jax.random.PRNGKey(args.seed)
     meshes = make_decoupled_meshes(args.num_devices)
 
-    logger, log_dir, run_name = create_logger(args, "ppo_decoupled")
+    logger, log_dir, run_name = create_logger(args, "ppo_decoupled", process_index=rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
         [
             make_dict_env(
-                args.env_id, args.seed + i, rank=0, args=args,
+                args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
                 run_name=log_dir, vector_env_idx=i, mask_velocities=args.mask_vel,
             )
             for i in range(args.num_envs)
@@ -122,6 +124,16 @@ def main(argv: Sequence[str] | None = None) -> None:
     global_step = 0
     start_time = time.perf_counter()
 
+    # Double-buffered overlap: the trainer mesh computes update N while the
+    # player collects rollout N+1 with one-update-stale weights — the same
+    # policy lag the reference's decoupled topology has (its player receives
+    # params back only after shipping the rollout, ppo_decoupled.py:294-307).
+    # JAX async dispatch provides the concurrency: train_step returns
+    # immediately, the weight transfer is enqueued behind it, and the player
+    # swaps in the new weights at the first iteration where the transfer has
+    # completed (`is_ready`), never blocking the env loop on trainer compute.
+    pending_agent = None
+    prev_metrics = None
     for update in range(start_update, num_updates + 1):
         lr = ops.polynomial_decay(
             update, initial=args.lr, final=0.0, max_decay_steps=num_updates
@@ -133,7 +145,16 @@ def main(argv: Sequence[str] | None = None) -> None:
             update, initial=args.ent_coef, final=0.0, max_decay_steps=num_updates
         ) if args.anneal_ent_coef else args.ent_coef
 
-        # ---- player: rollout with the latest policy copy --------------------
+        # ---- player: swap in new weights if the transfer landed -------------
+        if pending_agent is not None:
+            leaves = jax.tree_util.tree_leaves(pending_agent)
+            if update == num_updates or all(
+                leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready")
+            ):
+                player_agent = pending_agent
+                pending_agent = None
+
+        # ---- player: rollout (overlaps the in-flight trainer update) --------
         for _ in range(args.rollout_steps):
             key, step_key = jax.random.split(key)
             device_obs = {
@@ -179,16 +200,22 @@ def main(argv: Sequence[str] | None = None) -> None:
         }
         flat = meshes.to_trainers(flat)  # the data path (ICI, typed pytree)
 
-        # ---- trainers: the coupled single-jit update over the trainer mesh --
+        # ---- trainers: async-dispatched single-jit update -------------------
         key, train_key = jax.random.split(key)
         state, metrics = train_step(
             state, flat, train_key,
             jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
         )
-        # the weight path: updated params back to the player device
-        player_agent = meshes.to_player(state.agent)
-        for name, val in metrics.items():
-            aggregator.update(name, val)
+        # the weight path: updated params stream back to the player device
+        # behind the update; consumed by a later rollout when ready
+        pending_agent = meshes.to_player(state.agent)
+
+        # log the PREVIOUS update's metrics — pulling this update's scalars
+        # here would block the host on the trainer mesh and kill the overlap
+        if prev_metrics is not None:
+            for name, val in prev_metrics.items():
+                aggregator.update(name, val)
+        prev_metrics = metrics
 
         sps = global_step / (time.perf_counter() - start_time)
         logger.log_dict(aggregator.compute(), global_step)
@@ -205,6 +232,13 @@ def main(argv: Sequence[str] | None = None) -> None:
             )
 
     envs.close()
+    # drain the pipeline: final update's metrics + final weights to the player
+    if prev_metrics is not None:
+        for name, val in prev_metrics.items():
+            aggregator.update(name, val)
+        logger.log_dict(aggregator.compute(), global_step)
+        aggregator.reset()
+    player_agent = meshes.to_player(state.agent)
     test_env = make_dict_env(
         args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
     )()

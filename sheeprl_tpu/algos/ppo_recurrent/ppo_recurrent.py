@@ -33,7 +33,7 @@ import optax
 from ... import nn, ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
-from ...parallel import make_mesh, replicate
+from ...parallel import distributed_setup, make_mesh, process_index, replicate
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -167,16 +167,18 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     np.random.seed(args.seed)
+    distributed_setup()
+    rank = process_index()
     key = jax.random.PRNGKey(args.seed)
     mesh = make_mesh(args.num_devices)
 
-    logger, log_dir, run_name = create_logger(args, "ppo_recurrent")
+    logger, log_dir, run_name = create_logger(args, "ppo_recurrent", process_index=rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
         [
             make_dict_env(
-                args.env_id, args.seed + i, rank=0, args=args,
+                args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
                 run_name=log_dir, vector_env_idx=i, mask_velocities=args.mask_vel,
             )
             for i in range(args.num_envs)

@@ -22,7 +22,7 @@ import numpy as np
 
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
-from ...parallel import make_decoupled_meshes
+from ...parallel import distributed_setup, make_decoupled_meshes, process_index
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_env
 from ...utils.logger import create_logger
@@ -48,16 +48,18 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     np.random.seed(args.seed)
+    distributed_setup()
+    rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
     meshes = make_decoupled_meshes(args.num_devices)
 
-    logger, log_dir, run_name = create_logger(args, "sac_decoupled")
+    logger, log_dir, run_name = create_logger(args, "sac_decoupled", process_index=rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
         [
             make_env(
-                args.env_id, args.seed + i, 0, args.capture_video,
+                args.env_id, args.seed + rank * args.num_envs + i, rank, args.capture_video,
                 run_name=log_dir, prefix="train", vector_env_idx=i,
                 action_repeat=args.action_repeat,
             )
@@ -96,7 +98,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     min_size = 2 if args.sample_next_obs else 1
     buffer_size = (
-        max(args.buffer_size // args.num_envs, min_size) if not args.dry_run else min_size
+        max(args.buffer_size // (args.num_envs * world), min_size) if not args.dry_run else min_size
     )
     rb = ReplayBuffer(
         buffer_size, args.num_envs,
@@ -137,7 +139,20 @@ def main(argv: Sequence[str] | None = None) -> None:
     obs = np.asarray(obs, dtype=np.float32)
     start_time = time.perf_counter()
 
+    # Double-buffered overlap (same pattern as ppo_decoupled): the trainer
+    # mesh runs update N while the player steps envs with a slightly stale
+    # actor — harmless off-policy — swapping in new weights when the async
+    # transfer lands instead of blocking on it.
+    pending_actor = None
+    prev_metrics = None
     for global_step in range(start_step, num_updates + 1):
+        # ---- player: swap in new actor weights if the transfer landed -------
+        if pending_actor is not None:
+            leaves = jax.tree_util.tree_leaves(pending_actor)
+            if all(leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready")):
+                player_actor = pending_actor
+                pending_actor = None
+
         # ---- player: interaction + buffer -----------------------------------
         if global_step < learning_starts:
             actions = np.stack(
@@ -190,10 +205,15 @@ def main(argv: Sequence[str] | None = None) -> None:
                 key, train_key = jax.random.split(key)
                 do_ema = jnp.asarray(global_step % args.target_network_frequency == 0)
                 state, metrics = train_step(state, data, train_key, do_ema)
-            # the weight path: refreshed actor back to the player device
-            player_actor = meshes.to_player(state.agent.actor)
-            for name, val in metrics.items():
-                aggregator.update(name, val)
+            # the weight path: refreshed actor streams back to the player
+            # device behind the update; consumed when ready
+            pending_actor = meshes.to_player(state.agent.actor)
+            # log the previous update's metrics — pulling this update's
+            # scalars here would block the host and kill the overlap
+            if prev_metrics is not None:
+                for name, val in prev_metrics.items():
+                    aggregator.update(name, val)
+            prev_metrics = metrics
 
         sps = global_step / (time.perf_counter() - start_time)
         logger.log_dict(aggregator.compute(), global_step)
@@ -218,6 +238,12 @@ def main(argv: Sequence[str] | None = None) -> None:
                 rb.save(ckpt_path + ".buffer.npz")
 
     envs.close()
+    # drain the pipeline: final update's metrics
+    if prev_metrics is not None:
+        for name, val in prev_metrics.items():
+            aggregator.update(name, val)
+        logger.log_dict(aggregator.compute(), num_updates)
+        aggregator.reset()
     test_env = make_env(
         args.env_id, args.seed, 0, args.capture_video, run_name=log_dir, prefix="test"
     )()

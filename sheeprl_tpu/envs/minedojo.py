@@ -1,27 +1,364 @@
-"""MineDojo wrapper (capability target:
-/root/reference/sheeprl/envs/minedojo.py — 19-action functional map +
-3-head MultiDiscrete, `mask_*` action-mask obs keys, pitch/yaw limits,
-sticky attack/jump). The `minedojo` package is not present in this image;
-the wrapper raises an actionable error until the backend is installed."""
+"""MineDojo environment wrapper.
+
+Capability parity with /root/reference/sheeprl/envs/minedojo.py:60-284 — the
+19-action functional map compiled down to MineDojo's native 8-dim action
+vector, a 3-head MultiDiscrete action space (functional action, craft
+argument, equip/place/destroy argument), action-validity masks exposed as
+`mask_*` observation keys (consumed by `MinedojoActor`), pitch limits, and
+sticky attack/jump.
+
+Design differences from the reference (besides being a fresh implementation):
+
+- The MineDojo simulator is reached through an injectable *backend* object
+  instead of a module-level import, so the full action/observation mapping is
+  unit-testable in CI where the `minedojo` package (and a JDK) is absent.
+- The action translation (sticky state + table lookup) lives in a standalone
+  `ActionTranslator`, independent of the env plumbing.
+- Sticky attack *resets its counter* when another functional action is chosen;
+  the reference instead permanently disables sticky attack for the rest of the
+  episode (reference minedojo.py:186 writes `self._sticky_attack = 0`), which
+  reads as a bug rather than intent.
+- Choosing equip/place/destroy for an item not in the inventory falls back to
+  slot 0 (a no-op for the sim) instead of raising KeyError; masked policies
+  never hit this path, unmasked random exploration does.
+
+MineDojo's native action vector (see the MineDojo sim docs):
+  [0] move fwd/back (0 noop, 1 forward, 2 back)
+  [1] move left/right (0 noop, 1 left, 2 right)
+  [2] jump/sneak/sprint (0 noop, 1 jump, 2 sneak, 3 sprint)
+  [3] camera pitch bucket (0..24; 12 noop; 15 degrees per step)
+  [4] camera yaw bucket (0..24; 12 noop; 15 degrees per step)
+  [5] functional (0 noop, 1 use, 2 drop, 3 attack, 4 craft, 5 equip,
+      6 place, 7 destroy)
+  [6] craft argument (index into the craft/smelt vocabulary)
+  [7] inventory-slot argument (for equip/place/destroy)
+"""
 
 from __future__ import annotations
 
-try:
-    import minedojo  # noqa: F401
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
 
-    _MINEDOJO_AVAILABLE = True
-except ImportError:
-    _MINEDOJO_AVAILABLE = False
+import gymnasium as gym
+import numpy as np
+
+# functional-action codes in slot [5] of the native vector
+_FN_NOOP, _FN_USE, _FN_DROP, _FN_ATTACK, _FN_CRAFT = 0, 1, 2, 3, 4
+_FN_EQUIP, _FN_PLACE, _FN_DESTROY = 5, 6, 7
+
+N_HIGH_LEVEL_ACTIONS = 19
+_CAMERA_NOOP = 12  # bucket 12 of 0..24 = no rotation
+_CAMERA_STEP_DEGREES = 15.0
 
 
-class MineDojoWrapper:
-    def __init__(self, *args, **kwargs):
-        if not _MINEDOJO_AVAILABLE:
-            raise ModuleNotFoundError(
-                "minedojo is not installed: `pip install minedojo` (requires "
-                "JDK 8); env ids look like `minedojo_open-ended`"
+def build_action_table() -> np.ndarray:
+    """The [19, 8] table mapping high-level action ids to native vectors:
+    0 noop; 1-7 movement combos; 8-11 camera; 12-18 functional actions
+    (reference minedojo.py:16-36)."""
+    table = np.zeros((N_HIGH_LEVEL_ACTIONS, 8), dtype=np.int64)
+    table[:, 3] = table[:, 4] = _CAMERA_NOOP
+    table[1, 0] = 1  # forward
+    table[2, 0] = 2  # back
+    table[3, 1] = 1  # left
+    table[4, 1] = 2  # right
+    table[5, 0], table[5, 2] = 1, 1  # jump + forward
+    table[6, 0], table[6, 2] = 1, 2  # sneak + forward
+    table[7, 0], table[7, 2] = 1, 3  # sprint + forward
+    table[8, 3] = _CAMERA_NOOP - 1  # pitch down 15 degrees
+    table[9, 3] = _CAMERA_NOOP + 1  # pitch up 15 degrees
+    table[10, 4] = _CAMERA_NOOP - 1  # yaw left 15 degrees
+    table[11, 4] = _CAMERA_NOOP + 1  # yaw right 15 degrees
+    for high_id, fn in zip(range(12, 19), range(_FN_USE, _FN_DESTROY + 1)):
+        table[high_id, 5] = fn
+    return table
+
+
+ACTION_TABLE = build_action_table()
+
+
+@dataclass
+class ActionTranslator:
+    """Compiles (functional_id, craft_arg, item_arg) triples into native
+    8-dim actions, carrying the sticky attack/jump counters across steps
+    (reference minedojo.py:172-213)."""
+
+    sticky_attack: int = 30
+    sticky_jump: int = 10
+    attack_counter: int = 0
+    jump_counter: int = 0
+
+    def reset(self) -> None:
+        self.attack_counter = 0
+        self.jump_counter = 0
+
+    def translate(
+        self,
+        action: Sequence[int],
+        slot_of_item: Dict[int, int],
+    ) -> np.ndarray:
+        """`action` = the 3-head MultiDiscrete sample; `slot_of_item` maps an
+        item vocabulary id to the inventory slot currently holding it."""
+        native = ACTION_TABLE[int(action[0])].copy()
+
+        if self.sticky_attack:
+            if native[5] == _FN_ATTACK:
+                self.attack_counter = self.sticky_attack - 1
+            elif native[5] == _FN_NOOP and self.attack_counter > 0:
+                native[5] = _FN_ATTACK
+                self.attack_counter -= 1
+            elif native[5] != _FN_ATTACK:
+                self.attack_counter = 0
+
+        if self.sticky_jump:
+            if native[2] == 1:  # jump chosen
+                self.jump_counter = self.sticky_jump - 1
+            elif native[2] == 0 and self.jump_counter > 0:
+                native[2] = 1
+                # keep moving while the sticky jump plays out: repeated
+                # standing jumps go nowhere, so default to forward
+                if native[0] == 0 and native[1] == 0:
+                    native[0] = 1
+                self.jump_counter -= 1
+            elif native[2] != 1:
+                self.jump_counter = 0
+
+        native[6] = int(action[1]) if native[5] == _FN_CRAFT else 0
+        if native[5] in (_FN_EQUIP, _FN_PLACE, _FN_DESTROY):
+            native[7] = slot_of_item.get(int(action[2]), 0)
+        else:
+            native[7] = 0
+        return native
+
+
+class MineDojoBackend:
+    """Late-bound adapter over the real `minedojo` package. Tests substitute
+    an instance with a tiny item vocabulary and a scripted sim."""
+
+    def __init__(self):
+        import minedojo  # deferred: needs the package + JDK
+        from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS
+
+        self._minedojo = minedojo
+        self.all_items = ["_".join(item.split(" ")) for item in ALL_ITEMS]
+        self.craft_smelt_items = list(ALL_CRAFT_SMELT_ITEMS)
+
+    def make(self, task_id: str, **kwargs) -> Any:
+        return self._minedojo.make(task_id=task_id, **kwargs)
+
+
+class MineDojoWrapper(gym.Env):
+    """Gymnasium-facing MineDojo env with dict observations, action masks,
+    pitch limiting, and the 3-head MultiDiscrete action interface."""
+
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(
+        self,
+        task_id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: int = 30,
+        sticky_jump: int = 10,
+        backend: Optional[Any] = None,
+        **kwargs: Any,
+    ):
+        self._height = height
+        self._width = width
+        self._pitch_limits = pitch_limits
+        self._start_position = copy.deepcopy(kwargs.pop("start_position", None))
+        self._break_speed_multiplier = kwargs.pop("break_speed_multiplier", 100)
+        if self._start_position is not None and not (
+            pitch_limits[0] <= self._start_position["pitch"] <= pitch_limits[1]
+        ):
+            raise ValueError(
+                f"start pitch {self._start_position['pitch']} outside pitch "
+                f"limits {pitch_limits}"
             )
-        raise NotImplementedError(
-            "MineDojo wrapper pending implementation against an installed "
-            "minedojo backend (reference: sheeprl/envs/minedojo.py)"
+
+        self._backend = backend if backend is not None else MineDojoBackend()
+        self._items = list(self._backend.all_items)
+        self._craft_items = list(self._backend.craft_smelt_items)
+        self._item_id = {name: i for i, name in enumerate(self._items)}
+        self.n_items = len(self._items)
+
+        self._sim = self._backend.make(
+            task_id,
+            image_size=(height, width),
+            world_seed=seed,
+            start_position=self._start_position,
+            generate_world_type="default",
+            fast_reset=True,
+            break_speed_multiplier=self._break_speed_multiplier,
+            **kwargs,
         )
+        self._translator = ActionTranslator(
+            sticky_attack=sticky_attack, sticky_jump=sticky_jump
+        )
+        # per-item-id first inventory slot, rebuilt from every observation
+        self._slot_of_item: Dict[int, int] = {}
+        self._inventory_names: np.ndarray = np.array([], dtype=object)
+        self._inventory_max = np.zeros(self.n_items, dtype=np.float32)
+        self._pos: Dict[str, float] = {}
+
+        n_items, n_craft = self.n_items, len(self._craft_items)
+        rgb_shape = self._sim.observation_space["rgb"].shape
+        self.action_space = gym.spaces.MultiDiscrete(
+            np.array([N_HIGH_LEVEL_ACTIONS, n_craft, n_items])
+        )
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, rgb_shape, np.uint8),
+                "inventory": gym.spaces.Box(0.0, np.inf, (n_items,), np.float32),
+                "inventory_max": gym.spaces.Box(0.0, np.inf, (n_items,), np.float32),
+                "inventory_delta": gym.spaces.Box(
+                    -np.inf, np.inf, (n_items,), np.float32
+                ),
+                "equipment": gym.spaces.Box(0.0, 1.0, (n_items,), np.int32),
+                "life_stats": gym.spaces.Box(
+                    0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32
+                ),
+                "mask_action_type": gym.spaces.Box(
+                    0, 1, (N_HIGH_LEVEL_ACTIONS,), bool
+                ),
+                "mask_equip/place": gym.spaces.Box(0, 1, (n_items,), bool),
+                "mask_destroy": gym.spaces.Box(0, 1, (n_items,), bool),
+                "mask_craft_smelt": gym.spaces.Box(0, 1, (n_craft,), bool),
+            }
+        )
+        self.render_mode = "rgb_array"
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    # ---- observation conversion ---------------------------------------------
+
+    def _canonical(self, item: str) -> str:
+        return "_".join(item.split(" "))
+
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+        counts = np.zeros(self.n_items, dtype=np.float32)
+        self._slot_of_item = {}
+        names = [self._canonical(n) for n in list(inventory["name"])]
+        self._inventory_names = np.array(names, dtype=object)
+        for slot, (name, quantity) in enumerate(zip(names, inventory["quantity"])):
+            item_id = self._item_id[name]
+            # remember the FIRST slot holding each item (equip/place/destroy arg)
+            self._slot_of_item.setdefault(item_id, slot)
+            counts[item_id] += float(quantity)
+        self._inventory_max = np.maximum(counts, self._inventory_max)
+        return counts
+
+    def _convert_inventory_delta(self, delta: Dict[str, Any]) -> np.ndarray:
+        out = np.zeros(self.n_items, dtype=np.float32)
+        for names_key, qty_key, sign in (
+            ("inc_name_by_craft", "inc_quantity_by_craft", 1.0),
+            ("dec_name_by_craft", "dec_quantity_by_craft", -1.0),
+            ("inc_name_by_other", "inc_quantity_by_other", 1.0),
+            ("dec_name_by_other", "dec_quantity_by_other", -1.0),
+        ):
+            for name, quantity in zip(delta[names_key], delta[qty_key]):
+                out[self._item_id[self._canonical(name)]] += sign * float(quantity)
+        return out
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        one_hot = np.zeros(self.n_items, dtype=np.int32)
+        one_hot[self._item_id[self._canonical(equipment["name"][0])]] = 1
+        return one_hot
+
+    def _convert_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Project the sim's per-slot equip/destroy masks onto the item
+        vocabulary and gate the functional actions that would have no valid
+        argument (reference minedojo.py:156-170)."""
+        equip_mask = np.zeros(self.n_items, dtype=bool)
+        destroy_mask = np.zeros(self.n_items, dtype=bool)
+        for name, can_equip, can_destroy in zip(
+            self._inventory_names, masks["equip"], masks["destroy"]
+        ):
+            item_id = self._item_id[name]
+            equip_mask[item_id] |= bool(can_equip)
+            destroy_mask[item_id] |= bool(can_destroy)
+        fn_mask = np.asarray(masks["action_type"], dtype=bool).copy()
+        fn_mask[_FN_EQUIP] &= equip_mask.any()
+        fn_mask[_FN_PLACE] &= equip_mask.any()
+        fn_mask[_FN_DESTROY] &= destroy_mask.any()
+        action_type = np.concatenate(
+            [np.ones(12, dtype=bool), fn_mask[_FN_USE:]]  # movement/camera free
+        )
+        return {
+            "mask_action_type": action_type,
+            "mask_equip/place": equip_mask,
+            "mask_destroy": destroy_mask,
+            "mask_craft_smelt": np.asarray(masks["craft_smelt"], dtype=bool),
+        }
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": np.asarray(obs["rgb"]).copy(),
+            "inventory": self._convert_inventory(obs["inventory"]),
+            "inventory_max": self._inventory_max.copy(),
+            "inventory_delta": self._convert_inventory_delta(obs["delta_inv"]),
+            "equipment": self._convert_equipment(obs["equipment"]),
+            "life_stats": np.concatenate(
+                [
+                    np.asarray(obs["life_stats"]["life"], dtype=np.float32).reshape(-1),
+                    np.asarray(obs["life_stats"]["food"], dtype=np.float32).reshape(-1),
+                    np.asarray(obs["life_stats"]["oxygen"], dtype=np.float32).reshape(-1),
+                ]
+            ),
+            **self._convert_masks(obs["masks"]),
+        }
+
+    def _track_position(self, obs: Dict[str, Any]) -> None:
+        loc = obs["location_stats"]
+        self._pos = {
+            "x": float(loc["pos"][0]),
+            "y": float(loc["pos"][1]),
+            "z": float(loc["pos"][2]),
+            "pitch": float(np.asarray(loc["pitch"]).item()),
+            "yaw": float(np.asarray(loc["yaw"]).item()),
+        }
+
+    def _info(self, obs: Dict[str, Any], action=None) -> Dict[str, Any]:
+        info = {
+            "life_stats": {
+                "life": float(np.asarray(obs["life_stats"]["life"]).item()),
+                "oxygen": float(np.asarray(obs["life_stats"]["oxygen"]).item()),
+                "food": float(np.asarray(obs["life_stats"]["food"]).item()),
+            },
+            "location_stats": copy.deepcopy(self._pos),
+            "biomeid": float(np.asarray(obs["location_stats"]["biome_id"]).item()),
+        }
+        if action is not None:
+            info["action"] = list(np.asarray(action).tolist())
+        return info
+
+    # ---- gym API ------------------------------------------------------------
+
+    def step(self, action: np.ndarray):
+        requested = np.asarray(action)
+        native = self._translator.translate(requested, self._slot_of_item)
+        next_pitch = self._pos["pitch"] + (
+            (native[3] - _CAMERA_NOOP) * _CAMERA_STEP_DEGREES
+        )
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            native[3] = _CAMERA_NOOP
+
+        obs, reward, done, _ = self._sim.step(native)
+        self._track_position(obs)
+        return self._convert_obs(obs), reward, done, False, self._info(obs, requested)
+
+    def reset(self, seed=None, options=None):
+        obs = self._sim.reset()
+        self._track_position(obs)
+        self._translator.reset()
+        self._inventory_max = np.zeros(self.n_items, dtype=np.float32)
+        return self._convert_obs(obs), self._info(obs)
+
+    def render(self):
+        return None
+
+    def close(self):
+        self._sim.close()
+        return super().close()

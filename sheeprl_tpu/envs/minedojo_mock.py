@@ -1,0 +1,139 @@
+"""Scripted stand-in for the MineDojo simulator.
+
+The reference ships deterministic dummy envs in the package as its CI backend
+(/root/reference/sheeprl/envs/dummy.py); this extends that philosophy to
+MineDojo, whose real backend needs a JDK + Minecraft. The mock emits
+observations in the exact nested format the real sim produces (inventory
+name/quantity tables, delta_inv, equipment, life_stats, masks, location
+stats), accepts native 8-dim actions, and records them for assertions — so
+`MineDojoWrapper`'s full action/observation mapping runs in CI unmodified.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+# tiny vocabulary; "wooden pickaxe" keeps a space to exercise the
+# space->underscore canonicalization the real item names need
+MOCK_ITEMS = ["air", "stone", "dirt", "wooden pickaxe", "apple"]
+MOCK_CRAFT_ITEMS = ["stick", "torch", "planks"]
+
+
+class FakeMineDojoSim:
+    """Deterministic sim: fixed inventory, fixed life stats, a wandering
+    pitch that increases with every pitch-up action, episodes end after
+    `episode_length` steps."""
+
+    def __init__(
+        self,
+        image_size=(64, 64),
+        episode_length: int = 16,
+        inventory: Optional[Sequence[tuple]] = None,
+        **kwargs: Any,
+    ):
+        self._h, self._w = image_size
+        self._episode_length = episode_length
+        self._t = 0
+        self._pitch = 0.0
+        self._yaw = 0.0
+        # (name, quantity, can_equip, can_destroy) per inventory slot
+        self._inventory = list(
+            inventory
+            if inventory is not None
+            else [
+                ("air", 1, False, False),
+                ("stone", 3, False, True),
+                ("wooden pickaxe", 1, True, True),
+                ("stone", 2, False, True),
+            ]
+        )
+        self.received_actions: list = []
+        self.observation_space = {
+            "rgb": type("Box", (), {"shape": (3, self._h, self._w)})()
+        }
+
+    def _obs(self) -> Dict[str, Any]:
+        names = np.array([n for n, *_ in self._inventory], dtype=object)
+        quantities = np.array([q for _, q, *_ in self._inventory], dtype=np.int64)
+        return {
+            "rgb": np.full((3, self._h, self._w), self._t % 255, dtype=np.uint8),
+            "inventory": {"name": names, "quantity": quantities},
+            "delta_inv": {
+                "inc_name_by_craft": np.array(["stone"], dtype=object),
+                "inc_quantity_by_craft": np.array([1]),
+                "dec_name_by_craft": np.array([], dtype=object),
+                "dec_quantity_by_craft": np.array([]),
+                "inc_name_by_other": np.array([], dtype=object),
+                "inc_quantity_by_other": np.array([]),
+                "dec_name_by_other": np.array(["apple"], dtype=object),
+                "dec_quantity_by_other": np.array([1]),
+            },
+            "equipment": {"name": np.array(["wooden pickaxe"], dtype=object)},
+            "life_stats": {
+                "life": np.array([20.0]),
+                "food": np.array([20.0]),
+                "oxygen": np.array([300.0]),
+            },
+            "masks": {
+                # functional: noop/use/drop/attack/craft allowed; equip/place/
+                # destroy allowed (gated by inventory masks in the wrapper)
+                "action_type": np.ones(8, dtype=bool),
+                "equip": np.array(
+                    [e for _, _, e, _ in self._inventory], dtype=bool
+                ),
+                "destroy": np.array(
+                    [d for _, _, _, d in self._inventory], dtype=bool
+                ),
+                "craft_smelt": np.array(
+                    [True] * (len(MOCK_CRAFT_ITEMS) - 1) + [False]
+                ),
+            },
+            "location_stats": {
+                "pos": np.array([0.5, 64.0, -0.5]),
+                "pitch": np.array([self._pitch]),
+                "yaw": np.array([self._yaw]),
+                "biome_id": np.array([7]),
+            },
+        }
+
+    def reset(self) -> Dict[str, Any]:
+        self._t = 0
+        self._pitch = 0.0
+        self._yaw = 0.0
+        return self._obs()
+
+    def step(self, action):
+        action = np.asarray(action)
+        self.received_actions.append(action.copy())
+        self._t += 1
+        self._pitch += float(action[3] - 12) * 15.0
+        self._yaw += float(action[4] - 12) * 15.0
+        done = self._t >= self._episode_length
+        reward = 1.0 if done else 0.0
+        return self._obs(), reward, done, {}
+
+    def close(self) -> None:
+        pass
+
+
+class FakeMineDojoBackend:
+    """Backend object compatible with MineDojoWrapper(backend=...)."""
+
+    def __init__(self, episode_length: int = 16, inventory=None):
+        self.all_items = ["_".join(i.split(" ")) for i in MOCK_ITEMS]
+        self.craft_smelt_items = list(MOCK_CRAFT_ITEMS)
+        self._episode_length = episode_length
+        self._inventory = inventory
+        self.last_sim: Optional[FakeMineDojoSim] = None
+        self.last_make_kwargs: Dict[str, Any] = {}
+
+    def make(self, task_id: str, **kwargs: Any) -> FakeMineDojoSim:
+        self.last_make_kwargs = dict(kwargs, task_id=task_id)
+        self.last_sim = FakeMineDojoSim(
+            image_size=kwargs.get("image_size", (64, 64)),
+            episode_length=self._episode_length,
+            inventory=self._inventory,
+        )
+        return self.last_sim

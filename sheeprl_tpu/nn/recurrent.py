@@ -70,6 +70,19 @@ class LayerNormGRUCell(Module):
         return cls(proj=proj, norm=norm, hidden_size=hidden_size)
 
     def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
+        from ..ops.pallas_kernels import layernorm_gru_cell, use_pallas
+
+        if (
+            use_pallas("gru")
+            and self.norm is not None
+            and self.norm.scale is not None
+            and self.proj.bias is None
+            and x.ndim == 2
+        ):
+            return layernorm_gru_cell(
+                x, h, self.proj.weight, self.norm.scale, self.norm.offset,
+                self.norm.eps,
+            )
         parts = self.proj(jnp.concatenate([x, h], axis=-1))
         if self.norm is not None:
             parts = self.norm(parts)

@@ -441,6 +441,16 @@ class TwoHotEncodingDistribution(Distribution):
 
     def log_prob(self, x):
         # x: [..., 1] raw-scale targets
+        from .pallas_kernels import two_hot_log_prob, use_pallas
+
+        if use_pallas("two_hot"):
+            k = self.logits.shape[-1]
+            lp = two_hot_log_prob(
+                symlog(x).reshape(-1, 1).astype(jnp.float32),
+                self.logits.reshape(-1, k),
+                self.bins[None],
+            ).reshape(x.shape[:-1] + (1,))
+            return _sum_last(lp, self.dims)
         target = two_hot(symlog(x)[..., 0], self.bins)
         log_pred = jax.nn.log_softmax(self.logits, axis=-1)
         return _sum_last((target * log_pred).sum(axis=-1)[..., None], self.dims)

@@ -1,0 +1,422 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The BASELINE.md north star names four kernel targets: the LayerNorm-GRU cell
+(the RSSM scan body, reference /root/reference/sheeprl/models/models.py:330-402),
+symlog/symexp (reference utils/utils.py:125-133), and the two-hot log-prob
+(reference utils/distribution.py:220-266). Each kernel here
+
+  - fuses what XLA would otherwise stage through HBM: the GRU kernel keeps the
+    [B, 3H] pre-activation entirely in VMEM between the MXU matmul, the
+    layernorm moments, and the gate math; the two-hot kernel never
+    materializes the [N, K] two-hot target at all;
+  - differentiates: forward runs the kernel, backward is an analytic VJP
+    (two-hot, symlog) or a recompute-in-XLA VJP (GRU) so training numerics
+    stay exact;
+  - degrades gracefully: `use_pallas()` gates on the backend, the
+    SHEEPRL_TPU_PALLAS env var forces on/off, and interpret mode runs the
+    same kernels on CPU for numerics tests.
+
+Callers (nn.recurrent.LayerNormGRUCell, ops.distributions.TwoHotEncoding-
+Distribution) fall back to their plain-XLA paths whenever the kernels are
+disabled or the shapes are unsupported, so behavior is identical either way.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = [
+    "use_pallas",
+    "set_pallas",
+    "layernorm_gru_cell",
+    "two_hot_log_prob",
+    "symlog",
+    "symexp",
+]
+
+_FORCED: bool | None = None
+_INTERPRET = False  # tests flip this to run kernels on CPU
+
+
+def set_pallas(enabled: bool | None, interpret: bool = False) -> None:
+    """Force kernels on/off (None = auto: on when the default backend is
+    TPU). `interpret=True` runs kernels in the Pallas interpreter (CPU)."""
+    global _FORCED, _INTERPRET
+    _FORCED, _INTERPRET = enabled, interpret
+
+
+@functools.cache
+def _backend_is_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _env_flag(name: str) -> bool | None:
+    env = os.environ.get(name, "").lower()
+    if env in ("1", "on", "true"):
+        return True
+    if env in ("0", "off", "false"):
+        return False
+    return None
+
+
+def use_pallas(kind: str | None = None) -> bool:
+    """Master gate, optionally refined per kernel family via
+    SHEEPRL_TPU_PALLAS_<KIND> (KIND in GRU|TWO_HOT|SYMLOG) — the bench uses
+    the per-kernel switches to attribute wins/losses and keep only winners."""
+    if _FORCED is not None:
+        enabled = _FORCED
+    else:
+        master = _env_flag("SHEEPRL_TPU_PALLAS")
+        enabled = _backend_is_tpu() if master is None else master
+    if enabled and kind is not None:
+        per_kind = _env_flag(f"SHEEPRL_TPU_PALLAS_{kind.upper()}")
+        if per_kind is not None:
+            return per_kind
+    return enabled
+
+
+def _block_all(shape_dtypes):
+    return [pl.BlockSpec(memory_space=_VMEM) for _ in shape_dtypes]
+
+
+# =============================================================================
+# LayerNorm-GRU cell
+# =============================================================================
+
+
+def _gru_kernel(x_ref, h_ref, w_ref, scale_ref, offset_ref, out_ref, *, eps):
+    """One fused step: [x,h] @ W -> layernorm -> reset/cand/update gates.
+
+    Everything after the MXU matmul is VPU work on a [B, 3H] block that never
+    leaves VMEM — the fusion XLA can't be relied on to produce inside a scan
+    body (it re-materializes the pre-activation in HBM between the matmul and
+    the normalization reductions)."""
+    xh = jnp.concatenate([x_ref[:], h_ref[:]], axis=-1)
+    parts = jnp.dot(xh, w_ref[:], preferred_element_type=jnp.float32)
+    mean = jnp.mean(parts, axis=-1, keepdims=True)
+    centered = parts - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    parts = centered * jax.lax.rsqrt(var + eps) * scale_ref[:] + offset_ref[:]
+    hidden = h_ref.shape[-1]
+    r = parts[:, :hidden]
+    c = parts[:, hidden : 2 * hidden]
+    u = parts[:, 2 * hidden :]
+    update = jax.nn.sigmoid(u - 1.0)  # Hafner update-bias trick
+    cand = jnp.tanh(jax.nn.sigmoid(r) * c)
+    out_ref[:] = update * cand + (1.0 - update) * h_ref[:]
+
+
+def _gru_kernel_with_residuals(
+    x_ref, h_ref, w_ref, scale_ref, offset_ref, out_ref, hat_ref, rstd_ref, *, eps
+):
+    """Forward used under differentiation: additionally writes the normalized
+    pre-gate activations and the per-row inverse stddev, from which the
+    backward reconstructs everything with elementwise math + two matmuls
+    (no full recompute)."""
+    xh = jnp.concatenate([x_ref[:], h_ref[:]], axis=-1)
+    parts = jnp.dot(xh, w_ref[:], preferred_element_type=jnp.float32)
+    mean = jnp.mean(parts, axis=-1, keepdims=True)
+    centered = parts - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    hat = centered * rstd
+    post = hat * scale_ref[:] + offset_ref[:]
+    hidden = h_ref.shape[-1]
+    r = post[:, :hidden]
+    c = post[:, hidden : 2 * hidden]
+    u = post[:, 2 * hidden :]
+    update = jax.nn.sigmoid(u - 1.0)
+    cand = jnp.tanh(jax.nn.sigmoid(r) * c)
+    out_ref[:] = update * cand + (1.0 - update) * h_ref[:]
+    hat_ref[:] = hat
+    rstd_ref[:] = rstd
+
+
+def _gru_forward_with_residuals(x, h, w, scale, offset, eps):
+    batch, hidden = h.shape
+    dx = x.shape[-1]
+    bn = min(_GRU_BLOCK_ROWS, batch)
+    return pl.pallas_call(
+        functools.partial(_gru_kernel_with_residuals, eps=eps),
+        grid=(_cdiv(batch, bn),),
+        out_shape=(
+            jax.ShapeDtypeStruct((batch, hidden), x.dtype),
+            jax.ShapeDtypeStruct((batch, 3 * hidden), jnp.float32),
+            jax.ShapeDtypeStruct((batch, 1), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((bn, dx), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((bn, hidden), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec(w.shape, lambda i: (0, 0), memory_space=_VMEM),
+            pl.BlockSpec(scale.shape, lambda i: (0,), memory_space=_VMEM),
+            pl.BlockSpec(offset.shape, lambda i: (0,), memory_space=_VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((bn, hidden), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((bn, 3 * hidden), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=_VMEM),
+        ),
+        interpret=_INTERPRET,
+    )(x, h, w, scale, offset)
+
+
+def _gru_reference(x, h, w, scale, offset, eps):
+    """Plain-XLA twin of the kernel (used for the recompute backward and as
+    the numerics oracle in tests)."""
+    parts = jnp.concatenate([x, h], axis=-1) @ w
+    parts32 = parts.astype(jnp.float32)
+    mean = jnp.mean(parts32, axis=-1, keepdims=True)
+    var = jnp.var(parts32, axis=-1, keepdims=True)
+    parts = ((parts32 - mean) * jax.lax.rsqrt(var + eps) * scale + offset).astype(
+        x.dtype
+    )
+    r, c, u = jnp.split(parts, 3, axis=-1)
+    update = jax.nn.sigmoid(u - 1.0)
+    cand = jnp.tanh(jax.nn.sigmoid(r) * c)
+    return update * cand + (1.0 - update) * h
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+_GRU_BLOCK_ROWS = 256  # VMEM budget: [256, 3H] blocks + the full weight
+
+
+def _gru_forward(x, h, w, scale, offset, eps):
+    batch, hidden = h.shape
+    dx = x.shape[-1]
+    bn = min(_GRU_BLOCK_ROWS, batch)
+    return pl.pallas_call(
+        functools.partial(_gru_kernel, eps=eps),
+        grid=(_cdiv(batch, bn),),
+        out_shape=jax.ShapeDtypeStruct((batch, hidden), x.dtype),
+        in_specs=[
+            pl.BlockSpec((bn, dx), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((bn, hidden), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec(w.shape, lambda i: (0, 0), memory_space=_VMEM),
+            pl.BlockSpec(scale.shape, lambda i: (0,), memory_space=_VMEM),
+            pl.BlockSpec(offset.shape, lambda i: (0,), memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, hidden), lambda i: (i, 0), memory_space=_VMEM),
+        interpret=_INTERPRET,
+    )(x, h, w, scale, offset)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def layernorm_gru_cell(x, h, w, scale, offset, eps=1e-5):
+    """Fused LayerNorm-GRU step: x [B, Dx], h [B, H], w [Dx+H, 3H],
+    scale/offset [3H] -> new h [B, H]. Forward is the Pallas kernel; backward
+    recomputes through the XLA twin (exact, and the [B, 3H] residual never
+    needs saving)."""
+    return _gru_forward(x, h, w, scale, offset, eps)
+
+
+def _gru_fwd(x, h, w, scale, offset, eps):
+    out, hat, rstd = _gru_forward_with_residuals(x, h, w, scale, offset, eps)
+    return out, (x, h, w, scale, offset, hat, rstd)
+
+
+def _gru_bwd(eps, residuals, g):
+    """Analytic backward from the saved normalized activations: elementwise
+    gate/LN chain rules plus the two unavoidable matmuls (dW, dxh)."""
+    x, h, w, scale, offset, hat, rstd = residuals
+    hidden = h.shape[-1]
+    g = g.astype(jnp.float32)
+
+    post = hat * scale + offset
+    r = post[:, :hidden]
+    c = post[:, hidden : 2 * hidden]
+    u = post[:, 2 * hidden :]
+    sr = jax.nn.sigmoid(r)
+    pre_tanh = sr * c
+    cand = jnp.tanh(pre_tanh)
+    update = jax.nn.sigmoid(u - 1.0)
+
+    d_update = g * (cand - h)
+    d_cand = g * update
+    dh_direct = g * (1.0 - update)
+    d_u = d_update * update * (1.0 - update)
+    d_pre = d_cand * (1.0 - cand * cand)
+    d_c = d_pre * sr
+    d_r = d_pre * c * sr * (1.0 - sr)
+    dpost = jnp.concatenate([d_r, d_c, d_u], axis=-1)
+
+    dscale = jnp.sum(dpost * hat, axis=0)
+    doffset = jnp.sum(dpost, axis=0)
+    dhat = dpost * scale
+    # layernorm backward given hat and rstd
+    m1 = jnp.mean(dhat, axis=-1, keepdims=True)
+    m2 = jnp.mean(dhat * hat, axis=-1, keepdims=True)
+    dparts = rstd * (dhat - m1 - hat * m2)
+
+    xh = jnp.concatenate([x, h], axis=-1)
+    dw = xh.astype(jnp.float32).T @ dparts
+    dxh = dparts @ w.astype(jnp.float32).T
+    dx = dxh[:, : x.shape[-1]].astype(x.dtype)
+    dh = (dxh[:, x.shape[-1] :] + dh_direct).astype(h.dtype)
+    return dx, dh, dw.astype(w.dtype), dscale.astype(scale.dtype), doffset.astype(offset.dtype)
+
+
+layernorm_gru_cell.defvjp(_gru_fwd, _gru_bwd)
+
+
+# =============================================================================
+# Two-hot cross-entropy (the DreamerV3 reward/critic log-prob)
+# =============================================================================
+
+
+def _two_hot_log_prob_kernel(x_ref, logits_ref, bins_ref, out_ref):
+    """log p(x) under a categorical over `bins` with two-hot targets, without
+    materializing the [N, K] target: for each row, find the bracketing bins
+    by comparison counts, turn distances into the two interpolation weights,
+    and contract against the log-softmax row on the fly."""
+    x = x_ref[:]  # [N, 1]
+    logits = logits_ref[:]  # [N, K]
+    bins = bins_ref[:]  # [1, K]
+    k = logits.shape[-1]
+
+    log_z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1, keepdims=True)
+    log_probs = logits.astype(jnp.float32) - log_z  # [N, K]
+
+    below = jnp.sum((bins <= x).astype(jnp.int32), axis=-1, keepdims=True) - 1
+    above = k - jnp.sum((bins > x).astype(jnp.int32), axis=-1, keepdims=True)
+    below = jnp.clip(below, 0, k - 1)
+    above = jnp.clip(above, 0, k - 1)
+    equal = below == above
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)  # [N, K]
+    below_onehot = (idx == below).astype(jnp.float32)
+    above_onehot = (idx == above).astype(jnp.float32)
+    bin_below = jnp.sum(bins * below_onehot, axis=-1, keepdims=True)
+    bin_above = jnp.sum(bins * above_onehot, axis=-1, keepdims=True)
+    d_below = jnp.where(equal, 1.0, jnp.abs(bin_below - x))
+    d_above = jnp.where(equal, 1.0, jnp.abs(bin_above - x))
+    total = d_below + d_above
+    w_below = d_above / total
+    w_above = d_below / total
+
+    lp_below = jnp.sum(log_probs * below_onehot, axis=-1, keepdims=True)
+    lp_above = jnp.sum(log_probs * above_onehot, axis=-1, keepdims=True)
+    out_ref[:] = w_below * lp_below + w_above * lp_above
+
+
+_TWO_HOT_BLOCK_ROWS = 1024  # [1024, K~255] f32 working set stays well under VMEM
+
+
+def _two_hot_forward(x, logits, bins):
+    n, k = logits.shape
+    bn = min(_TWO_HOT_BLOCK_ROWS, n)
+    return pl.pallas_call(
+        _two_hot_log_prob_kernel,
+        grid=(_cdiv(n, bn),),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        in_specs=[
+            pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((bn, k), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=_VMEM),
+        interpret=_INTERPRET,
+    )(x, logits, bins)
+
+
+@jax.custom_vjp
+def two_hot_log_prob(x, logits, bins):
+    """x [N, 1] scalar targets, logits [N, K], bins [1, K] -> log-prob [N, 1].
+
+    Gradient flows to `logits` only (the DreamerV3 losses treat the two-hot
+    target as a constant): d/dlogits = (target - softmax(logits)) * g."""
+    return _two_hot_forward(x, logits, bins)
+
+
+def _two_hot_fwd(x, logits, bins):
+    return _two_hot_forward(x, logits, bins), (x, logits, bins)
+
+
+def _two_hot_bwd(residuals, g):
+    from .math import two_hot as dense_two_hot
+
+    x, logits, bins = residuals
+    target = dense_two_hot(x[:, 0], bins[0])  # [N, K]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    dlogits = ((target - probs) * g).astype(logits.dtype)
+    return jnp.zeros_like(x), dlogits, jnp.zeros_like(bins)
+
+
+two_hot_log_prob.defvjp(_two_hot_fwd, _two_hot_bwd)
+
+
+# =============================================================================
+# symlog / symexp
+# =============================================================================
+
+
+def _symlog_kernel(x_ref, out_ref):
+    x = x_ref[:]
+    out_ref[:] = jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def _symexp_kernel(x_ref, out_ref):
+    x = x_ref[:]
+    out_ref[:] = jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def _elementwise(kernel, x):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=_VMEM)],
+        out_specs=pl.BlockSpec(memory_space=_VMEM),
+        interpret=_INTERPRET,
+    )(x)
+
+
+@jax.custom_vjp
+def symlog(x):
+    """sign(x) * log1p(|x|) with the analytic gradient 1 / (1 + |x|)."""
+    return _elementwise(_symlog_kernel, x)
+
+
+def _symlog_fwd(x):
+    return _elementwise(_symlog_kernel, x), x
+
+
+def _symlog_bwd(x, g):
+    return (g / (1.0 + jnp.abs(x)),)
+
+
+symlog.defvjp(_symlog_fwd, _symlog_bwd)
+
+
+@jax.custom_vjp
+def symexp(x):
+    """sign(x) * (exp(|x|) - 1) with the analytic gradient exp(|x|)."""
+    return _elementwise(_symexp_kernel, x)
+
+
+def _symexp_fwd(x):
+    return _elementwise(_symexp_kernel, x), x
+
+
+def _symexp_bwd(x, g):
+    return (g * jnp.exp(jnp.abs(x)),)
+
+
+symexp.defvjp(_symexp_fwd, _symexp_bwd)

@@ -1,5 +1,6 @@
 from .decoupled import DecoupledMeshes, make_decoupled_meshes
 from .mesh import (
+    assert_divisible,
     data_sharding,
     distributed_setup,
     local_mesh_devices,
@@ -12,6 +13,7 @@ from .mesh import (
 
 __all__ = [
     "DecoupledMeshes",
+    "assert_divisible",
     "data_sharding",
     "distributed_setup",
     "local_mesh_devices",

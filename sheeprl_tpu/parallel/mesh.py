@@ -37,7 +37,19 @@ __all__ = [
     "replicate",
     "local_mesh_devices",
     "process_index",
+    "assert_divisible",
 ]
+
+
+def assert_divisible(total: int, n_dev: int, what: str) -> None:
+    """Refuse silently-degraded sharding: a batch dimension that does not
+    divide the mesh would either need padding or fall back to replicated
+    compute, so a bad size/device combination is a configuration error."""
+    if n_dev > 1 and total % n_dev != 0:
+        raise ValueError(
+            f"{what}={total} is not divisible by the {n_dev}-device mesh; "
+            f"pick a size that is a multiple of the device count"
+        )
 
 
 def distributed_setup(
@@ -97,8 +109,17 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 def shard_batch(tree: Any, mesh: Mesh, axis: int = 0, axis_name: str = "data") -> Any:
     """device_put a host batch with its `axis` sharded over the mesh — one
-    transfer per leaf, landing already distributed (no broadcast+slice)."""
+    transfer per leaf, landing already distributed (no broadcast+slice).
+
+    Multi-host: each process passes its *local* shard of the batch and the
+    result is a global array spanning the pod (the JAX-native replacement for
+    the reference's DistributedSampler sharding, SURVEY.md §2.7)."""
     sharding = data_sharding(mesh, axis, axis_name)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+            tree,
+        )
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
 
 

@@ -24,9 +24,27 @@ __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint"]
 
 def save_checkpoint(path: str, state: dict[str, Any], args: Any = None) -> None:
     """Save `state` (a pytree of arrays/Modules/ints) at `path` (a directory);
-    optionally store the run config alongside as args.json."""
+    optionally store the run config alongside as args.json.
+
+    Multi-host: process 0 writes alone — params/opt-state are replicated so
+    its copy is complete (the SPMD analog of the reference's rank-0
+    `fabric.save`, callback.py:23-64)."""
+    import jax
+    import numpy as np
+
+    if jax.process_index() != 0:
+        return
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    def _to_host(x):
+        # non-fully-addressable (pod-spanning) arrays are replicated in this
+        # framework, so the local replica is the complete value
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.asarray(x.addressable_data(0))
+        return x
+
+    state = jax.tree_util.tree_map(_to_host, state)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state, force=True)
     ckptr.wait_until_finished()

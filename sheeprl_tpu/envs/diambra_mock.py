@@ -1,0 +1,111 @@
+"""Scripted stand-in for the DIAMBRA Arena engine.
+
+Same philosophy as `minedojo_mock.py`/`minerl_mock.py`: the real engine is a
+licensed docker container, so CI drives `DiambraWrapper` through a fake that
+mimics the engine's interface — old-gym 4-tuple step API, a dict observation
+space mixing image frames, Box vectors, and Discrete scalars, and
+discrete/multidiscrete action spaces — while recording the settings/wrappers
+dicts and `rank` passed to `make` for assertions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class _Discrete:
+    def __init__(self, n: int):
+        self.n = n
+
+
+class _MultiDiscrete:
+    def __init__(self, nvec):
+        self.nvec = np.asarray(nvec)
+
+
+class _Box:
+    def __init__(self, low, high, shape, dtype):
+        self.low, self.high, self.shape, self.dtype = low, high, shape, dtype
+
+
+class _DictSpace:
+    def __init__(self, spaces: Dict[str, Any]):
+        self.spaces = spaces
+
+
+class FakeDiambraEngine:
+    """Deterministic fake engine: fixed frames, oscillating health bars,
+    episodes end after `episode_length` steps."""
+
+    def __init__(
+        self,
+        env_id: str,
+        settings: dict,
+        wrappers: dict,
+        seed,
+        rank: int,
+        episode_length: int = 8,
+    ):
+        self.env_id = env_id
+        self.settings = settings
+        self.wrappers = wrappers
+        self.seed = seed
+        self.rank = rank
+        self._episode_length = episode_length
+        self._t = 0
+        self.received_actions: list = []
+
+        h, w, gray = settings["frame_shape"]
+        channels = 1 if gray else 3
+        self._frame_shape = (h, w, channels)
+        if settings["action_space"] == "discrete":
+            self.action_space: Any = _Discrete(10)
+        else:
+            self.action_space = _MultiDiscrete([9, 8])
+        self.observation_space = _DictSpace(
+            {
+                "frame": _Box(0, 255, self._frame_shape, np.uint8),
+                "ownHealth": _Box(0.0, 1.0, (1,), np.float32),
+                "oppHealth": _Box(0.0, 1.0, (1,), np.float32),
+                "stage": _Discrete(3),
+                "ownSide": _Discrete(2),
+            }
+        )
+
+    def _obs(self) -> Dict[str, Any]:
+        return {
+            "frame": np.full(self._frame_shape, self._t % 255, dtype=np.uint8),
+            "ownHealth": np.array([1.0 - 0.1 * self._t], dtype=np.float32),
+            "oppHealth": np.array([1.0 - 0.05 * self._t], dtype=np.float32),
+            "stage": 1,  # engine emits Discrete obs as bare ints
+            "ownSide": self.rank % 2,
+        }
+
+    def reset(self) -> Dict[str, Any]:
+        self._t = 0
+        return self._obs()
+
+    def step(self, action):
+        self.received_actions.append(np.asarray(action).copy())
+        self._t += 1
+        done = self._t >= self._episode_length
+        return self._obs(), (1.0 if done else 0.1), done, {}
+
+    def close(self) -> None:
+        pass
+
+
+class FakeDiambraBackend:
+    """Backend object compatible with DiambraWrapper(backend=...)."""
+
+    def __init__(self, episode_length: int = 8):
+        self._episode_length = episode_length
+        self.last_engine: Optional[FakeDiambraEngine] = None
+
+    def make(self, env_id: str, settings: dict, wrappers: dict, seed, rank: int):
+        self.last_engine = FakeDiambraEngine(
+            env_id, settings, wrappers, seed, rank, self._episode_length
+        )
+        return self.last_engine

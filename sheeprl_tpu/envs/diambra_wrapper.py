@@ -1,28 +1,134 @@
-"""DIAMBRA arcade wrapper (capability target:
-/root/reference/sheeprl/envs/diambra_wrapper.py — discrete/multidiscrete
-action spaces, per-rank port offsetting). The `diambra` packages are not
-present in this image; the wrapper raises an actionable error until the
-backend is installed."""
+"""DIAMBRA Arena environment wrapper.
+
+Capability parity with /root/reference/sheeprl/envs/diambra_wrapper.py:20-103
+— arcade fighting games behind a dict observation space, discrete or
+multidiscrete action spaces, settings/wrapper plumbing (sticky actions force
+`step_ratio=1`, the engine's own frame-stack/dilation wrappers are disabled
+in favor of the framework's), and per-rank engine instances (the reference
+offsets engine ports by `rank`; here `rank` is forwarded to the backend's
+`make`).
+
+Design difference from the reference: the `diambra.arena` engine is reached
+through an injectable *backend* object instead of module-level imports, so
+the settings construction and observation conversion are unit-testable in CI
+where the DIAMBRA engine (a licensed docker container) is absent — the same
+strategy as `minedojo.py` / `minerl.py`.
+"""
 
 from __future__ import annotations
 
-try:
-    import diambra.arena  # noqa: F401
+import warnings
+from typing import Any, Dict, Optional, Tuple, Union
 
-    _DIAMBRA_AVAILABLE = True
-except ImportError:
-    _DIAMBRA_AVAILABLE = False
+import gymnasium
+import numpy as np
 
 
-class DiambraWrapper:
-    def __init__(self, *args, **kwargs):
-        if not _DIAMBRA_AVAILABLE:
-            raise ModuleNotFoundError(
-                "diambra is not installed: `pip install diambra diambra-arena` "
-                "(requires the DIAMBRA docker engine); env ids look like "
-                "`diambra_doapp`"
-            )
-        raise NotImplementedError(
-            "DIAMBRA wrapper pending implementation against an installed "
-            "diambra backend (reference: sheeprl/envs/diambra_wrapper.py)"
+class DiambraBackend:
+    """Late-bound adapter over the real `diambra.arena` package."""
+
+    def __init__(self):
+        import diambra.arena  # deferred: needs the engine + ROMs
+
+        self._arena = diambra.arena
+
+    def make(self, env_id: str, settings: dict, wrappers: dict, seed, rank: int):
+        return self._arena.make(env_id, settings, wrappers, seed=seed, rank=rank)
+
+
+class DiambraWrapper(gymnasium.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(
+        self,
+        env_id: str,
+        action_space: str = "discrete",
+        screen_size: Union[int, Tuple[int, int]] = 64,
+        grayscale: bool = False,
+        attack_but_combination: bool = True,
+        actions_stack: int = 1,
+        noop_max: int = 0,
+        sticky_actions: int = 1,
+        seed: Optional[int] = None,
+        rank: int = 0,
+        diambra_settings: Optional[Dict[str, Any]] = None,
+        diambra_wrappers: Optional[Dict[str, Any]] = None,
+        backend: Optional[Any] = None,
+    ) -> None:
+        super().__init__()
+        if isinstance(screen_size, int):
+            screen_size = (screen_size,) * 2
+
+        settings = {
+            **(diambra_settings or {}),
+            "action_space": action_space,
+            "attack_but_combination": attack_but_combination,
+            "frame_shape": (*screen_size, int(1 * grayscale)),
+        }
+        # sticky actions repeat the same command N engine frames; a step
+        # ratio > 1 would multiply the repeat (reference wrapper.py:47-52)
+        if sticky_actions > 1:
+            if settings.get("step_ratio", 2) > 1:
+                warnings.warn(
+                    "step_ratio forced to 1 because sticky actions are active "
+                    f"({sticky_actions})"
+                )
+            settings["step_ratio"] = 1
+        diambra_wrappers = dict(diambra_wrappers or {})
+        # frame handling belongs to the framework pipeline (_ImageTransform /
+        # FrameStack in utils/env.py), not the engine
+        if diambra_wrappers.pop("frame_stack", None) is not None:
+            warnings.warn("the DIAMBRA frame_stack wrapper is disabled")
+        if diambra_wrappers.pop("dilation", None) is not None:
+            warnings.warn("the DIAMBRA dilation wrapper is disabled")
+        wrappers = {
+            **diambra_wrappers,
+            "no_op_max": noop_max,
+            "flatten": True,
+            "actions_stack": actions_stack,
+            "sticky_actions": sticky_actions,
+        }
+
+        self._backend = backend if backend is not None else DiambraBackend()
+        self._env = self._backend.make(env_id, settings, wrappers, seed, rank)
+
+        self.action_space = (
+            gymnasium.spaces.Discrete(self._env.action_space.n)
+            if action_space == "discrete"
+            else gymnasium.spaces.MultiDiscrete(self._env.action_space.nvec)
         )
+        obs: Dict[str, gymnasium.spaces.Box] = {}
+        for key, space in self._env.observation_space.spaces.items():
+            if hasattr(space, "n"):  # engine-side Discrete -> 1-dim Box
+                obs[key] = gymnasium.spaces.Box(0, space.n - 1, (1,), np.int32)
+            elif hasattr(space, "low"):  # engine-side Box
+                obs[key] = gymnasium.spaces.Box(
+                    space.low, space.high, space.shape, space.dtype
+                )
+            else:
+                raise RuntimeError(
+                    f"invalid observation space for {key}: {type(space)}"
+                )
+        self.observation_space = gymnasium.spaces.Dict(obs)
+        self.render_mode = "rgb_array"
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            key: np.asarray(value).reshape(self.observation_space[key].shape)
+            for key, value in obs.items()
+        }
+
+    def step(self, action: Any):
+        obs, reward, done, infos = self._env.step(action)
+        infos["env_domain"] = "DIAMBRA"
+        return self._convert_obs(obs), reward, done, False, infos
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
+        return self._convert_obs(self._env.reset()), {"env_domain": "DIAMBRA"}
+
+    def render(self):
+        return None
+
+    def close(self):
+        self._env.close()
+        return super().close()

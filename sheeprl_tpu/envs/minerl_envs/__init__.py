@@ -1,0 +1,19 @@
+from .tasks import (
+    CUSTOM_TASKS,
+    ActionHead,
+    RewardItem,
+    TaskSpec,
+    custom_navigate,
+    custom_obtain_diamond,
+    custom_obtain_iron_pickaxe,
+)
+
+__all__ = [
+    "ActionHead",
+    "CUSTOM_TASKS",
+    "RewardItem",
+    "TaskSpec",
+    "custom_navigate",
+    "custom_obtain_diamond",
+    "custom_obtain_iron_pickaxe",
+]

@@ -1,0 +1,266 @@
+"""Declarative MineRL custom-task definitions.
+
+Capability parity with the reference's herobraine `EnvSpec` subclasses
+(/root/reference/sheeprl/envs/minerl_envs/{backend,navigate,obtain}.py —
+CustomNavigate, CustomObtainDiamond, CustomObtainIronPickaxe), redesigned as
+*pure data*: a `TaskSpec` fully describes a task's action interface,
+observables, rewards, and server configuration without importing `minerl`.
+The spec is consumed two ways:
+
+- `sheeprl_tpu.envs.minerl.MineRLBackend` compiles it into a real herobraine
+  EnvSpec (handlers built lazily, only when the `minerl` package exists);
+- `sheeprl_tpu.envs.minerl_mock.FakeMineRLBackend` interprets it directly,
+  so the entire task surface (action enumeration, reward schedules, success
+  rules) is unit-testable in CI with no JDK/Minecraft.
+
+The data below mirrors the reference tasks field by field: the base keyboard
+action set (backend.py:16), navigate's compass/place-dirt/touch-block reward
+(navigate.py:30-78), and the obtain tasks' inventory observations, crafting
+action vocabularies, and item reward schedules (obtain.py:53-259).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence, Tuple
+
+# keyboard keys every task shares (reference backend.py:16)
+SIMPLE_KEYBOARD_ACTIONS = (
+    "forward",
+    "back",
+    "left",
+    "right",
+    "jump",
+    "sneak",
+    "sprint",
+    "attack",
+)
+
+NAVIGATE_STEPS = 6000
+
+
+@dataclass(frozen=True)
+class ActionHead:
+    """One entry of the sim's dict action space.
+
+    kind: "binary" (0/1 key press), "camera" ([pitch, yaw] degree deltas), or
+    "enum" (categorical over `values`, first entry the no-op, reference
+    encodes it as the herobraine Enum's "none").
+    """
+
+    key: str
+    kind: str
+    values: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if self.kind not in ("binary", "camera", "enum"):
+            raise ValueError(f"unknown action head kind: {self.kind}")
+        if self.kind == "enum" and not self.values:
+            raise ValueError(f"enum head {self.key} needs values")
+
+
+@dataclass(frozen=True)
+class RewardItem:
+    """One row of an obtain-style reward schedule (obtain.py:169-182)."""
+
+    item: str
+    amount: int
+    reward: float
+
+
+def _base_heads() -> Tuple[ActionHead, ...]:
+    return tuple(
+        [ActionHead(k, "binary") for k in SIMPLE_KEYBOARD_ACTIONS]
+        + [ActionHead("camera", "camera")]
+    )
+
+
+@dataclass(frozen=True)
+class TaskSpec:
+    """Complete description of a MineRL custom task."""
+
+    name: str
+    max_episode_steps: int
+    # action interface: base keyboard+camera plus task-specific enum heads
+    extra_heads: Tuple[ActionHead, ...] = ()
+    # observables beyond pov/life-stats (backend.py:32-37)
+    inventory_items: Tuple[str, ...] = ()
+    has_compass: bool = False
+    has_equipment: bool = False
+    # rewards
+    reward_schedule: Tuple[RewardItem, ...] = ()
+    dense: bool = False  # dense: reward every collection, else once per item
+    touch_block_rewards: Tuple[Tuple[str, float], ...] = ()  # navigate
+    compass_distance_reward: float = 0.0  # navigate dense shaping, per block
+    # episode-end conditions
+    quit_on_touch_block: Tuple[str, ...] = ()
+    quit_on_possess: Tuple[Tuple[str, int], ...] = ()
+    quit_on_craft: Tuple[Tuple[str, int], ...] = ()
+    # server / world configuration
+    world_generator: str = "default"  # "default" | "biome:<id>"
+    start_time: int = 6000
+    allow_time_passage: bool = False
+    allow_spawning: bool = False
+    weather: Optional[str] = None
+    starting_inventory: Tuple[Tuple[str, int], ...] = ()
+    navigation_decorator: bool = False
+    # success rule: reward threshold (navigate) or schedule coverage (obtain)
+    success_reward_threshold: Optional[float] = None
+
+    @property
+    def action_heads(self) -> Tuple[ActionHead, ...]:
+        return _base_heads() + self.extra_heads
+
+    def determine_success(self, rewards: Sequence[float]) -> bool:
+        """Reference success rules: navigate sums rewards against a threshold
+        (navigate.py:90-94); obtain checks the set of distinct reward values
+        covers the schedule up to 10% missing (obtain.py:151-160)."""
+        if self.success_reward_threshold is not None:
+            return sum(rewards) >= self.success_reward_threshold
+        if self.reward_schedule:
+            targets = {r.reward for r in self.reward_schedule}
+            seen = targets.intersection(set(rewards))
+            max_missing = round(len(self.reward_schedule) * 0.1)
+            return len(seen) >= len(targets) - max_missing
+        return False
+
+
+# --- navigate (reference navigate.py:19-94) ----------------------------------
+
+
+def custom_navigate(dense: bool = False, extreme: bool = False) -> TaskSpec:
+    suffix = ("Extreme" if extreme else "") + ("Dense" if dense else "")
+    return TaskSpec(
+        name=f"CustomMineRLNavigate{suffix}-v0",
+        max_episode_steps=NAVIGATE_STEPS,
+        extra_heads=(ActionHead("place", "enum", ("none", "dirt")),),
+        inventory_items=("dirt",),
+        has_compass=True,
+        dense=dense,
+        touch_block_rewards=(("diamond_block", 100.0),),
+        compass_distance_reward=1.0 if dense else 0.0,
+        quit_on_touch_block=("diamond_block",),
+        world_generator="biome:3" if extreme else "default",
+        start_time=6000,
+        allow_time_passage=False,
+        allow_spawning=False,
+        weather="clear",
+        starting_inventory=(("compass", 1),),
+        navigation_decorator=True,
+        success_reward_threshold=160.0 if dense else 100.0,
+    )
+
+
+# --- obtain family (reference obtain.py:24-259) ------------------------------
+
+_OBTAIN_INVENTORY = (
+    "dirt",
+    "coal",
+    "torch",
+    "log",
+    "planks",
+    "stick",
+    "crafting_table",
+    "wooden_axe",
+    "wooden_pickaxe",
+    "stone",
+    "cobblestone",
+    "furnace",
+    "stone_axe",
+    "stone_pickaxe",
+    "iron_ore",
+    "iron_ingot",
+    "iron_axe",
+    "iron_pickaxe",
+)
+
+_OBTAIN_HEADS = (
+    ActionHead(
+        "place",
+        "enum",
+        ("none", "dirt", "stone", "cobblestone", "crafting_table", "furnace", "torch"),
+    ),
+    ActionHead(
+        "equip",
+        "enum",
+        (
+            "none",
+            "air",
+            "wooden_axe",
+            "wooden_pickaxe",
+            "stone_axe",
+            "stone_pickaxe",
+            "iron_axe",
+            "iron_pickaxe",
+        ),
+    ),
+    ActionHead("craft", "enum", ("none", "torch", "stick", "planks", "crafting_table")),
+    ActionHead(
+        "nearbyCraft",
+        "enum",
+        (
+            "none",
+            "wooden_axe",
+            "wooden_pickaxe",
+            "stone_axe",
+            "stone_pickaxe",
+            "iron_axe",
+            "iron_pickaxe",
+            "furnace",
+        ),
+    ),
+    ActionHead("nearbySmelt", "enum", ("none", "iron_ingot", "coal")),
+)
+
+_IRON_SCHEDULE = (
+    RewardItem("log", 1, 1),
+    RewardItem("planks", 1, 2),
+    RewardItem("stick", 1, 4),
+    RewardItem("crafting_table", 1, 4),
+    RewardItem("wooden_pickaxe", 1, 8),
+    RewardItem("cobblestone", 1, 16),
+    RewardItem("furnace", 1, 32),
+    RewardItem("stone_pickaxe", 1, 32),
+    RewardItem("iron_ore", 1, 64),
+    RewardItem("iron_ingot", 1, 128),
+    RewardItem("iron_pickaxe", 1, 256),
+)
+
+
+def _obtain_base(name: str, dense: bool, max_episode_steps: int) -> TaskSpec:
+    return TaskSpec(
+        name=name,
+        max_episode_steps=max_episode_steps,
+        extra_heads=_OBTAIN_HEADS,
+        inventory_items=_OBTAIN_INVENTORY,
+        has_equipment=True,
+        dense=dense,
+        start_time=6000,
+        allow_time_passage=True,
+        allow_spawning=True,
+    )
+
+
+def custom_obtain_diamond(dense: bool = False) -> TaskSpec:
+    suffix = "Dense" if dense else ""
+    return replace(
+        _obtain_base(f"CustomMineRLObtainDiamond{suffix}-v0", dense, 18000),
+        reward_schedule=_IRON_SCHEDULE + (RewardItem("diamond", 1, 1024),),
+        quit_on_possess=(("diamond", 1),),
+    )
+
+
+def custom_obtain_iron_pickaxe(dense: bool = False) -> TaskSpec:
+    suffix = "Dense" if dense else ""
+    return replace(
+        _obtain_base(f"CustomMineRLObtainIronPickaxe{suffix}-v0", dense, 6000),
+        reward_schedule=_IRON_SCHEDULE,
+        quit_on_craft=(("iron_pickaxe", 1),),
+    )
+
+
+CUSTOM_TASKS = {
+    "custom_navigate": custom_navigate,
+    "custom_obtain_diamond": custom_obtain_diamond,
+    "custom_obtain_iron_pickaxe": custom_obtain_iron_pickaxe,
+}

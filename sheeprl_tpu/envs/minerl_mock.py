@@ -1,0 +1,143 @@
+"""Scripted stand-in for the MineRL simulator.
+
+Same philosophy as `minedojo_mock.py`: the reference ships deterministic
+dummy envs as its CI backend (/root/reference/sheeprl/envs/dummy.py); this
+extends that to MineRL, whose real backend needs a JDK + Minecraft. The fake
+sim consumes the declarative `TaskSpec`, validates every dict action against
+the spec's action heads (keys, enum vocabularies, camera shape), emits
+observations in the exact nested format the real 0.4.4 sim produces
+(pov, life_stats, inventory dict, compass angle, equipped_items), and records
+actions for assertions — so `MineRLWrapper`'s full mapping runs in CI
+unmodified.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .minerl_envs.tasks import TaskSpec
+
+# small vocabulary standing in for minerl's mc.ALL_ITEMS; "iron ore" keeps a
+# space to exercise the space->underscore canonicalization
+MOCK_ALL_ITEMS = [
+    "air",
+    "dirt",
+    "log",
+    "planks",
+    "stick",
+    "crafting_table",
+    "wooden_pickaxe",
+    "cobblestone",
+    "iron ore",
+    "iron_pickaxe",
+    "compass",
+    "other",
+]
+
+
+class FakeMineRLSim:
+    """Deterministic sim: scripted inventory/compass trajectories, episodes
+    end after `episode_length` steps with a touch-block style reward."""
+
+    def __init__(
+        self,
+        spec: TaskSpec,
+        resolution=(64, 64),
+        episode_length: int = 16,
+        inventory: Optional[Dict[str, int]] = None,
+    ):
+        self.spec = spec
+        self._h, self._w = resolution
+        self._episode_length = episode_length
+        self._t = 0
+        self._initial_inventory = dict(
+            inventory
+            if inventory is not None
+            else {"air": 2, "dirt": 3, "wooden_pickaxe": 1, "iron ore": 2}
+        )
+        self._inventory = dict(self._initial_inventory)
+        self._equipped = "wooden_pickaxe"
+        self.received_actions: List[Dict[str, Any]] = []
+
+    def _obs(self) -> Dict[str, Any]:
+        obs: Dict[str, Any] = {
+            "pov": np.full((self._h, self._w, 3), self._t % 255, dtype=np.uint8),
+            "life_stats": {
+                "life": np.array([20.0]),
+                "food": np.array([20.0]),
+                "air": np.array([300.0]),
+            },
+            "inventory": dict(self._inventory),
+        }
+        if self.spec.has_compass:
+            obs["compass"] = {"angle": np.array([45.0 - self._t])}
+        if self.spec.has_equipment:
+            obs["equipped_items"] = {"mainhand": {"type": self._equipped}}
+        return obs
+
+    def _validate(self, action: Dict[str, Any]) -> None:
+        heads = {h.key: h for h in self.spec.action_heads}
+        if set(action) != set(heads):
+            raise ValueError(
+                f"action keys {sorted(action)} != spec keys {sorted(heads)}"
+            )
+        for key, value in action.items():
+            head = heads[key]
+            if head.kind == "enum" and value not in head.values:
+                raise ValueError(f"invalid enum value {value!r} for {key}")
+            if head.kind == "camera" and np.asarray(value).shape != (2,):
+                raise ValueError(f"camera action must be [pitch, yaw], got {value!r}")
+            if head.kind == "binary" and int(value) not in (0, 1):
+                raise ValueError(f"binary action {key} must be 0/1, got {value!r}")
+
+    def reset(self) -> Dict[str, Any]:
+        self._t = 0
+        self._inventory = dict(self._initial_inventory)
+        self._equipped = "wooden_pickaxe"
+        return self._obs()
+
+    def step(self, action: Dict[str, Any]):
+        self._validate(action)
+        self.received_actions.append(
+            {
+                k: (np.asarray(v).copy() if isinstance(v, np.ndarray) else v)
+                for k, v in action.items()
+            }
+        )
+        self._t += 1
+        # scripted dynamics: picking up dirt every step with "attack" held
+        if action.get("attack"):
+            self._inventory["dirt"] = self._inventory.get("dirt", 0) + 1
+        if action.get("equip", "none") != "none":
+            self._equipped = action["equip"]
+        done = self._t >= self._episode_length
+        reward = 100.0 if done else (1.0 if self.spec.dense else 0.0)
+        return self._obs(), reward, done, {}
+
+    def close(self) -> None:
+        pass
+
+
+class FakeMineRLBackend:
+    """Backend object compatible with MineRLWrapper(backend=...)."""
+
+    def __init__(self, episode_length: int = 16, inventory=None):
+        self.all_items = list(MOCK_ALL_ITEMS)
+        self._episode_length = episode_length
+        self._inventory = inventory
+        self.last_sim: Optional[FakeMineRLSim] = None
+        self.last_make_kwargs: Dict[str, Any] = {}
+
+    def make(self, spec: TaskSpec, resolution=(64, 64), break_speed=100, seed=None):
+        self.last_make_kwargs = dict(
+            spec=spec, resolution=resolution, break_speed=break_speed, seed=seed
+        )
+        self.last_sim = FakeMineRLSim(
+            spec,
+            resolution=resolution,
+            episode_length=self._episode_length,
+            inventory=self._inventory,
+        )
+        return self.last_sim

@@ -77,7 +77,12 @@ def latest_checkpoint(ckpt_dir: str) -> str | None:
     """Newest `ckpt_*` entry in a run's checkpoint directory."""
     if not os.path.isdir(ckpt_dir):
         return None
-    entries = [e for e in os.listdir(ckpt_dir) if e.startswith("ckpt_")]
+    # checkpoints are `ckpt_<step>` directories; skip `ckpt_<step>.args.json`
+    # sidecars and anything else that isn't a bare step suffix
+    entries = []
+    for e in os.listdir(ckpt_dir):
+        if e.startswith("ckpt_") and e.split("_")[-1].isdigit():
+            entries.append(e)
     if not entries:
         return None
     entries.sort(key=lambda e: int(e.split("_")[-1]))

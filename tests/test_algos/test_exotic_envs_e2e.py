@@ -1,0 +1,72 @@
+"""End-to-end runs on the mocked MineRL and DIAMBRA backends: drive the full
+pipeline — make_dict_env prefix dispatch, wrapper action/obs mapping, the
+framework image transform — through one real training update (the CI analogs
+of the reference's MineRL/DIAMBRA configurations)."""
+
+import os
+
+import pytest
+
+import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+import sheeprl_tpu.envs.diambra_wrapper as diambra_mod
+import sheeprl_tpu.envs.minerl as minerl_mod
+from sheeprl_tpu.envs.diambra_mock import FakeDiambraBackend
+from sheeprl_tpu.envs.minerl_mock import FakeMineRLBackend
+from sheeprl_tpu.utils.registry import tasks
+
+
+@pytest.mark.timeout(600)
+def test_dreamer_v3_minerl_mocked(tmp_path, monkeypatch):
+    monkeypatch.setattr(minerl_mod, "MineRLBackend", FakeMineRLBackend)
+    tasks["dreamer_v3"]([
+        "--dry_run",
+        "--num_devices=1",
+        "--env_id=minerl_custom_navigate",
+        "--num_envs=1",
+        "--sync_env",
+        "--per_rank_batch_size=1",
+        "--per_rank_sequence_length=1",
+        "--buffer_size=8",
+        "--learning_starts=0",
+        "--gradient_steps=1",
+        "--horizon=4",
+        "--dense_units=8",
+        "--cnn_channels_multiplier=2",
+        "--recurrent_state_size=8",
+        "--hidden_size=8",
+        "--stochastic_size=4",
+        "--discrete_size=4",
+        "--mlp_layers=1",
+        "--train_every=1",
+        "--checkpoint_every=1",
+        f"--root_dir={tmp_path}",
+        "--run_name=minerl",
+        "--cnn_keys", "rgb",
+        "--mlp_keys", "inventory", "max_inventory", "life_stats", "compass",
+    ])
+    ckpt_dir = tmp_path / "minerl" / "checkpoints"
+    assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))
+
+
+@pytest.mark.timeout(600)
+def test_ppo_diambra_mocked(tmp_path, monkeypatch):
+    monkeypatch.setattr(diambra_mod, "DiambraBackend", FakeDiambraBackend)
+    tasks["ppo"]([
+        "--dry_run",
+        "--num_devices=1",
+        "--env_id=diambra_doapp",
+        "--num_envs=1",
+        "--sync_env",
+        "--rollout_steps=8",
+        "--per_rank_batch_size=4",
+        "--update_epochs=1",
+        "--dense_units=8",
+        "--mlp_layers=1",
+        "--checkpoint_every=1",
+        f"--root_dir={tmp_path}",
+        "--run_name=diambra",
+        "--cnn_keys", "frame",
+        "--mlp_keys", "ownHealth", "oppHealth", "stage", "ownSide",
+    ])
+    ckpt_dir = tmp_path / "diambra" / "checkpoints"
+    assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))

@@ -1,0 +1,97 @@
+"""DIAMBRA wrapper unit tests against the scripted fake engine: settings
+construction (frame shape, sticky-actions step-ratio forcing, disabled
+engine-side frame stacking), discrete/multidiscrete action spaces, Discrete
+-> Box observation conversion, and per-rank engine instantiation."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.diambra_mock import FakeDiambraBackend
+from sheeprl_tpu.envs.diambra_wrapper import DiambraWrapper
+
+
+def make_env(**kwargs):
+    backend = FakeDiambraBackend(episode_length=kwargs.pop("episode_length", 8))
+    env = DiambraWrapper("doapp", backend=backend, **kwargs)
+    return env, backend
+
+
+def test_settings_and_wrappers_construction():
+    env, backend = make_env(
+        screen_size=48,
+        grayscale=True,
+        attack_but_combination=False,
+        actions_stack=4,
+        noop_max=5,
+        seed=3,
+        rank=2,
+        diambra_settings={"difficulty": 4},
+        diambra_wrappers={"normalize_reward": True},
+    )
+    eng = backend.last_engine
+    assert eng.env_id == "doapp"
+    assert eng.settings["frame_shape"] == (48, 48, 1)
+    assert eng.settings["difficulty"] == 4
+    assert eng.settings["attack_but_combination"] is False
+    assert eng.wrappers["no_op_max"] == 5
+    assert eng.wrappers["actions_stack"] == 4
+    assert eng.wrappers["flatten"] is True
+    assert eng.wrappers["normalize_reward"] is True
+    assert eng.seed == 3 and eng.rank == 2
+
+
+def test_sticky_actions_force_step_ratio():
+    with pytest.warns(UserWarning, match="step_ratio forced to 1"):
+        env, backend = make_env(sticky_actions=4)
+    assert backend.last_engine.settings["step_ratio"] == 1
+    assert backend.last_engine.wrappers["sticky_actions"] == 4
+    # explicit step_ratio=1 passes through silently
+    env, backend = make_env(
+        sticky_actions=4, diambra_settings={"step_ratio": 1}
+    )
+    assert backend.last_engine.settings["step_ratio"] == 1
+
+
+def test_engine_frame_wrappers_disabled():
+    with pytest.warns(UserWarning, match="frame_stack wrapper is disabled"):
+        _, backend = make_env(diambra_wrappers={"frame_stack": 4})
+    assert "frame_stack" not in backend.last_engine.wrappers
+    with pytest.warns(UserWarning, match="dilation wrapper is disabled"):
+        _, backend = make_env(diambra_wrappers={"dilation": 2})
+    assert "dilation" not in backend.last_engine.wrappers
+
+
+def test_action_spaces():
+    env, _ = make_env(action_space="discrete")
+    assert env.action_space.n == 10
+    env, _ = make_env(action_space="multi_discrete")
+    np.testing.assert_array_equal(env.action_space.nvec, [9, 8])
+
+
+def test_observation_space_conversion():
+    env, _ = make_env()
+    spaces = env.observation_space.spaces
+    assert set(spaces) == {"frame", "ownHealth", "oppHealth", "stage", "ownSide"}
+    assert spaces["frame"].shape == (64, 64, 3)
+    # engine Discrete obs become 1-dim int32 Boxes (reference :79-83)
+    assert spaces["stage"].shape == (1,) and spaces["stage"].dtype == np.int32
+    assert spaces["stage"].high[0] == 2
+    assert spaces["ownSide"].high[0] == 1
+
+
+def test_step_reset_and_obs_reshape():
+    env, backend = make_env(episode_length=3, rank=1)
+    obs, info = env.reset()
+    assert info["env_domain"] == "DIAMBRA"
+    # bare-int Discrete obs reshaped into (1,) arrays
+    assert obs["stage"].shape == (1,) and obs["stage"][0] == 1
+    assert obs["ownSide"][0] == 1  # rank % 2
+    assert obs["frame"].shape == (64, 64, 3)
+    done = False
+    steps = 0
+    while not done:
+        obs, reward, done, trunc, info = env.step(env.action_space.sample())
+        steps += 1
+    assert steps == 3 and reward == 1.0 and not trunc
+    assert info["env_domain"] == "DIAMBRA"
+    assert len(backend.last_engine.received_actions) == 3

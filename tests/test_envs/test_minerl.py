@@ -1,0 +1,277 @@
+"""MineRL wrapper + task-spec unit tests against the scripted mock backend —
+the mapping logic the reference leaves untested (its wrapper requires a live
+Minecraft): flat action enumeration from the dict action interface, sticky
+attack/jump, pitch limits with yaw wrap, inventory/equipment/compass
+conversion, and the declarative task definitions (action vocabularies,
+reward schedules, success rules)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.minerl import (
+    MineRLWrapper,
+    StickyActions,
+    build_actions_map,
+    make_noop,
+)
+from sheeprl_tpu.envs.minerl_mock import MOCK_ALL_ITEMS, FakeMineRLBackend
+from sheeprl_tpu.envs.minerl_envs.tasks import (
+    CUSTOM_TASKS,
+    custom_navigate,
+    custom_obtain_diamond,
+    custom_obtain_iron_pickaxe,
+)
+
+
+def make_env(task_id="custom_navigate", **kwargs):
+    backend = FakeMineRLBackend(episode_length=kwargs.pop("episode_length", 16))
+    env = MineRLWrapper(task_id, backend=backend, **kwargs)
+    return env, backend
+
+
+# ---- task specs --------------------------------------------------------------
+
+
+def test_navigate_spec():
+    spec = custom_navigate()
+    assert spec.name == "CustomMineRLNavigate-v0"
+    assert spec.has_compass and not spec.has_equipment
+    assert spec.max_episode_steps == 6000
+    heads = {h.key: h for h in spec.action_heads}
+    assert set(heads) == {
+        "forward", "back", "left", "right", "jump", "sneak", "sprint",
+        "attack", "camera", "place",
+    }
+    assert heads["place"].values == ("none", "dirt")
+    assert spec.touch_block_rewards == (("diamond_block", 100.0),)
+    assert spec.world_generator == "default"
+    assert custom_navigate(extreme=True).world_generator == "biome:3"
+    assert custom_navigate(dense=True).name == "CustomMineRLNavigateDense-v0"
+    assert (
+        custom_navigate(dense=True, extreme=True).name
+        == "CustomMineRLNavigateExtremeDense-v0"
+    )
+
+
+def test_navigate_success_rule():
+    spec = custom_navigate()
+    assert spec.determine_success([100.0])
+    assert not spec.determine_success([50.0, 49.0])
+    dense = custom_navigate(dense=True)
+    # threshold raised by 60 in the dense variant (reference navigate.py:90-94)
+    assert not dense.determine_success([100.0])
+    assert dense.determine_success([100.0, 60.0])
+
+
+def test_obtain_specs():
+    diamond = custom_obtain_diamond()
+    iron = custom_obtain_iron_pickaxe()
+    assert diamond.name == "CustomMineRLObtainDiamond-v0"
+    assert custom_obtain_diamond(dense=True).name == "CustomMineRLObtainDiamondDense-v0"
+    assert diamond.max_episode_steps == 18000 and iron.max_episode_steps == 6000
+    assert diamond.has_equipment and not diamond.has_compass
+    # diamond schedule = iron schedule + the 1024 diamond row
+    assert len(diamond.reward_schedule) == 12 and len(iron.reward_schedule) == 11
+    assert diamond.reward_schedule[-1].item == "diamond"
+    assert diamond.reward_schedule[-1].reward == 1024
+    assert [r.reward for r in iron.reward_schedule] == [
+        1, 2, 4, 4, 8, 16, 32, 32, 64, 128, 256,
+    ]
+    assert diamond.quit_on_possess == (("diamond", 1),)
+    assert iron.quit_on_craft == (("iron_pickaxe", 1),)
+    heads = {h.key: h for h in diamond.extra_heads}
+    assert set(heads) == {"place", "equip", "craft", "nearbyCraft", "nearbySmelt"}
+    assert len(heads["place"].values) == 7
+    assert len(heads["nearbySmelt"].values) == 3
+
+
+def test_obtain_success_rule():
+    iron = custom_obtain_iron_pickaxe()
+    rewards = [r.reward for r in iron.reward_schedule]
+    assert iron.determine_success(rewards)
+    # 10% of 11 rounds to 1 missing value allowed; distinct values are 9
+    # (4 and 32 repeat), so dropping one distinct value still succeeds
+    assert iron.determine_success([r for r in rewards if r != 256])
+    assert not iron.determine_success([r for r in rewards if r not in (128, 256)])
+
+
+# ---- action enumeration ------------------------------------------------------
+
+
+def test_actions_map_navigate():
+    actions = build_actions_map(custom_navigate())
+    # noop + 8 keys + 4 camera + 1 place value
+    assert len(actions) == 14
+    assert actions[0] == {}
+    assert actions[1] == {"forward": 1}
+    # jump/sneak/sprint bundle forward (reference minerl.py:90-91)
+    assert actions[5] == {"jump": 1, "forward": 1}
+    assert actions[6] == {"sneak": 1, "forward": 1}
+    assert actions[7] == {"sprint": 1, "forward": 1}
+    assert actions[8] == {"attack": 1}
+    np.testing.assert_array_equal(actions[9]["camera"], [-15, 0])
+    np.testing.assert_array_equal(actions[12]["camera"], [0, 15])
+    assert actions[13] == {"place": "dirt"}
+
+
+def test_actions_map_obtain():
+    actions = build_actions_map(custom_obtain_diamond())
+    # noop + 8 keys + 4 camera + (6 place + 7 equip + 4 craft + 7 nearbyCraft
+    # + 2 nearbySmelt) enum values
+    assert len(actions) == 39
+    assert {"place": "torch"} in actions
+    assert {"equip": "iron_pickaxe"} in actions
+    assert {"craft": "planks"} in actions
+    assert {"nearbyCraft": "furnace"} in actions
+    assert {"nearbySmelt": "coal"} in actions
+    # enum no-op values never appear as actions
+    assert not any(
+        v == "none" for a in actions for v in a.values() if isinstance(v, str)
+    )
+
+
+def test_noop_covers_all_heads():
+    spec = custom_obtain_diamond()
+    noop = make_noop(spec)
+    assert set(noop) == {h.key for h in spec.action_heads}
+    assert noop["place"] == "none" and noop["forward"] == 0
+    np.testing.assert_array_equal(noop["camera"], [0, 0])
+
+
+# ---- sticky actions ----------------------------------------------------------
+
+
+def test_sticky_attack_holds_and_suppresses_jump():
+    st = StickyActions(sticky_attack=3, sticky_jump=0)
+    out = st.apply({"attack": 1, "jump": 0})
+    assert out["attack"] == 1 and st.attack_counter == 2
+    out = st.apply({"attack": 0, "jump": 1})
+    assert out["attack"] == 1 and out["jump"] == 0  # attack wins over jump
+    st.apply({"attack": 0, "jump": 0})
+    out = st.apply({"attack": 0, "jump": 0})
+    assert out["attack"] == 0  # counter exhausted
+
+
+def test_sticky_jump_forces_forward():
+    st = StickyActions(sticky_attack=0, sticky_jump=2)
+    out = st.apply({"attack": 0, "jump": 1, "forward": 0})
+    assert out["jump"] == 1 and out["forward"] == 1 and st.jump_counter == 1
+    out = st.apply({"attack": 0, "jump": 0, "forward": 0})
+    assert out["jump"] == 1 and out["forward"] == 1
+    out = st.apply({"attack": 0, "jump": 0, "forward": 0})
+    assert out["jump"] == 0
+
+
+# ---- wrapper -----------------------------------------------------------------
+
+
+def test_spaces_navigate_vs_obtain():
+    env, _ = make_env("custom_navigate")
+    assert env.action_space.n == 14
+    assert set(env.observation_space.spaces) == {
+        "rgb", "life_stats", "inventory", "max_inventory", "compass",
+    }
+    assert env.observation_space["rgb"].shape == (64, 64, 3)
+    assert env.observation_space["inventory"].shape == (len(MOCK_ALL_ITEMS),)
+
+    env2, _ = make_env("custom_obtain_diamond")
+    assert env2.action_space.n == 39
+    assert set(env2.observation_space.spaces) == {
+        "rgb", "life_stats", "inventory", "max_inventory", "equipment",
+    }
+
+
+def test_obs_conversion():
+    env, _ = make_env("custom_obtain_diamond")
+    obs, _ = env.reset()
+    # mock inventory: air x2 (counts 1 per ENTRY, not quantity), dirt x3,
+    # wooden_pickaxe x1, "iron ore" x2 (canonicalized to iron_ore)
+    assert obs["inventory"][MOCK_ALL_ITEMS.index("air")] == 1.0
+    assert obs["inventory"][MOCK_ALL_ITEMS.index("dirt")] == 3.0
+    assert obs["inventory"][MOCK_ALL_ITEMS.index("iron ore")] == 2.0
+    np.testing.assert_allclose(obs["life_stats"], [20.0, 20.0, 300.0])
+    equipped = np.flatnonzero(obs["equipment"])
+    assert list(equipped) == [MOCK_ALL_ITEMS.index("wooden_pickaxe")]
+    assert obs["rgb"].shape == (64, 64, 3) and obs["rgb"].dtype == np.uint8
+
+
+def test_compass_and_max_inventory_track():
+    env, _ = make_env("custom_navigate")
+    obs, _ = env.reset()
+    assert obs["compass"].shape == (1,) and obs["compass"][0] == 45.0
+    dirt = MOCK_ALL_ITEMS.index("dirt")
+    assert obs["max_inventory"][dirt] == 3.0
+    obs, *_ = env.step(8)  # attack: mock adds one dirt per attack step
+    assert obs["inventory"][dirt] == 4.0 and obs["max_inventory"][dirt] == 4.0
+    obs, _ = env.reset()
+    assert obs["max_inventory"][dirt] == 3.0  # running max resets
+
+
+def test_equip_action_reaches_sim():
+    env, backend = make_env("custom_obtain_diamond")
+    env.reset()
+    equip_id = env.actions_map.index({"equip": "iron_pickaxe"})
+    obs, *_ = env.step(equip_id)
+    assert backend.last_sim.received_actions[-1]["equip"] == "iron_pickaxe"
+    assert list(np.flatnonzero(obs["equipment"])) == [
+        MOCK_ALL_ITEMS.index("iron_pickaxe")
+    ]
+
+
+def test_pitch_limit_blocks_rotation_yaw_wraps():
+    env, backend = make_env("custom_navigate", pitch_limits=(-60, 60))
+    env.reset()
+    pitch_up = next(
+        i for i, a in enumerate(env.actions_map)
+        if "camera" in a and a["camera"][0] > 0
+    )
+    for _ in range(4):  # 4 x +15 = +60: allowed
+        env.step(pitch_up)
+    assert env._pos["pitch"] == 60.0
+    env.step(pitch_up)  # would exceed -> pitch component zeroed
+    assert env._pos["pitch"] == 60.0
+    np.testing.assert_array_equal(
+        backend.last_sim.received_actions[-1]["camera"], [0.0, 0.0]
+    )
+    yaw_right = next(
+        i for i, a in enumerate(env.actions_map)
+        if "camera" in a and a["camera"][1] > 0
+    )
+    for _ in range(13):  # 13 x +15 = 195 -> wraps to -165
+        env.step(yaw_right)
+    assert env._pos["yaw"] == -165.0
+
+
+def test_full_episode_actions_valid_and_termination():
+    env, backend = make_env("custom_obtain_diamond", episode_length=5)
+    env.reset()
+    rng = np.random.default_rng(0)
+    done = False
+    steps = 0
+    while not done:
+        # the fake sim validates keys/enums/camera of every action
+        _, reward, done, trunc, _ = env.step(rng.integers(env.action_space.n))
+        steps += 1
+    assert steps == 5 and reward == 100.0 and not trunc
+    assert len(backend.last_sim.received_actions) == 5
+
+
+def test_make_kwargs_forwarded_and_unknown_task():
+    backend = FakeMineRLBackend()
+    MineRLWrapper(
+        "custom_navigate", height=32, width=32, seed=7, backend=backend,
+        break_speed_multiplier=50, dense=True,
+    )
+    kw = backend.last_make_kwargs
+    assert kw["resolution"] == (32, 32)
+    assert kw["break_speed"] == 50
+    assert kw["seed"] == 7
+    assert kw["spec"].dense
+    with pytest.raises(ValueError, match="unknown MineRL task"):
+        MineRLWrapper("custom_fly_to_moon", backend=backend)
+
+
+def test_registry_exposes_all_reference_tasks():
+    assert set(CUSTOM_TASKS) == {
+        "custom_navigate", "custom_obtain_diamond", "custom_obtain_iron_pickaxe",
+    }

@@ -1,0 +1,119 @@
+"""Template for a custom distributed RL topology on a device mesh.
+
+The reference ships a 3-tier process template — one buffer process, N player
+processes, M trainer processes wired with pickled-object collectives
+(/root/reference/examples/architecture_template.py). On TPU the same roles
+live in ONE SPMD program over disjoint sub-meshes of the device set:
+
+  - PLAYER tier: device 0 runs jitted policy inference for the host env
+    loop (env stepping itself is host Python — it never belongs on device);
+  - BUFFER tier: the replay buffer is not a process at all — it is a ring
+    of arrays (host numpy here; HBM `jax.Array`s in the real algorithms)
+    whose sample batches are `device_put` straight onto the trainer
+    sharding, replacing the reference's buffer process + scatter;
+  - TRAINER tier: the remaining devices form a `Mesh(("data",))`; the
+    jitted update runs with the batch sharded over that axis and XLA
+    inserts the gradient all-reduce (replacing the DDP trainer group);
+  - WEIGHTS path: updated params are `device_put` back to the player
+    device (replacing the flattened-parameter broadcast).
+
+Because it is one program, there are no shutdown sentinels, no uneven-input
+Join contexts, and no pickling — control flow is ordinary Python, and every
+transfer is a typed pytree over ICI.
+
+Run without hardware on a virtual 8-device CPU mesh:
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/architecture_template.py
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.nn.blocks import MLP
+from sheeprl_tpu.parallel import make_decoupled_meshes
+
+OBS_DIM, ACT_DIM = 8, 4
+ROLLOUT, BATCH, UPDATES = 256, 128, 10
+
+
+def main():
+    meshes = make_decoupled_meshes()  # device 0 = player, rest = trainers
+    print(
+        f"player: {meshes.player_device}, "
+        f"trainers: {meshes.num_trainers} devices"
+    )
+
+    # --- model + optimizer, replicated across the trainer mesh --------------
+    policy = MLP.init(jax.random.PRNGKey(0), OBS_DIM, [64, 64], ACT_DIM)
+    optimizer = optax.adam(3e-4)
+    opt_state = optimizer.init(policy)
+    policy = meshes.replicated_on_trainers(policy)
+    opt_state = meshes.replicated_on_trainers(opt_state)
+
+    # --- player tier: jitted inference on the player device -----------------
+    player_policy = meshes.to_player(policy)
+
+    @jax.jit
+    def act(policy, obs, key):
+        logits = policy(obs)
+        return jax.random.categorical(key, logits)
+
+    # --- trainer tier: one jitted update over the sharded batch -------------
+    @jax.jit
+    def train_step(policy, opt_state, batch):
+        def loss_fn(p):
+            logits = p(batch["obs"])
+            logp = jax.nn.log_softmax(logits)
+            chosen = jnp.take_along_axis(logp, batch["actions"][:, None], axis=-1)
+            return -jnp.mean(chosen[:, 0] * batch["returns"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(policy)
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return optax.apply_updates(policy, updates), opt_state, loss
+
+    # --- buffer tier: a plain host ring whose samples land sharded ----------
+    rng = np.random.default_rng(0)
+    obs_ring = np.zeros((ROLLOUT, OBS_DIM), np.float32)
+    act_ring = np.zeros((ROLLOUT,), np.int32)
+    ret_ring = np.zeros((ROLLOUT,), np.float32)
+
+    key = jax.random.PRNGKey(1)
+    for update in range(UPDATES):
+        # player: collect a rollout (a scripted "env" here)
+        for t in range(ROLLOUT):
+            obs = rng.normal(size=(1, OBS_DIM)).astype(np.float32)
+            key, sk = jax.random.split(key)
+            action = act(player_policy, jnp.asarray(obs), sk)
+            obs_ring[t] = obs[0]
+            act_ring[t] = int(action[0])
+            ret_ring[t] = rng.normal()
+
+        # buffer -> trainers: typed pytree transfer, sharded on the batch axis
+        idx = rng.integers(0, ROLLOUT, size=BATCH)
+        batch = meshes.to_trainers(
+            {
+                "obs": jnp.asarray(obs_ring[idx]),
+                "actions": jnp.asarray(act_ring[idx]),
+                "returns": jnp.asarray(ret_ring[idx]),
+            },
+            axis=0,
+        )
+
+        # trainers: sharded update (XLA all-reduces the grads)
+        policy, opt_state, loss = train_step(policy, opt_state, batch)
+
+        # trainers -> player: weight refresh
+        player_policy = meshes.to_player(policy)
+        print(f"update {update}: loss {float(loss):+.4f}")
+
+    print("template ok")
+
+
+if __name__ == "__main__":
+    main()

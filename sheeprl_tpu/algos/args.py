@@ -56,6 +56,10 @@ class StandardArgs:
     precision: str = Arg(default="float32", help="compute dtype for the train step (float32|bfloat16)")
 
     def __setattr__(self, name: str, value: Any) -> None:
+        if name == "precision" and value not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"precision must be 'float32' or 'bfloat16', got {value!r}"
+            )
         super().__setattr__(name, value)
         if name == "log_dir" and value:
             os.makedirs(value, exist_ok=True)

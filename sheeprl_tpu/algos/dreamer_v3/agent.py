@@ -355,17 +355,25 @@ class RSSM(nn.Module):
         return mixed.reshape(logits.shape)
 
     def _transition(self, recurrent_out: jax.Array, key=None):
-        """-> (prior_logits [..., S*D], prior [..., S, D]); mode when key=None."""
-        logits = self._uniform_mix(self.transition_model(recurrent_out))
-        return logits, compute_stochastic_state(logits, self.discrete, key)
+        """-> (prior_logits [..., S*D], prior [..., S, D]); mode when key=None.
+
+        Logits/unimix/sampling run in f32 even under bf16 compute (the KL and
+        straight-through gradients need the precision); the sampled one-hot
+        state is cast back to the compute dtype for the recurrent path."""
+        logits = self._uniform_mix(
+            self.transition_model(recurrent_out).astype(jnp.float32)
+        )
+        state = compute_stochastic_state(logits, self.discrete, key)
+        return logits, state.astype(recurrent_out.dtype)
 
     def _representation(self, recurrent_state: jax.Array, embedded_obs: jax.Array, key=None):
         logits = self._uniform_mix(
             self.representation_model(
                 jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
-            )
+            ).astype(jnp.float32)
         )
-        return logits, compute_stochastic_state(logits, self.discrete, key)
+        state = compute_stochastic_state(logits, self.discrete, key)
+        return logits, state.astype(recurrent_state.dtype)
 
     def dynamic(
         self,
@@ -380,10 +388,13 @@ class RSSM(nn.Module):
         `is_first`, the action/recurrent state are zeroed and the posterior is
         re-seeded from the transition prior's mode."""
         k_prior, k_post = jax.random.split(key)
-        is_first = is_first.astype(jnp.float32)
-        action = (1.0 - is_first) * action
+        # the recurrent carry's dtype is the compute dtype; keep every branch
+        # of the reset arithmetic in it (a stray f32 would promote the chain)
+        dt = recurrent_state.dtype
+        is_first = is_first.astype(dt)
+        action = (1.0 - is_first) * action.astype(dt)
         recurrent_state = (1.0 - is_first) * recurrent_state
-        posterior_flat = posterior.reshape(*posterior.shape[:-2], -1)
+        posterior_flat = posterior.astype(dt).reshape(*posterior.shape[:-2], -1)
         init_post = self._transition(recurrent_state, key=None)[1]
         init_post = init_post.reshape(posterior_flat.shape)
         posterior_flat = (1.0 - is_first) * posterior_flat + is_first * init_post
@@ -515,7 +526,9 @@ class Actor(nn.Module):
 
     def _head_logits(self, state: jax.Array, mask: dict | None = None) -> list[jax.Array]:
         x = self.model(state)
-        return [head(x) for head in self.heads]
+        # distribution math (log-softmax, unimix, truncated-normal cdfs)
+        # always runs in f32, whatever the trunk's compute dtype
+        return [head(x).astype(jnp.float32) for head in self.heads]
 
     def dists(self, state: jax.Array, mask: dict | None = None) -> tuple:
         """The per-head action distributions at `state`."""

@@ -119,6 +119,10 @@ def make_train_step(
     stoch_size = args.stochastic_size * args.discrete_size
     horizon = args.horizon
     action_splits = np.cumsum(actions_dim)[:-1]
+    # --precision bfloat16: model forwards (conv trunks, RSSM scan,
+    # imagination) run in bf16 — params stay f32 (every layer casts its
+    # weights to the input dtype), normalizations/logits/losses stay f32
+    compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
 
     def train_step(state: DV3TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]
@@ -131,19 +135,22 @@ def make_train_step(
             lambda c, t: tau * c + (1.0 - tau) * t, state.critic, state.target_critic
         )
 
-        batch_obs = {k: data[k] / 255.0 for k in cnn_keys}
-        batch_obs.update({k: data[k] for k in mlp_keys})
+        obs_targets = {k: data[k] / 255.0 for k in cnn_keys}
+        obs_targets.update({k: data[k] for k in mlp_keys})
+        batch_obs = {k: v.astype(compute_dtype) for k, v in obs_targets.items()}
         is_first = data["is_first"].at[0].set(1.0)
         batch_actions = jnp.concatenate(
             [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
-        )
+        ).astype(compute_dtype)
         continue_targets = 1.0 - data["dones"]
 
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
             embedded = wm.encoder(batch_obs)
-            posterior0 = jnp.zeros((B, args.stochastic_size, args.discrete_size))
-            recurrent0 = jnp.zeros((B, args.recurrent_state_size))
+            posterior0 = jnp.zeros(
+                (B, args.stochastic_size, args.discrete_size), compute_dtype
+            )
+            recurrent0 = jnp.zeros((B, args.recurrent_state_size), compute_dtype)
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
                 wm.rssm.scan_dynamic(
                     posterior0, recurrent0, batch_actions, embedded, is_first, k_wm
@@ -152,21 +159,29 @@ def make_train_step(
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
             )
-            reconstructed = wm.observation_model(latent_states)
+            reconstructed = {
+                k: v.astype(jnp.float32)
+                for k, v in wm.observation_model(latent_states).items()
+            }
             po = {
                 k: MSEDistribution(_mode=reconstructed[k], dims=3) for k in cnn_keys
             }
             po.update(
                 {k: SymlogDistribution(_mode=reconstructed[k], dims=1) for k in mlp_keys}
             )
-            pr = TwoHotEncodingDistribution(logits=wm.reward_model(latent_states), dims=1)
+            pr = TwoHotEncodingDistribution(
+                logits=wm.reward_model(latent_states).astype(jnp.float32), dims=1
+            )
             pc = Independent(
-                base=Bernoulli(logits=wm.continue_model(latent_states)), event_ndims=1
+                base=Bernoulli(
+                    logits=wm.continue_model(latent_states).astype(jnp.float32)
+                ),
+                event_ndims=1,
             )
             shaped = (T, B, args.stochastic_size, args.discrete_size)
             losses = reconstruction_loss(
                 po,
-                batch_obs,
+                obs_targets,
                 pr,
                 data["rewards"],
                 priors_logits.reshape(shaped),
@@ -205,7 +220,7 @@ def make_train_step(
                 latent = jnp.concatenate([prior, recurrent], axis=-1)
                 k_act, k_trans = jax.random.split(k)
                 acts, _ = actor(jax.lax.stop_gradient(latent), key=k_act)
-                action = jnp.concatenate(acts, axis=-1)
+                action = jnp.concatenate(acts, axis=-1).astype(prior.dtype)
                 new_prior, new_recurrent = world_model.rssm.imagination(
                     prior, recurrent, action, k_trans
                 )
@@ -227,13 +242,21 @@ def make_train_step(
             )  # [H+1, T*B, A]
 
             predicted_values = TwoHotEncodingDistribution(
-                logits=state.critic(imagined_trajectories), dims=1
+                logits=state.critic(imagined_trajectories).astype(jnp.float32),
+                dims=1,
             ).mean
             predicted_rewards = TwoHotEncodingDistribution(
-                logits=world_model.reward_model(imagined_trajectories), dims=1
+                logits=world_model.reward_model(imagined_trajectories).astype(
+                    jnp.float32
+                ),
+                dims=1,
             ).mean
             continues = Independent(
-                base=Bernoulli(logits=world_model.continue_model(imagined_trajectories)),
+                base=Bernoulli(
+                    logits=world_model.continue_model(imagined_trajectories).astype(
+                        jnp.float32
+                    )
+                ),
                 event_ndims=1,
             ).mode
             continues = jnp.concatenate([true_continue0, continues[1:]], axis=0)
@@ -289,11 +312,13 @@ def make_train_step(
         # ---- critic ----------------------------------------------------------
         traj_sg = jax.lax.stop_gradient(imagined_trajectories[:-1])
         target_values = TwoHotEncodingDistribution(
-            logits=target_critic(traj_sg), dims=1
+            logits=target_critic(traj_sg).astype(jnp.float32), dims=1
         ).mean
 
         def critic_loss_fn(critic):
-            qv = TwoHotEncodingDistribution(logits=critic(traj_sg), dims=1)
+            qv = TwoHotEncodingDistribution(
+                logits=critic(traj_sg).astype(jnp.float32), dims=1
+            )
             value_loss = -qv.log_prob(jax.lax.stop_gradient(lambda_values))
             value_loss = value_loss - qv.log_prob(jax.lax.stop_gradient(target_values))
             return jnp.mean(value_loss * discount[:-1, :, 0])

@@ -80,7 +80,14 @@ class LayerNormGRUCell(Module):
             and x.ndim == 2
         ):
             return layernorm_gru_cell(
-                x, h, self.proj.weight, self.norm.scale, self.norm.offset,
+                x,
+                h,
+                # weights follow the input dtype (bf16 compute with f32
+                # master params, like the plain-XLA Linear path); LN affine
+                # params stay f32 — the kernel normalizes in f32 regardless
+                self.proj.weight.astype(x.dtype),
+                self.norm.scale,
+                self.norm.offset,
                 self.norm.eps,
             )
         parts = self.proj(jnp.concatenate([x, h], axis=-1))

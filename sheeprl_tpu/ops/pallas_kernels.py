@@ -119,7 +119,8 @@ def _gru_kernel(x_ref, h_ref, w_ref, scale_ref, offset_ref, out_ref, *, eps):
     u = parts[:, 2 * hidden :]
     update = jax.nn.sigmoid(u - 1.0)  # Hafner update-bias trick
     cand = jnp.tanh(jax.nn.sigmoid(r) * c)
-    out_ref[:] = update * cand + (1.0 - update) * h_ref[:]
+    out = update * cand + (1.0 - update) * h_ref[:].astype(jnp.float32)
+    out_ref[:] = out.astype(out_ref.dtype)
 
 
 def _gru_kernel_with_residuals(
@@ -143,7 +144,8 @@ def _gru_kernel_with_residuals(
     u = post[:, 2 * hidden :]
     update = jax.nn.sigmoid(u - 1.0)
     cand = jnp.tanh(jax.nn.sigmoid(r) * c)
-    out_ref[:] = update * cand + (1.0 - update) * h_ref[:]
+    out = update * cand + (1.0 - update) * h_ref[:].astype(jnp.float32)
+    out_ref[:] = out.astype(out_ref.dtype)
     hat_ref[:] = hat
     rstd_ref[:] = rstd
 

@@ -662,11 +662,14 @@ class AsyncReplayBuffer:
         sequential: bool = False,
         obs_keys: Sequence[str] = ("observations",),
         seed: int = 0,
+        split: str = "even",
     ):
         if buffer_size <= 0:
             raise ValueError(f"buffer size must be > 0, got {buffer_size}")
         if n_envs <= 0:
             raise ValueError(f"n_envs must be > 0, got {n_envs}")
+        if split not in ("even", "multinomial"):
+            raise ValueError(f"split must be 'even' or 'multinomial', got {split!r}")
         self._buffer_size = buffer_size
         self._n_envs = n_envs
         self._storage_kind = storage
@@ -674,6 +677,7 @@ class AsyncReplayBuffer:
         self._sequential = sequential
         self._obs_keys = tuple(obs_keys)
         self._seed = seed
+        self._split = split
         self._buf: list[ReplayBuffer] | None = None
         self._np_rng = np.random.default_rng(seed)
 
@@ -732,16 +736,33 @@ class AsyncReplayBuffer:
         n_samples: int = 1,
         **_: object,
     ) -> Batch:
-        """Partitions the batch across env-buffers via bincount and
-        concatenates on the batch axis (buffers.py:687-699)."""
+        """Partitions the batch across env-buffers and concatenates on the
+        batch axis (buffers.py:687-699).
+
+        The default `split="even"` partition is a TPU-first redesign: every
+        env contributes `B // n_envs` samples (the remainder rotates across
+        envs), so the per-env device gathers keep STATIC shapes — at most
+        two compiled variants per env, and no recompiles in the steady
+        state. The reference's multinomial bincount partition
+        (buffers.py:687-693) draws a different count vector every call,
+        which under jit would recompile the gather for each new shape; it
+        remains available as `split="multinomial"` (host-storage runs lose
+        nothing by using it)."""
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError("batch_size and n_samples must be > 0")
         if self._buf is None:
             raise RuntimeError("no samples in buffer; call add() first")
-        counts = np.bincount(
-            self._np_rng.integers(0, self._n_envs, size=batch_size),
-            minlength=self._n_envs,
-        )
+        if self._split == "even":
+            base, rem = divmod(batch_size, self._n_envs)
+            counts = np.full(self._n_envs, base, dtype=np.int64)
+            if rem:
+                start = int(self._np_rng.integers(0, self._n_envs))
+                counts[(start + np.arange(rem)) % self._n_envs] += 1
+        else:
+            counts = np.bincount(
+                self._np_rng.integers(0, self._n_envs, size=batch_size),
+                minlength=self._n_envs,
+            )
         parts = []
         for b, n in zip(self._buf, counts):
             if n == 0:

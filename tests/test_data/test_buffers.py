@@ -205,6 +205,42 @@ class TestAsyncReplayBuffer:
         s = arb.sample(32)
         assert s["observations"].shape == (32, 1)
 
+    def test_even_split_static_shapes(self):
+        # the default partition draws B // n_envs from every env (remainder
+        # rotating), so per-env gather shapes stay static under jit
+        arb = AsyncReplayBuffer(16, n_envs=4, storage="host", sequential=False)
+        arb.add(make_rows(8, 4))
+        # spy on the per-env sample sizes actually requested
+        requested: list[tuple[int, ...]] = []
+        originals = [b.sample for b in arb.buffer]
+        for b, orig in zip(arb.buffer, originals):
+            def spied(n, *a, _orig=orig, **kw):
+                requested.append(n)
+                return _orig(n, *a, **kw)
+            b.sample = spied
+        for _ in range(20):
+            s = arb.sample(8)
+            assert s["observations"].shape == (8, 1)
+        # divisible batch: every env contributes exactly B // n_envs
+        assert set(requested) == {2}
+        # indivisible batch: per-env counts are only floor/floor+1 — at most
+        # two distinct shapes ever reach the jitted gather
+        requested.clear()
+        for _ in range(20):
+            arb.sample(5)
+        assert set(requested) <= {1, 2}
+        assert sum(requested) == 20 * 5
+
+    def test_multinomial_split_still_available(self):
+        arb = AsyncReplayBuffer(
+            16, n_envs=4, storage="host", sequential=False, split="multinomial"
+        )
+        arb.add(make_rows(8, 4))
+        s = arb.sample(32)
+        assert s["observations"].shape == (32, 1)
+        with pytest.raises(ValueError, match="split"):
+            AsyncReplayBuffer(16, n_envs=4, split="bogus")
+
 
 @pytest.mark.parametrize("storage", STORAGES)
 def test_state_dict_roundtrip(storage):

@@ -8,13 +8,16 @@ north-star shape (config 4/5) with the host env-step cost removed. Metric is
 env-steps/sec/chip, the reference's `Time/step_per_second`
 (/root/reference/sheeprl/algos/dreamer_v3/dreamer_v3.py:675).
 
-The one JSON line carries three measurements (VERDICT r1 #4/#5 receipts):
+The one JSON line carries four measurements (VERDICT r1 #4/#5 receipts):
   - value / duty_cycle_sps: the jitted policy-step + single-jit update duty
     cycle at train_every=5, one fixed device-resident batch (device pipeline
-    only), with the better of kernels-on/off;
+    only), with the best of kernels-on/off x f32/bf16;
   - pallas_on_sps / pallas_off_sps: the same cycle with the Pallas kernel
     pass (LayerNorm-GRU cell, two-hot log-prob) enabled / disabled — the
     kernel-keep decision is made from these numbers at runtime;
+  - bf16_sps: the same cycle under --precision bfloat16 on the winning
+    kernel config; bf16_kept records whether it beat f32 (the e2e run then
+    uses the winning precision);
   - e2e_sps: the honest end-to-end loop — AsyncReplayBuffer.add every env
     step, rb.sample -> uint8 preservation/float cast -> host->device
     transfer -> train step — i.e. everything the framework owns including
@@ -110,6 +113,7 @@ def _dv3_player_fns(args, actions_dim, is_continuous):
             discrete_size=args.discrete_size,
             recurrent_state_size=args.recurrent_state_size,
             is_continuous=is_continuous,
+            compute_dtype=args.precision,
         )
 
     player_step = jax.jit(lambda p, s, o, k: p.step(s, o, k, jnp.float32(0.0)))
@@ -266,8 +270,8 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         import jax
         import jax.numpy as jnp
 
-        state_ = jax.tree_util.tree_map(jnp.copy, state_)
         try:
+            state_ = jax.tree_util.tree_map(jnp.copy, state_)
             return fn(args_, state_, *fn_args)
         except Exception:
             traceback.print_exc(file=sys.stderr)

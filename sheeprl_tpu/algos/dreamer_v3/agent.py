@@ -683,21 +683,25 @@ class PlayerDV3(nn.Module):
     discrete_size: int = nn.static(default=32)
     recurrent_state_size: int = nn.static(default=512)
     is_continuous: bool = nn.static(default=False)
+    # "bfloat16" runs the encoder/recurrent/latent path in bf16 (actions are
+    # still sampled from f32 logits — Actor heads always cast)
+    compute_dtype: str = nn.static(default="float32")
 
     def init_states(self, n_envs: int) -> PlayerState:
         """Zero actions, zero recurrent state, transition-mode stochastic
         state (reference agent.py:501-522)."""
-        recurrent = jnp.zeros((n_envs, self.recurrent_state_size))
+        dt = jnp.dtype(self.compute_dtype)
+        recurrent = jnp.zeros((n_envs, self.recurrent_state_size), dt)
         stochastic = self.rssm._transition(recurrent, key=None)[1]
         return PlayerState(
-            actions=jnp.zeros((n_envs, int(sum(self.actions_dim)))),
+            actions=jnp.zeros((n_envs, int(sum(self.actions_dim))), dt),
             recurrent_state=recurrent,
             stochastic_state=stochastic.reshape(n_envs, -1),
         )
 
     def reset_states(self, state: PlayerState, reset_mask: jax.Array) -> PlayerState:
         """Re-initialize the rows where `reset_mask` ([N] bool/float) is set."""
-        m = reset_mask.reshape(-1, 1).astype(jnp.float32)
+        m = reset_mask.reshape(-1, 1).astype(state.recurrent_state.dtype)
         fresh = self.init_states(state.actions.shape[0])
         return PlayerState(
             actions=(1 - m) * state.actions + m * fresh.actions,
@@ -718,6 +722,8 @@ class PlayerDV3(nn.Module):
         `expl_amount` is a traced scalar so exploration decay never
         recompiles. Returns (new_state, actions [N, sum(actions_dim)])."""
         k_repr, k_act, k_expl = jax.random.split(key, 3)
+        dt = jnp.dtype(self.compute_dtype)
+        obs = {k: v.astype(dt) for k, v in obs.items()}
         embedded = self.encoder(obs)
         recurrent = self.rssm.recurrent_model(
             jnp.concatenate([state.stochastic_state, state.actions], axis=-1),
@@ -729,7 +735,8 @@ class PlayerDV3(nn.Module):
         actions, _ = self.actor(latent, key=k_act, is_training=is_training, mask=mask)
         cat = exploration_actions(actions, self.is_continuous, expl_amount, k_expl)
         new_state = PlayerState(
-            actions=cat, recurrent_state=recurrent, stochastic_state=stochastic
+            actions=cat.astype(dt), recurrent_state=recurrent,
+            stochastic_state=stochastic,
         )
         return new_state, cat
 

@@ -501,6 +501,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             discrete_size=args.discrete_size,
             recurrent_state_size=args.recurrent_state_size,
             is_continuous=is_continuous,
+            compute_dtype=args.precision,
         )
 
     player = make_player(state)

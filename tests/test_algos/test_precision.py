@@ -89,6 +89,39 @@ def test_bfloat16_step_finite_and_close_to_f32():
         )
 
 
+def test_bfloat16_player_step():
+    from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3
+
+    args = _tiny_args("bfloat16")
+    obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+    world_model, actor, *_ = build_models(
+        jax.random.PRNGKey(0), [3], False, args, obs_space, ["rgb"], []
+    )
+    player = PlayerDV3(
+        encoder=world_model.encoder,
+        rssm=world_model.rssm,
+        actor=actor,
+        actions_dim=(3,),
+        stochastic_size=args.stochastic_size,
+        discrete_size=args.discrete_size,
+        recurrent_state_size=args.recurrent_state_size,
+        is_continuous=False,
+        compute_dtype="bfloat16",
+    )
+    state = player.init_states(2)
+    assert state.recurrent_state.dtype == jnp.bfloat16
+    obs = {"rgb": jnp.zeros((2, 64, 64, 3), jnp.float32)}
+    new_state, actions = jax.jit(
+        lambda p, s, o, k: p.step(s, o, k, jnp.float32(0.0))
+    )(player, state, obs, jax.random.PRNGKey(1))
+    # env-facing actions stay f32 one-hots; the carry stays bf16
+    assert actions.dtype == jnp.float32
+    assert new_state.recurrent_state.dtype == jnp.bfloat16
+    assert np.all(np.isfinite(np.asarray(actions)))
+    reset = player.reset_states(new_state, jnp.array([1.0, 0.0]))
+    assert reset.recurrent_state.dtype == jnp.bfloat16
+
+
 def test_bfloat16_params_actually_update():
     state_bf, _ = _run_one_step("bfloat16")
     args = _tiny_args("bfloat16")

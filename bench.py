@@ -184,7 +184,7 @@ def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
     import numpy as np
 
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
-    from sheeprl_tpu.data import AsyncReplayBuffer
+    from sheeprl_tpu.data import AsyncReplayBuffer, stage_batch
 
     T, B = args.per_rank_sequence_length, args.per_rank_batch_size
     n_envs = args.num_envs
@@ -235,12 +235,8 @@ def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
             player_state, _ = player_step(player, player_state, dev_obs, sk)
             add_step(obs_u8)
         local_data = rb.sample(B, sequence_length=T, n_samples=1)
-        sample = {
-            k: jnp.asarray(v[0]).astype(
-                jnp.float32 if v.dtype != np.uint8 else jnp.uint8
-            )
-            for k, v in local_data.items()
-        }
+        staged = stage_batch(local_data)
+        sample = {k: v[0] for k, v in staged.items()}
         key, tk = jax.random.split(key)
         state, metrics = train_step(state, sample, tk, jnp.float32(0.02))
         jax.block_until_ready(metrics)

@@ -26,7 +26,7 @@ import numpy as np
 import optax
 
 from ... import nn, ops
-from ...data import AsyncReplayBuffer, EpisodeBuffer
+from ...data import AsyncReplayBuffer, EpisodeBuffer, stage_batch
 from ...ops.distributions import (
     Bernoulli,
     Independent,
@@ -650,14 +650,10 @@ def main(argv: Sequence[str] | None = None) -> None:
                     n_samples=n_samples,
                     prioritize_ends=args.prioritize_ends,
                 )
+            staged = stage_batch(local_data, to_host=jax.process_count() > 1)
             for i in range(n_samples):
                 tau = 1.0 if gradient_steps % args.critic_target_network_update_freq == 0 else 0.0
-                sample = {
-                    k: jnp.asarray(v[i]).astype(
-                        jnp.float32 if v.dtype != np.uint8 else jnp.uint8
-                    )
-                    for k, v in local_data.items()
-                }
+                sample = {k: v[i] for k, v in staged.items()}
                 if n_dev > 1:
                     sample = shard_batch(sample, mesh, axis=1)
                 key, train_key = jax.random.split(key)

@@ -33,7 +33,7 @@ import numpy as np
 import optax
 
 from ... import nn, ops
-from ...data import AsyncReplayBuffer
+from ...data import AsyncReplayBuffer, stage_batch
 from ...envs import make_vector_env
 from ...envs.wrappers import RestartOnException
 from ...ops.distributions import (
@@ -666,17 +666,13 @@ def main(argv: Sequence[str] | None = None) -> None:
                 sequence_length=args.per_rank_sequence_length,
                 n_samples=n_samples,
             )
+            staged = stage_batch(local_data, to_host=jax.process_count() > 1)
             for i in range(n_samples):
                 if gradient_steps % args.critic_target_network_update_freq == 0:
                     tau = 1.0 if gradient_steps == 0 else args.critic_tau
                 else:
                     tau = 0.0
-                sample = {
-                    k: jnp.asarray(v[i]).astype(
-                        jnp.float32 if v.dtype != np.uint8 else jnp.uint8
-                    )
-                    for k, v in local_data.items()
-                }
+                sample = {k: v[i] for k, v in staged.items()}
                 if n_dev > 1:
                     sample = shard_batch(sample, mesh, axis=1)
                 key, train_key = jax.random.split(key)

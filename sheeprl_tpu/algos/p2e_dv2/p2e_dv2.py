@@ -26,7 +26,7 @@ import numpy as np
 import optax
 
 from ... import nn, ops
-from ...data import AsyncReplayBuffer, EpisodeBuffer
+from ...data import AsyncReplayBuffer, EpisodeBuffer, stage_batch
 from ...envs import make_vector_env
 from ...ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
 from ...parallel import (
@@ -775,14 +775,10 @@ def main(argv: Sequence[str] | None = None) -> None:
                     prioritize_ends=args.prioritize_ends,
                 )
             train_step = train_step_exploring if is_exploring else train_step_task
+            staged = stage_batch(local_data, to_host=jax.process_count() > 1)
             for i in range(n_samples):
                 tau = 1.0 if gradient_steps % args.critic_target_network_update_freq == 0 else 0.0
-                sample = {
-                    k: jnp.asarray(v[i]).astype(
-                        jnp.float32 if v.dtype != np.uint8 else jnp.uint8
-                    )
-                    for k, v in local_data.items()
-                }
+                sample = {k: v[i] for k, v in staged.items()}
                 if n_dev > 1:
                     sample = shard_batch(sample, mesh, axis=1)
                 key, train_key = jax.random.split(key)

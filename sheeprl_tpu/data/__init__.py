@@ -1,3 +1,15 @@
-from .buffers import AsyncReplayBuffer, EpisodeBuffer, ReplayBuffer, SequentialReplayBuffer
+from .buffers import (
+    AsyncReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+    stage_batch,
+)
 
-__all__ = ["ReplayBuffer", "SequentialReplayBuffer", "EpisodeBuffer", "AsyncReplayBuffer"]
+__all__ = [
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "EpisodeBuffer",
+    "AsyncReplayBuffer",
+    "stage_batch",
+]

@@ -47,9 +47,46 @@ __all__ = [
     "SequentialReplayBuffer",
     "EpisodeBuffer",
     "AsyncReplayBuffer",
+    "stage_batch",
 ]
 
 Batch = dict[str, np.ndarray]
+DeviceBatch = dict[str, jax.Array]
+
+
+def stage_batch(
+    local_data: Mapping[str, "np.ndarray | jax.Array"], *, to_host: bool = False
+) -> "Batch | DeviceBatch":
+    """Stage a sampled `[n_samples, ...]` block for the gradient loop in ONE
+    conversion per key: uint8 preserved (pixels normalize on device inside
+    the train step), everything else cast to f32.
+
+    Default (`to_host=False`): the block lands on device, the Dreamer mains
+    index it per gradient step (`v[i]`), so the row slice happens on device
+    and the host->device DMA overlaps the in-flight update via JAX async
+    dispatch — replacing a per-row transfer that serialized host staging
+    with device compute (the reference moves rows eagerly per step,
+    dreamer_v3.py:635-646). The whole block lives in HBM for the duration
+    of the loop — the same arrays a device-storage buffer already gathered.
+
+    `to_host=True` is for multi-process runs: `shard_batch`'s
+    `make_array_from_process_local_data` path needs host numpy per row, so
+    staging pulls the block to host once (one d2h for device-storage
+    buffers) instead of paying a synchronous per-row device round-trip."""
+    if to_host:
+        return {
+            k: np.asarray(v).astype(
+                np.float32 if np.asarray(v).dtype != np.uint8 else np.uint8,
+                copy=False,
+            )
+            for k, v in local_data.items()
+        }
+    return {
+        k: jnp.asarray(v).astype(
+            jnp.float32 if v.dtype != np.uint8 else jnp.uint8
+        )
+        for k, v in local_data.items()
+    }
 
 
 def _as_time_env(data: Mapping[str, np.ndarray]) -> Batch:

@@ -702,6 +702,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "batch_size": args.per_rank_batch_size,
                 },
                 args=args,
+                block=args.dry_run or global_step == num_updates,
             )
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")

@@ -317,6 +317,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "global_step": global_step,
                 },
                 args=args,
+                block=args.dry_run or global_step == num_updates,
             )
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + ".buffer.npz")

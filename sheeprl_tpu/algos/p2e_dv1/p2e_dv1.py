@@ -699,6 +699,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "critic_exploration_optimizer": state.critic_exploration_opt,
                 },
                 args=args,
+                block=args.dry_run or global_step == num_updates,
             )
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")

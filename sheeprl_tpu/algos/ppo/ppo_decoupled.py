@@ -229,6 +229,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 os.path.join(log_dir, "checkpoints", f"ckpt_{update}"),
                 {"agent": state.agent, "optimizer": state.opt_state, "update_step": update},
                 args=args,
+                block=args.dry_run or update == num_updates,
             )
 
     envs.close()

@@ -342,6 +342,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "update_step": update,
                 },
                 args=args,
+                block=args.dry_run or update == num_updates,
             )
 
     envs.close()

@@ -76,8 +76,7 @@ def stage_batch(
     if to_host:
         return {
             k: np.asarray(v).astype(
-                np.float32 if np.asarray(v).dtype != np.uint8 else np.uint8,
-                copy=False,
+                np.float32 if v.dtype != np.uint8 else np.uint8, copy=False
             )
             for k, v in local_data.items()
         }

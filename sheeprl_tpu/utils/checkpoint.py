@@ -13,18 +13,50 @@ self-describing and resume can rebuild the exact config
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 from typing import Any
 
 import orbax.checkpoint as ocp
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint"]
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "latest_checkpoint",
+    "wait_checkpoint",
+]
+
+# one async checkpointer per process: saves overlap training (orbax commits
+# atomically via tmp-dir + rename, so a crash mid-save leaves the previous
+# checkpoint intact) and at most one save is in flight at a time
+_CKPTR: ocp.StandardCheckpointer | None = None
 
 
-def save_checkpoint(path: str, state: dict[str, Any], args: Any = None) -> None:
+def _checkpointer() -> ocp.StandardCheckpointer:
+    global _CKPTR
+    if _CKPTR is None:
+        _CKPTR = ocp.StandardCheckpointer()
+        atexit.register(wait_checkpoint)
+    return _CKPTR
+
+
+def wait_checkpoint() -> None:
+    """Block until the in-flight async save (if any) has committed."""
+    if _CKPTR is not None:
+        _CKPTR.wait_until_finished()
+
+
+def save_checkpoint(
+    path: str, state: dict[str, Any], args: Any = None, block: bool = False
+) -> None:
     """Save `state` (a pytree of arrays/Modules/ints) at `path` (a directory);
     optionally store the run config alongside as args.json.
+
+    The write is asynchronous — training continues while orbax commits; the
+    next save (or `wait_checkpoint`/process exit) synchronizes. Pass
+    `block=True` for the final checkpoint of a run so callers observe it on
+    return (the reference's `fabric.save` is always blocking).
 
     Multi-host: process 0 writes alone — params/opt-state are replicated so
     its copy is complete (the SPMD analog of the reference's rank-0
@@ -45,9 +77,11 @@ def save_checkpoint(path: str, state: dict[str, Any], args: Any = None) -> None:
         return x
 
     state = jax.tree_util.tree_map(_to_host, state)
-    ckptr = ocp.StandardCheckpointer()
+    ckptr = _checkpointer()
+    ckptr.wait_until_finished()  # at most one outstanding save
     ckptr.save(path, state, force=True)
-    ckptr.wait_until_finished()
+    if block:
+        ckptr.wait_until_finished()
     if args is not None:
         cfg = args.as_dict() if hasattr(args, "as_dict") else dict(args)
         with open(path + ".args.json", "w") as fh:
@@ -58,6 +92,7 @@ def load_checkpoint(path: str, template: dict[str, Any] | None = None) -> dict[s
     """Restore a checkpoint. With `template` (a pytree of the same structure,
     e.g. freshly-initialized models), leaves are restored into the template's
     types (Module dataclasses stay Modules); without it, raw nested dicts."""
+    wait_checkpoint()  # never read past an in-flight save
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
     if template is None:

@@ -263,9 +263,12 @@ class RSSMV2(RSSM):
         key,
     ):
         k_prior, k_post = jax.random.split(key)
-        is_first = is_first.astype(jnp.float32)
-        action = (1.0 - is_first) * action
-        posterior_flat = (1.0 - is_first) * posterior.reshape(*posterior.shape[:-2], -1)
+        dt = recurrent_state.dtype
+        is_first = is_first.astype(dt)
+        action = (1.0 - is_first) * action.astype(dt)
+        posterior_flat = (1.0 - is_first) * posterior.astype(dt).reshape(
+            *posterior.shape[:-2], -1
+        )
         recurrent_state = (1.0 - is_first) * recurrent_state
         recurrent_state = self.recurrent_model(
             jnp.concatenate([posterior_flat, action], axis=-1), recurrent_state
@@ -284,11 +287,12 @@ class PlayerDV2(PlayerDV3):
     def init_states(self, n_envs: int):
         from ..dreamer_v3.agent import PlayerState
 
+        dt = jnp.dtype(self.compute_dtype)
         return PlayerState(
-            actions=jnp.zeros((n_envs, int(sum(self.actions_dim)))),
-            recurrent_state=jnp.zeros((n_envs, self.recurrent_state_size)),
+            actions=jnp.zeros((n_envs, int(sum(self.actions_dim))), dt),
+            recurrent_state=jnp.zeros((n_envs, self.recurrent_state_size), dt),
             stochastic_state=jnp.zeros(
-                (n_envs, self.stochastic_size * self.discrete_size)
+                (n_envs, self.stochastic_size * self.discrete_size), dt
             ),
         )
 

@@ -415,12 +415,20 @@ class RSSM(nn.Module):
         embedded_obs: jax.Array,  # [T, B, E]
         is_first: jax.Array,  # [T, B, 1]
         key,
+        remat: bool = False,
     ):
         """The full dynamic-learning sequence as ONE `lax.scan` over time —
         the reference's Python loop (dreamer_v3.py:117-124) fused into a
         single compiled recurrence. Returns stacked
         (recurrent_states [T,B,R], priors_logits [T,B,S*D],
-        posteriors [T,B,S,D], posteriors_logits [T,B,S*D])."""
+        posteriors [T,B,S,D], posteriors_logits [T,B,S*D]).
+
+        `remat=True` rematerializes the step body on the backward pass
+        (`jax.checkpoint`): per-step activations of the recurrent/transition/
+        representation MLPs are recomputed instead of stored across all T
+        steps — HBM footprint of the world-model backward drops from
+        O(T x intermediates) to O(T x states), buying batch/sequence size at
+        the cost of one extra forward."""
         keys = jax.random.split(key, actions.shape[0])
 
         def step(carry, inp):
@@ -431,6 +439,10 @@ class RSSM(nn.Module):
             )
             return (post, rec), (rec, prior_logits, post, post_logits)
 
+        if remat:
+            # prevent_cse=False: under lax.scan the loop-carried dependence
+            # already blocks the CSE that flag guards against
+            step = jax.checkpoint(step, prevent_cse=False)
         _, outs = jax.lax.scan(
             step, (posterior0, recurrent0), (actions, embedded_obs, is_first, keys)
         )

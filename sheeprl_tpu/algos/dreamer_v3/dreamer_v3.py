@@ -153,7 +153,13 @@ def make_train_step(
             recurrent0 = jnp.zeros((B, args.recurrent_state_size), compute_dtype)
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
                 wm.rssm.scan_dynamic(
-                    posterior0, recurrent0, batch_actions, embedded, is_first, k_wm
+                    posterior0,
+                    recurrent0,
+                    batch_actions,
+                    embedded,
+                    is_first,
+                    k_wm,
+                    remat=args.remat,
                 )
             )
             latent_states = jnp.concatenate(

@@ -18,6 +18,16 @@ from typing import Any, Optional
 from ..utils.parser import Arg
 
 
+def require_float32(args: "StandardArgs") -> None:
+    """Guard for tasks without a bf16 compute path: reject the flag loudly
+    instead of silently training in f32 (call at the top of `main()`)."""
+    if args.precision != "float32":
+        raise NotImplementedError(
+            "--precision bfloat16 is currently implemented for "
+            "dreamer_v2/dreamer_v3 only"
+        )
+
+
 @dataclasses.dataclass
 class StandardArgs:
     exp_name: str = Arg(default="default", help="name of this experiment")

@@ -37,6 +37,7 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
+from ..args import require_float32
 from ..ppo.agent import one_hot_to_env_actions
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from ..dreamer_v2.utils import preprocess_obs, test
@@ -274,6 +275,7 @@ def make_train_step(
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV1Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

@@ -121,7 +121,11 @@ class MLPEncoder(nn.Module):
         return self.model.output_dim
 
     def __call__(self, obs: dict) -> jax.Array:
-        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1).astype(jnp.float32)
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        # non-float keys (bool masks, int counters) become f32; float inputs
+        # keep their dtype so bf16 compute flows through
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(jnp.float32)
         return self.model(x)
 
 

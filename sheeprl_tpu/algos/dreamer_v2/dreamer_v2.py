@@ -106,6 +106,9 @@ def make_train_step(
     stoch_size = args.stochastic_size * args.discrete_size
     horizon = args.horizon
     action_splits = np.cumsum(actions_dim)[:-1]
+    # --precision bfloat16: model forwards run in bf16, params stay f32,
+    # logits/losses stay f32 (same policy as dreamer_v3.make_train_step)
+    compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
 
     def train_step(state: DV2TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]
@@ -117,24 +120,35 @@ def make_train_step(
             lambda c, t: tau * c + (1.0 - tau) * t, state.critic, state.target_critic
         )
 
-        batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
-        batch_obs.update({k: data[k] for k in mlp_keys})
+        obs_targets = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
+        obs_targets.update({k: data[k] for k in mlp_keys})
+        batch_obs = {k: v.astype(compute_dtype) for k, v in obs_targets.items()}
         is_first = data["is_first"].at[0].set(1.0)
 
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
             embedded = wm.encoder(batch_obs)
-            posterior0 = jnp.zeros((B, args.stochastic_size, args.discrete_size))
-            recurrent0 = jnp.zeros((B, args.recurrent_state_size))
+            posterior0 = jnp.zeros(
+                (B, args.stochastic_size, args.discrete_size), compute_dtype
+            )
+            recurrent0 = jnp.zeros((B, args.recurrent_state_size), compute_dtype)
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
                 wm.rssm.scan_dynamic(
-                    posterior0, recurrent0, data["actions"], embedded, is_first, k_wm
+                    posterior0,
+                    recurrent0,
+                    data["actions"].astype(compute_dtype),
+                    embedded,
+                    is_first,
+                    k_wm,
                 )
             )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
             )
-            decoded = wm.observation_model(latent_states)
+            decoded = {
+                k: v.astype(jnp.float32)
+                for k, v in wm.observation_model(latent_states).items()
+            }
             po = {
                 k: Independent(
                     base=Normal(loc=decoded[k], scale=jnp.ones_like(decoded[k])),
@@ -142,13 +156,15 @@ def make_train_step(
                 )
                 for k in decoded
             }
-            pr_mean = wm.reward_model(latent_states)
+            pr_mean = wm.reward_model(latent_states).astype(jnp.float32)
             pr = Independent(
                 base=Normal(loc=pr_mean, scale=jnp.ones_like(pr_mean)), event_ndims=1
             )
             if args.use_continues:
                 pc = Independent(
-                    base=Bernoulli(logits=wm.continue_model(latent_states)),
+                    base=Bernoulli(
+                        logits=wm.continue_model(latent_states).astype(jnp.float32)
+                    ),
                     event_ndims=1,
                 )
                 continue_targets = (1.0 - data["dones"]) * args.gamma
@@ -157,7 +173,7 @@ def make_train_step(
             shaped = (T, B, args.stochastic_size, args.discrete_size)
             losses = reconstruction_loss(
                 po,
-                batch_obs,
+                obs_targets,
                 pr,
                 data["rewards"],
                 priors_logits.reshape(shaped),
@@ -196,7 +212,7 @@ def make_train_step(
                 latent = jnp.concatenate([prior, recurrent], axis=-1)
                 k_act, k_trans = jax.random.split(k)
                 acts, _ = actor(jax.lax.stop_gradient(latent), key=k_act)
-                action = jnp.concatenate(acts, axis=-1)
+                action = jnp.concatenate(acts, axis=-1).astype(prior.dtype)
                 new_prior, new_recurrent = world_model.rssm.imagination(
                     prior, recurrent, action, k_trans
                 )
@@ -216,12 +232,18 @@ def make_train_step(
                 [jnp.zeros_like(actions_h[:1]), actions_h], axis=0
             )  # [H+1, T*B, A]
 
-            predicted_target_values = target_critic(imagined_trajectories)
-            predicted_rewards = world_model.reward_model(imagined_trajectories)
+            predicted_target_values = target_critic(imagined_trajectories).astype(
+                jnp.float32
+            )
+            predicted_rewards = world_model.reward_model(
+                imagined_trajectories
+            ).astype(jnp.float32)
             if args.use_continues:
                 continues = Independent(
                     base=Bernoulli(
-                        logits=world_model.continue_model(imagined_trajectories)
+                        logits=world_model.continue_model(
+                            imagined_trajectories
+                        ).astype(jnp.float32)
                     ),
                     event_ndims=1,
                 ).mean
@@ -289,7 +311,7 @@ def make_train_step(
         lambda_sg = jax.lax.stop_gradient(lambda_values)
 
         def critic_loss_fn(critic):
-            qv_mean = critic(traj_sg)
+            qv_mean = critic(traj_sg).astype(jnp.float32)
             qv = Independent(
                 base=Normal(loc=qv_mean, scale=jnp.ones_like(qv_mean)), event_ndims=1
             )
@@ -444,6 +466,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             discrete_size=args.discrete_size,
             recurrent_state_size=args.recurrent_state_size,
             is_continuous=is_continuous,
+            compute_dtype=args.precision,
         )
 
     player = make_player(state)

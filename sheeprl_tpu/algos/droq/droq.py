@@ -38,6 +38,7 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
+from ..args import require_float32
 from ..sac.loss import critic_loss, entropy_loss, policy_loss
 from ..sac.sac import make_optimizers, policy_step
 from ..sac.utils import test
@@ -137,6 +138,7 @@ def make_train_step(args: DROQArgs, qf_optim, actor_optim, alpha_optim):
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DROQArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

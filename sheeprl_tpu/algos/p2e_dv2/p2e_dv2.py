@@ -43,6 +43,7 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
+from ..args import require_float32
 from ..ppo.agent import one_hot_to_env_actions
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from ..dreamer_v2.agent import PlayerDV2
@@ -455,6 +456,7 @@ def make_train_step(
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(P2EDV2Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

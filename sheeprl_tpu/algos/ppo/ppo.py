@@ -48,6 +48,7 @@ from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
+from ..args import require_float32
 from ...utils.parser import DataclassArgumentParser
 from .agent import PPOAgent, one_hot_to_env_actions
 from .args import PPOArgs
@@ -190,6 +191,7 @@ def test(agent: PPOAgent, env: gym.Env, logger, args: PPOArgs) -> float:
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(PPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

@@ -32,6 +32,7 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
+from ..args import require_float32
 from .agent import PPOAgent, one_hot_to_env_actions
 from .args import PPOArgs
 from .ppo import (
@@ -50,6 +51,7 @@ from .ppo import (
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(PPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

@@ -40,6 +40,7 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
+from ..args import require_float32
 from ..ppo.loss import entropy_loss, policy_loss, value_loss
 from ..ppo.ppo import make_optimizer
 from .agent import RecurrentPPOAgent
@@ -158,6 +159,7 @@ def test(agent: RecurrentPPOAgent, env: gym.Env, logger, args, obs_key: str) -> 
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(RecurrentPPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

@@ -45,6 +45,7 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
+from ..args import require_float32
 from .agent import SACAgent
 from .args import SACArgs
 from .loss import critic_loss, entropy_loss, policy_loss
@@ -155,6 +156,7 @@ def policy_step(actor, obs, key):
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

@@ -43,6 +43,7 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
+from ..args import require_float32
 from ..ppo.ppo import validate_obs_keys
 from ..sac.loss import critic_loss, entropy_loss, policy_loss
 from .agent import (
@@ -241,6 +242,7 @@ def _policy_step_fn(cnn_keys):
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACAEArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

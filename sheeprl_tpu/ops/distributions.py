@@ -114,6 +114,9 @@ class TanhNormal(Distribution):
         return self.sample_and_log_prob(key, sample_shape)[0]
 
     def log_prob(self, value):
+        # f32 throughout: in bf16 the clip bound 1 - 1e-6 rounds to exactly
+        # 1.0 and arctanh(1.0) = inf would poison the loss
+        value = value.astype(jnp.float32)
         eps = 1e-6
         u = jnp.arctanh(jnp.clip(value, -1.0 + eps, 1.0 - eps))
         base_lp = (

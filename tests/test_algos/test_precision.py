@@ -140,6 +140,86 @@ def test_bfloat16_player_step():
     assert reset.recurrent_state.dtype == jnp.bfloat16
 
 
+def _run_one_dv2_step(precision, continuous=False):
+    from sheeprl_tpu.algos.dreamer_v2 import agent as dv2_agent
+    from sheeprl_tpu.algos.dreamer_v2.args import DreamerV2Args
+    from sheeprl_tpu.algos.dreamer_v2 import dreamer_v2 as dv2
+
+    args = DreamerV2Args(num_envs=2, env_id="dummy")
+    args.cnn_keys, args.mlp_keys = ["rgb"], []
+    args.dense_units = 16
+    args.hidden_size = 16
+    args.recurrent_state_size = 16
+    args.cnn_channels_multiplier = 4
+    args.stochastic_size = 4
+    args.discrete_size = 4
+    args.horizon = 4
+    args.mlp_layers = 1
+    args.precision = precision
+    T, B = 5, 3
+    actions_dim = [2] if continuous else [3]
+    obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+    world_model, actor, critic, target_critic = dv2_agent.build_models(
+        jax.random.PRNGKey(0), actions_dim, continuous, args, obs_space, ["rgb"], []
+    )
+    world_opt, actor_opt, critic_opt = dv2.make_optimizers(args)
+    state = dv2.DV2TrainState(
+        world_model=world_model,
+        actor=actor,
+        critic=critic,
+        target_critic=target_critic,
+        world_opt=world_opt.init(world_model),
+        actor_opt=actor_opt.init(actor),
+        critic_opt=critic_opt.init(critic),
+    )
+    train_step = dv2.make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim, continuous
+    )
+    rng = np.random.default_rng(0)
+    if continuous:
+        actions = np.tanh(rng.normal(size=(T, B, 2)) * 3).astype(np.float32)
+    else:
+        actions = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (T, B))]
+    data = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 64, 64, 3), dtype=np.uint8)),
+        "actions": jnp.asarray(actions),
+        "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+        "dones": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+    _, metrics = jax.jit(train_step)(
+        state, data, jax.random.PRNGKey(7), jnp.float32(1.0)
+    )
+    return {k: float(v) for k, v in metrics.items()}
+
+
+def test_dv2_bfloat16_step_finite_and_close_to_f32():
+    m_bf = _run_one_dv2_step("bfloat16")
+    m_f32 = _run_one_dv2_step("float32")
+    assert all(np.isfinite(v) for v in m_bf.values()), m_bf
+    for name in ("Loss/reconstruction_loss", "Loss/reward_loss", "State/kl"):
+        ref = abs(m_f32[name]) + 1.0
+        assert abs(m_bf[name] - m_f32[name]) / ref < 0.15, (
+            name, m_bf[name], m_f32[name],
+        )
+
+
+def test_dv2_bfloat16_continuous_actions_finite():
+    # saturated tanh actions round to exactly +/-1 in bf16; TanhNormal's
+    # log_prob computes in f32 so the actor loss stays finite
+    m = _run_one_dv2_step("bfloat16", continuous=True)
+    assert all(np.isfinite(v) for v in m.values()), m
+
+
+def test_unsupported_tasks_reject_bfloat16():
+    import sheeprl_tpu.algos  # noqa: F401
+    from sheeprl_tpu.utils.registry import tasks
+
+    for task in ("ppo", "sac", "dreamer_v1"):
+        with pytest.raises(NotImplementedError, match="bfloat16"):
+            tasks[task](["--precision", "bfloat16", "--dry_run"])
+
+
 def test_bfloat16_params_actually_update():
     state_bf, _ = _run_one_step("bfloat16")
     args = _tiny_args("bfloat16")

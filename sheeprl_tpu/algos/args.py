@@ -24,7 +24,7 @@ def require_float32(args: "StandardArgs") -> None:
     if args.precision != "float32":
         raise NotImplementedError(
             "--precision bfloat16 is currently implemented for "
-            "dreamer_v2/dreamer_v3 only"
+            "dreamer_v2/dreamer_v3/p2e_dv2 only"
         )
 
 

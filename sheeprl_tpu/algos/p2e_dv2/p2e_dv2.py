@@ -43,7 +43,6 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from ..ppo.agent import one_hot_to_env_actions
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from ..dreamer_v2.agent import PlayerDV2
@@ -112,6 +111,9 @@ def make_train_step(
     stoch_size = args.stochastic_size * args.discrete_size
     horizon = args.horizon
     action_splits = np.cumsum(actions_dim)[:-1]
+    # --precision bfloat16: same policy as dreamer_v2/dreamer_v3 — forwards
+    # in bf16, f32 master params, f32 logits/losses/ensemble-disagreement
+    compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
 
     def behaviour_update(
         actor, critic, target_critic, actor_opt, critic_opt,
@@ -131,7 +133,7 @@ def make_train_step(
                 latent = jnp.concatenate([prior, recurrent], axis=-1)
                 k_act, k_trans = jax.random.split(k)
                 acts, _ = actor(jax.lax.stop_gradient(latent), key=k_act)
-                action = jnp.concatenate(acts, axis=-1)
+                action = jnp.concatenate(acts, axis=-1).astype(prior.dtype)
                 new_prior, new_recurrent = world_model.rssm.imagination(
                     prior, recurrent, action, k_trans
                 )
@@ -146,12 +148,18 @@ def make_train_step(
                 [jnp.zeros_like(actions_h[:1]), actions_h], axis=0
             )  # [H+1, T*B, A]
 
-            predicted_target_values = target_critic(imagined_trajectories)
-            rewards = reward_fn(imagined_trajectories, imagined_actions)
+            predicted_target_values = target_critic(imagined_trajectories).astype(
+                jnp.float32
+            )
+            rewards = reward_fn(imagined_trajectories, imagined_actions).astype(
+                jnp.float32
+            )
             if args.use_continues:
                 continues = Independent(
                     base=Bernoulli(
-                        logits=world_model.continue_model(imagined_trajectories)
+                        logits=world_model.continue_model(
+                            imagined_trajectories
+                        ).astype(jnp.float32)
                     ),
                     event_ndims=1,
                 ).mean
@@ -214,7 +222,7 @@ def make_train_step(
         lambda_sg = jax.lax.stop_gradient(lambda_values)
 
         def critic_loss_fn(critic):
-            qv_mean = critic(traj_sg)
+            qv_mean = critic(traj_sg).astype(jnp.float32)
             qv = Independent(
                 base=Normal(loc=qv_mean, scale=jnp.ones_like(qv_mean)), event_ndims=1
             )
@@ -249,25 +257,36 @@ def make_train_step(
             state.target_critic_exploration,
         )
 
-        batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
-        batch_obs.update({k: data[k] for k in mlp_keys})
+        obs_targets = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
+        obs_targets.update({k: data[k] for k in mlp_keys})
+        batch_obs = {k: v.astype(compute_dtype) for k, v in obs_targets.items()}
         is_first = data["is_first"].at[0].set(1.0)
 
         # ---- world model (reward/continue on detached latents) --------------
         def world_loss_fn(wm: WorldModel):
             embedded = wm.encoder(batch_obs)
-            posterior0 = jnp.zeros((B, args.stochastic_size, args.discrete_size))
-            recurrent0 = jnp.zeros((B, args.recurrent_state_size))
+            posterior0 = jnp.zeros(
+                (B, args.stochastic_size, args.discrete_size), compute_dtype
+            )
+            recurrent0 = jnp.zeros((B, args.recurrent_state_size), compute_dtype)
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
                 wm.rssm.scan_dynamic(
-                    posterior0, recurrent0, data["actions"], embedded, is_first, k_wm
+                    posterior0,
+                    recurrent0,
+                    data["actions"].astype(compute_dtype),
+                    embedded,
+                    is_first,
+                    k_wm,
                 )
             )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
             )
             latents_sg = jax.lax.stop_gradient(latent_states)
-            decoded = wm.observation_model(latent_states)
+            decoded = {
+                k: v.astype(jnp.float32)
+                for k, v in wm.observation_model(latent_states).items()
+            }
             po = {
                 k: Independent(
                     base=Normal(loc=decoded[k], scale=jnp.ones_like(decoded[k])),
@@ -275,13 +294,16 @@ def make_train_step(
                 )
                 for k in decoded
             }
-            pr_mean = wm.reward_model(latents_sg)
+            pr_mean = wm.reward_model(latents_sg).astype(jnp.float32)
             pr = Independent(
                 base=Normal(loc=pr_mean, scale=jnp.ones_like(pr_mean)), event_ndims=1
             )
             if args.use_continues:
                 pc = Independent(
-                    base=Bernoulli(logits=wm.continue_model(latents_sg)), event_ndims=1
+                    base=Bernoulli(
+                        logits=wm.continue_model(latents_sg).astype(jnp.float32)
+                    ),
+                    event_ndims=1,
                 )
                 continue_targets = (1.0 - data["dones"]) * args.gamma
             else:
@@ -289,7 +311,7 @@ def make_train_step(
             shaped = (T, B, args.stochastic_size, args.discrete_size)
             losses = reconstruction_loss(
                 po,
-                batch_obs,
+                obs_targets,
                 pr,
                 data["rewards"],
                 priors_logits.reshape(shaped),
@@ -344,7 +366,9 @@ def make_train_step(
         )
         if exploring:
             # ---- ensemble learning: predict the next posterior --------------
-            posteriors_flat_sg = jax.lax.stop_gradient(posteriors).reshape(T, B, -1)
+            posteriors_flat_sg = (
+                jax.lax.stop_gradient(posteriors).reshape(T, B, -1).astype(jnp.float32)
+            )
             ens_input = jnp.concatenate(
                 [
                     posteriors_flat_sg,
@@ -370,12 +394,15 @@ def make_train_step(
             metrics["Grads/ensemble"] = optax.global_norm(ens_grads)
 
             def intrinsic_reward_fn(traj, actions):
+                # disagreement in f32 end to end: the ensemble is trained on
+                # f32 inputs, and under bf16 the per-member rounding noise
+                # (~2^-9 relative) would floor the variance signal
                 preds = ensemble_apply(
                     ensembles,
                     jnp.concatenate(
                         [jax.lax.stop_gradient(traj), jax.lax.stop_gradient(actions)],
                         axis=-1,
-                    ),
+                    ).astype(jnp.float32),
                 )  # [N_ens, H+1, T*B, S*D]
                 return (
                     preds.var(axis=0).mean(axis=-1, keepdims=True)
@@ -456,7 +483,6 @@ def make_train_step(
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(P2EDV2Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -571,6 +597,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             discrete_size=args.discrete_size,
             recurrent_state_size=args.recurrent_state_size,
             is_continuous=is_continuous,
+            compute_dtype=args.precision,
         )
 
     player_step = jax.jit(

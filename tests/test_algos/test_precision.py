@@ -211,6 +211,70 @@ def test_dv2_bfloat16_continuous_actions_finite():
     assert all(np.isfinite(v) for v in m.values()), m
 
 
+@pytest.mark.timeout(600)
+def test_p2e_dv2_bfloat16_exploring_step():
+    """The EXPLORING train step under bf16 — ensemble fit + intrinsic
+    disagreement reward + dual actor-critic (a dry run never reaches this
+    branch: exploration flips off before the single training call)."""
+    from sheeprl_tpu.algos.p2e_dv2 import p2e_dv2 as p2e
+    from sheeprl_tpu.algos.p2e_dv2.agent import build_models as build_p2e
+    from sheeprl_tpu.algos.p2e_dv2.args import P2EDV2Args
+
+    args = P2EDV2Args(num_envs=2, env_id="dummy")
+    args.cnn_keys, args.mlp_keys = ["rgb"], []
+    args.dense_units = 8
+    args.hidden_size = 8
+    args.recurrent_state_size = 8
+    args.cnn_channels_multiplier = 2
+    args.stochastic_size = 4
+    args.discrete_size = 4
+    args.horizon = 4
+    args.mlp_layers = 1
+    args.num_ensembles = 2
+    args.precision = "bfloat16"
+    T, B = 4, 2
+    obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+    (world_model, actor_task, critic_task, target_critic_task, actor_expl,
+     critic_expl, target_critic_expl, ensembles) = build_p2e(
+        jax.random.PRNGKey(0), [3], False, args, obs_space, ["rgb"], []
+    )
+    optimizers = p2e.make_optimizers(args)
+    state = p2e.P2EDV2TrainState(
+        world_model=world_model,
+        actor_task=actor_task,
+        critic_task=critic_task,
+        target_critic_task=target_critic_task,
+        actor_exploration=actor_expl,
+        critic_exploration=critic_expl,
+        target_critic_exploration=target_critic_expl,
+        ensembles=ensembles,
+        world_opt=optimizers[0].init(world_model),
+        actor_task_opt=optimizers[1].init(actor_task),
+        critic_task_opt=optimizers[2].init(critic_task),
+        actor_exploration_opt=optimizers[3].init(actor_expl),
+        critic_exploration_opt=optimizers[4].init(critic_expl),
+        ensemble_opt=optimizers[5].init(ensembles),
+    )
+    train_step = p2e.make_train_step(
+        args, optimizers, ["rgb"], [], [3], False, exploring=True
+    )
+    rng = np.random.default_rng(0)
+    data = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 64, 64, 3), dtype=np.uint8)),
+        "actions": jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, (T, B))]),
+        "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+        "dones": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+    _, metrics = jax.jit(train_step)(
+        state, data, jax.random.PRNGKey(7), jnp.float32(1.0)
+    )
+    metrics = {k: float(v) for k, v in metrics.items()}
+    assert "Loss/ensemble_loss" in metrics
+    assert "Rewards/intrinsic" in metrics
+    assert all(np.isfinite(v) for v in metrics.values()), metrics
+
+
 def test_unsupported_tasks_reject_bfloat16():
     import sheeprl_tpu.algos  # noqa: F401
     from sheeprl_tpu.utils.registry import tasks

@@ -51,7 +51,8 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
-    shard_batch,
+    seq_axis_size,
+    shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
@@ -113,9 +114,18 @@ def make_train_step(
     mlp_keys: Sequence[str],
     actions_dim: Sequence[int],
     is_continuous: bool,
+    mesh=None,
 ):
     """Build the single-jit DreamerV3 update (reference train(),
-    dreamer_v3.py:48-313)."""
+    dreamer_v3.py:48-313).
+
+    With a 2-D `(data, seq)` mesh (`--seq_devices`), the step is
+    context-parallel: the `[T, B]` batch arrives time-sharded over "seq" and
+    batch-sharded over "data"; the per-timestep stages (conv encoder/decoder,
+    reward/continue heads, imagination over the T*B flattened axis) compute
+    in that layout, while sharding constraints reshard the RSSM scan's
+    inputs/outputs to batch-only — GSPMD inserts the all-gather/slice
+    collectives over ICI at the two phase boundaries."""
     stoch_size = args.stochastic_size * args.discrete_size
     horizon = args.horizon
     action_splits = np.cumsum(actions_dim)[:-1]
@@ -123,6 +133,19 @@ def make_train_step(
     # imagination) run in bf16 — params stay f32 (every layer casts its
     # weights to the input dtype), normalizations/logits/losses stay f32
     compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
+
+    seq_parallel = mesh is not None and seq_axis_size(mesh) > 1
+    if seq_parallel:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def constrain(x, *spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec))
+            )
+    else:
+
+        def constrain(x, *spec):
+            return x
 
     def train_step(state: DV3TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]
@@ -146,7 +169,10 @@ def make_train_step(
 
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
-            embedded = wm.encoder(batch_obs)
+            # encoder computes on the (seq, data)-sharded input layout; the
+            # scan needs full T per batch shard, so its inputs reshard to
+            # batch-only (an all-gather of the small embedding over "seq")
+            embedded = constrain(wm.encoder(batch_obs), None, "data")
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -155,13 +181,19 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    batch_actions,
+                    constrain(batch_actions, None, "data"),
                     embedded,
-                    is_first,
+                    constrain(is_first, None, "data"),
                     k_wm,
                     remat=args.remat,
                 )
             )
+            # back to time-sharded for the decoder/reward/continue heads —
+            # each "seq" shard keeps its own T-chunk (a local slice)
+            recurrent_states = constrain(recurrent_states, "seq", "data")
+            priors_logits = constrain(priors_logits, "seq", "data")
+            posteriors = constrain(posteriors, "seq", "data")
+            posteriors_logits = constrain(posteriors_logits, "seq", "data")
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
             )
@@ -213,11 +245,22 @@ def make_train_step(
         world_model = optax.apply_updates(state.world_model, wm_updates)
 
         # ---- behaviour: imagination + actor ---------------------------------
-        imagined_prior0 = jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size)
-        recurrent0 = jax.lax.stop_gradient(recurrent_states).reshape(
-            T * B, args.recurrent_state_size
+        # imagination flattens [T, B] -> rows; a (seq, data)-sharded [T, B]
+        # flattens to rows sharded over the full device grid, so the
+        # imagination scan, actor and critic parallelize over all devices
+        imagined_prior0 = constrain(
+            jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size),
+            ("seq", "data"),
         )
-        true_continue0 = (1.0 - data["dones"]).reshape(1, T * B, 1)
+        recurrent0 = constrain(
+            jax.lax.stop_gradient(recurrent_states).reshape(
+                T * B, args.recurrent_state_size
+            ),
+            ("seq", "data"),
+        )
+        true_continue0 = constrain(
+            (1.0 - data["dones"]).reshape(1, T * B, 1), None, ("seq", "data")
+        )
         img_keys = jax.random.split(k_img, horizon + 1)
 
         def actor_loss_fn(actor):
@@ -408,11 +451,18 @@ def main(argv: Sequence[str] | None = None) -> None:
     distributed_setup()
     rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
-    mesh = make_mesh(args.num_devices)
+    mesh = make_mesh(args.num_devices, seq_devices=args.seq_devices)
     n_dev = mesh.devices.size
-    # the global batch (per-process batch x world) shards over the global mesh
+    # the global batch (per-process batch x world) shards over the data axis;
+    # the sequence length shards over the seq axis when context parallelism
+    # is on
     assert_divisible(
-        args.per_rank_batch_size * world, n_dev, "per_rank_batch_size*world"
+        args.per_rank_batch_size * world,
+        mesh.shape["data"],
+        "per_rank_batch_size*world",
+    )
+    assert_divisible(
+        args.per_rank_sequence_length, args.seq_devices, "per_rank_sequence_length"
     )
 
     logger, log_dir, run_name = create_logger(args, "dreamer_v3", process_index=rank)
@@ -526,6 +576,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         mlp_keys,
         actions_dim,
         is_continuous,
+        mesh=mesh,
     )
 
     buffer_size = (
@@ -680,7 +731,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     tau = 0.0
                 sample = {k: v[i] for k, v in staged.items()}
                 if n_dev > 1:
-                    sample = shard_batch(sample, mesh, axis=1)
+                    sample = shard_time_batch(sample, mesh, time_axis=0, batch_axis=1)
                 key, train_key = jax.random.split(key)
                 state, metrics = train_step(state, sample, train_key, jnp.float32(tau))
                 gradient_steps += 1

@@ -8,7 +8,10 @@ from .mesh import (
     process_index,
     replicate,
     replicated_sharding,
+    seq_axis_size,
     shard_batch,
+    shard_time_batch,
+    time_batch_sharding,
 )
 
 __all__ = [
@@ -22,5 +25,8 @@ __all__ = [
     "process_index",
     "replicate",
     "replicated_sharding",
+    "seq_axis_size",
     "shard_batch",
+    "shard_time_batch",
+    "time_batch_sharding",
 ]

@@ -10,6 +10,13 @@ sharding annotations, riding ICI within a slice and DCN across slices.
 Axes:
   - "data": batch/env-parallelism (the reference's DDP world) — params
     replicated, batch sharded, grad psum implicit in the sharded jit.
+  - "seq": optional sequence/context parallelism — the TIME axis of
+    `[T, B]` sequence batches sharded across devices for the per-timestep
+    stages (conv encoder/decoder, reward/continue heads), with sharding
+    constraints resharding to batch-only around the sequential RSSM scan.
+    GSPMD inserts the all-gather/all-to-all collectives over ICI. Lets the
+    world-model losses scale to long sequences / small batches where pure
+    data parallelism runs out of batch to shard.
   - decoupled player/trainer topologies use *sub-meshes* of the same device
     set (see sheeprl_tpu/parallel/decoupled.py) instead of torch process
     groups.
@@ -38,6 +45,9 @@ __all__ = [
     "local_mesh_devices",
     "process_index",
     "assert_divisible",
+    "seq_axis_size",
+    "shard_time_batch",
+    "time_batch_sharding",
 ]
 
 
@@ -89,11 +99,31 @@ def make_mesh(
     platform: Optional[str] = None,
     axis_name: str = "data",
     devices: Any = None,
+    seq_devices: int = 1,
 ) -> Mesh:
-    """1-D data mesh over (a prefix of) the visible devices."""
+    """Data mesh over (a prefix of) the visible devices. With
+    `seq_devices > 1` the mesh is 2-D `(axis_name, "seq")` of shape
+    `(n // seq_devices, seq_devices)` — the context-parallel layout where
+    "seq" shards the time axis of sequence batches."""
     if devices is None:
         devices = local_mesh_devices(num_devices, platform)
-    return Mesh(np.asarray(devices), (axis_name,))
+    devices = np.asarray(devices)
+    if seq_devices > 1:
+        if devices.size % seq_devices != 0:
+            raise ValueError(
+                f"seq_devices={seq_devices} must divide the device count "
+                f"({devices.size})"
+            )
+        return Mesh(
+            devices.reshape(devices.size // seq_devices, seq_devices),
+            (axis_name, "seq"),
+        )
+    return Mesh(devices, (axis_name,))
+
+
+def seq_axis_size(mesh: Mesh) -> int:
+    """Size of the sequence/context-parallel axis (1 when absent)."""
+    return mesh.shape.get("seq", 1)
 
 
 def data_sharding(mesh: Mesh, axis: int = 0, axis_name: str = "data") -> NamedSharding:
@@ -103,24 +133,60 @@ def data_sharding(mesh: Mesh, axis: int = 0, axis_name: str = "data") -> NamedSh
     return NamedSharding(mesh, P(*spec))
 
 
+def time_batch_sharding(
+    mesh: Mesh, time_axis: int = 0, batch_axis: int = 1
+) -> NamedSharding:
+    """Sharding for `[..., T, ..., B, ...]` sequence batches: batch over
+    "data" and — when the mesh has a "seq" axis — time over "seq" (the
+    context-parallel input layout)."""
+    spec = [None] * (max(time_axis, batch_axis) + 1)
+    spec[batch_axis] = "data"
+    if seq_axis_size(mesh) > 1:
+        spec[time_axis] = "seq"
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_time_batch(
+    tree: Any, mesh: Mesh, time_axis: int = 0, batch_axis: int = 1
+) -> Any:
+    """`shard_batch` for `[T, B, ...]` sequence data: batch always shards
+    over "data"; time additionally shards over "seq" when present.
+
+    Multi-host: each process contributes full-T, local-B data, so every seq
+    group (a fixed data index, all seq indices) must live on ONE process —
+    a seq axis spanning hosts would stitch unrelated per-host samples along
+    time. `make_mesh` lays devices out process-major, so this holds whenever
+    seq_devices divides the local device count; guard against the rest."""
+    if jax.process_count() > 1 and seq_axis_size(mesh) > 1:
+        for row in mesh.devices:  # fixed data index, varying seq
+            if len({d.process_index for d in row}) != 1:
+                raise ValueError(
+                    "the seq mesh axis spans processes; pick seq_devices "
+                    f"dividing the local device count ({jax.local_device_count()})"
+                )
+    return _put_sharded(tree, time_batch_sharding(mesh, time_axis, batch_axis))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(tree: Any, mesh: Mesh, axis: int = 0, axis_name: str = "data") -> Any:
-    """device_put a host batch with its `axis` sharded over the mesh — one
-    transfer per leaf, landing already distributed (no broadcast+slice).
-
-    Multi-host: each process passes its *local* shard of the batch and the
-    result is a global array spanning the pod (the JAX-native replacement for
-    the reference's DistributedSampler sharding, SURVEY.md §2.7)."""
-    sharding = data_sharding(mesh, axis, axis_name)
+def _put_sharded(tree: Any, sharding: NamedSharding) -> Any:
+    """One transfer per leaf, landing already distributed. Multi-host: each
+    process passes its *local* shard and the result is a global array
+    spanning the pod (the JAX-native replacement for the reference's
+    DistributedSampler sharding, SURVEY.md §2.7)."""
     if jax.process_count() > 1:
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
             tree,
         )
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def shard_batch(tree: Any, mesh: Mesh, axis: int = 0, axis_name: str = "data") -> Any:
+    """device_put a host batch with its `axis` sharded over the mesh."""
+    return _put_sharded(tree, data_sharding(mesh, axis, axis_name))
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:

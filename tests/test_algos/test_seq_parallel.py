@@ -1,0 +1,161 @@
+"""Sequence/context parallelism for the DreamerV3 world-model update.
+
+`--seq_devices S` runs the train step over a 2-D (data, seq) mesh: the
+[T, B] batch arrives time-sharded over "seq" and batch-sharded over "data";
+the per-timestep stages (conv encoder/decoder, reward/continue heads,
+imagination) compute in that layout while sharding constraints reshard the
+sequential RSSM scan to batch-only. These tests check (a) numerics: the
+context-parallel step produces the same metrics as the unsharded step on
+identical inputs, and (b) the e2e main runs under a (2, 4) mesh on the
+virtual 8-device CPU harness.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+from sheeprl_tpu import ops
+from sheeprl_tpu.utils.registry import tasks
+
+from .test_multidevice import DV3_TINY
+
+
+def _tiny_setup(seed=0):
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_models
+    from sheeprl_tpu.algos.dreamer_v3.args import DreamerV3Args
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+        DV3TrainState,
+        make_optimizers,
+    )
+
+    args = DreamerV3Args(num_envs=2, env_id="dummy")
+    args.cnn_keys, args.mlp_keys = ["rgb"], []
+    args.dense_units = 16
+    args.hidden_size = 16
+    args.recurrent_state_size = 16
+    args.cnn_channels_multiplier = 4
+    args.stochastic_size = 4
+    args.discrete_size = 4
+    args.horizon = 4
+    args.mlp_layers = 1
+    args.per_rank_batch_size = 4
+    args.per_rank_sequence_length = 8
+
+    obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+    world_model, actor, critic, target_critic = build_models(
+        jax.random.PRNGKey(seed), [3], False, args, obs_space, ["rgb"], []
+    )
+    world_opt, actor_opt, critic_opt = make_optimizers(args)
+    state = DV3TrainState(
+        world_model=world_model,
+        actor=actor,
+        critic=critic,
+        target_critic=target_critic,
+        world_opt=world_opt.init(world_model),
+        actor_opt=actor_opt.init(actor),
+        critic_opt=critic_opt.init(critic),
+        moments=ops.Moments.init(args.moments_decay, args.moment_max),
+    )
+    return args, state, (world_opt, actor_opt, critic_opt)
+
+
+def _tiny_batch(args):
+    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
+    rng = np.random.default_rng(0)
+    return {
+        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 64, 64, 3), dtype=np.uint8)),
+        "actions": jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, (T, B))]),
+        "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+        "dones": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+
+
+@pytest.mark.timeout(600)
+def test_seq_parallel_matches_single_device():
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
+
+    args, state, (world_opt, actor_opt, critic_opt) = _tiny_setup()
+    data = _tiny_batch(args)
+    key = jax.random.PRNGKey(7)
+
+    # single-device reference
+    step_ref = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], [3], False
+    )
+    state_ref = jax.tree_util.tree_map(jnp.copy, state)
+    _, metrics_ref = step_ref(state_ref, dict(data), key, jnp.float32(1.0))
+
+    # (data=2, seq=4) context-parallel run on the same inputs
+    mesh = make_mesh(8, seq_devices=4)
+    assert mesh.shape == {"data": 2, "seq": 4}
+    step_sp = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], [3], False, mesh=mesh
+    )
+    state_sp = replicate(jax.tree_util.tree_map(jnp.copy, state), mesh)
+    sharded = shard_time_batch(dict(data), mesh, time_axis=0, batch_axis=1)
+    _, metrics_sp = step_sp(state_sp, sharded, key, jnp.float32(1.0))
+
+    for name in metrics_ref:
+        np.testing.assert_allclose(
+            np.asarray(metrics_ref[name]),
+            np.asarray(metrics_sp[name]),
+            rtol=2e-3,
+            atol=2e-3,
+            err_msg=f"metric {name} diverged under seq parallelism",
+        )
+
+
+@pytest.mark.timeout(600)
+def test_dreamer_v3_seq_parallel_e2e(tmp_path):
+    # a dry run adds a single transition — too few for T=4 sequences — so
+    # run a short real loop instead (8 env steps, training from step 6)
+    tasks["dreamer_v3"](
+        [
+            a
+            for a in DV3_TINY
+            if not a.startswith(("--per_rank_sequence_length", "--dry_run"))
+        ]
+        + [
+            "--per_rank_sequence_length=4",
+            "--per_rank_batch_size=2",
+            "--num_devices=8",
+            "--seq_devices=4",
+            "--total_steps=8",
+            "--learning_starts=6",
+            "--buffer_size=16",
+            "--checkpoint_every=8",
+            f"--root_dir={tmp_path}",
+            "--run_name=sp",
+        ]
+    )
+    ckpt_dir = tmp_path / "sp" / "checkpoints"
+    assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))
+
+
+@pytest.mark.timeout(300)
+def test_seq_devices_must_divide_sequence_length(tmp_path):
+    with pytest.raises(ValueError, match="not divisible"):
+        tasks["dreamer_v3"](
+            [a for a in DV3_TINY if not a.startswith("--per_rank_sequence_length")]
+            + [
+                "--per_rank_sequence_length=3",
+                "--per_rank_batch_size=2",
+                "--num_devices=8",
+                "--seq_devices=4",
+                f"--root_dir={tmp_path}",
+                "--run_name=bad",
+            ]
+        )
+
+
+def test_seq_devices_must_divide_device_count():
+    from sheeprl_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="must divide"):
+        make_mesh(8, seq_devices=3)

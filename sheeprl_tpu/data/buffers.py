@@ -683,11 +683,58 @@ class EpisodeBuffer:
             }
         )
 
+class _AsyncEnvView:
+    """Single-env handle into the unified device store of an
+    `AsyncReplayBuffer`, exposing the slice of the `ReplayBuffer` surface the
+    training loops use per env (`pos`/`full`/`buffer_size`/`set_at` for the
+    crash-restart row surgery, reference dreamer_v3.py:565-573)."""
+
+    __slots__ = ("_parent", "_env")
+
+    def __init__(self, parent: "AsyncReplayBuffer", env: int):
+        self._parent = parent
+        self._env = env
+
+    @property
+    def pos(self) -> int:
+        return int(self._parent._upos[self._env])
+
+    @property
+    def full(self) -> bool:
+        return bool(self._parent._ufull[self._env])
+
+    @property
+    def buffer_size(self) -> int:
+        return self._parent._buffer_size
+
+    @property
+    def buffer(self):
+        store = self._parent._store
+        if store is None:
+            return None
+        return {k: v[:, self._env : self._env + 1] for k, v in store.items()}
+
+    def set_at(self, key: str, time_idx: int, value) -> None:
+        self._parent._set_at(self._env, key, time_idx, value)
+
 
 class AsyncReplayBuffer:
-    """One independent (Sequential)ReplayBuffer per env; `add(data, indices)`
-    writes only the given env columns — envs that reset mid-step can append
-    their reset records without touching the others (buffers.py:537-699)."""
+    """Per-env independent rings with `add(data, indices)` — envs that reset
+    mid-step can append their reset records without touching the others
+    (reference buffers.py:537-699).
+
+    Storage backends:
+      - **device**: ONE unified HBM store `[capacity, n_envs, *item]` with a
+        per-env write-head vector. `add` is a single jitted scatter at
+        `(rows, env_cols)` and `sample` a single jitted gather for the whole
+        batch — one dispatch each, instead of the n_envs-fan-out a
+        buffer-per-env design pays (which dominates the end-to-end step time
+        when host<->device latency is non-trivial). Per-env independence is
+        index arithmetic: each env column has its own position/full state and
+        sampling validity window.
+      - **host**/memmap: one numpy `ReplayBuffer` per env (adds are cheap on
+        host; capacities beyond HBM).
+    """
 
     def __init__(
         self,
@@ -714,11 +761,21 @@ class AsyncReplayBuffer:
         self._obs_keys = tuple(obs_keys)
         self._seed = seed
         self._split = split
-        self._buf: list[ReplayBuffer] | None = None
         self._np_rng = np.random.default_rng(seed)
+        # host path: one ReplayBuffer per env
+        self._buf: list[ReplayBuffer] | None = None
+        # device path: unified store + per-env head state
+        self._store: dict[str, jax.Array] | None = None
+        self._upos = np.zeros(n_envs, dtype=np.int64)
+        self._ufull = np.zeros(n_envs, dtype=bool)
+        self._key = jax.random.PRNGKey(seed)
 
     @property
     def buffer(self):
+        if self._storage_kind == "device":
+            if self._store is None:
+                return None
+            return tuple(_AsyncEnvView(self, e) for e in range(self._n_envs))
         return tuple(self._buf) if self._buf is not None else None
 
     @property
@@ -731,6 +788,10 @@ class AsyncReplayBuffer:
 
     @property
     def full(self):
+        if self._storage_kind == "device":
+            if self._store is None:
+                return None
+            return tuple(bool(f) for f in self._ufull)
         if self._buf is None:
             return None
         return tuple(b.full for b in self._buf)
@@ -738,6 +799,7 @@ class AsyncReplayBuffer:
     def __len__(self) -> int:
         return self._buffer_size
 
+    # -- host path: one ReplayBuffer per env ---------------------------------
     def _ensure_buffers(self) -> None:
         if self._buf is not None:
             return
@@ -756,13 +818,140 @@ class AsyncReplayBuffer:
             for i in range(self._n_envs)
         ]
 
+    # -- device path: unified store ------------------------------------------
+    def _allocate_store(self, data: Batch) -> None:
+        self._store = {
+            k: jnp.zeros(
+                (self._buffer_size, self._n_envs, *v.shape[2:]), dtype=v.dtype
+            )
+            for k, v in data.items()
+        }
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    @staticmethod
+    @partial(jax.jit, donate_argnums=0)
+    def _store_add(store, data, rows, cols):
+        """Scatter `[T, n]`-column data at per-env write heads: one dispatch
+        for all envs and keys (rows [T, n] absolute ring indices, cols [n]
+        env columns)."""
+        return {
+            k: store[k].at[rows, cols[None, :]].set(data[k].astype(store[k].dtype))
+            for k in store
+        }
+
+    def _set_at(self, env: int, key: str, time_idx: int, value) -> None:
+        if self._store is None:
+            raise RuntimeError("buffer not initialized; add data first")
+        item = jnp.asarray(value).reshape(self._store[key].shape[2:])
+        self._store[key] = self._store[key].at[time_idx, env].set(
+            item.astype(self._store[key].dtype)
+        )
+
     def add(self, data: Mapping[str, np.ndarray], indices: Sequence[int] | None = None) -> None:
         data = _as_time_env(dict(data))
-        self._ensure_buffers()
         if indices is None:
             indices = range(self._n_envs)
-        for col, env_idx in enumerate(indices):
-            self._buf[env_idx].add({k: v[:, col : col + 1] for k, v in data.items()})
+        cols = np.asarray(list(indices), dtype=np.int64)
+        data_len, width = next(iter(data.values())).shape[:2]
+        if width != cols.size:
+            raise ValueError(
+                f"data has {width} env columns but {cols.size} indices given"
+            )
+        if data_len == 0 or cols.size == 0:
+            return
+        if self._storage_kind != "device":
+            self._ensure_buffers()
+            for col, env_idx in enumerate(cols):
+                self._buf[env_idx].add({k: v[:, col : col + 1] for k, v in data.items()})
+            return
+        if data_len > self._buffer_size:
+            data = {k: v[-self._buffer_size :] for k, v in data.items()}
+            data_len = self._buffer_size
+        if self._store is None:
+            self._allocate_store(data)
+        starts = self._upos[cols]
+        rows = (starts[None, :] + np.arange(data_len)[:, None]) % self._buffer_size
+        self._store = self._store_add(
+            self._store,
+            {k: jnp.asarray(v) for k, v in data.items()},
+            jnp.asarray(rows),
+            jnp.asarray(cols),
+        )
+        self._ufull[cols] |= starts + data_len >= self._buffer_size
+        self._upos[cols] = (starts + data_len) % self._buffer_size
+
+    # -- sampling -------------------------------------------------------------
+    def _partition(self, batch_size: int) -> np.ndarray:
+        """Per-env sample counts. The default `split="even"` is a TPU-first
+        redesign: every env contributes `B // n_envs` (remainder rotating),
+        so gather shapes stay static under jit. The reference's multinomial
+        bincount partition (buffers.py:687-693) remains available as
+        `split="multinomial"` (with the unified device store its shapes are
+        static too: counts only change the gather's env-index *contents*)."""
+        if self._split == "even":
+            base, rem = divmod(batch_size, self._n_envs)
+            counts = np.full(self._n_envs, base, dtype=np.int64)
+            if rem:
+                start = int(self._np_rng.integers(0, self._n_envs))
+                counts[(start + np.arange(rem)) % self._n_envs] += 1
+            return counts
+        return np.bincount(
+            self._np_rng.integers(0, self._n_envs, size=batch_size),
+            minlength=self._n_envs,
+        )
+
+    def _windows(self, exclude: int) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized per-env validity windows — the base `_valid_ranges`
+        rule (buffers.py:166-186) over the position vector."""
+        pos = self._upos
+        cap = self._buffer_size
+        first = pos - exclude
+        second_end = np.where(first >= 0, cap, cap + first)
+        n_valid = np.where(
+            self._ufull, np.maximum(first, 0) + second_end - pos, first
+        )
+        return np.maximum(first, 0), n_valid
+
+    @staticmethod
+    @partial(
+        jax.jit,
+        static_argnames=("n_samples", "seq_len", "sequential", "sample_next_obs", "obs_keys"),
+    )
+    def _store_sample(
+        store, key, env_idx, first, n_valid, pos,
+        n_samples, seq_len, sequential, sample_next_obs, obs_keys,
+    ):
+        """One gather for the whole batch: each output row draws a start
+        index inside its env's validity window, windows index the ring
+        modulo capacity, and the env column selects the ring."""
+        capacity = next(iter(store.values())).shape[0]
+        bd = env_idx.shape[0]
+        u = jax.random.uniform(key, (bd,))
+        nv = n_valid[env_idx]
+        r = jnp.minimum((u * nv).astype(jnp.int32), (nv - 1).astype(jnp.int32))
+        f = first[env_idx]
+        p = pos[env_idx]
+        start = jnp.where(r < f, r, r - f + p)
+        idx = (start[:, None] + jnp.arange(seq_len)) % capacity  # [BD, L]
+        ecol = env_idx[:, None]
+
+        def gather(v, ix):
+            g = v[ix, ecol]  # [BD, L, *item]
+            if not sequential:
+                return g[:, 0]
+            batch = bd // n_samples
+            g = g.reshape(n_samples, batch, seq_len, *g.shape[2:])
+            return jnp.swapaxes(g, 1, 2)  # [n_samples, L, B, *item]
+
+        out = {k: gather(v, idx) for k, v in store.items()}
+        if sample_next_obs:
+            nxt = (idx + 1) % capacity
+            for k in obs_keys:
+                out[f"next_{k}"] = gather(store[k], nxt)
+        return out
 
     def sample(
         self,
@@ -772,33 +961,56 @@ class AsyncReplayBuffer:
         n_samples: int = 1,
         **_: object,
     ) -> Batch:
-        """Partitions the batch across env-buffers and concatenates on the
-        batch axis (buffers.py:687-699).
-
-        The default `split="even"` partition is a TPU-first redesign: every
-        env contributes `B // n_envs` samples (the remainder rotates across
-        envs), so the per-env device gathers keep STATIC shapes — at most
-        two compiled variants per env, and no recompiles in the steady
-        state. The reference's multinomial bincount partition
-        (buffers.py:687-693) draws a different count vector every call,
-        which under jit would recompile the gather for each new shape; it
-        remains available as `split="multinomial"` (host-storage runs lose
-        nothing by using it)."""
+        """Partitions the batch across envs and samples each env's window
+        (reference buffers.py:687-699); device storage runs the whole batch
+        as one jitted gather."""
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError("batch_size and n_samples must be > 0")
+        if self._storage_kind != "device":
+            return self._sample_host(
+                batch_size, sample_next_obs, sequence_length, n_samples
+            )
+        if self._store is None:
+            raise RuntimeError("no samples in buffer; call add() first")
+        if self._sequential and sequence_length > self._buffer_size:
+            raise ValueError(f"too long sequence_length ({sequence_length})")
+        counts = self._partition(batch_size)
+        seq_len = sequence_length if self._sequential else 1
+        exclude = (seq_len - 1) if self._sequential else (1 if sample_next_obs else 0)
+        first, n_valid = self._windows(exclude)
+        bad = (counts > 0) & (n_valid <= 0)
+        if bad.any():
+            if self._sequential:
+                e = int(np.argmax(bad))
+                raise ValueError(
+                    f"too long sequence_length ({sequence_length}) for env "
+                    f"{e} with pos={int(self._upos[e])}, full={bool(self._ufull[e])}"
+                )
+            raise RuntimeError(
+                "not enough valid entries to sample; add more data first"
+            )
+        env_row = np.repeat(np.arange(self._n_envs, dtype=np.int32), counts)
+        env_idx = np.tile(env_row, n_samples) if self._sequential else env_row
+        return self._store_sample(
+            self._store,
+            self._next_key(),
+            jnp.asarray(env_idx),
+            jnp.asarray(first.astype(np.int32)),
+            jnp.asarray(n_valid.astype(np.int32)),
+            jnp.asarray(self._upos.astype(np.int32)),
+            n_samples,
+            seq_len,
+            self._sequential,
+            sample_next_obs,
+            self._obs_keys if sample_next_obs else (),
+        )
+
+    def _sample_host(
+        self, batch_size: int, sample_next_obs: bool, sequence_length: int, n_samples: int
+    ) -> Batch:
         if self._buf is None:
             raise RuntimeError("no samples in buffer; call add() first")
-        if self._split == "even":
-            base, rem = divmod(batch_size, self._n_envs)
-            counts = np.full(self._n_envs, base, dtype=np.int64)
-            if rem:
-                start = int(self._np_rng.integers(0, self._n_envs))
-                counts[(start + np.arange(rem)) % self._n_envs] += 1
-        else:
-            counts = np.bincount(
-                self._np_rng.integers(0, self._n_envs, size=batch_size),
-                minlength=self._n_envs,
-            )
+        counts = self._partition(batch_size)
         parts = []
         for b, n in zip(self._buf, counts):
             if n == 0:
@@ -816,16 +1028,68 @@ class AsyncReplayBuffer:
                 parts.append(b.sample(int(n), sample_next_obs=sample_next_obs))
         axis = 2 if self._sequential else 0
         keys = parts[0].keys()
-        xp = jnp if self._storage_kind == "device" else np
-        return {k: xp.concatenate([p[k] for p in parts], axis=axis) for k in keys}
+        return {k: np.concatenate([p[k] for p in parts], axis=axis) for k in keys}
 
+    # -- checkpointing --------------------------------------------------------
     def to_state_dict(self) -> dict:
+        """Per-env state list — one format for both storage backends (the
+        device store serializes as per-env column slices)."""
+        if self._storage_kind == "device":
+            if self._store is None:
+                empty = {
+                    "buf": None, "pos": 0, "full": False,
+                    "buffer_size": self._buffer_size, "n_envs": 1,
+                }
+                return {"buffers": [dict(empty) for _ in range(self._n_envs)]}
+            host = {k: np.asarray(v) for k, v in self._store.items()}
+            return {
+                "buffers": [
+                    {
+                        "buf": {k: v[:, i : i + 1] for k, v in host.items()},
+                        "pos": int(self._upos[i]),
+                        "full": bool(self._ufull[i]),
+                        "buffer_size": self._buffer_size,
+                        "n_envs": 1,
+                    }
+                    for i in range(self._n_envs)
+                ]
+            }
         self._ensure_buffers()
         return {"buffers": [b.to_state_dict() for b in self._buf]}
 
     def load_state_dict(self, state: dict) -> None:
+        buffers = state["buffers"]
+        if len(buffers) != self._n_envs:
+            raise ValueError("checkpointed buffer n_envs mismatch")
+        if self._storage_kind == "device":
+            for s in buffers:
+                if s["buffer_size"] != self._buffer_size:
+                    raise ValueError("checkpointed buffer shape mismatch")
+            if all(s["buf"] is None for s in buffers):
+                self._store = None
+            else:
+                # envs that never received data (buf=None) contribute a zero
+                # column; their pos/full restore as 0/False below
+                template = next(s["buf"] for s in buffers if s["buf"] is not None)
+                self._store = {
+                    k: jnp.asarray(
+                        np.concatenate(
+                            [
+                                s["buf"][k]
+                                if s["buf"] is not None
+                                else np.zeros_like(template[k])
+                                for s in buffers
+                            ],
+                            axis=1,
+                        )
+                    )
+                    for k in template.keys()
+                }
+            self._upos = np.asarray([int(s["pos"]) for s in buffers], dtype=np.int64)
+            self._ufull = np.asarray([bool(s["full"]) for s in buffers], dtype=bool)
+            return
         self._ensure_buffers()
-        for b, s in zip(self._buf, state["buffers"]):
+        for b, s in zip(self._buf, buffers):
             b.load_state_dict(s)
 
     def save(self, path: str) -> None:
@@ -849,11 +1113,11 @@ class AsyncReplayBuffer:
             raise ValueError("checkpointed buffer n_envs mismatch")
         if int(data["buffer_size"]) != self._buffer_size:
             raise ValueError("checkpointed buffer shape mismatch")
-        self._ensure_buffers()
-        for i, b in enumerate(self._buf):
+        buffers = []
+        for i in range(self._n_envs):
             prefix = f"b{i}_buf_"
             bufs = {k[len(prefix):]: data[k] for k in data.files if k.startswith(prefix)}
-            b.load_state_dict(
+            buffers.append(
                 {
                     "buf": bufs or None,
                     "pos": int(data[f"b{i}_pos"]),
@@ -862,3 +1126,4 @@ class AsyncReplayBuffer:
                     "n_envs": 1,
                 }
             )
+        self.load_state_dict({"buffers": buffers})

@@ -484,6 +484,47 @@ def bench_ppo_decoupled() -> None:
     )
 
 
+def _wait_for_backend(retries: int = 4, delay_s: float = 60.0) -> None:
+    """The axon TPU tunnel is intermittently unavailable; a failed backend
+    init is retried with backoff so a transient outage at bench time does
+    not cost the round its artifact. Exhausted retries re-raise: a partial
+    CPU number would be misleading, a missing one is at least honest.
+
+    Two subtleties of jax's backend cache: (a) a failed accelerator init can
+    leave a CPU-only `_backends` cache behind, making later `jax.devices()`
+    calls 'succeed' on CPU — so when the configured platform list prefers an
+    accelerator, a CPU-only device set counts as failure; (b) the cache must
+    be cleared between attempts or the retry would just re-read it."""
+    import jax
+
+    preferred = (jax.config.jax_platforms or "").split(",")[0]
+    want_accelerator = preferred not in ("", "cpu")
+    for attempt in range(retries):
+        try:
+            devices = jax.devices()
+            if want_accelerator and all(d.platform == "cpu" for d in devices):
+                raise RuntimeError(
+                    f"configured platform {preferred!r} unavailable; only CPU "
+                    "devices came up"
+                )
+            return
+        except Exception as e:  # backend init surfaces RuntimeError or worse
+            if attempt == retries - 1:
+                raise
+            print(
+                f"backend unavailable (attempt {attempt + 1}/{retries}): {e}; "
+                f"retrying in {delay_s:.0f}s",
+                file=sys.stderr,
+            )
+            try:
+                from jax.extend.backend import clear_backends
+
+                clear_backends()
+            except Exception:
+                pass
+            time.sleep(delay_s)
+
+
 def main() -> None:
     import argparse
 
@@ -493,6 +534,7 @@ def main() -> None:
     )
     parser.add_argument("--tiny", action="store_true")
     opts = parser.parse_args()
+    _wait_for_backend()
     if opts.algo == "ppo":
         bench_ppo()
     elif opts.algo == "ppo_decoupled":

@@ -38,3 +38,35 @@ def test_conv_transpose_other_configs_use_general_path():
     )
     x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 4, 4, 3)).astype(np.float32))
     assert layer(x).shape == (1, 11, 11, 4)
+
+
+def test_conv_transpose_subpixel_gradients_match_lax():
+    """Input- and kernel-gradients through the subpixel fast path must match
+    the lax.conv_transpose lowering (the DV3 decoder trains through it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.nn.layers import ConvTranspose2d
+
+    layer = ConvTranspose2d.init(
+        jax.random.PRNGKey(2), 6, 3, 4, stride=2, padding="SAME"
+    )
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 8, 8, 6)).astype(np.float32)
+    )
+
+    def loss_fast(kernel, x):
+        return jnp.sum(jnp.sin(layer.replace(kernel=kernel)(x)))
+
+    def loss_ref(kernel, x):
+        y = jax.lax.conv_transpose(
+            x, kernel, strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + layer.bias
+        return jnp.sum(jnp.sin(y))
+
+    gk_fast, gx_fast = jax.grad(loss_fast, argnums=(0, 1))(layer.kernel, x)
+    gk_ref, gx_ref = jax.grad(loss_ref, argnums=(0, 1))(layer.kernel, x)
+    np.testing.assert_allclose(np.asarray(gk_fast), np.asarray(gk_ref), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx_fast), np.asarray(gx_ref), atol=1e-4)

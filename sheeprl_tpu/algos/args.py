@@ -64,6 +64,15 @@ class StandardArgs:
         default=-1, help="number of devices in the data mesh axis; -1 = all local devices"
     )
     precision: str = Arg(default="float32", help="compute dtype for the train step (float32|bfloat16)")
+    profile: bool = Arg(
+        default=False,
+        help="capture a jax.profiler trace (XProf/TensorBoard 'profile' "
+        "plugin) of a bounded window of training iterations into "
+        "<log_dir>/profile",
+    )
+    profile_steps: int = Arg(
+        default=5, help="number of training iterations in the profile window"
+    )
 
     def __setattr__(self, name: str, value: Any) -> None:
         if name == "precision" and value not in ("float32", "bfloat16"):

@@ -36,6 +36,7 @@ from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_chec
 from ...utils.env import make_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
+from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
 from ..args import require_float32
@@ -156,6 +157,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     logger, log_dir, run_name = create_logger(args, "droq", process_index=rank)
     logger.log_hyperparams(args.as_dict())
+    profiler = StepProfiler.from_args(args, log_dir, rank)
 
     envs = make_vector_env(
         [
@@ -300,6 +302,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 state, metrics = train_step(state, data, actor_batch, train_key)
             for name, val in metrics.items():
                 aggregator.update(name, val)
+            profiler.tick()
 
         sps = global_step / (time.perf_counter() - start_time)
         logger.log_dict(aggregator.compute(), global_step)
@@ -324,6 +327,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + ".buffer.npz")
 
+    profiler.close()
     envs.close()
     test_env = make_env(
         args.env_id, args.seed, 0, args.capture_video, run_name=log_dir, prefix="test"

@@ -41,6 +41,7 @@ from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_chec
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
+from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
 from ..ppo.agent import one_hot_to_env_actions
@@ -506,6 +507,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     logger, log_dir, run_name = create_logger(args, "p2e_dv2", process_index=rank)
     logger.log_hyperparams(args.as_dict())
+    profiler = StepProfiler.from_args(args, log_dir, rank)
 
     envs = make_vector_env(
         [
@@ -815,6 +817,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 gradient_steps += 1
                 for name, val in metrics.items():
                     aggregator.update(name, val)
+                profiler.tick()
             player = make_player(state, exploring=is_exploring)
             step_before_training = args.train_every // single_global_step
             if args.expl_decay:
@@ -867,6 +870,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+    profiler.close()
     envs.close()
     player = make_player(state, exploring=False)
     test(player, logger, args, cnn_keys, mlp_keys, log_dir, "few-shot")

@@ -47,6 +47,7 @@ from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_chec
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
+from ...utils.profiler import StepProfiler
 from ...utils.registry import register_algorithm
 from ..args import require_float32
 from ...utils.parser import DataclassArgumentParser
@@ -124,6 +125,11 @@ def make_train_step(args: PPOArgs, optimizer, num_minibatches: int):
 
     def train_step(state: TrainState, data: dict, key, lr, clip_coef, ent_coef):
         n = data["logprobs"].shape[0]
+        # when num_minibatches does not divide the rollout, each epoch
+        # trains on a fresh random subset of num_minibatches*mb_size rows and
+        # the n % num_minibatches remainder of that epoch's permutation is
+        # left out (matching the reference's BatchSampler drop; static shapes
+        # require a fixed minibatch size under jit)
         mb_size = n // num_minibatches
 
         def minibatch_body(carry, idx):
@@ -214,6 +220,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     logger, log_dir, run_name = create_logger(args, "ppo", process_index=rank)
     logger.log_hyperparams(args.as_dict())
+    profiler = StepProfiler.from_args(args, log_dir, rank)
 
     envs = make_vector_env(
         [
@@ -331,6 +338,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
         for name, val in metrics.items():
             aggregator.update(name, val)
+        profiler.tick()
 
         # ---- logging + checkpoint -------------------------------------------
         sps = global_step / (time.perf_counter() - start_time)
@@ -348,6 +356,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 block=args.dry_run or update == num_updates,
             )
 
+    profiler.close()
     envs.close()
     test_env = make_dict_env(
         args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"

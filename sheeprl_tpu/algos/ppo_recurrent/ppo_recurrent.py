@@ -38,6 +38,7 @@ from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_chec
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
+from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
 from ..args import require_float32
@@ -176,6 +177,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     logger, log_dir, run_name = create_logger(args, "ppo_recurrent", process_index=rank)
     logger.log_hyperparams(args.as_dict())
+    profiler = StepProfiler.from_args(args, log_dir, rank)
 
     envs = make_vector_env(
         [
@@ -327,6 +329,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
         for name, val in metrics.items():
             aggregator.update(name, val)
+        profiler.tick()
 
         sps = global_step / (time.perf_counter() - start_time)
         logger.log_dict(aggregator.compute(), global_step)
@@ -347,6 +350,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 block=args.dry_run or update == num_updates,
             )
 
+    profiler.close()
     envs.close()
     test_env = make_dict_env(
         args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"

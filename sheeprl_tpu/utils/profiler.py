@@ -1,0 +1,83 @@
+"""Native profiling hooks — the TPU-first upgrade over the reference's only
+timing signal (`Time/step_per_second` wall-clock, reference ppo.py:372; it
+has no profiler integration at all, SURVEY.md §5).
+
+`StepProfiler` captures a bounded window of training iterations as a
+jax.profiler trace (XPlane + TensorBoard `plugins/profile` format, viewable
+in XProf/TensorBoard): device op timelines, HLO cost breakdowns, and
+host<->device transfers — the data needed to attribute a slow step to MXU
+underutilization, HBM pressure, or dispatch gaps. The window is bounded so a
+multi-day run can profile its steady state without unbounded trace files.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["StepProfiler"]
+
+
+class StepProfiler:
+    """Trace a bounded window of jitted update calls.
+
+    Call `tick()` once per update call (after it has been dispatched): the
+    first tick starts the trace, the (steps+1)-th stops it. Inactive
+    (`profile_dir=None`) it is a no-op. `close()` stops early on run
+    teardown; a crash mid-window still flushes the partial trace via an
+    atexit hook registered when the trace starts.
+    """
+
+    def __init__(self, profile_dir: str | None, steps: int = 5):
+        self._dir = profile_dir
+        self._steps = max(int(steps), 1)
+        self._seen = 0
+        self._running = False
+        self._done = profile_dir is None
+
+    @classmethod
+    def from_args(cls, args, log_dir: str, rank: int = 0) -> "StepProfiler":
+        """The mains' construction policy in one place: trace on process 0
+        only, into `<log_dir>/profile`."""
+        enabled = getattr(args, "profile", False) and rank == 0
+        return cls(
+            os.path.join(log_dir, "profile") if enabled else None,
+            getattr(args, "profile_steps", 5),
+        )
+
+    @property
+    def active(self) -> bool:
+        return self._running
+
+    def tick(self) -> None:
+        if self._done:
+            return
+        if not self._running:
+            os.makedirs(self._dir, exist_ok=True)
+            jax.profiler.start_trace(self._dir)
+            self._running = True
+            atexit.register(self.close)
+            return
+        self._seen += 1
+        if self._seen >= self._steps:
+            self.close()
+
+    @staticmethod
+    def _device_barrier() -> None:
+        """Wait for in-flight dispatched work: per-device execution is
+        FIFO, so blocking on a fresh op enqueued on each local device drains
+        everything dispatched before it — without this, stop_trace cuts the
+        device timeline mid-step (async dispatch returns before the last
+        profiled update finishes)."""
+        for d in jax.local_devices():
+            jax.block_until_ready(jnp.add(jax.device_put(0.0, d), 1.0))
+
+    def close(self) -> None:
+        if self._running:
+            self._device_barrier()
+            jax.profiler.stop_trace()
+            self._running = False
+        self._done = True

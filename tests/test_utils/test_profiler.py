@@ -1,0 +1,42 @@
+"""StepProfiler: bounded-window jax.profiler trace capture (the TPU-native
+observability upgrade over the reference's wall-clock-only timing,
+SURVEY.md §5)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.profiler import StepProfiler
+
+
+def test_inactive_without_dir():
+    p = StepProfiler(None)
+    p.tick()
+    p.close()
+    assert not p.active
+
+
+def test_bounded_window_writes_xplane(tmp_path):
+    d = str(tmp_path / "profile")
+    p = StepProfiler(d, steps=2)
+    p.tick()  # starts the trace
+    assert p.active
+    for _ in range(2):
+        jnp.ones((8, 8)).sum().block_until_ready()
+        p.tick()
+    assert not p.active  # window closed itself
+    p.tick()  # further ticks are no-ops
+    traces = glob.glob(os.path.join(d, "plugins", "profile", "*", "*.xplane.pb"))
+    assert traces, f"no xplane trace written under {d}"
+
+
+def test_close_flushes_short_runs(tmp_path):
+    d = str(tmp_path / "profile")
+    p = StepProfiler(d, steps=100)
+    p.tick()
+    jnp.ones(4).sum().block_until_ready()
+    p.close()
+    assert not p.active
+    assert glob.glob(os.path.join(d, "plugins", "profile", "*", "*.xplane.pb"))

@@ -77,7 +77,10 @@ class StepProfiler:
 
     def close(self) -> None:
         if self._running:
-            self._device_barrier()
-            jax.profiler.stop_trace()
-            self._running = False
+            try:
+                # a poisoned backend at crash time must not stop the flush
+                self._device_barrier()
+            finally:
+                jax.profiler.stop_trace()
+                self._running = False
         self._done = True

@@ -73,3 +73,70 @@ def test_ppo_learns_cartpole(tmp_path):
     env.close()
     mean_return = float(np.mean(returns))
     assert mean_return >= 400.0, f"PPO failed to learn CartPole: {returns}"
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1800)
+def test_sac_learns_pendulum(tmp_path):
+    """SAC must actually swing up Pendulum (random policy: ~-1400 return;
+    solved: >= -300), same capability check as the PPO test."""
+    from sheeprl_tpu.algos.sac.agent import SACAgent
+    from sheeprl_tpu.algos.sac.args import SACArgs
+    from sheeprl_tpu.algos.sac.sac import make_optimizers
+
+    tasks["sac"]([
+        "--env_id", "Pendulum-v1",
+        "--seed", "5",
+        "--num_devices", "1",
+        "--num_envs", "1",
+        "--sync_env",
+        "--total_steps", "15000",
+        "--learning_starts", "1000",
+        "--per_rank_batch_size", "128",
+        "--gradient_steps", "1",
+        "--actor_hidden_size", "256",
+        "--critic_hidden_size", "256",
+        "--checkpoint_every", "1000000",  # only the final checkpoint
+        "--root_dir", str(tmp_path),
+        "--run_name", "learn",
+    ])
+    ckpt = latest_checkpoint(str(tmp_path / "learn" / "checkpoints"))
+    assert ckpt is not None
+
+    env = gym.make("Pendulum-v1")
+    template_agent = SACAgent.init(
+        jax.random.PRNGKey(0),
+        int(np.prod(env.observation_space.shape)),
+        int(np.prod(env.action_space.shape)),
+        actor_hidden_size=256,
+        critic_hidden_size=256,
+        action_low=env.action_space.low,
+        action_high=env.action_space.high,
+    )
+    qf_opt, actor_opt, alpha_opt = make_optimizers(SACArgs())
+    state = load_checkpoint(
+        ckpt,
+        {
+            "agent": template_agent,
+            "qf_optimizer": qf_opt.init(template_agent.critics),
+            "actor_optimizer": actor_opt.init(template_agent.actor),
+            "alpha_optimizer": alpha_opt.init(template_agent.log_alpha),
+            "global_step": 0,
+        },
+    )
+    actor = state["agent"].actor
+    greedy = jax.jit(actor.get_greedy_actions)
+
+    returns = []
+    for episode in range(10):
+        obs, _ = env.reset(seed=1000 + episode)
+        done, ep_return = False, 0.0
+        while not done:
+            action = greedy(jnp.asarray(obs, jnp.float32)[None])
+            obs, reward, terminated, truncated, _ = env.step(np.asarray(action[0]))
+            ep_return += float(reward)
+            done = terminated or truncated
+        returns.append(ep_return)
+    env.close()
+    mean_return = float(np.mean(returns))
+    assert mean_return >= -300.0, f"SAC failed to learn Pendulum: {returns}"

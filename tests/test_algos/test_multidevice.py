@@ -122,3 +122,80 @@ def test_dreamer_v3_indivisible_batch_raises(tmp_path):
                 "--run_name=bad",
             ]
         )
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("num_devices", [2])
+def test_droq_multidevice(tmp_path, num_devices):
+    tasks["droq"]([
+        "--env_id", "Pendulum-v1",
+        "--dry_run",
+        "--num_envs", "1",
+        "--per_rank_batch_size", "2",
+        "--buffer_size", "8",
+        "--learning_starts", "0",
+        "--gradient_steps", "2",
+        "--actor_hidden_size", "8",
+        "--critic_hidden_size", "8",
+        "--num_devices", str(num_devices),
+        "--root_dir", str(tmp_path),
+        "--run_name", f"dev{num_devices}",
+    ])
+    assert os.path.exists(tmp_path / f"dev{num_devices}" / "checkpoints" / "ckpt_1")
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("num_devices", [2])
+def test_sac_ae_multidevice(tmp_path, num_devices):
+    tasks["sac_ae"]([
+        "--env_id", "continuous_dummy",
+        "--dry_run",
+        "--num_envs", "1",
+        "--per_rank_batch_size", "2",
+        "--buffer_size", "8",
+        "--learning_starts", "0",
+        "--gradient_steps", "1",
+        "--actor_hidden_size", "16",
+        "--critic_hidden_size", "16",
+        "--features_dim", "16",
+        "--dense_units", "8",
+        "--mlp_layers", "1",
+        "--cnn_channels_multiplier", "1",
+        "--num_devices", str(num_devices),
+        "--root_dir", str(tmp_path),
+        "--run_name", f"dev{num_devices}",
+    ])
+    assert os.path.exists(tmp_path / f"dev{num_devices}" / "checkpoints" / "ckpt_1")
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.parametrize("num_devices", [2])
+def test_dreamer_v2_multidevice(tmp_path, num_devices):
+    tasks["dreamer_v2"](
+        DV3_TINY
+        + [
+            f"--per_rank_batch_size={num_devices}",
+            f"--num_devices={num_devices}",
+            f"--root_dir={tmp_path}",
+            f"--run_name=dev{num_devices}",
+        ]
+    )
+    ckpt_dir = tmp_path / f"dev{num_devices}" / "checkpoints"
+    assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.parametrize("num_devices", [2])
+def test_p2e_dv1_multidevice(tmp_path, num_devices):
+    tasks["p2e_dv1"](
+        # DreamerV1-family config: Gaussian latent, no --discrete_size
+        [a for a in DV3_TINY if not a.startswith("--discrete_size")]
+        + [
+            f"--per_rank_batch_size={num_devices}",
+            f"--num_devices={num_devices}",
+            f"--root_dir={tmp_path}",
+            f"--run_name=dev{num_devices}",
+        ]
+    )
+    ckpt_dir = tmp_path / f"dev{num_devices}" / "checkpoints"
+    assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))

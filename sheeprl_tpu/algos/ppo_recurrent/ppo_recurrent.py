@@ -33,7 +33,14 @@ import optax
 from ... import nn, ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
-from ...parallel import distributed_setup, make_mesh, process_index, replicate
+from ...parallel import (
+    assert_divisible,
+    distributed_setup,
+    make_mesh,
+    process_index,
+    replicate,
+    shard_batch,
+)
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -174,6 +181,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     rank = process_index()
     key = jax.random.PRNGKey(args.seed)
     mesh = make_mesh(args.num_devices)
+    n_dev = mesh.devices.size
 
     logger, log_dir, run_name = create_logger(args, "ppo_recurrent", process_index=rank)
     logger.log_hyperparams(args.as_dict())
@@ -229,6 +237,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     seq_len = min(args.per_rank_batch_size, args.rollout_steps)
     n_windows = args.rollout_steps // seq_len
     n_sequences = n_windows * args.num_envs
+    # DP: the [L, n_sequences] windowed batch shards its sequence axis
+    # (global = per-process x world, as in ppo.py)
+    assert_divisible(
+        n_sequences * jax.process_count(), n_dev, "windows*num_envs*world"
+    )
     num_minibatches = (
         min(args.per_rank_num_batches, n_sequences)
         if args.per_rank_num_batches > 0
@@ -322,6 +335,8 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
         data["returns"], data["advantages"] = returns, advantages
         windows = _to_windows(data, seq_len)
+        if n_dev > 1:
+            windows = shard_batch(windows, mesh, axis=1)
         key, train_key = jax.random.split(key)
         state, metrics = train_step(
             state, windows, train_key,

@@ -199,3 +199,29 @@ def test_p2e_dv1_multidevice(tmp_path, num_devices):
     )
     ckpt_dir = tmp_path / f"dev{num_devices}" / "checkpoints"
     assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("num_devices", [2])
+def test_ppo_recurrent_multidevice(tmp_path, num_devices):
+    tasks["ppo_recurrent"]([
+        "--env_id=CartPole-v1",
+        "--dry_run",
+        "--num_devices", str(num_devices),
+        "--num_envs=2",
+        "--sync_env",
+        "--rollout_steps=8",
+        "--per_rank_batch_size=4",
+        "--per_rank_num_batches=2",
+        "--update_epochs=2",
+        "--lstm_hidden_size=8",
+        "--actor_hidden_size=8",
+        "--critic_hidden_size=8",
+        "--actor_pre_lstm_hidden_size=8",
+        "--critic_pre_lstm_hidden_size=8",
+        "--checkpoint_every=1",
+        f"--root_dir={tmp_path}",
+        f"--run_name=dev{num_devices}",
+    ])
+    ckpt_dir = tmp_path / f"dev{num_devices}" / "checkpoints"
+    assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))

@@ -29,6 +29,7 @@ from ...parallel import distributed_setup, make_decoupled_meshes, process_index
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
+from ...utils.profiler import StepProfiler
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
@@ -67,6 +68,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     meshes = make_decoupled_meshes(args.num_devices)
 
     logger, log_dir, run_name = create_logger(args, "ppo_decoupled", process_index=rank)
+    profiler = StepProfiler.from_args(args, log_dir, rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
@@ -217,6 +219,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         if prev_metrics is not None:
             for name, val in prev_metrics.items():
                 aggregator.update(name, val)
+        profiler.tick()
         prev_metrics = metrics
 
         sps = global_step / (time.perf_counter() - start_time)
@@ -234,6 +237,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 block=args.dry_run or update == num_updates,
             )
 
+    profiler.close()
     envs.close()
     # drain the pipeline: final update's metrics + final weights to the player
     if prev_metrics is not None:

@@ -334,7 +334,12 @@ def main(argv: Sequence[str] | None = None) -> None:
             next_value[0], jnp.asarray(next_done), args.gamma, args.gae_lambda,
         )
         data["returns"], data["advantages"] = returns, advantages
-        windows = _to_windows(data, seq_len)
+        # "rewards" is only read by the GAE call above; keep it out of the
+        # windowed/sharded batch the jitted update consumes (ppo.py does the
+        # same for its unused keys)
+        windows = _to_windows(
+            {k: v for k, v in data.items() if k != "rewards"}, seq_len
+        )
         if n_dev > 1:
             windows = shard_batch(windows, mesh, axis=1)
         key, train_key = jax.random.split(key)

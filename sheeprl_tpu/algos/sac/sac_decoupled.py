@@ -26,6 +26,7 @@ from ...parallel import distributed_setup, make_decoupled_meshes, process_index
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_env
 from ...utils.logger import create_logger
+from ...utils.profiler import StepProfiler
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
@@ -56,6 +57,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     meshes = make_decoupled_meshes(args.num_devices)
 
     logger, log_dir, run_name = create_logger(args, "sac_decoupled", process_index=rank)
+    profiler = StepProfiler.from_args(args, log_dir, rank)
     logger.log_hyperparams(args.as_dict())
 
     envs = make_vector_env(
@@ -215,6 +217,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             if prev_metrics is not None:
                 for name, val in prev_metrics.items():
                     aggregator.update(name, val)
+            profiler.tick()
             prev_metrics = metrics
 
         sps = global_step / (time.perf_counter() - start_time)
@@ -240,6 +243,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + ".buffer.npz")
 
+    profiler.close()
     envs.close()
     # drain the pipeline: final update's metrics
     if prev_metrics is not None:

@@ -40,7 +40,8 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
-    shard_batch,
+    seq_axis_size,
+    shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...envs import make_vector_env
@@ -101,15 +102,29 @@ def make_train_step(
     mlp_keys: Sequence[str],
     actions_dim: Sequence[int],
     is_continuous: bool,
+    mesh=None,
 ):
     """Build the single-jit DreamerV2 update (reference train(),
-    dreamer_v2.py:45-374)."""
+    dreamer_v2.py:45-374). With a 2-D (data, seq) mesh the step is
+    context-parallel like dreamer_v3.make_train_step: time-sharded conv/head
+    stages, batch-only resharding around the RSSM scan."""
     stoch_size = args.stochastic_size * args.discrete_size
     horizon = args.horizon
     action_splits = np.cumsum(actions_dim)[:-1]
     # --precision bfloat16: model forwards run in bf16, params stay f32,
     # logits/losses stay f32 (same policy as dreamer_v3.make_train_step)
     compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
+
+    seq_parallel = mesh is not None and seq_axis_size(mesh) > 1
+    if seq_parallel:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def constrain(x, *spec):
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+    else:
+
+        def constrain(x, *spec):
+            return x
 
     def train_step(state: DV2TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]
@@ -128,7 +143,10 @@ def make_train_step(
 
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
-            embedded = wm.encoder(batch_obs)
+            # context parallelism: encoder runs (seq, data)-sharded; the scan
+            # inputs reshard to batch-only, its outputs back to time-sharded
+            # for the decoder/heads (same scheme as dreamer_v3)
+            embedded = constrain(wm.encoder(batch_obs), None, "data")
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -137,12 +155,16 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    data["actions"].astype(compute_dtype),
+                    constrain(data["actions"].astype(compute_dtype), None, "data"),
                     embedded,
-                    is_first,
+                    constrain(is_first, None, "data"),
                     k_wm,
                 )
             )
+            recurrent_states = constrain(recurrent_states, "seq", "data")
+            priors_logits = constrain(priors_logits, "seq", "data")
+            posteriors = constrain(posteriors, "seq", "data")
+            posteriors_logits = constrain(posteriors_logits, "seq", "data")
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
             )
@@ -199,9 +221,15 @@ def make_train_step(
         world_model = optax.apply_updates(state.world_model, wm_updates)
 
         # ---- behaviour: imagination + actor ---------------------------------
-        imagined_prior0 = jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size)
-        recurrent0 = jax.lax.stop_gradient(recurrent_states).reshape(
-            T * B, args.recurrent_state_size
+        imagined_prior0 = constrain(
+            jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size),
+            ("seq", "data"),
+        )
+        recurrent0 = constrain(
+            jax.lax.stop_gradient(recurrent_states).reshape(
+                T * B, args.recurrent_state_size
+            ),
+            ("seq", "data"),
         )
         img_keys = jax.random.split(k_img, horizon)
 
@@ -248,7 +276,9 @@ def make_train_step(
                     ),
                     event_ndims=1,
                 ).mean
-                true_continue0 = (1.0 - data["dones"]).reshape(1, T * B, 1) * args.gamma
+                true_continue0 = constrain(
+                    (1.0 - data["dones"]).reshape(1, T * B, 1), None, ("seq", "data")
+                ) * args.gamma
                 continues = jnp.concatenate([true_continue0, continues[1:]], axis=0)
             else:
                 continues = (
@@ -384,11 +414,16 @@ def main(argv: Sequence[str] | None = None) -> None:
     distributed_setup()
     rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
-    mesh = make_mesh(args.num_devices)
+    mesh = make_mesh(args.num_devices, seq_devices=args.seq_devices)
     n_dev = mesh.devices.size
     # the global batch (per-process batch x world) shards over the global mesh
     assert_divisible(
-        args.per_rank_batch_size * world, n_dev, "per_rank_batch_size*world"
+        args.per_rank_batch_size * world,
+        mesh.shape["data"],
+        "per_rank_batch_size*world",
+    )
+    assert_divisible(
+        args.per_rank_sequence_length, args.seq_devices, "per_rank_sequence_length"
     )
 
     logger, log_dir, run_name = create_logger(args, "dreamer_v2", process_index=rank)
@@ -486,6 +521,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         mlp_keys,
         actions_dim,
         is_continuous,
+        mesh=mesh,
     )
 
     buffer_size = args.buffer_size // (args.num_envs * world) if not args.dry_run else 4
@@ -680,7 +716,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 tau = 1.0 if gradient_steps % args.critic_target_network_update_freq == 0 else 0.0
                 sample = {k: v[i] for k, v in staged.items()}
                 if n_dev > 1:
-                    sample = shard_batch(sample, mesh, axis=1)
+                    sample = shard_time_batch(sample, mesh, time_axis=0, batch_axis=1)
                 key, train_key = jax.random.split(key)
                 state, metrics = train_step(state, sample, train_key, jnp.float32(tau))
                 gradient_steps += 1

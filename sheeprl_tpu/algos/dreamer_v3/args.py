@@ -54,15 +54,6 @@ class DreamerV3Args(DreamerV2Args):
         "all T steps, trading one extra forward for HBM to fit larger "
         "batch/sequence sizes",
     )
-    seq_devices: int = Arg(
-        default=1,
-        help="sequence/context parallelism: shard the TIME axis of the "
-        "[T, B] world-model batch over this many devices for the "
-        "per-timestep stages (conv encoder/decoder, reward/continue heads, "
-        "imagination), resharding to batch-only around the sequential RSSM "
-        "scan; must divide num_devices, and T must divide by it. Use when "
-        "long sequences / small batches run out of batch to data-shard",
-    )
     hafner_initialization: bool = Arg(
         default=True,
         help="Hafner init: Xavier-normal everywhere, Xavier-uniform on distribution output "

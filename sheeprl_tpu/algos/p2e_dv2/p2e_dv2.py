@@ -489,6 +489,13 @@ def main(argv: Sequence[str] | None = None) -> None:
         if saved:
             saved.update(checkpoint_path=args.checkpoint_path)
             (args,) = parser.parse_dict(saved)
+    # after the checkpoint restore: a ckpt saved by dreamer_v2/v3 with
+    # --seq_devices would otherwise reinstate the flag past the guard
+    if args.seq_devices > 1:
+        raise ValueError(
+            "sequence parallelism (--seq_devices) is not wired for p2e_dv2 "
+            "yet; it is available on dreamer_v2 and dreamer_v3"
+        )
     args.screen_size = 64
     args.frame_stack = -1
 

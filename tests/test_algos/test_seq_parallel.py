@@ -24,15 +24,8 @@ from sheeprl_tpu.utils.registry import tasks
 from .test_multidevice import DV3_TINY
 
 
-def _tiny_setup(seed=0):
-    from sheeprl_tpu.algos.dreamer_v3.agent import build_models
-    from sheeprl_tpu.algos.dreamer_v3.args import DreamerV3Args
-    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
-        DV3TrainState,
-        make_optimizers,
-    )
-
-    args = DreamerV3Args(num_envs=2, env_id="dummy")
+def _tiny_config(args):
+    """Shared tiny-model hyperparameters for both Dreamer equivalence tests."""
     args.cnn_keys, args.mlp_keys = ["rgb"], []
     args.dense_units = 16
     args.hidden_size = 16
@@ -44,10 +37,23 @@ def _tiny_setup(seed=0):
     args.mlp_layers = 1
     args.per_rank_batch_size = 4
     args.per_rank_sequence_length = 8
+    return args
 
-    obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+
+_OBS_SPACE = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+
+
+def _tiny_setup(seed=0):
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_models
+    from sheeprl_tpu.algos.dreamer_v3.args import DreamerV3Args
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+        DV3TrainState,
+        make_optimizers,
+    )
+
+    args = _tiny_config(DreamerV3Args(num_envs=2, env_id="dummy"))
     world_model, actor, critic, target_critic = build_models(
-        jax.random.PRNGKey(seed), [3], False, args, obs_space, ["rgb"], []
+        jax.random.PRNGKey(seed), [3], False, args, _OBS_SPACE, ["rgb"], []
     )
     world_opt, actor_opt, critic_opt = make_optimizers(args)
     state = DV3TrainState(
@@ -61,6 +67,17 @@ def _tiny_setup(seed=0):
         moments=ops.Moments.init(args.moments_decay, args.moment_max),
     )
     return args, state, (world_opt, actor_opt, critic_opt)
+
+
+def _assert_metrics_match(metrics_ref, metrics_sp, what):
+    for name in metrics_ref:
+        np.testing.assert_allclose(
+            np.asarray(metrics_ref[name]),
+            np.asarray(metrics_sp[name]),
+            rtol=2e-3,
+            atol=2e-3,
+            err_msg=f"{what} metric {name} diverged under seq parallelism",
+        )
 
 
 def _tiny_batch(args):
@@ -101,14 +118,7 @@ def test_seq_parallel_matches_single_device():
     sharded = shard_time_batch(dict(data), mesh, time_axis=0, batch_axis=1)
     _, metrics_sp = step_sp(state_sp, sharded, key, jnp.float32(1.0))
 
-    for name in metrics_ref:
-        np.testing.assert_allclose(
-            np.asarray(metrics_ref[name]),
-            np.asarray(metrics_sp[name]),
-            rtol=2e-3,
-            atol=2e-3,
-            err_msg=f"metric {name} diverged under seq parallelism",
-        )
+    _assert_metrics_match(metrics_ref, metrics_sp, "DV3")
 
 
 @pytest.mark.timeout(600)
@@ -159,3 +169,57 @@ def test_seq_devices_must_divide_device_count():
 
     with pytest.raises(ValueError, match="must divide"):
         make_mesh(8, seq_devices=3)
+
+
+@pytest.mark.timeout(600)
+def test_dreamer_v2_seq_parallel_matches_single_device():
+    """The DreamerV2 context-parallel step must be metric-equivalent too."""
+    from sheeprl_tpu.algos.dreamer_v2.agent import build_models
+    from sheeprl_tpu.algos.dreamer_v2.args import DreamerV2Args
+    from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import (
+        DV2TrainState,
+        make_optimizers,
+        make_train_step,
+    )
+    from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
+
+    args = _tiny_config(DreamerV2Args(num_envs=2, env_id="dummy"))
+    world_model, actor, critic, target_critic = build_models(
+        jax.random.PRNGKey(0), [3], False, args, _OBS_SPACE, ["rgb"], []
+    )
+    world_opt, actor_opt, critic_opt = make_optimizers(args)
+    state = DV2TrainState(
+        world_model=world_model,
+        actor=actor,
+        critic=critic,
+        target_critic=target_critic,
+        world_opt=world_opt.init(world_model),
+        actor_opt=actor_opt.init(actor),
+        critic_opt=critic_opt.init(critic),
+    )
+    data = _tiny_batch(args)
+    key = jax.random.PRNGKey(7)
+
+    step_ref = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], [3], False
+    )
+    state_ref = jax.tree_util.tree_map(jnp.copy, state)
+    _, metrics_ref = step_ref(state_ref, dict(data), key, jnp.float32(1.0))
+
+    mesh = make_mesh(8, seq_devices=4)
+    step_sp = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], [3], False, mesh=mesh
+    )
+    state_sp = replicate(jax.tree_util.tree_map(jnp.copy, state), mesh)
+    sharded = shard_time_batch(dict(data), mesh, time_axis=0, batch_axis=1)
+    _, metrics_sp = step_sp(state_sp, sharded, key, jnp.float32(1.0))
+
+    _assert_metrics_match(metrics_ref, metrics_sp, "DV2")
+
+
+@pytest.mark.timeout(300)
+def test_p2e_dv2_rejects_seq_devices(tmp_path):
+    with pytest.raises(ValueError, match="seq_devices"):
+        tasks["p2e_dv2"](
+            ["--seq_devices=2", f"--root_dir={tmp_path}", "--run_name=bad"]
+        )

@@ -40,7 +40,7 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
-    seq_axis_size,
+    make_constrain,
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -115,16 +115,7 @@ def make_train_step(
     # logits/losses stay f32 (same policy as dreamer_v3.make_train_step)
     compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
 
-    seq_parallel = mesh is not None and seq_axis_size(mesh) > 1
-    if seq_parallel:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        def constrain(x, *spec):
-            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
-    else:
-
-        def constrain(x, *spec):
-            return x
+    constrain = make_constrain(mesh)
 
     def train_step(state: DV2TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]

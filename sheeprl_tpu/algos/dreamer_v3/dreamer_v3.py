@@ -51,7 +51,7 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
-    seq_axis_size,
+    make_constrain,
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -135,18 +135,7 @@ def make_train_step(
     # weights to the input dtype), normalizations/logits/losses stay f32
     compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
 
-    seq_parallel = mesh is not None and seq_axis_size(mesh) > 1
-    if seq_parallel:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        def constrain(x, *spec):
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P(*spec))
-            )
-    else:
-
-        def constrain(x, *spec):
-            return x
+    constrain = make_constrain(mesh)
 
     def train_step(state: DV3TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]

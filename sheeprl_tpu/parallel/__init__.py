@@ -4,6 +4,7 @@ from .mesh import (
     data_sharding,
     distributed_setup,
     local_mesh_devices,
+    make_constrain,
     make_mesh,
     process_index,
     replicate,
@@ -21,6 +22,7 @@ __all__ = [
     "distributed_setup",
     "local_mesh_devices",
     "make_decoupled_meshes",
+    "make_constrain",
     "make_mesh",
     "process_index",
     "replicate",

@@ -45,6 +45,7 @@ __all__ = [
     "local_mesh_devices",
     "process_index",
     "assert_divisible",
+    "make_constrain",
     "seq_axis_size",
     "shard_time_batch",
     "time_batch_sharding",
@@ -124,6 +125,25 @@ def make_mesh(
 def seq_axis_size(mesh: Mesh) -> int:
     """Size of the sequence/context-parallel axis (1 when absent)."""
     return mesh.shape.get("seq", 1)
+
+
+def make_constrain(mesh: Optional[Mesh]):
+    """Return `constrain(x, *spec)` applying a `with_sharding_constraint`
+    when `mesh` has an active "seq" axis, else the identity — the helper the
+    context-parallel train steps use at their phase boundaries."""
+    if mesh is not None and seq_axis_size(mesh) > 1:
+
+        def constrain(x, *spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec))
+            )
+
+    else:
+
+        def constrain(x, *spec):
+            return x
+
+    return constrain
 
 
 def data_sharding(mesh: Mesh, axis: int = 0, axis_name: str = "data") -> NamedSharding:

@@ -223,3 +223,30 @@ def test_p2e_dv2_rejects_seq_devices(tmp_path):
         tasks["p2e_dv2"](
             ["--seq_devices=2", f"--root_dir={tmp_path}", "--run_name=bad"]
         )
+
+
+@pytest.mark.timeout(600)
+def test_dreamer_v2_seq_parallel_e2e(tmp_path):
+    """The DV2 main-loop wiring (shard_time_batch + divisibility asserts)
+    under a (2, 4) mesh, mirroring the DV3 e2e test."""
+    tasks["dreamer_v2"](
+        [
+            a
+            for a in DV3_TINY
+            if not a.startswith(("--per_rank_sequence_length", "--dry_run"))
+        ]
+        + [
+            "--per_rank_sequence_length=4",
+            "--per_rank_batch_size=2",
+            "--num_devices=8",
+            "--seq_devices=4",
+            "--total_steps=8",
+            "--learning_starts=6",
+            "--buffer_size=16",
+            "--checkpoint_every=8",
+            f"--root_dir={tmp_path}",
+            "--run_name=sp",
+        ]
+    )
+    ckpt_dir = tmp_path / "sp" / "checkpoints"
+    assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))

@@ -1,0 +1,44 @@
+"""Smoke-test the driver-facing bench entry: `python bench.py --tiny` must
+print exactly one JSON line with the contract keys whatever the backend —
+the round artifact depends on it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.timeout(900)
+def test_bench_tiny_prints_contract_json():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    flags = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = flags
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--tiny"],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        timeout=850,
+    )
+    diag = f"stdout: {proc.stdout!r}\nstderr tail: {proc.stderr[-2000:]!r}"
+    assert proc.returncode == 0, diag
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, f"expected ONE JSON line; {diag}"
+    payload = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in payload, f"missing contract key {k}"
+    # a 0.0 value means every guarded measurement failed (sentinel) — the
+    # guarded tracebacks land on stderr, so surface them
+    assert payload["value"] > 0, diag

@@ -35,7 +35,8 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
-    shard_batch,
+    make_constrain,
+    shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
@@ -104,9 +105,12 @@ def make_train_step(
     actions_dim: Sequence[int],
     is_continuous: bool,
     exploring: bool,
+    mesh=None,
 ):
     """Build the single-jit P2E-DV2 update (reference train(),
-    p2e_dv2.py:44-500)."""
+    p2e_dv2.py:44-500). With a 2-D (data, seq) mesh the step is
+    context-parallel like dreamer_v2/dreamer_v3: time-sharded conv/head/
+    ensemble stages, batch-only resharding around the RSSM scan."""
     (world_optimizer, actor_task_optimizer, critic_task_optimizer,
      actor_expl_optimizer, critic_expl_optimizer, ensemble_optimizer) = optimizers
     stoch_size = args.stochastic_size * args.discrete_size
@@ -115,6 +119,7 @@ def make_train_step(
     # --precision bfloat16: same policy as dreamer_v2/dreamer_v3 — forwards
     # in bf16, f32 master params, f32 logits/losses/ensemble-disagreement
     compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
+    constrain = make_constrain(mesh)
 
     def behaviour_update(
         actor, critic, target_critic, actor_opt, critic_opt,
@@ -265,7 +270,8 @@ def make_train_step(
 
         # ---- world model (reward/continue on detached latents) --------------
         def world_loss_fn(wm: WorldModel):
-            embedded = wm.encoder(batch_obs)
+            # context parallelism: same boundary scheme as dreamer_v2/v3
+            embedded = constrain(wm.encoder(batch_obs), None, "data")
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -274,12 +280,16 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    data["actions"].astype(compute_dtype),
+                    constrain(data["actions"].astype(compute_dtype), None, "data"),
                     embedded,
-                    is_first,
+                    constrain(is_first, None, "data"),
                     k_wm,
                 )
             )
+            recurrent_states = constrain(recurrent_states, "seq", "data")
+            priors_logits = constrain(priors_logits, "seq", "data")
+            posteriors = constrain(posteriors, "seq", "data")
+            posteriors_logits = constrain(posteriors_logits, "seq", "data")
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
             )
@@ -336,11 +346,19 @@ def make_train_step(
         )
         world_model = optax.apply_updates(state.world_model, wm_updates)
 
-        imagined_prior0 = jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size)
-        recurrent0 = jax.lax.stop_gradient(recurrent_states).reshape(
-            T * B, args.recurrent_state_size
+        imagined_prior0 = constrain(
+            jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size),
+            ("seq", "data"),
         )
-        true_continue0 = (1.0 - data["dones"]).reshape(1, T * B, 1)
+        recurrent0 = constrain(
+            jax.lax.stop_gradient(recurrent_states).reshape(
+                T * B, args.recurrent_state_size
+            ),
+            ("seq", "data"),
+        )
+        true_continue0 = constrain(
+            (1.0 - data["dones"]).reshape(1, T * B, 1), None, ("seq", "data")
+        )
 
         shaped = (T, B, args.stochastic_size, args.discrete_size)
         metrics = {
@@ -489,13 +507,6 @@ def main(argv: Sequence[str] | None = None) -> None:
         if saved:
             saved.update(checkpoint_path=args.checkpoint_path)
             (args,) = parser.parse_dict(saved)
-    # after the checkpoint restore: a ckpt saved by dreamer_v2/v3 with
-    # --seq_devices would otherwise reinstate the flag past the guard
-    if args.seq_devices > 1:
-        raise ValueError(
-            "sequence parallelism (--seq_devices) is not wired for p2e_dv2 "
-            "yet; it is available on dreamer_v2 and dreamer_v3"
-        )
     args.screen_size = 64
     args.frame_stack = -1
 
@@ -505,11 +516,16 @@ def main(argv: Sequence[str] | None = None) -> None:
     distributed_setup()
     rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
-    mesh = make_mesh(args.num_devices)
+    mesh = make_mesh(args.num_devices, seq_devices=args.seq_devices)
     n_dev = mesh.devices.size
     # the global batch (per-process batch x world) shards over the global mesh
     assert_divisible(
-        args.per_rank_batch_size * world, n_dev, "per_rank_batch_size*world"
+        args.per_rank_batch_size * world,
+        mesh.shape["data"],
+        "per_rank_batch_size*world",
+    )
+    assert_divisible(
+        args.per_rank_sequence_length, args.seq_devices, "per_rank_sequence_length"
     )
 
     logger, log_dir, run_name = create_logger(args, "p2e_dv2", process_index=rank)
@@ -615,10 +631,12 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
     )
     train_step_exploring = make_train_step(
-        args, optimizers, cnn_keys, mlp_keys, actions_dim, is_continuous, exploring=True
+        args, optimizers, cnn_keys, mlp_keys, actions_dim, is_continuous,
+        exploring=True, mesh=mesh,
     )
     train_step_task = make_train_step(
-        args, optimizers, cnn_keys, mlp_keys, actions_dim, is_continuous, exploring=False
+        args, optimizers, cnn_keys, mlp_keys, actions_dim, is_continuous,
+        exploring=False, mesh=mesh,
     )
 
     buffer_size = args.buffer_size // (args.num_envs * world) if not args.dry_run else 4
@@ -818,7 +836,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 tau = 1.0 if gradient_steps % args.critic_target_network_update_freq == 0 else 0.0
                 sample = {k: v[i] for k, v in staged.items()}
                 if n_dev > 1:
-                    sample = shard_batch(sample, mesh, axis=1)
+                    sample = shard_time_batch(sample, mesh, time_axis=0, batch_axis=1)
                 key, train_key = jax.random.split(key)
                 state, metrics = train_step(state, sample, train_key, jnp.float32(tau))
                 gradient_steps += 1

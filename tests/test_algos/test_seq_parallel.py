@@ -121,11 +121,12 @@ def test_seq_parallel_matches_single_device():
     _assert_metrics_match(metrics_ref, metrics_sp, "DV3")
 
 
-@pytest.mark.timeout(600)
-def test_dreamer_v3_seq_parallel_e2e(tmp_path):
-    # a dry run adds a single transition — too few for T=4 sequences — so
-    # run a short real loop instead (8 env steps, training from step 6)
-    tasks["dreamer_v3"](
+
+
+def _run_seq_parallel_e2e(task_name, tmp_path, extra=()):
+    """Shared e2e: a short real loop under a (2, 4) mesh (a dry run adds a
+    single transition — too few for T=4 sequences), asserting a checkpoint."""
+    tasks[task_name](
         [
             a
             for a in DV3_TINY
@@ -140,12 +141,18 @@ def test_dreamer_v3_seq_parallel_e2e(tmp_path):
             "--learning_starts=6",
             "--buffer_size=16",
             "--checkpoint_every=8",
+            *extra,
             f"--root_dir={tmp_path}",
             "--run_name=sp",
         ]
     )
     ckpt_dir = tmp_path / "sp" / "checkpoints"
     assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))
+
+
+@pytest.mark.timeout(600)
+def test_dreamer_v3_seq_parallel_e2e(tmp_path):
+    _run_seq_parallel_e2e("dreamer_v3", tmp_path)
 
 
 @pytest.mark.timeout(300)
@@ -217,36 +224,75 @@ def test_dreamer_v2_seq_parallel_matches_single_device():
     _assert_metrics_match(metrics_ref, metrics_sp, "DV2")
 
 
-@pytest.mark.timeout(300)
-def test_p2e_dv2_rejects_seq_devices(tmp_path):
-    with pytest.raises(ValueError, match="seq_devices"):
-        tasks["p2e_dv2"](
-            ["--seq_devices=2", f"--root_dir={tmp_path}", "--run_name=bad"]
-        )
+@pytest.mark.timeout(900)
+def test_p2e_dv2_seq_parallel_e2e(tmp_path):
+    """P2E-DV2 dual-AC + ensemble under the mesh (whole Dreamer family)."""
+    _run_seq_parallel_e2e(
+        "p2e_dv2", tmp_path,
+        extra=("--exploration_steps=8", "--num_ensembles=2"),
+    )
 
 
 @pytest.mark.timeout(600)
 def test_dreamer_v2_seq_parallel_e2e(tmp_path):
-    """The DV2 main-loop wiring (shard_time_batch + divisibility asserts)
-    under a (2, 4) mesh, mirroring the DV3 e2e test."""
-    tasks["dreamer_v2"](
-        [
-            a
-            for a in DV3_TINY
-            if not a.startswith(("--per_rank_sequence_length", "--dry_run"))
-        ]
-        + [
-            "--per_rank_sequence_length=4",
-            "--per_rank_batch_size=2",
-            "--num_devices=8",
-            "--seq_devices=4",
-            "--total_steps=8",
-            "--learning_starts=6",
-            "--buffer_size=16",
-            "--checkpoint_every=8",
-            f"--root_dir={tmp_path}",
-            "--run_name=sp",
-        ]
+    """The DV2 main-loop wiring (shard_time_batch + divisibility asserts)."""
+    _run_seq_parallel_e2e("dreamer_v2", tmp_path)
+
+
+@pytest.mark.timeout(900)
+def test_p2e_dv2_seq_parallel_matches_single_device():
+    """The exploring-phase P2E-DV2 step (ensemble loss over time-shifted
+    posteriors + disagreement reward + dual AC) must be metric-equivalent
+    under the (2, 4) mesh."""
+    from sheeprl_tpu.algos.p2e_dv2.agent import build_models
+    from sheeprl_tpu.algos.p2e_dv2.args import P2EDV2Args
+    from sheeprl_tpu.algos.p2e_dv2.p2e_dv2 import (
+        P2EDV2TrainState,
+        make_optimizers,
+        make_train_step,
     )
-    ckpt_dir = tmp_path / "sp" / "checkpoints"
-    assert any(e.startswith("ckpt_") for e in os.listdir(ckpt_dir))
+    from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
+
+    args = _tiny_config(P2EDV2Args(num_envs=2, env_id="dummy"))
+    args.num_ensembles = 2
+    (
+        world_model, actor_task, critic_task, target_critic_task,
+        actor_expl, critic_expl, target_critic_expl, ensembles,
+    ) = build_models(jax.random.PRNGKey(0), [3], False, args, _OBS_SPACE, ["rgb"], [])
+    optimizers = make_optimizers(args)
+    (world_opt, actor_task_opt, critic_task_opt,
+     actor_expl_opt, critic_expl_opt, ensemble_opt) = optimizers
+    state = P2EDV2TrainState(
+        world_model=world_model,
+        actor_task=actor_task,
+        critic_task=critic_task,
+        target_critic_task=target_critic_task,
+        actor_exploration=actor_expl,
+        critic_exploration=critic_expl,
+        target_critic_exploration=target_critic_expl,
+        ensembles=ensembles,
+        world_opt=world_opt.init(world_model),
+        actor_task_opt=actor_task_opt.init(actor_task),
+        critic_task_opt=critic_task_opt.init(critic_task),
+        actor_exploration_opt=actor_expl_opt.init(actor_expl),
+        critic_exploration_opt=critic_expl_opt.init(critic_expl),
+        ensemble_opt=ensemble_opt.init(ensembles),
+    )
+    data = _tiny_batch(args)
+    key = jax.random.PRNGKey(7)
+
+    step_ref = make_train_step(
+        args, optimizers, ["rgb"], [], [3], False, exploring=True
+    )
+    state_ref = jax.tree_util.tree_map(jnp.copy, state)
+    _, metrics_ref = step_ref(state_ref, dict(data), key, jnp.float32(1.0))
+
+    mesh = make_mesh(8, seq_devices=4)
+    step_sp = make_train_step(
+        args, optimizers, ["rgb"], [], [3], False, exploring=True, mesh=mesh
+    )
+    state_sp = replicate(jax.tree_util.tree_map(jnp.copy, state), mesh)
+    sharded = shard_time_batch(dict(data), mesh, time_axis=0, batch_axis=1)
+    _, metrics_sp = step_sp(state_sp, sharded, key, jnp.float32(1.0))
+
+    _assert_metrics_match(metrics_ref, metrics_sp, "P2E-DV2")

@@ -29,7 +29,9 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
-    shard_batch,
+    constrain_time_batch,
+    make_constrain,
+    shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
@@ -80,9 +82,11 @@ def make_train_step(
     critic_optimizer,
     cnn_keys: Sequence[str],
     mlp_keys: Sequence[str],
+    mesh=None,
 ):
     """Build the single-jit DreamerV1 update (reference train(),
     dreamer_v1.py:40-356)."""
+    constrain = make_constrain(mesh)
     horizon = args.horizon
 
     def train_step(state: DV1TrainState, data: dict, key):
@@ -93,13 +97,23 @@ def make_train_step(
 
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
-            embedded = wm.encoder(batch_obs)
+            embedded = constrain(wm.encoder(batch_obs), None, "data")
             posterior0 = jnp.zeros((B, args.stochastic_size))
             recurrent0 = jnp.zeros((B, args.recurrent_state_size))
             recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds = (
                 wm.rssm.scan_dynamic(
-                    posterior0, recurrent0, data["actions"], embedded, k_wm
+                    posterior0,
+                    recurrent0,
+                    constrain(data["actions"], None, "data"),
+                    embedded,
+                    k_wm,
                 )
+            )
+            (recurrent_states, posteriors, post_means, post_stds,
+             prior_means, prior_stds) = constrain_time_batch(
+                constrain,
+                recurrent_states, posteriors, post_means, post_stds,
+                prior_means, prior_stds,
             )
             latent_states = jnp.concatenate([posteriors, recurrent_states], axis=-1)
             decoded = wm.observation_model(latent_states)
@@ -148,11 +162,15 @@ def make_train_step(
         world_model = optax.apply_updates(state.world_model, wm_updates)
 
         # ---- behaviour: imagination + actor ---------------------------------
-        imagined_prior0 = jax.lax.stop_gradient(posteriors).reshape(
-            T * B, args.stochastic_size
+        imagined_prior0 = constrain(
+            jax.lax.stop_gradient(posteriors).reshape(T * B, args.stochastic_size),
+            ("seq", "data"),
         )
-        recurrent0 = jax.lax.stop_gradient(recurrent_states).reshape(
-            T * B, args.recurrent_state_size
+        recurrent0 = constrain(
+            jax.lax.stop_gradient(recurrent_states).reshape(
+                T * B, args.recurrent_state_size
+            ),
+            ("seq", "data"),
         )
         img_keys = jax.random.split(k_img, horizon)
 
@@ -291,11 +309,16 @@ def main(argv: Sequence[str] | None = None) -> None:
     distributed_setup()
     rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
-    mesh = make_mesh(args.num_devices)
+    mesh = make_mesh(args.num_devices, seq_devices=args.seq_devices)
     n_dev = mesh.devices.size
     # the global batch (per-process batch x world) shards over the global mesh
     assert_divisible(
-        args.per_rank_batch_size * world, n_dev, "per_rank_batch_size*world"
+        args.per_rank_batch_size * world,
+        mesh.shape["data"],
+        "per_rank_batch_size*world",
+    )
+    assert_divisible(
+        args.per_rank_sequence_length, args.seq_devices, "per_rank_sequence_length"
     )
 
     logger, log_dir, run_name = create_logger(args, "dreamer_v1", process_index=rank)
@@ -380,7 +403,8 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
     )
     train_step = make_train_step(
-        args, world_optimizer, actor_optimizer, critic_optimizer, cnn_keys, mlp_keys
+        args, world_optimizer, actor_optimizer, critic_optimizer, cnn_keys,
+        mlp_keys, mesh=mesh,
     )
 
     buffer_size = args.buffer_size // (args.num_envs * world) if not args.dry_run else 4
@@ -512,7 +536,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             for i in range(n_samples):
                 sample = {k: v[i] for k, v in staged.items()}
                 if n_dev > 1:
-                    sample = shard_batch(sample, mesh, axis=1)
+                    sample = shard_time_batch(sample, mesh, time_axis=0, batch_axis=1)
                 key, train_key = jax.random.split(key)
                 state, metrics = train_step(state, sample, train_key)
                 gradient_steps += 1

@@ -37,7 +37,9 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
-    shard_batch,
+    constrain_time_batch,
+    make_constrain,
+    shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
@@ -104,12 +106,14 @@ def make_train_step(
     cnn_keys: Sequence[str],
     mlp_keys: Sequence[str],
     exploring: bool,
+    mesh=None,
 ):
     """Build the single-jit P2E-DV1 update (reference train(),
     p2e_dv1.py:44-355). `exploring=False` compiles the task-only program."""
     (world_optimizer, actor_task_optimizer, critic_task_optimizer,
      actor_expl_optimizer, critic_expl_optimizer, ensemble_optimizer) = optimizers
     horizon = args.horizon
+    constrain = make_constrain(mesh)
 
     def behaviour_update(
         actor, critic, actor_opt, critic_opt, actor_optimizer_, critic_optimizer_,
@@ -205,13 +209,23 @@ def make_train_step(
 
         # ---- world model (reward/continue on detached latents) --------------
         def world_loss_fn(wm: WorldModel):
-            embedded = wm.encoder(batch_obs)
+            embedded = constrain(wm.encoder(batch_obs), None, "data")
             posterior0 = jnp.zeros((B, args.stochastic_size))
             recurrent0 = jnp.zeros((B, args.recurrent_state_size))
             recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds = (
                 wm.rssm.scan_dynamic(
-                    posterior0, recurrent0, data["actions"], embedded, k_wm
+                    posterior0,
+                    recurrent0,
+                    constrain(data["actions"], None, "data"),
+                    embedded,
+                    k_wm,
                 )
+            )
+            (recurrent_states, posteriors, post_means, post_stds,
+             prior_means, prior_stds) = constrain_time_batch(
+                constrain,
+                recurrent_states, posteriors, post_means, post_stds,
+                prior_means, prior_stds,
             )
             latent_states = jnp.concatenate([posteriors, recurrent_states], axis=-1)
             latents_sg = jax.lax.stop_gradient(latent_states)
@@ -258,11 +272,15 @@ def make_train_step(
         )
         world_model = optax.apply_updates(state.world_model, wm_updates)
 
-        imagined_prior0 = jax.lax.stop_gradient(posteriors).reshape(
-            T * B, args.stochastic_size
+        imagined_prior0 = constrain(
+            jax.lax.stop_gradient(posteriors).reshape(T * B, args.stochastic_size),
+            ("seq", "data"),
         )
-        recurrent0 = jax.lax.stop_gradient(recurrent_states).reshape(
-            T * B, args.recurrent_state_size
+        recurrent0 = constrain(
+            jax.lax.stop_gradient(recurrent_states).reshape(
+                T * B, args.recurrent_state_size
+            ),
+            ("seq", "data"),
         )
         metrics = {
             "Loss/reconstruction_loss": rec_loss,
@@ -401,11 +419,16 @@ def main(argv: Sequence[str] | None = None) -> None:
     distributed_setup()
     rank, world = process_index(), jax.process_count()
     key = jax.random.PRNGKey(args.seed)
-    mesh = make_mesh(args.num_devices)
+    mesh = make_mesh(args.num_devices, seq_devices=args.seq_devices)
     n_dev = mesh.devices.size
     # the global batch (per-process batch x world) shards over the global mesh
     assert_divisible(
-        args.per_rank_batch_size * world, n_dev, "per_rank_batch_size*world"
+        args.per_rank_batch_size * world,
+        mesh.shape["data"],
+        "per_rank_batch_size*world",
+    )
+    assert_divisible(
+        args.per_rank_sequence_length, args.seq_devices, "per_rank_sequence_length"
     )
 
     logger, log_dir, run_name = create_logger(args, "p2e_dv1", process_index=rank)
@@ -503,10 +526,10 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
     )
     train_step_exploring = make_train_step(
-        args, optimizers, cnn_keys, mlp_keys, exploring=True
+        args, optimizers, cnn_keys, mlp_keys, exploring=True, mesh=mesh
     )
     train_step_task = make_train_step(
-        args, optimizers, cnn_keys, mlp_keys, exploring=False
+        args, optimizers, cnn_keys, mlp_keys, exploring=False, mesh=mesh
     )
 
     buffer_size = args.buffer_size // (args.num_envs * world) if not args.dry_run else 4
@@ -652,7 +675,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             for i in range(n_samples):
                 sample = {k: v[i] for k, v in staged.items()}
                 if n_dev > 1:
-                    sample = shard_batch(sample, mesh, axis=1)
+                    sample = shard_time_batch(sample, mesh, time_axis=0, batch_axis=1)
                 key, train_key = jax.random.split(key)
                 state, metrics = train_step(state, sample, train_key)
                 gradient_steps += 1

@@ -35,6 +35,7 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
+    constrain_time_batch,
     make_constrain,
     shard_time_batch,
 )
@@ -286,10 +287,12 @@ def make_train_step(
                     k_wm,
                 )
             )
-            recurrent_states = constrain(recurrent_states, "seq", "data")
-            priors_logits = constrain(priors_logits, "seq", "data")
-            posteriors = constrain(posteriors, "seq", "data")
-            posteriors_logits = constrain(posteriors_logits, "seq", "data")
+            recurrent_states, priors_logits, posteriors, posteriors_logits = (
+                constrain_time_batch(
+                    constrain,
+                    recurrent_states, priors_logits, posteriors, posteriors_logits,
+                )
+            )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
             )

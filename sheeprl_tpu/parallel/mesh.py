@@ -45,6 +45,7 @@ __all__ = [
     "local_mesh_devices",
     "process_index",
     "assert_divisible",
+    "constrain_time_batch",
     "make_constrain",
     "seq_axis_size",
     "shard_time_batch",
@@ -144,6 +145,13 @@ def make_constrain(mesh: Optional[Mesh]):
             return x
 
     return constrain
+
+
+def constrain_time_batch(constrain, *arrays):
+    """Apply the time-sharded `("seq", "data")` boundary spec to each of the
+    `[T, B, ...]` RSSM scan outputs (the shared reshard point of every
+    Dreamer-family train step)."""
+    return tuple(constrain(a, "seq", "data") for a in arrays)
 
 
 def data_sharding(mesh: Mesh, axis: int = 0, axis_name: str = "data") -> NamedSharding:

@@ -126,12 +126,11 @@ def test_seq_parallel_matches_single_device():
 def _run_seq_parallel_e2e(task_name, tmp_path, extra=()):
     """Shared e2e: a short real loop under a (2, 4) mesh (a dry run adds a
     single transition — too few for T=4 sequences), asserting a checkpoint."""
+    drop = ["--per_rank_sequence_length", "--dry_run"]
+    if task_name in ("dreamer_v1", "p2e_dv1"):
+        drop.append("--discrete_size")  # Gaussian latent: no discrete size
     tasks[task_name](
-        [
-            a
-            for a in DV3_TINY
-            if not a.startswith(("--per_rank_sequence_length", "--dry_run"))
-        ]
+        [a for a in DV3_TINY if not a.startswith(tuple(drop))]
         + [
             "--per_rank_sequence_length=4",
             "--per_rank_batch_size=2",
@@ -296,3 +295,115 @@ def test_p2e_dv2_seq_parallel_matches_single_device():
     _, metrics_sp = step_sp(state_sp, sharded, key, jnp.float32(1.0))
 
     _assert_metrics_match(metrics_ref, metrics_sp, "P2E-DV2")
+
+
+@pytest.mark.timeout(600)
+def test_dreamer_v1_seq_parallel_matches_single_device():
+    """The Gaussian-RSSM (DV1) context-parallel step must be metric-equivalent."""
+    from sheeprl_tpu.algos.dreamer_v1.agent import build_models
+    from sheeprl_tpu.algos.dreamer_v1.args import DreamerV1Args
+    from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import (
+        DV1TrainState,
+        make_optimizers,
+        make_train_step,
+    )
+    from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
+
+    args = _tiny_config(DreamerV1Args(num_envs=2, env_id="dummy"))
+    world_model, actor, critic = build_models(
+        jax.random.PRNGKey(0), [3], False, args, _OBS_SPACE, ["rgb"], []
+    )
+    world_opt, actor_opt, critic_opt = make_optimizers(args)
+    state = DV1TrainState(
+        world_model=world_model,
+        actor=actor,
+        critic=critic,
+        world_opt=world_opt.init(world_model),
+        actor_opt=actor_opt.init(actor),
+        critic_opt=critic_opt.init(critic),
+    )
+    data = _tiny_batch(args)
+    key = jax.random.PRNGKey(7)
+
+    step_ref = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], []
+    )
+    state_ref = jax.tree_util.tree_map(jnp.copy, state)
+    _, metrics_ref = step_ref(state_ref, dict(data), key)
+
+    mesh = make_mesh(8, seq_devices=4)
+    step_sp = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], mesh=mesh
+    )
+    state_sp = replicate(jax.tree_util.tree_map(jnp.copy, state), mesh)
+    sharded = shard_time_batch(dict(data), mesh, time_axis=0, batch_axis=1)
+    _, metrics_sp = step_sp(state_sp, sharded, key)
+
+    _assert_metrics_match(metrics_ref, metrics_sp, "DV1")
+
+
+@pytest.mark.timeout(600)
+def test_dreamer_v1_seq_parallel_e2e(tmp_path):
+    _run_seq_parallel_e2e("dreamer_v1", tmp_path)
+
+
+@pytest.mark.timeout(900)
+def test_p2e_dv1_seq_parallel_e2e(tmp_path):
+    _run_seq_parallel_e2e(
+        "p2e_dv1", tmp_path,
+        extra=("--exploration_steps=8", "--num_ensembles=2"),
+    )
+
+
+@pytest.mark.timeout(900)
+def test_p2e_dv1_seq_parallel_matches_single_device():
+    """P2E-DV1's exploring-phase step (ensemble fit + disagreement reward +
+    dual AC on the Gaussian RSSM) must be metric-equivalent under the mesh."""
+    from sheeprl_tpu.algos.p2e_dv1.agent import build_models
+    from sheeprl_tpu.algos.p2e_dv1.args import P2EDV1Args
+    from sheeprl_tpu.algos.p2e_dv1.p2e_dv1 import (
+        P2EDV1TrainState,
+        make_optimizers,
+        make_train_step,
+    )
+    from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
+
+    args = _tiny_config(P2EDV1Args(num_envs=2, env_id="dummy"))
+    args.num_ensembles = 2
+    (
+        world_model, actor_task, critic_task,
+        actor_expl, critic_expl, ensembles,
+    ) = build_models(jax.random.PRNGKey(0), [3], False, args, _OBS_SPACE, ["rgb"], [])
+    optimizers = make_optimizers(args)
+    (world_opt, actor_task_opt, critic_task_opt,
+     actor_expl_opt, critic_expl_opt, ensemble_opt) = optimizers
+    state = P2EDV1TrainState(
+        world_model=world_model,
+        actor_task=actor_task,
+        critic_task=critic_task,
+        actor_exploration=actor_expl,
+        critic_exploration=critic_expl,
+        ensembles=ensembles,
+        world_opt=world_opt.init(world_model),
+        actor_task_opt=actor_task_opt.init(actor_task),
+        critic_task_opt=critic_task_opt.init(critic_task),
+        actor_exploration_opt=actor_expl_opt.init(actor_expl),
+        critic_exploration_opt=critic_expl_opt.init(critic_expl),
+        ensemble_opt=ensemble_opt.init(ensembles),
+    )
+    data = _tiny_batch(args)
+    key = jax.random.PRNGKey(7)
+
+    step_ref = make_train_step(args, optimizers, ["rgb"], [], exploring=True)
+    state_ref = jax.tree_util.tree_map(jnp.copy, state)
+    _, metrics_ref = step_ref(state_ref, dict(data), key)
+
+    mesh = make_mesh(8, seq_devices=4)
+    step_sp = make_train_step(
+        args, optimizers, ["rgb"], [], exploring=True, mesh=mesh
+    )
+    state_sp = replicate(jax.tree_util.tree_map(jnp.copy, state), mesh)
+    sharded = shard_time_batch(dict(data), mesh, time_axis=0, batch_axis=1)
+    _, metrics_sp = step_sp(state_sp, sharded, key)
+
+    _assert_metrics_match(metrics_ref, metrics_sp, "P2E-DV1")

@@ -96,3 +96,18 @@ class StandardArgs:
     def from_dict(cls, d: dict[str, Any]):
         keys = {f.name for f in dataclasses.fields(cls) if f.init}
         return cls(**{k: v for k, v in d.items() if k in keys})
+
+@dataclasses.dataclass
+class SeqParallelArgs:
+    """Mixin for tasks supporting sequence/context parallelism (the whole
+    Dreamer family)."""
+
+    seq_devices: int = Arg(
+        default=1,
+        help="sequence/context parallelism: shard the TIME axis of the "
+        "[T, B] world-model batch over this many devices for the "
+        "per-timestep stages (conv encoder/decoder, reward/continue heads, "
+        "imagination), resharding to batch-only around the sequential RSSM "
+        "scan; must divide num_devices, and T must divide by it. Use when "
+        "long sequences / small batches run out of batch to data-shard",
+    )

@@ -7,11 +7,11 @@ import dataclasses
 from typing import List, Optional
 
 from ...utils.parser import Arg
-from ..args import StandardArgs
+from ..args import SeqParallelArgs, StandardArgs
 
 
 @dataclasses.dataclass
-class DreamerV1Args(StandardArgs):
+class DreamerV1Args(SeqParallelArgs, StandardArgs):
     # Experiment settings
     share_data: bool = Arg(default=False, help="toggle sharing data between processes")
     per_rank_batch_size: int = Arg(default=50, help="the batch size for each rank")
@@ -49,14 +49,6 @@ class DreamerV1Args(StandardArgs):
     dense_act: str = Arg(default="elu", help="activation for the dense layers")
     cnn_act: str = Arg(default="relu", help="activation for the convolutional layers")
 
-    seq_devices: int = Arg(
-        default=1,
-        help="sequence/context parallelism: shard the TIME axis of the "
-        "[T, B] world-model batch over this many devices for the "
-        "per-timestep stages (conv encoder/decoder, reward/continue heads, "
-        "imagination), resharding to batch-only around the sequential RSSM "
-        "scan; must divide num_devices, and T must divide by it",
-    )
 
     # Environment settings
     expl_amount: float = Arg(default=0.3, help="the exploration amount to add to the actions")

@@ -7,11 +7,11 @@ import dataclasses
 from typing import List, Optional
 
 from ...utils.parser import Arg
-from ..args import StandardArgs
+from ..args import SeqParallelArgs, StandardArgs
 
 
 @dataclasses.dataclass
-class DreamerV2Args(StandardArgs):
+class DreamerV2Args(SeqParallelArgs, StandardArgs):
     env_id: str = Arg(default="dmc_walker_walk", help="the id of the environment")
 
     # Experiment settings
@@ -71,15 +71,6 @@ class DreamerV2Args(StandardArgs):
         help="actor objective mix: 0 = dynamics backpropagation, 1 = reinforce",
     )
 
-    seq_devices: int = Arg(
-        default=1,
-        help="sequence/context parallelism: shard the TIME axis of the "
-        "[T, B] world-model batch over this many devices for the "
-        "per-timestep stages (conv encoder/decoder, reward/continue heads, "
-        "imagination), resharding to batch-only around the sequential RSSM "
-        "scan; must divide num_devices, and T must divide by it. Use when "
-        "long sequences / small batches run out of batch to data-shard",
-    )
 
     # Environment settings
     expl_amount: float = Arg(default=0.0, help="the exploration amount to add to the actions")

@@ -38,6 +38,30 @@ assert batch["x"].shape == (4, 3)  # global shape
 total = jax.jit(lambda t: t["x"].sum())(batch)
 # process 0 contributes 2*3*1, process 1 contributes 2*3*2 -> 18
 np.testing.assert_allclose(float(total), 18.0)
+
+# --- context-parallel layout across hosts --------------------------------
+from jax.sharding import Mesh
+from sheeprl_tpu.parallel import shard_time_batch
+
+# (data=2 over processes, seq=2 within each process): every seq group is
+# process-local, so each process contributes full-T, local-B data
+mesh2 = make_mesh(seq_devices=2)
+assert dict(mesh2.shape) == {"data": 2, "seq": 2}
+local_tb = np.full((4, 1, 3), float(pid + 1), dtype=np.float32)  # [T, B_local, F]
+seq_batch = shard_time_batch({"x": local_tb}, mesh2)
+assert seq_batch["x"].shape == (4, 2, 3)  # global [T, B, F]
+total2 = jax.jit(lambda t: t["x"].sum())(seq_batch)
+np.testing.assert_allclose(float(total2), 4 * 3 * (1 + 2))
+
+# a seq axis spanning processes must be rejected (it would stitch the two
+# hosts' unrelated samples along time)
+bad = Mesh(np.asarray(jax.devices()).reshape(2, 2).T, ("data", "seq"))
+try:
+    shard_time_batch({"x": local_tb}, bad)
+except ValueError as e:
+    assert "spans processes" in str(e), e
+else:
+    raise AssertionError("cross-process seq axis was not rejected")
 print(f"proc {pid} ok", flush=True)
 """
 

@@ -75,6 +75,55 @@ def test_ppo_learns_cartpole(tmp_path):
     assert mean_return >= 400.0, f"PPO failed to learn CartPole: {returns}"
 
 
+
+
+def _eval_pendulum_actor(actor, episodes=10):
+    """Greedy Pendulum rollout returns for a restored SAC-family actor."""
+    env = gym.make("Pendulum-v1")
+    greedy = jax.jit(actor.get_greedy_actions)
+    returns = []
+    for episode in range(episodes):
+        obs, _ = env.reset(seed=1000 + episode)
+        done, ep_return = False, 0.0
+        while not done:
+            action = greedy(jnp.asarray(obs, jnp.float32)[None])
+            obs, reward, terminated, truncated, _ = env.step(np.asarray(action[0]))
+            ep_return += float(reward)
+            done = terminated or truncated
+        returns.append(ep_return)
+    env.close()
+    return returns
+
+
+def _restore_sac_family_actor(ckpt, AgentCls, make_optimizers, args, **agent_kw):
+    """Rebuild the checkpoint template for the shared SAC/DroQ key contract
+    and return the restored actor."""
+    env = gym.make("Pendulum-v1")
+    template_agent = AgentCls.init(
+        jax.random.PRNGKey(0),
+        int(np.prod(env.observation_space.shape)),
+        int(np.prod(env.action_space.shape)),
+        actor_hidden_size=256,
+        critic_hidden_size=256,
+        action_low=env.action_space.low,
+        action_high=env.action_space.high,
+        **agent_kw,
+    )
+    env.close()
+    qf_opt, actor_opt, alpha_opt = make_optimizers(args)
+    state = load_checkpoint(
+        ckpt,
+        {
+            "agent": template_agent,
+            "qf_optimizer": qf_opt.init(template_agent.critics),
+            "actor_optimizer": actor_opt.init(template_agent.actor),
+            "alpha_optimizer": alpha_opt.init(template_agent.log_alpha),
+            "global_step": 0,
+        },
+    )
+    return state["agent"].actor
+
+
 @pytest.mark.slow
 @pytest.mark.timeout(1800)
 def test_sac_learns_pendulum(tmp_path):
@@ -103,40 +152,46 @@ def test_sac_learns_pendulum(tmp_path):
     ckpt = latest_checkpoint(str(tmp_path / "learn" / "checkpoints"))
     assert ckpt is not None
 
-    env = gym.make("Pendulum-v1")
-    template_agent = SACAgent.init(
-        jax.random.PRNGKey(0),
-        int(np.prod(env.observation_space.shape)),
-        int(np.prod(env.action_space.shape)),
-        actor_hidden_size=256,
-        critic_hidden_size=256,
-        action_low=env.action_space.low,
-        action_high=env.action_space.high,
+    actor = _restore_sac_family_actor(
+        ckpt, SACAgent, make_optimizers, SACArgs()
     )
-    qf_opt, actor_opt, alpha_opt = make_optimizers(SACArgs())
-    state = load_checkpoint(
-        ckpt,
-        {
-            "agent": template_agent,
-            "qf_optimizer": qf_opt.init(template_agent.critics),
-            "actor_optimizer": actor_opt.init(template_agent.actor),
-            "alpha_optimizer": alpha_opt.init(template_agent.log_alpha),
-            "global_step": 0,
-        },
-    )
-    actor = state["agent"].actor
-    greedy = jax.jit(actor.get_greedy_actions)
-
-    returns = []
-    for episode in range(10):
-        obs, _ = env.reset(seed=1000 + episode)
-        done, ep_return = False, 0.0
-        while not done:
-            action = greedy(jnp.asarray(obs, jnp.float32)[None])
-            obs, reward, terminated, truncated, _ = env.step(np.asarray(action[0]))
-            ep_return += float(reward)
-            done = terminated or truncated
-        returns.append(ep_return)
-    env.close()
+    returns = _eval_pendulum_actor(actor)
     mean_return = float(np.mean(returns))
     assert mean_return >= -300.0, f"SAC failed to learn Pendulum: {returns}"
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1800)
+def test_droq_learns_pendulum(tmp_path):
+    """DroQ's high-UTD critic loop must also swing up Pendulum — its
+    dropout/LayerNorm ensemble and per-round EMA are the pieces the SAC test
+    does not cover."""
+    from sheeprl_tpu.algos.droq.agent import DROQAgent
+    from sheeprl_tpu.algos.droq.args import DROQArgs
+    from sheeprl_tpu.algos.sac.sac import make_optimizers
+
+    tasks["droq"]([
+        "--env_id", "Pendulum-v1",
+        "--seed", "5",
+        "--num_devices", "1",
+        "--num_envs", "1",
+        "--sync_env",
+        "--total_steps", "10000",
+        "--learning_starts", "1000",
+        "--per_rank_batch_size", "128",
+        "--gradient_steps", "2",
+        "--actor_hidden_size", "256",
+        "--critic_hidden_size", "256",
+        "--checkpoint_every", "1000000",
+        "--root_dir", str(tmp_path),
+        "--run_name", "learn",
+    ])
+    ckpt = latest_checkpoint(str(tmp_path / "learn" / "checkpoints"))
+    assert ckpt is not None
+
+    actor = _restore_sac_family_actor(
+        ckpt, DROQAgent, make_optimizers, DROQArgs()
+    )
+    returns = _eval_pendulum_actor(actor)
+    mean_return = float(np.mean(returns))
+    assert mean_return >= -300.0, f"DroQ failed to learn Pendulum: {returns}"

@@ -268,6 +268,12 @@ def make_train_step(
                 )
                 return (new_prior, new_recurrent), (latent, action)
 
+            if args.remat:
+                # --remat also covers the imagination backward: recompute the
+                # actor/transition activations of each horizon step instead
+                # of storing them across all H steps (same policy as the
+                # RSSM dynamic scan)
+                img_step = jax.checkpoint(img_step, prevent_cse=False)
             # H imagination steps emitting the pre-step latent, plus the final
             # latent/action pair outside the scan: H+1 trajectory entries from
             # exactly H RSSM transitions (reference loop, dreamer_v3.py:217-223)

@@ -151,6 +151,7 @@ def make_train_step(
                     embedded,
                     constrain(is_first, None, "data"),
                     k_wm,
+                    remat=args.remat,
                 )
             )
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
@@ -245,6 +246,8 @@ def make_train_step(
             # H imagination steps; trajectory entry i is reached BY action i
             # (imagined_actions[0] is the zero action, reference
             # dreamer_v2.py:243-276)
+            if args.remat:
+                img_step = jax.checkpoint(img_step, prevent_cse=False)
             _, (new_latents, actions_h) = jax.lax.scan(
                 img_step, (imagined_prior0, recurrent0), img_keys
             )

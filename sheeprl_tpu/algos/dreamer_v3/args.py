@@ -47,13 +47,6 @@ class DreamerV3Args(DreamerV2Args):
     layer_norm: bool = Arg(default=True, help="whether to apply LayerNorm after every layer")
     critic_tau: float = Arg(default=0.02, help="EMA tau: target = tau*critic + (1-tau)*target")
     unimix: float = Arg(default=0.01, help="uniform mix for stochastic-state/action categoricals")
-    remat: bool = Arg(
-        default=False,
-        help="rematerialize the RSSM scan body on backward (jax.checkpoint): "
-        "recompute per-step MLP activations instead of storing them across "
-        "all T steps, trading one extra forward for HBM to fit larger "
-        "batch/sequence sizes",
-    )
     hafner_initialization: bool = Arg(
         default=True,
         help="Hafner init: Xavier-normal everywhere, Xavier-uniform on distribution output "

@@ -147,6 +147,8 @@ def make_train_step(
                 new_latent = jnp.concatenate([new_prior, new_recurrent], axis=-1)
                 return (new_prior, new_recurrent), (new_latent, action)
 
+            if args.remat:
+                img_step = jax.checkpoint(img_step, prevent_cse=False)
             _, (new_latents, actions_h) = jax.lax.scan(
                 img_step, (imagined_prior0, recurrent0), img_keys
             )
@@ -285,6 +287,7 @@ def make_train_step(
                     embedded,
                     constrain(is_first, None, "data"),
                     k_wm,
+                    remat=args.remat,
                 )
             )
             recurrent_states, priors_logits, posteriors, posteriors_logits = (

@@ -96,7 +96,10 @@ def test_remat_step_matches_plain():
     # params exercise the checkpointed backward)
     state_remat, m_remat = _run_one_step("float32", remat=True)
     state_plain, m_plain = _run_one_step("float32", remat=False)
-    for name in ("Loss/reconstruction_loss", "Loss/reward_loss", "State/kl"):
+    for name in (
+        "Loss/reconstruction_loss", "Loss/reward_loss", "State/kl",
+        "Loss/policy_loss", "Loss/value_loss",
+    ):
         np.testing.assert_allclose(m_remat[name], m_plain[name], rtol=1e-4)
     for a, b in zip(
         jax.tree_util.tree_leaves(state_remat.world_model),
@@ -140,12 +143,13 @@ def test_bfloat16_player_step():
     assert reset.recurrent_state.dtype == jnp.bfloat16
 
 
-def _run_one_dv2_step(precision, continuous=False):
+def _run_one_dv2_step(precision, continuous=False, remat=False):
     from sheeprl_tpu.algos.dreamer_v2 import agent as dv2_agent
     from sheeprl_tpu.algos.dreamer_v2.args import DreamerV2Args
     from sheeprl_tpu.algos.dreamer_v2 import dreamer_v2 as dv2
 
     args = DreamerV2Args(num_envs=2, env_id="dummy")
+    args.remat = remat
     args.cnn_keys, args.mlp_keys = ["rgb"], []
     args.dense_units = 16
     args.hidden_size = 16
@@ -204,6 +208,18 @@ def test_dv2_bfloat16_step_finite_and_close_to_f32():
         )
 
 
+def test_dv2_remat_step_matches_plain():
+    # remat changes memory usage, not numerics (now covers the DV2 RSSM scan
+    # AND the imagination scan)
+    m_remat = _run_one_dv2_step("float32", remat=True)
+    m_plain = _run_one_dv2_step("float32", remat=False)
+    for name in (
+        "Loss/reconstruction_loss", "Loss/reward_loss", "State/kl",
+        "Loss/policy_loss", "Loss/value_loss",
+    ):
+        np.testing.assert_allclose(m_remat[name], m_plain[name], rtol=1e-4)
+
+
 def test_dv2_bfloat16_continuous_actions_finite():
     # saturated tanh actions round to exactly +/-1 in bf16; TanhNormal's
     # log_prob computes in f32 so the actor loss stays finite
@@ -212,15 +228,18 @@ def test_dv2_bfloat16_continuous_actions_finite():
 
 
 @pytest.mark.timeout(600)
-def test_p2e_dv2_bfloat16_exploring_step():
-    """The EXPLORING train step under bf16 — ensemble fit + intrinsic
-    disagreement reward + dual actor-critic (a dry run never reaches this
-    branch: exploration flips off before the single training call)."""
+@pytest.mark.parametrize("precision,remat", [("bfloat16", False), ("float32", True)])
+def test_p2e_dv2_exploring_step_variants(precision, remat):
+    """The EXPLORING train step under bf16 and under remat — ensemble fit +
+    intrinsic disagreement reward + dual actor-critic (a dry run never
+    reaches this branch: exploration flips off before the single training
+    call; remat additionally checkpoints the dual imagination scans)."""
     from sheeprl_tpu.algos.p2e_dv2 import p2e_dv2 as p2e
     from sheeprl_tpu.algos.p2e_dv2.agent import build_models as build_p2e
     from sheeprl_tpu.algos.p2e_dv2.args import P2EDV2Args
 
     args = P2EDV2Args(num_envs=2, env_id="dummy")
+    args.remat = remat
     args.cnn_keys, args.mlp_keys = ["rgb"], []
     args.dense_units = 8
     args.hidden_size = 8
@@ -231,7 +250,7 @@ def test_p2e_dv2_bfloat16_exploring_step():
     args.horizon = 4
     args.mlp_layers = 1
     args.num_ensembles = 2
-    args.precision = "bfloat16"
+    args.precision = precision
     T, B = 4, 2
     obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
     (world_model, actor_task, critic_task, target_critic_task, actor_expl,

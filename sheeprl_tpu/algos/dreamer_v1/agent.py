@@ -114,11 +114,15 @@ class RSSMV1(nn.Module):
         )
         return recurrent_state, posterior, prior, posterior_mean_std, prior_mean_std
 
-    def scan_dynamic(self, posterior0, recurrent0, actions, embedded_obs, key):
+    def scan_dynamic(
+        self, posterior0, recurrent0, actions, embedded_obs, key, remat=False
+    ):
         """The dynamic-learning sequence as one `lax.scan` over time
         (replacing the reference's Python loop, dreamer_v1.py:151-165).
         Returns stacked (recurrent_states, posteriors, post_means, post_stds,
-        prior_means, prior_stds), all `[T, B, ...]`."""
+        prior_means, prior_stds), all `[T, B, ...]`. `remat=True`
+        rematerializes the step body on backward (same policy as the
+        discrete RSSM, dreamer_v3/agent.py)."""
         keys = jax.random.split(key, actions.shape[0])
 
         def step(carry, inp):
@@ -127,6 +131,8 @@ class RSSMV1(nn.Module):
             rec, post, _, (pm, ps), (qm, qs) = self.dynamic(post, rec, a, emb, k)
             return (post, rec), (rec, post, pm, ps, qm, qs)
 
+        if remat:
+            step = jax.checkpoint(step, prevent_cse=False)
         _, outs = jax.lax.scan(
             step, (posterior0, recurrent0), (actions, embedded_obs, keys)
         )

@@ -50,6 +50,14 @@ class DreamerV1Args(SeqParallelArgs, StandardArgs):
     cnn_act: str = Arg(default="relu", help="activation for the convolutional layers")
 
 
+    remat: bool = Arg(
+        default=False,
+        help="rematerialize the RSSM/imagination scan bodies on backward "
+        "(jax.checkpoint): recompute per-step MLP activations instead of "
+        "storing them across all T steps, trading one extra forward for HBM "
+        "to fit larger batch/sequence sizes",
+    )
+
     # Environment settings
     expl_amount: float = Arg(default=0.3, help="the exploration amount to add to the actions")
     expl_decay: bool = Arg(default=False, help="whether or not to decrement the exploration amount")

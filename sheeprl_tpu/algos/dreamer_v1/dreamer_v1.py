@@ -107,6 +107,7 @@ def make_train_step(
                     constrain(data["actions"], None, "data"),
                     embedded,
                     k_wm,
+                    remat=args.remat,
                 )
             )
             (recurrent_states, posteriors, post_means, post_stds,
@@ -187,6 +188,8 @@ def make_train_step(
                 new_latent = jnp.concatenate([new_prior, new_recurrent], axis=-1)
                 return (new_prior, new_recurrent), new_latent
 
+            if args.remat:
+                img_step = jax.checkpoint(img_step, prevent_cse=False)
             # H imagination steps; trajectory entries are the POST-step
             # latents (reference dreamer_v1.py:252-258 — no entry for z0)
             _, imagined_trajectories = jax.lax.scan(

@@ -136,6 +136,8 @@ def make_train_step(
                 new_latent = jnp.concatenate([new_prior, new_recurrent], axis=-1)
                 return (new_prior, new_recurrent), (new_latent, action)
 
+            if args.remat:
+                img_step = jax.checkpoint(img_step, prevent_cse=False)
             _, (imagined_trajectories, imagined_actions) = jax.lax.scan(
                 img_step, (imagined_prior0, recurrent0), img_keys
             )  # [H, T*B, L] / [H, T*B, A]
@@ -219,6 +221,7 @@ def make_train_step(
                     constrain(data["actions"], None, "data"),
                     embedded,
                     k_wm,
+                    remat=args.remat,
                 )
             )
             (recurrent_states, posteriors, post_means, post_stds,

@@ -318,3 +318,124 @@ def test_bfloat16_params_actually_update():
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
     )
     assert changed
+
+
+def test_dv1_remat_step_matches_plain():
+    """DV1-family remat (Gaussian RSSM scan + imagination checkpoint) is
+    numerics-neutral, incl. the behaviour losses."""
+    from sheeprl_tpu.algos.dreamer_v1.agent import build_models as build_dv1
+    from sheeprl_tpu.algos.dreamer_v1.args import DreamerV1Args
+    from sheeprl_tpu.algos.dreamer_v1 import dreamer_v1 as dv1
+
+    def run(remat):
+        args = DreamerV1Args(num_envs=2, env_id="dummy")
+        args.remat = remat
+        args.cnn_keys, args.mlp_keys = ["rgb"], []
+        args.dense_units = 16
+        args.hidden_size = 16
+        args.recurrent_state_size = 16
+        args.cnn_channels_multiplier = 4
+        args.stochastic_size = 4
+        args.horizon = 4
+        args.mlp_layers = 1
+        T, B = 5, 3
+        obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+        world_model, actor, critic = build_dv1(
+            jax.random.PRNGKey(0), [3], False, args, obs_space, ["rgb"], []
+        )
+        world_opt, actor_opt, critic_opt = dv1.make_optimizers(args)
+        state = dv1.DV1TrainState(
+            world_model=world_model,
+            actor=actor,
+            critic=critic,
+            world_opt=world_opt.init(world_model),
+            actor_opt=actor_opt.init(actor),
+            critic_opt=critic_opt.init(critic),
+        )
+        step = dv1.make_train_step(
+            args, world_opt, actor_opt, critic_opt, ["rgb"], []
+        )
+        rng = np.random.default_rng(0)
+        data = {
+            "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 64, 64, 3), dtype=np.uint8)),
+            "actions": jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, (T, B))]),
+            "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+            "dones": jnp.zeros((T, B, 1), jnp.float32),
+            "is_first": jnp.zeros((T, B, 1), jnp.float32),
+        }
+        _, metrics = step(state, data, jax.random.PRNGKey(7))
+        return {k: float(v) for k, v in metrics.items()}
+
+    m_remat, m_plain = run(True), run(False)
+    for name in (
+        "Loss/reconstruction_loss", "Loss/reward_loss", "State/kl",
+        "Loss/policy_loss", "Loss/value_loss",
+        # gradient norms exercise the checkpointed backward, not just the
+        # forward losses
+        "Grads/world_model", "Grads/actor", "Grads/critic",
+    ):
+        np.testing.assert_allclose(m_remat[name], m_plain[name], rtol=1e-3)
+
+
+@pytest.mark.timeout(600)
+def test_p2e_dv1_exploring_step_remat_matches_plain():
+    """P2E-DV1's EXPLORING step under remat (ensemble fit + disagreement
+    reward through the checkpointed dual imaginations) is numerics-neutral."""
+    from sheeprl_tpu.algos.p2e_dv1.agent import build_models as build_p2e
+    from sheeprl_tpu.algos.p2e_dv1.args import P2EDV1Args
+    from sheeprl_tpu.algos.p2e_dv1 import p2e_dv1 as p2e
+
+    def run(remat):
+        args = P2EDV1Args(num_envs=2, env_id="dummy")
+        args.remat = remat
+        args.cnn_keys, args.mlp_keys = ["rgb"], []
+        args.dense_units = 8
+        args.hidden_size = 8
+        args.recurrent_state_size = 8
+        args.cnn_channels_multiplier = 2
+        args.stochastic_size = 4
+        args.horizon = 4
+        args.mlp_layers = 1
+        args.num_ensembles = 2
+        T, B = 4, 2
+        obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
+        (world_model, actor_task, critic_task,
+         actor_expl, critic_expl, ensembles) = build_p2e(
+            jax.random.PRNGKey(0), [3], False, args, obs_space, ["rgb"], []
+        )
+        optimizers = p2e.make_optimizers(args)
+        (world_opt, at_opt, ct_opt, ae_opt, ce_opt, ens_opt) = optimizers
+        state = p2e.P2EDV1TrainState(
+            world_model=world_model,
+            actor_task=actor_task,
+            critic_task=critic_task,
+            actor_exploration=actor_expl,
+            critic_exploration=critic_expl,
+            ensembles=ensembles,
+            world_opt=world_opt.init(world_model),
+            actor_task_opt=at_opt.init(actor_task),
+            critic_task_opt=ct_opt.init(critic_task),
+            actor_exploration_opt=ae_opt.init(actor_expl),
+            critic_exploration_opt=ce_opt.init(critic_expl),
+            ensemble_opt=ens_opt.init(ensembles),
+        )
+        step = p2e.make_train_step(args, optimizers, ["rgb"], [], exploring=True)
+        rng = np.random.default_rng(0)
+        data = {
+            "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 64, 64, 3), dtype=np.uint8)),
+            "actions": jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, (T, B))]),
+            "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+            "dones": jnp.zeros((T, B, 1), jnp.float32),
+            "is_first": jnp.zeros((T, B, 1), jnp.float32),
+        }
+        _, metrics = step(state, data, jax.random.PRNGKey(7))
+        return {k: float(v) for k, v in metrics.items()}
+
+    m_remat, m_plain = run(True), run(False)
+    assert all(np.isfinite(v) for v in m_remat.values()), m_remat
+    for name in (
+        "Loss/reconstruction_loss", "Loss/ensemble_loss",
+        "Loss/policy_loss_exploration", "Loss/value_loss_exploration",
+        "Grads/actor_exploration", "Grads/world_model",
+    ):
+        np.testing.assert_allclose(m_remat[name], m_plain[name], rtol=1e-3)

@@ -42,6 +42,7 @@ from ...parallel import (
     replicate,
     constrain_time_batch,
     make_constrain,
+    scan_batch_spec,
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -120,6 +121,7 @@ def make_train_step(
 
     def train_step(state: DV2TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]
+        scan_spec = scan_batch_spec(mesh, B)
         k_wm, k_img = jax.random.split(key)
 
         # hard target-critic copy gated by traced tau in {0, 1}
@@ -135,10 +137,11 @@ def make_train_step(
 
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
-            # context parallelism: encoder runs (seq, data)-sharded; the scan
-            # inputs reshard to batch-only, its outputs back to time-sharded
-            # for the decoder/heads (same scheme as dreamer_v3)
-            embedded = constrain(wm.encoder(batch_obs), None, "data")
+            # context parallelism: encoder runs (seq, data)-sharded; the
+            # scan inputs reshard along the batch axis (fully-sharded or
+            # data-only per scan_batch_spec), its outputs back to
+            # time-sharded for the decoder/heads (same scheme as dreamer_v3)
+            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -147,9 +150,9 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(data["actions"].astype(compute_dtype), None, "data"),
+                    constrain(data["actions"].astype(compute_dtype), *scan_spec),
                     embedded,
-                    constrain(is_first, None, "data"),
+                    constrain(is_first, *scan_spec),
                     k_wm,
                     remat=args.remat,
                 )

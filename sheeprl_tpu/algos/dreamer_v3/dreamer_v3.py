@@ -53,6 +53,7 @@ from ...parallel import (
     replicate,
     constrain_time_batch,
     make_constrain,
+    scan_batch_spec,
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -140,6 +141,7 @@ def make_train_step(
 
     def train_step(state: DV3TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]
+        scan_spec = scan_batch_spec(mesh, B)
         k_wm, k_img = jax.random.split(key)
 
         # EMA target-critic update happens before the gradient step with the
@@ -161,9 +163,11 @@ def make_train_step(
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
             # encoder computes on the (seq, data)-sharded input layout; the
-            # scan needs full T per batch shard, so its inputs reshard to
-            # batch-only (an all-gather of the small embedding over "seq")
-            embedded = constrain(wm.encoder(batch_obs), None, "data")
+            # scan needs full T per shard, so its inputs reshard along the
+            # batch axis only — over the full grid when B divides it (no
+            # redundant scan compute), else over "data" with the seq groups
+            # replicating the scan (scan_batch_spec)
+            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -172,15 +176,16 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(batch_actions, None, "data"),
+                    constrain(batch_actions, *scan_spec),
                     embedded,
-                    constrain(is_first, None, "data"),
+                    constrain(is_first, *scan_spec),
                     k_wm,
                     remat=args.remat,
                 )
             )
-            # back to time-sharded for the decoder/reward/continue heads —
-            # each "seq" shard keeps its own T-chunk (a local slice)
+            # back to time-sharded for the decoder/reward/continue heads
+            # (a local T-slice under the replicated-scan layout, an
+            # all-to-all under the fully-sharded one)
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
                 constrain_time_batch(
                     constrain,

@@ -39,6 +39,7 @@ from ...parallel import (
     replicate,
     constrain_time_batch,
     make_constrain,
+    scan_batch_spec,
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -205,20 +206,21 @@ def make_train_step(
 
     def train_step(state: P2EDV1TrainState, data: dict, key):
         T, B = data["dones"].shape[:2]
+        scan_spec = scan_batch_spec(mesh, B)
         k_wm, k_expl, k_task = jax.random.split(key, 3)
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: data[k] for k in mlp_keys})
 
         # ---- world model (reward/continue on detached latents) --------------
         def world_loss_fn(wm: WorldModel):
-            embedded = constrain(wm.encoder(batch_obs), None, "data")
+            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
             posterior0 = jnp.zeros((B, args.stochastic_size))
             recurrent0 = jnp.zeros((B, args.recurrent_state_size))
             recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds = (
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(data["actions"], None, "data"),
+                    constrain(data["actions"], *scan_spec),
                     embedded,
                     k_wm,
                     remat=args.remat,

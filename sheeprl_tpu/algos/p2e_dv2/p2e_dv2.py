@@ -37,6 +37,7 @@ from ...parallel import (
     replicate,
     constrain_time_batch,
     make_constrain,
+    scan_batch_spec,
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -252,6 +253,7 @@ def make_train_step(
 
     def train_step(state: P2EDV2TrainState, data: dict, key, tau):
         T, B = data["dones"].shape[:2]
+        scan_spec = scan_batch_spec(mesh, B)
         k_wm, k_expl, k_task = jax.random.split(key, 3)
 
         # hard target copies for BOTH critics (reference p2e_dv2.py:893-897)
@@ -274,7 +276,7 @@ def make_train_step(
         # ---- world model (reward/continue on detached latents) --------------
         def world_loss_fn(wm: WorldModel):
             # context parallelism: same boundary scheme as dreamer_v2/v3
-            embedded = constrain(wm.encoder(batch_obs), None, "data")
+            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -283,9 +285,9 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(data["actions"].astype(compute_dtype), None, "data"),
+                    constrain(data["actions"].astype(compute_dtype), *scan_spec),
                     embedded,
-                    constrain(is_first, None, "data"),
+                    constrain(is_first, *scan_spec),
                     k_wm,
                     remat=args.remat,
                 )

@@ -47,6 +47,7 @@ __all__ = [
     "assert_divisible",
     "constrain_time_batch",
     "make_constrain",
+    "scan_batch_spec",
     "seq_axis_size",
     "shard_time_batch",
     "time_batch_sharding",
@@ -152,6 +153,19 @@ def constrain_time_batch(constrain, *arrays):
     `[T, B, ...]` RSSM scan outputs (the shared reshard point of every
     Dreamer-family train step)."""
     return tuple(constrain(a, "seq", "data") for a in arrays)
+
+
+def scan_batch_spec(mesh: Optional[Mesh], batch_size: int) -> tuple:
+    """Partition spec for the `[T, B, ...]` inputs of the sequential RSSM
+    scan under context parallelism. The scan needs full T per shard, so its
+    batch is the only shardable axis: when B divides the WHOLE device grid,
+    shard it over both axes — every device computes a distinct B-slice and
+    nothing is redundant; otherwise shard over "data" only (the seq groups
+    then compute replicated scans, correct but seq-times the FLOPs)."""
+    if mesh is not None and seq_axis_size(mesh) > 1:
+        if batch_size % mesh.devices.size == 0:
+            return (None, ("data", "seq"))
+    return (None, "data")
 
 
 def data_sharding(mesh: Mesh, axis: int = 0, axis_name: str = "data") -> NamedSharding:

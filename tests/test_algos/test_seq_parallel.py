@@ -92,12 +92,17 @@ def _tiny_batch(args):
     }
 
 
-@pytest.mark.timeout(600)
-def test_seq_parallel_matches_single_device():
+@pytest.mark.timeout(900)
+@pytest.mark.parametrize("batch_size", [4, 8])
+def test_seq_parallel_matches_single_device(batch_size):
+    """batch_size=4 exercises the replicated-scan fallback (B < devices);
+    batch_size=8 the fully-sharded scan (B divides the whole grid, every
+    device computes a distinct B-slice — no redundant scan compute)."""
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
     from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
 
     args, state, (world_opt, actor_opt, critic_opt) = _tiny_setup()
+    args.per_rank_batch_size = batch_size
     data = _tiny_batch(args)
     key = jax.random.PRNGKey(7)
 

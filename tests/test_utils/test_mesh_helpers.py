@@ -1,0 +1,40 @@
+"""Unit tests for the context-parallel mesh helpers."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel import (
+    make_mesh,
+    scan_batch_spec,
+    seq_axis_size,
+    time_batch_sharding,
+)
+
+
+def test_scan_batch_spec_regimes():
+    mesh = make_mesh(8, seq_devices=4)  # (data=2, seq=4)
+    # B divides the whole grid -> fully sharded scan batch
+    assert scan_batch_spec(mesh, 8) == (None, ("data", "seq"))
+    assert scan_batch_spec(mesh, 16) == (None, ("data", "seq"))
+    # B doesn't divide -> data-only (seq groups replicate the scan)
+    assert scan_batch_spec(mesh, 4) == (None, "data")
+    assert scan_batch_spec(mesh, 6) == (None, "data")
+    # 1-D mesh or no mesh -> data-only spec (constrain is identity anyway)
+    assert scan_batch_spec(make_mesh(8), 8) == (None, "data")
+    assert scan_batch_spec(None, 8) == (None, "data")
+
+
+def test_time_batch_sharding_specs():
+    mesh2 = make_mesh(8, seq_devices=2)
+    spec = time_batch_sharding(mesh2).spec
+    assert tuple(spec) == ("seq", "data")
+    mesh1 = make_mesh(8)
+    spec = time_batch_sharding(mesh1).spec
+    assert tuple(spec) == (None, "data")
+
+
+def test_seq_axis_size():
+    assert seq_axis_size(make_mesh(8)) == 1
+    assert seq_axis_size(make_mesh(8, seq_devices=2)) == 2
+    with pytest.raises(ValueError, match="must divide"):
+        make_mesh(8, seq_devices=5)

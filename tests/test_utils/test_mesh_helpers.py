@@ -1,6 +1,5 @@
 """Unit tests for the context-parallel mesh helpers."""
 
-import numpy as np
 import pytest
 
 from sheeprl_tpu.parallel import (

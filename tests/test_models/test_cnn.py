@@ -70,3 +70,26 @@ def test_conv_transpose_subpixel_gradients_match_lax():
     gk_ref, gx_ref = jax.grad(loss_ref, argnums=(0, 1))(layer.kernel, x)
     np.testing.assert_allclose(np.asarray(gk_fast), np.asarray(gk_ref), atol=1e-4)
     np.testing.assert_allclose(np.asarray(gx_fast), np.asarray(gx_ref), atol=1e-4)
+
+
+def test_conv_transpose_subpixel_bf16_dtype_and_numerics():
+    """The fast path under bf16 inputs keeps the dtype and stays close to
+    the f32 result (the --precision bfloat16 decoder path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.nn.layers import ConvTranspose2d
+
+    layer = ConvTranspose2d.init(
+        jax.random.PRNGKey(4), 4, 3, 4, stride=2, padding="SAME"
+    )
+    x32 = jnp.asarray(
+        np.random.default_rng(2).normal(size=(2, 8, 8, 4)).astype(np.float32)
+    )
+    y32 = layer(x32)
+    y16 = layer(x32.astype(jnp.bfloat16))
+    assert y16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y16, dtype=np.float32), np.asarray(y32), rtol=0.1, atol=0.05
+    )

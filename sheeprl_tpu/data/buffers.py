@@ -929,9 +929,12 @@ class AsyncReplayBuffer:
         modulo capacity, and the env column selects the ring."""
         capacity = next(iter(store.values())).shape[0]
         bd = env_idx.shape[0]
-        u = jax.random.uniform(key, (bd,))
         nv = n_valid[env_idx]
-        r = jnp.minimum((u * nv).astype(jnp.int32), (nv - 1).astype(jnp.int32))
+        # exact integer sampling (matching the base ReplayBuffer paths):
+        # float32-uniform scaling biases windows approaching 2^24 entries and
+        # can never return the top index; maxval broadcasts per-row (>=1 so
+        # a not-yet-valid env degenerates to index 0 instead of UB)
+        r = jax.random.randint(key, (bd,), 0, jnp.maximum(nv, 1))
         f = first[env_idx]
         p = pos[env_idx]
         start = jnp.where(r < f, r, r - f + p)
@@ -1062,9 +1065,19 @@ class AsyncReplayBuffer:
         if len(buffers) != self._n_envs:
             raise ValueError("checkpointed buffer n_envs mismatch")
         if self._storage_kind == "device":
+            # mirror the host branch's per-env ReplayBuffer validation: each
+            # entry must be a 1-env column or the concatenation below builds a
+            # store whose env width differs from self._n_envs and only fails
+            # later with an opaque shape error during add/sample
             for s in buffers:
                 if s["buffer_size"] != self._buffer_size:
                     raise ValueError("checkpointed buffer shape mismatch")
+                if s.get("n_envs", 1) != 1:
+                    raise ValueError("checkpointed buffer entry n_envs != 1")
+                if s["buf"] is not None and any(
+                    v.shape[1] != 1 for v in s["buf"].values()
+                ):
+                    raise ValueError("checkpointed buffer env-width != 1")
             if all(s["buf"] is None for s in buffers):
                 self._store = None
             else:

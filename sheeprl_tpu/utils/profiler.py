@@ -76,11 +76,18 @@ class StepProfiler:
             jax.block_until_ready(jnp.add(jax.device_put(0.0, d), 1.0))
 
     def close(self) -> None:
-        if self._running:
+        if not self._running:
+            self._done = True
+            return
+        # clear the flags even when the flush itself raises: a second close()
+        # (explicit teardown after the atexit hook already ran, or vice versa)
+        # must never call _device_barrier/stop_trace again on a dead trace
+        self._running = False
+        try:
+            # a poisoned backend at crash time must not stop the flush
+            self._device_barrier()
+        finally:
             try:
-                # a poisoned backend at crash time must not stop the flush
-                self._device_barrier()
-            finally:
                 jax.profiler.stop_trace()
-                self._running = False
-        self._done = True
+            finally:
+                self._done = True

@@ -29,6 +29,7 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
+    constrain_scan_inputs,
     constrain_time_batch,
     make_constrain,
     scan_batch_spec,
@@ -99,14 +100,14 @@ def make_train_step(
 
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
-            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
+            embedded = constrain_scan_inputs(constrain, scan_spec, wm.encoder(batch_obs))
             posterior0 = jnp.zeros((B, args.stochastic_size))
             recurrent0 = jnp.zeros((B, args.recurrent_state_size))
             recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds = (
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(data["actions"], *scan_spec),
+                    constrain_scan_inputs(constrain, scan_spec, data["actions"]),
                     embedded,
                     k_wm,
                     remat=args.remat,
@@ -117,6 +118,7 @@ def make_train_step(
                 constrain,
                 recurrent_states, posteriors, post_means, post_stds,
                 prior_means, prior_stds,
+                from_spec=scan_spec,
             )
             latent_states = jnp.concatenate([posteriors, recurrent_states], axis=-1)
             decoded = wm.observation_model(latent_states)
@@ -166,14 +168,14 @@ def make_train_step(
 
         # ---- behaviour: imagination + actor ---------------------------------
         imagined_prior0 = constrain(
-            jax.lax.stop_gradient(posteriors).reshape(T * B, args.stochastic_size),
-            ("seq", "data"),
+            jnp.swapaxes(jax.lax.stop_gradient(posteriors), 0, 1).reshape(T * B, args.stochastic_size),
+            ("data", "seq"),
         )
         recurrent0 = constrain(
-            jax.lax.stop_gradient(recurrent_states).reshape(
+            jnp.swapaxes(jax.lax.stop_gradient(recurrent_states), 0, 1).reshape(
                 T * B, args.recurrent_state_size
             ),
-            ("seq", "data"),
+            ("data", "seq"),
         )
         img_keys = jax.random.split(k_img, horizon)
 

@@ -40,6 +40,7 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
+    constrain_scan_inputs,
     constrain_time_batch,
     make_constrain,
     scan_batch_spec,
@@ -138,10 +139,10 @@ def make_train_step(
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
             # context parallelism: encoder runs (seq, data)-sharded; the
-            # scan inputs reshard along the batch axis (fully-sharded or
-            # data-only per scan_batch_spec), its outputs back to
+            # scan inputs reshard along the batch axis (data-only per
+            # scan_batch_spec), its outputs back to
             # time-sharded for the decoder/heads (same scheme as dreamer_v3)
-            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
+            embedded = constrain_scan_inputs(constrain, scan_spec, wm.encoder(batch_obs))
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -150,9 +151,9 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(data["actions"].astype(compute_dtype), *scan_spec),
+                    constrain_scan_inputs(constrain, scan_spec, data["actions"].astype(compute_dtype)),
                     embedded,
-                    constrain(is_first, *scan_spec),
+                    constrain_scan_inputs(constrain, scan_spec, is_first),
                     k_wm,
                     remat=args.remat,
                 )
@@ -161,7 +162,8 @@ def make_train_step(
                 constrain_time_batch(
                     constrain,
                     recurrent_states, priors_logits, posteriors, posteriors_logits,
-                )
+                from_spec=scan_spec,
+            )
             )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
@@ -220,14 +222,14 @@ def make_train_step(
 
         # ---- behaviour: imagination + actor ---------------------------------
         imagined_prior0 = constrain(
-            jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size),
-            ("seq", "data"),
+            jnp.swapaxes(jax.lax.stop_gradient(posteriors), 0, 1).reshape(T * B, stoch_size),
+            ("data", "seq"),
         )
         recurrent0 = constrain(
-            jax.lax.stop_gradient(recurrent_states).reshape(
+            jnp.swapaxes(jax.lax.stop_gradient(recurrent_states), 0, 1).reshape(
                 T * B, args.recurrent_state_size
             ),
-            ("seq", "data"),
+            ("data", "seq"),
         )
         img_keys = jax.random.split(k_img, horizon)
 
@@ -277,7 +279,8 @@ def make_train_step(
                     event_ndims=1,
                 ).mean
                 true_continue0 = constrain(
-                    (1.0 - data["dones"]).reshape(1, T * B, 1), None, ("seq", "data")
+                    jnp.swapaxes(1.0 - data["dones"], 0, 1).reshape(1, T * B, 1),
+            None, ("data", "seq"),
                 ) * args.gamma
                 continues = jnp.concatenate([true_continue0, continues[1:]], axis=0)
             else:

@@ -51,6 +51,7 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
+    constrain_scan_inputs,
     constrain_time_batch,
     make_constrain,
     scan_batch_spec,
@@ -163,11 +164,13 @@ def make_train_step(
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
             # encoder computes on the (seq, data)-sharded input layout; the
-            # scan needs full T per shard, so its inputs reshard along the
-            # batch axis only — over the full grid when B divides it (no
-            # redundant scan compute), else over "data" with the seq groups
-            # replicating the scan (scan_batch_spec)
-            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
+            # scan needs full T per shard, so its inputs reshard to
+            # batch-over-"data" with the seq groups replicating the scan
+            # (scan_batch_spec explains why this beats the fully-sharded
+            # alternative under GSPMD)
+            embedded = constrain_scan_inputs(
+                constrain, scan_spec, wm.encoder(batch_obs)
+            )
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -176,20 +179,20 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(batch_actions, *scan_spec),
+                    constrain_scan_inputs(constrain, scan_spec, batch_actions),
                     embedded,
-                    constrain(is_first, *scan_spec),
+                    constrain_scan_inputs(constrain, scan_spec, is_first),
                     k_wm,
                     remat=args.remat,
                 )
             )
             # back to time-sharded for the decoder/reward/continue heads
-            # (a local T-slice under the replicated-scan layout, an
-            # all-to-all under the fully-sharded one)
+            # (a local T-slice out of the replicated-scan layout)
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
                 constrain_time_batch(
                     constrain,
                     recurrent_states, priors_logits, posteriors, posteriors_logits,
+                    from_spec=scan_spec,
                 )
             )
             latent_states = jnp.concatenate(
@@ -247,17 +250,18 @@ def make_train_step(
         # flattens to rows sharded over the full device grid, so the
         # imagination scan, actor and critic parallelize over all devices
         imagined_prior0 = constrain(
-            jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size),
-            ("seq", "data"),
+            jnp.swapaxes(jax.lax.stop_gradient(posteriors), 0, 1).reshape(T * B, stoch_size),
+            ("data", "seq"),
         )
         recurrent0 = constrain(
-            jax.lax.stop_gradient(recurrent_states).reshape(
+            jnp.swapaxes(jax.lax.stop_gradient(recurrent_states), 0, 1).reshape(
                 T * B, args.recurrent_state_size
             ),
-            ("seq", "data"),
+            ("data", "seq"),
         )
         true_continue0 = constrain(
-            (1.0 - data["dones"]).reshape(1, T * B, 1), None, ("seq", "data")
+            jnp.swapaxes(1.0 - data["dones"], 0, 1).reshape(1, T * B, 1),
+            None, ("data", "seq"),
         )
         img_keys = jax.random.split(k_img, horizon + 1)
 

@@ -37,6 +37,7 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
+    constrain_scan_inputs,
     constrain_time_batch,
     make_constrain,
     scan_batch_spec,
@@ -213,14 +214,14 @@ def make_train_step(
 
         # ---- world model (reward/continue on detached latents) --------------
         def world_loss_fn(wm: WorldModel):
-            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
+            embedded = constrain_scan_inputs(constrain, scan_spec, wm.encoder(batch_obs))
             posterior0 = jnp.zeros((B, args.stochastic_size))
             recurrent0 = jnp.zeros((B, args.recurrent_state_size))
             recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds = (
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(data["actions"], *scan_spec),
+                    constrain_scan_inputs(constrain, scan_spec, data["actions"]),
                     embedded,
                     k_wm,
                     remat=args.remat,
@@ -231,6 +232,7 @@ def make_train_step(
                 constrain,
                 recurrent_states, posteriors, post_means, post_stds,
                 prior_means, prior_stds,
+                from_spec=scan_spec,
             )
             latent_states = jnp.concatenate([posteriors, recurrent_states], axis=-1)
             latents_sg = jax.lax.stop_gradient(latent_states)
@@ -278,14 +280,14 @@ def make_train_step(
         world_model = optax.apply_updates(state.world_model, wm_updates)
 
         imagined_prior0 = constrain(
-            jax.lax.stop_gradient(posteriors).reshape(T * B, args.stochastic_size),
-            ("seq", "data"),
+            jnp.swapaxes(jax.lax.stop_gradient(posteriors), 0, 1).reshape(T * B, args.stochastic_size),
+            ("data", "seq"),
         )
         recurrent0 = constrain(
-            jax.lax.stop_gradient(recurrent_states).reshape(
+            jnp.swapaxes(jax.lax.stop_gradient(recurrent_states), 0, 1).reshape(
                 T * B, args.recurrent_state_size
             ),
-            ("seq", "data"),
+            ("data", "seq"),
         )
         metrics = {
             "Loss/reconstruction_loss": rec_loss,
@@ -305,8 +307,12 @@ def make_train_step(
         )
         if exploring:
             # ---- ensemble learning (reference p2e_dv1.py:184-202) -----------
+            # built from the time-major scan outputs — imagined_prior0/
+            # recurrent0 are batch-major flattened rows and would scramble
+            # (t, b) alignment with actions and the embedded[1:] targets
             ens_input = jnp.concatenate(
-                [imagined_prior0.reshape(T, B, -1), recurrent0.reshape(T, B, -1),
+                [jax.lax.stop_gradient(posteriors).reshape(T, B, -1),
+                 jax.lax.stop_gradient(recurrent_states),
                  jax.lax.stop_gradient(data["actions"])],
                 axis=-1,
             )

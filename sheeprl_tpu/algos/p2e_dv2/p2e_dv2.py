@@ -35,6 +35,7 @@ from ...parallel import (
     make_mesh,
     process_index,
     replicate,
+    constrain_scan_inputs,
     constrain_time_batch,
     make_constrain,
     scan_batch_spec,
@@ -276,7 +277,7 @@ def make_train_step(
         # ---- world model (reward/continue on detached latents) --------------
         def world_loss_fn(wm: WorldModel):
             # context parallelism: same boundary scheme as dreamer_v2/v3
-            embedded = constrain(wm.encoder(batch_obs), *scan_spec)
+            embedded = constrain_scan_inputs(constrain, scan_spec, wm.encoder(batch_obs))
             posterior0 = jnp.zeros(
                 (B, args.stochastic_size, args.discrete_size), compute_dtype
             )
@@ -285,9 +286,9 @@ def make_train_step(
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain(data["actions"].astype(compute_dtype), *scan_spec),
+                    constrain_scan_inputs(constrain, scan_spec, data["actions"].astype(compute_dtype)),
                     embedded,
-                    constrain(is_first, *scan_spec),
+                    constrain_scan_inputs(constrain, scan_spec, is_first),
                     k_wm,
                     remat=args.remat,
                 )
@@ -296,7 +297,8 @@ def make_train_step(
                 constrain_time_batch(
                     constrain,
                     recurrent_states, priors_logits, posteriors, posteriors_logits,
-                )
+                from_spec=scan_spec,
+            )
             )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], axis=-1
@@ -355,17 +357,18 @@ def make_train_step(
         world_model = optax.apply_updates(state.world_model, wm_updates)
 
         imagined_prior0 = constrain(
-            jax.lax.stop_gradient(posteriors).reshape(T * B, stoch_size),
-            ("seq", "data"),
+            jnp.swapaxes(jax.lax.stop_gradient(posteriors), 0, 1).reshape(T * B, stoch_size),
+            ("data", "seq"),
         )
         recurrent0 = constrain(
-            jax.lax.stop_gradient(recurrent_states).reshape(
+            jnp.swapaxes(jax.lax.stop_gradient(recurrent_states), 0, 1).reshape(
                 T * B, args.recurrent_state_size
             ),
-            ("seq", "data"),
+            ("data", "seq"),
         )
         true_continue0 = constrain(
-            (1.0 - data["dones"]).reshape(1, T * B, 1), None, ("seq", "data")
+            jnp.swapaxes(1.0 - data["dones"], 0, 1).reshape(1, T * B, 1),
+            None, ("data", "seq"),
         )
 
         shaped = (T, B, args.stochastic_size, args.discrete_size)
@@ -393,6 +396,9 @@ def make_train_step(
         )
         if exploring:
             # ---- ensemble learning: predict the next posterior --------------
+            # time-major [T, B, S*D] — NOT the batch-major imagination
+            # flatten: rows here must align with data["actions"] and the
+            # [1:] next-step targets
             posteriors_flat_sg = (
                 jax.lax.stop_gradient(posteriors).reshape(T, B, -1).astype(jnp.float32)
             )

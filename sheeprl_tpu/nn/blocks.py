@@ -24,6 +24,30 @@ def _split(key, n):
     return jax.random.split(key, n) if n > 0 else []
 
 
+def _fold_rows(x: jax.Array):
+    """Fold leading dims of `[lead..., H, W, C]` into conv rows; returns the
+    rows and the inverse. A `[T, B]` sequence batch folds BATCH-major: under
+    context parallelism the input is `("seq", "data")`-sharded, and batch-
+    major rows are contiguously sharded over the full mesh grid
+    (`P(("data", "seq"))`) so the convs parallelize over every device — the
+    time-major fold interleaves the shards, which GSPMD can only represent
+    by replicating the convs over "data" (observed in the dp x sp DV3 step,
+    round 3). The swap is sharding metadata plus a local relayout; numerics
+    are unchanged (each (t, b) row maps through the same convolution)."""
+    lead = x.shape[:-3]
+    if len(lead) == 2:
+        x = jnp.swapaxes(x, 0, 1)
+    rows = x.reshape((-1,) + x.shape[-3:])
+
+    def unfold(y: jax.Array) -> jax.Array:
+        if len(lead) == 2:
+            t, b = lead
+            return jnp.swapaxes(y.reshape((b, t) + y.shape[1:]), 0, 1)
+        return y.reshape(lead + y.shape[1:])
+
+    return rows, unfold
+
+
 class MLP(Module):
     """Linear stack with optional per-layer LayerNorm / dropout and output head.
 
@@ -136,16 +160,40 @@ class CNN(Module):
         return cls(layers=layers, norms=norms, act=act)
 
     def __call__(self, x: jax.Array) -> jax.Array:
-        """x: [..., H, W, C] — leading batch dims are folded around the convs."""
-        lead = x.shape[:-3]
-        x = x.reshape((-1,) + x.shape[-3:])
+        """x: [..., H, W, C] — leading batch dims are folded around the convs
+        (batch-major for sequence batches, see _fold_rows)."""
+        from ..ops import pallas_cnn
+
+        x, unfold = _fold_rows(x)
         act = activation(self.act)
         for i, layer in enumerate(self.layers):
+            norm = self.norms[i]
+            if (
+                norm is not None
+                and norm.scale is not None
+                and layer.bias is None
+                # even spatial dims only: the kernel computes h//2 while the
+                # XLA SAME path computes ceil(h/2) — odd inputs (e.g. the
+                # 21x21 stage of an 84x84 encoder) must stay unfused or the
+                # toggle would change output shapes
+                and x.shape[-3] % 2 == 0
+                and x.shape[-2] % 2 == 0
+                and pallas_cnn.cnn_stage_supported(
+                    layer.kernel.shape, layer.stride, layer.padding, True, self.act
+                )
+            ):
+                # fused Dreamer miniblock: conv + LayerNorm + SiLU in one
+                # Pallas kernel (ops/pallas_cnn.py)
+                x = pallas_cnn.conv_ln_silu(
+                    x, layer.kernel.astype(x.dtype), norm.scale, norm.offset,
+                    norm.eps,
+                )
+                continue
             x = layer(x)
-            if self.norms[i] is not None:
-                x = self.norms[i](x)
+            if norm is not None:
+                x = norm(x)
             x = act(x)
-        return x.reshape(lead + x.shape[1:])
+        return unfold(x)
 
 
 class DeCNN(Module):
@@ -202,18 +250,36 @@ class DeCNN(Module):
         return cls(layers=layers, norms=norms, act=act, act_last=act_last)
 
     def __call__(self, x: jax.Array) -> jax.Array:
-        """x: [..., H, W, C] latent grid -> [..., H', W', C'] image."""
-        lead = x.shape[:-3]
-        x = x.reshape((-1,) + x.shape[-3:])
+        """x: [..., H, W, C] latent grid -> [..., H', W', C'] image
+        (leading dims folded batch-major, see _fold_rows)."""
+        from ..ops import pallas_cnn
+
+        x, unfold = _fold_rows(x)
         act = activation(self.act)
         last = len(self.layers) - 1
         for i, layer in enumerate(self.layers):
+            norm = self.norms[i]
+            if (
+                norm is not None
+                and norm.scale is not None
+                and layer.bias is None
+                and (i != last or self.act_last)
+                and pallas_cnn.cnn_stage_supported(
+                    layer.kernel.shape, layer.stride, layer.padding, True, self.act
+                )
+            ):
+                # fused subpixel-deconv + LayerNorm + SiLU Pallas stage
+                x = pallas_cnn.deconv_ln_silu(
+                    x, layer.kernel.astype(x.dtype), norm.scale, norm.offset,
+                    norm.eps,
+                )
+                continue
             x = layer(x)
-            if self.norms[i] is not None:
-                x = self.norms[i](x)
+            if norm is not None:
+                x = norm(x)
             if i != last or self.act_last:
                 x = act(x)
-        return x.reshape(lead + x.shape[1:])
+        return unfold(x)
 
 
 class NatureCNN(Module):

@@ -45,6 +45,7 @@ __all__ = [
     "local_mesh_devices",
     "process_index",
     "assert_divisible",
+    "constrain_scan_inputs",
     "constrain_time_batch",
     "make_constrain",
     "scan_batch_spec",
@@ -148,23 +149,56 @@ def make_constrain(mesh: Optional[Mesh]):
     return constrain
 
 
-def constrain_time_batch(constrain, *arrays):
+_FULL_SCAN_SPEC = (None, ("data", "seq"))
+
+
+def constrain_time_batch(constrain, *arrays, from_spec=None):
     """Apply the time-sharded `("seq", "data")` boundary spec to each of the
     `[T, B, ...]` RSSM scan outputs (the shared reshard point of every
-    Dreamer-family train step)."""
+    Dreamer-family train step).
+
+    When the outputs come from the fully-sharded scan layout
+    (`from_spec == (None, ("data", "seq"))`), reshard via the batch-on-"data"
+    intermediate — see `constrain_scan_inputs` for why."""
+    if from_spec == _FULL_SCAN_SPEC:
+        arrays = tuple(constrain(a, None, "data") for a in arrays)
     return tuple(constrain(a, "seq", "data") for a in arrays)
+
+
+def constrain_scan_inputs(constrain, scan_spec, *arrays):
+    """Reshard time-sharded `[T, B, ...]` arrays into the RSSM scan layout.
+
+    The direct reshard `("seq", "data") <-> (None, ("data", "seq"))` moves a
+    mesh sub-axis between tensor axes in one step; GSPMD handles the forward
+    but meets its TRANSPOSE in the backward pass with an involuntary full
+    rematerialization (replicate-then-repartition — observed in the dp x sp
+    DV3 backward, MULTICHIP_r02). Stepping through the batch-on-"data"
+    intermediate splits both directions into a single-axis all-gather plus a
+    local slice, which GSPMD partitions efficiently both ways."""
+    if scan_spec == _FULL_SCAN_SPEC:
+        arrays = tuple(constrain(a, None, "data") for a in arrays)
+    out = tuple(constrain(a, *scan_spec) for a in arrays)
+    return out if len(out) > 1 else out[0]
 
 
 def scan_batch_spec(mesh: Optional[Mesh], batch_size: int) -> tuple:
     """Partition spec for the `[T, B, ...]` inputs of the sequential RSSM
-    scan under context parallelism. The scan needs full T per shard, so its
-    batch is the only shardable axis: when B divides the WHOLE device grid,
-    shard it over both axes — every device computes a distinct B-slice and
-    nothing is redundant; otherwise shard over "data" only (the seq groups
-    then compute replicated scans, correct but seq-times the FLOPs)."""
-    if mesh is not None and seq_axis_size(mesh) > 1:
-        if batch_size % mesh.devices.size == 0:
-            return (None, ("data", "seq"))
+    scan under context parallelism: batch over "data", replicated over
+    "seq". The scan needs full T per shard, so its batch is the only
+    shardable axis; the seq groups compute replicated scans (seq-times the
+    scan FLOPs — a small, latency-bound slice of the step), and both phase
+    boundaries are then single-axis reshards: a "seq" all-gather into the
+    scan, a local time-slice out of it, in both differentiation directions.
+
+    The alternative — sharding the scan batch over the WHOLE grid,
+    `(None, ("data", "seq"))`, when B divides it — does zero redundant
+    FLOPs but its boundary reshard moves a mesh sub-axis between tensor
+    axes, which GSPMD's transpose meets with an involuntary full
+    rematerialization (replicate + repartition) in EVERY backward pass
+    (MULTICHIP_r02; still present through a two-step reshard). Until the
+    Shardy partitioner handles that pattern, the replicated-scan layout is
+    strictly faster end-to-end; `constrain_scan_inputs` keeps the two-step
+    path for when a fully-sharded spec returns."""
     return (None, "data")
 
 

@@ -95,9 +95,11 @@ def _tiny_batch(args):
 @pytest.mark.timeout(900)
 @pytest.mark.parametrize("batch_size", [4, 8])
 def test_seq_parallel_matches_single_device(batch_size):
-    """batch_size=4 exercises the replicated-scan fallback (B < devices);
-    batch_size=8 the fully-sharded scan (B divides the whole grid, every
-    device computes a distinct B-slice — no redundant scan compute)."""
+    """Both sizes run the replicated-scan layout (scan batch over "data",
+    seq groups replicating the scan — see scan_batch_spec for why the
+    fully-sharded alternative is off); batch_size=4 keeps B < devices, the
+    long-context regime context parallelism exists for, batch_size=8 the
+    B-divides-grid case that previously took the fully-sharded path."""
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
     from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
 

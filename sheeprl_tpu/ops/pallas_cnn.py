@@ -1,0 +1,332 @@
+"""Pallas TPU kernels for the DreamerV3 CNN encoder/decoder stages — the
+fourth north-star kernel family (BASELINE.md; reference hot path
+/root/reference/sheeprl/algos/dreamer_v3/agent.py:31-203 and
+/root/reference/sheeprl/models/models.py:121-284).
+
+Encoder stage = Conv2d(k4, s2, SAME, no bias) -> LayerNorm(C) -> SiLU.
+Decoder stage = ConvTranspose2d(k4, s2, SAME, no bias) -> LayerNorm(C) -> SiLU,
+computed in the subpixel formulation (dense 2x2 conv + depth-to-space, the
+same regrouping as nn.layers.ConvTranspose2d._subpixel_k4s2).
+
+What the fusion buys: one kernel per stage keeps the im2col patch matrix,
+the conv pre-activation, the LayerNorm moments and the SiLU entirely in
+VMEM — XLA stages the conv output through HBM before the channel-reduction
+LayerNorm can run. The convolution itself becomes a single MXU matmul
+(strided parity slices build the patch matrix in registers; for s=2 every
+input pixel appears in exactly 4 patches, so the patch matrix is 4x the
+input — it lives and dies inside VMEM).
+
+Differentiation follows the GRU kernel's policy (pallas_kernels.py): the
+forward-with-residuals kernel additionally emits the normalized activations
+and inverse stddev; the backward is plain XLA — elementwise LN/SiLU math
+from the residuals plus XLA's own conv VJP for dx/dW — so training numerics
+are exactly those of the unfused path.
+
+Keep-decision: bench.py measures duty cycles with the family toggled via
+SHEEPRL_TPU_PALLAS_CNN and keeps the winner, like every other family.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_kernels import _VMEM, _cdiv, _interpret_mode, use_pallas
+
+__all__ = ["conv_ln_silu", "deconv_ln_silu", "cnn_stage_supported"]
+
+
+# pixels of conv output aimed at one grid step (M dimension of the MXU
+# matmul); the batch tile adapts so bn * ho * wo stays near this
+_ROWS_TARGET = 2048
+
+
+def cnn_stage_supported(kernel_shape, stride, padding, has_norm, act) -> bool:
+    """Eligibility for the fused stage: the Dreamer k4/s2/SAME LayerNorm-SiLU
+    miniblock exactly (callers fall back to plain XLA otherwise)."""
+    return (
+        use_pallas("cnn")
+        and tuple(kernel_shape[:2]) == (4, 4)
+        and tuple(stride) == (2, 2)
+        and padding == "SAME"
+        and has_norm
+        and act == "silu"
+    )
+
+
+def _silu(z):
+    return z * jax.nn.sigmoid(z)
+
+
+# =============================================================================
+# encoder stage: conv k4/s2/SAME + LayerNorm + SiLU
+# =============================================================================
+
+
+def _enc_kernel(xp_ref, w_ref, scale_ref, offset_ref, y_ref, *, eps, ho, wo,
+                residuals=False, hat_ref=None, rstd_ref=None):
+    xp = xp_ref[:]  # [bn, H+2, W+2, Cin], pre-padded
+    bn, cin = xp.shape[0], xp.shape[-1]
+    cout = w_ref.shape[-1]
+    # im2col via 16 strided parity slices: out pixel (i, j) reads padded rows
+    # 2i+kh, cols 2j+kw — slice start kh, stride 2, length ho
+    cols = [
+        jax.lax.slice(
+            xp,
+            (0, kh, kw, 0),
+            (bn, kh + 2 * ho - 1, kw + 2 * wo - 1, cin),
+            (1, 2, 2, 1),
+        )
+        for kh in range(4)
+        for kw in range(4)
+    ]
+    patches = jnp.concatenate(cols, axis=-1).reshape(bn * ho * wo, 16 * cin)
+    pre = jnp.dot(patches, w_ref[:], preferred_element_type=jnp.float32)
+    mean = jnp.mean(pre, axis=-1, keepdims=True)
+    centered = pre - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    hat = centered * rstd
+    z = hat * scale_ref[:] + offset_ref[:]
+    y = _silu(z)
+    y_ref[:] = y.reshape(bn, ho, wo, cout).astype(y_ref.dtype)
+    if residuals:
+        hat_ref[:] = hat.reshape(bn, ho, wo, cout)
+        rstd_ref[:] = rstd.reshape(bn, ho, wo, 1)
+
+
+def _enc_call(x, wmat, scale, offset, eps, residuals):
+    n, h, w, cin = x.shape
+    ho, wo = h // 2, w // 2
+    cout = wmat.shape[-1]
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    bn = max(1, min(n, _ROWS_TARGET // max(ho * wo, 1)))
+    out_shape = [jax.ShapeDtypeStruct((n, ho, wo, cout), x.dtype)]
+    out_specs = [
+        pl.BlockSpec((bn, ho, wo, cout), lambda i: (i, 0, 0, 0), memory_space=_VMEM)
+    ]
+    if residuals:
+        out_shape += [
+            jax.ShapeDtypeStruct((n, ho, wo, cout), jnp.float32),
+            jax.ShapeDtypeStruct((n, ho, wo, 1), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec(
+                (bn, ho, wo, cout), lambda i: (i, 0, 0, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec((bn, ho, wo, 1), lambda i: (i, 0, 0, 0), memory_space=_VMEM),
+        ]
+    kernel = functools.partial(
+        _enc_kernel, eps=eps, ho=ho, wo=wo, residuals=residuals
+    )
+    if residuals:
+        body = lambda xr, wr, sr, or_, yr, hr, rr: kernel(
+            xr, wr, sr, or_, yr, hat_ref=hr, rstd_ref=rr
+        )
+    else:
+        body = kernel
+    out = pl.pallas_call(
+        body,
+        grid=(_cdiv(n, bn),),
+        out_shape=tuple(out_shape) if residuals else out_shape[0],
+        in_specs=[
+            pl.BlockSpec(
+                (bn, h + 2, w + 2, cin), lambda i: (i, 0, 0, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(wmat.shape, lambda i: (0, 0), memory_space=_VMEM),
+            pl.BlockSpec(scale.shape, lambda i: (0,), memory_space=_VMEM),
+            pl.BlockSpec(offset.shape, lambda i: (0,), memory_space=_VMEM),
+        ],
+        out_specs=tuple(out_specs) if residuals else out_specs[0],
+        interpret=_interpret_mode(),
+    )(xp, wmat, scale, offset)
+    return out
+
+
+def _enc_conv(x, w):
+    """The bare conv (XLA) — its VJP supplies dx/dW in the backward."""
+    return jax.lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(2, 2),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _ln_silu_bwd(dy, hat, rstd, scale, offset):
+    """Grad of SiLU(LayerNorm(pre)) wrt pre / scale / offset from the saved
+    normalized activations and inverse stddev."""
+    dy = dy.astype(jnp.float32)
+    z = hat * scale + offset
+    sig = jax.nn.sigmoid(z)
+    dz = dy * (sig * (1.0 + z * (1.0 - sig)))  # SiLU'
+    dscale = jnp.sum(dz * hat, axis=tuple(range(dz.ndim - 1)))
+    doffset = jnp.sum(dz, axis=tuple(range(dz.ndim - 1)))
+    g = dz * scale
+    dpre = rstd * (
+        g
+        - jnp.mean(g, axis=-1, keepdims=True)
+        - hat * jnp.mean(g * hat, axis=-1, keepdims=True)
+    )
+    return dpre, dscale, doffset
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def conv_ln_silu(x, w, scale, offset, eps=1e-3):
+    """Fused Dreamer encoder stage. x: [N, H, W, Cin] (H, W even),
+    w: [4, 4, Cin, Cout] conv kernel, scale/offset: LayerNorm affine."""
+    cin, cout = w.shape[2], w.shape[3]
+    return _enc_call(x, w.reshape(16 * cin, cout), scale, offset, eps, False)
+
+
+def _conv_ln_silu_fwd(x, w, scale, offset, eps):
+    cin, cout = w.shape[2], w.shape[3]
+    y, hat, rstd = _enc_call(x, w.reshape(16 * cin, cout), scale, offset, eps, True)
+    return y, (x, w, scale, offset, hat, rstd)
+
+
+def _conv_ln_silu_bwd(eps, res, dy):
+    x, w, scale, offset, hat, rstd = res
+    dpre, dscale, doffset = _ln_silu_bwd(dy, hat, rstd, scale, offset)
+    _, conv_vjp = jax.vjp(_enc_conv, x, w)
+    dx, dw = conv_vjp(dpre.astype(x.dtype))
+    return dx, dw.astype(w.dtype), dscale.astype(scale.dtype), doffset.astype(offset.dtype)
+
+
+conv_ln_silu.defvjp(_conv_ln_silu_fwd, _conv_ln_silu_bwd)
+
+
+# =============================================================================
+# decoder stage: subpixel deconv k4/s2/SAME + LayerNorm + SiLU
+# =============================================================================
+
+
+def _dec_kernel(xp_ref, w_ref, scale_ref, offset_ref, y_ref, *, eps, h, w,
+                residuals=False, hat_ref=None, rstd_ref=None):
+    xp = xp_ref[:]  # [bn, h+2, w+2, Cin], pre-padded
+    bn, cin = xp.shape[0], xp.shape[-1]
+    cout4 = w_ref.shape[-1]
+    cout = cout4 // 4
+    # dense 2x2 conv over the padded grid -> per-pixel 2x2 output phases
+    cols = [
+        jax.lax.slice(xp, (0, a, b, 0), (bn, a + h + 1, b + w + 1, cin))
+        for a in range(2)
+        for b in range(2)
+    ]
+    patches = jnp.concatenate(cols, axis=-1).reshape(bn * (h + 1) * (w + 1), 4 * cin)
+    ph = jnp.dot(patches, w_ref[:], preferred_element_type=jnp.float32)
+    ph = ph.reshape(bn, h + 1, w + 1, 2, 2, cout)
+    # subpixel interleave (same phase selection as ConvTranspose2d._subpixel_k4s2)
+    row0 = jnp.stack([ph[:, :h, :w, 0, 0], ph[:, :h, 1:, 0, 1]], axis=3)
+    row1 = jnp.stack([ph[:, 1:, :w, 1, 0], ph[:, 1:, 1:, 1, 1]], axis=3)
+    pre = jnp.stack([row0, row1], axis=2).reshape(bn * 2 * h * 2 * w, cout)
+    mean = jnp.mean(pre, axis=-1, keepdims=True)
+    centered = pre - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    hat = centered * rstd
+    z = hat * scale_ref[:] + offset_ref[:]
+    y = _silu(z)
+    y_ref[:] = y.reshape(bn, 2 * h, 2 * w, cout).astype(y_ref.dtype)
+    if residuals:
+        hat_ref[:] = hat.reshape(bn, 2 * h, 2 * w, cout)
+        rstd_ref[:] = rstd.reshape(bn, 2 * h, 2 * w, 1)
+
+
+def _dec_call(x, wmat, scale, offset, eps, residuals):
+    n, h, w, cin = x.shape
+    cout = wmat.shape[-1] // 4
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    bn = max(1, min(n, _ROWS_TARGET // max(4 * h * w, 1)))
+    out_shape = [jax.ShapeDtypeStruct((n, 2 * h, 2 * w, cout), x.dtype)]
+    out_specs = [
+        pl.BlockSpec(
+            (bn, 2 * h, 2 * w, cout), lambda i: (i, 0, 0, 0), memory_space=_VMEM
+        )
+    ]
+    if residuals:
+        out_shape += [
+            jax.ShapeDtypeStruct((n, 2 * h, 2 * w, cout), jnp.float32),
+            jax.ShapeDtypeStruct((n, 2 * h, 2 * w, 1), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec(
+                (bn, 2 * h, 2 * w, cout), lambda i: (i, 0, 0, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(
+                (bn, 2 * h, 2 * w, 1), lambda i: (i, 0, 0, 0), memory_space=_VMEM
+            ),
+        ]
+    kernel = functools.partial(_dec_kernel, eps=eps, h=h, w=w, residuals=residuals)
+    if residuals:
+        body = lambda xr, wr, sr, or_, yr, hr, rr: kernel(
+            xr, wr, sr, or_, yr, hat_ref=hr, rstd_ref=rr
+        )
+    else:
+        body = kernel
+    return pl.pallas_call(
+        body,
+        grid=(_cdiv(n, bn),),
+        out_shape=tuple(out_shape) if residuals else out_shape[0],
+        in_specs=[
+            pl.BlockSpec(
+                (bn, h + 2, w + 2, cin), lambda i: (i, 0, 0, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(wmat.shape, lambda i: (0, 0), memory_space=_VMEM),
+            pl.BlockSpec(scale.shape, lambda i: (0,), memory_space=_VMEM),
+            pl.BlockSpec(offset.shape, lambda i: (0,), memory_space=_VMEM),
+        ],
+        out_specs=tuple(out_specs) if residuals else out_specs[0],
+        interpret=_interpret_mode(),
+    )(xp, wmat, scale, offset)
+
+
+def _dec_wmat(k):
+    """[4, 4, Cin, Cout] transposed-conv kernel -> [4*Cin, 4*Cout] dense 2x2
+    phase matrix, ordering matched to _dec_kernel's cols/phases (identical to
+    ConvTranspose2d._subpixel_k4s2's regrouping)."""
+    cin, cout = k.shape[2], k.shape[3]
+    kk = k.reshape(2, 2, 2, 2, cin, cout)  # [a, dh, b, dw, cin, cout]
+    return kk.transpose(0, 2, 4, 1, 3, 5).reshape(4 * cin, 4 * cout)
+
+
+def _dec_deconv(x, k):
+    """The bare transposed conv (XLA subpixel formulation) — VJP source for
+    the backward."""
+    n, h, w, cin = x.shape
+    cout = k.shape[3]
+    kk = _dec_wmat(k.astype(x.dtype)).reshape(2, 2, cin, 4 * cout)
+    ph = jax.lax.conv_general_dilated(
+        x, kk, window_strides=(1, 1), padding=((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ).reshape(n, h + 1, w + 1, 2, 2, cout)
+    row0 = jnp.stack([ph[:, :h, :w, 0, 0], ph[:, :h, 1:, 0, 1]], axis=3)
+    row1 = jnp.stack([ph[:, 1:, :w, 1, 0], ph[:, 1:, 1:, 1, 1]], axis=3)
+    return jnp.stack([row0, row1], axis=2).reshape(n, 2 * h, 2 * w, cout)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def deconv_ln_silu(x, k, scale, offset, eps=1e-3):
+    """Fused Dreamer decoder stage. x: [N, H, W, Cin],
+    k: [4, 4, Cin, Cout] transposed-conv kernel, scale/offset: LN affine."""
+    return _dec_call(x, _dec_wmat(k), scale, offset, eps, False)
+
+
+def _deconv_ln_silu_fwd(x, k, scale, offset, eps):
+    y, hat, rstd = _dec_call(x, _dec_wmat(k), scale, offset, eps, True)
+    return y, (x, k, scale, offset, hat, rstd)
+
+
+def _deconv_ln_silu_bwd(eps, res, dy):
+    x, k, scale, offset, hat, rstd = res
+    dpre, dscale, doffset = _ln_silu_bwd(dy, hat, rstd, scale, offset)
+    _, vjp = jax.vjp(_dec_deconv, x, k)
+    dx, dk = vjp(dpre.astype(x.dtype))
+    return dx, dk.astype(k.dtype), dscale.astype(scale.dtype), doffset.astype(offset.dtype)
+
+
+deconv_ln_silu.defvjp(_deconv_ln_silu_fwd, _deconv_ln_silu_bwd)

@@ -2,8 +2,10 @@
 
 The BASELINE.md north star names four kernel targets: the LayerNorm-GRU cell
 (the RSSM scan body, reference /root/reference/sheeprl/models/models.py:330-402),
-symlog/symexp (reference utils/utils.py:125-133), and the two-hot log-prob
-(reference utils/distribution.py:220-266). Each kernel here
+symlog/symexp (reference utils/utils.py:125-133), the two-hot log-prob
+(reference utils/distribution.py:220-266), and the CNN encoder/decoder
+stages (ops/pallas_cnn.py — fused conv/deconv + LayerNorm + SiLU,
+per-family switch SHEEPRL_TPU_PALLAS_CNN). Each kernel here
 
   - fuses what XLA would otherwise stage through HBM: the GRU kernel keeps the
     [B, 3H] pre-activation entirely in VMEM between the MXU matmul, the
@@ -58,6 +60,12 @@ def set_pallas(enabled: bool | None, interpret: bool = False) -> None:
     _FORCED, _INTERPRET = enabled, interpret
 
 
+def _interpret_mode() -> bool:
+    """Read the current interpret flag at trace time (pallas_cnn and other
+    kernel modules must see flips made after their import)."""
+    return _INTERPRET
+
+
 @functools.cache
 def _backend_is_tpu() -> bool:
     try:
@@ -77,8 +85,9 @@ def _env_flag(name: str) -> bool | None:
 
 def use_pallas(kind: str | None = None) -> bool:
     """Master gate, optionally refined per kernel family via
-    SHEEPRL_TPU_PALLAS_<KIND> (KIND in GRU|TWO_HOT|SYMLOG) — the bench uses
-    the per-kernel switches to attribute wins/losses and keep only winners."""
+    SHEEPRL_TPU_PALLAS_<KIND> (KIND in GRU|TWO_HOT|SYMLOG|CNN) — the bench
+    uses the per-kernel switches to attribute wins/losses and keep only
+    winners."""
     if _FORCED is not None:
         enabled = _FORCED
     else:

@@ -1,0 +1,136 @@
+"""CNN-stage Pallas kernel numerics (the fourth north-star family): the
+fused conv/deconv + LayerNorm + SiLU stages must match their plain-XLA twins
+in value and gradient in interpret mode on CPU, and the CNN/DeCNN blocks
+must produce identical outputs with the family toggled (VERDICT r2 #4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.nn.blocks import CNN, DeCNN
+from sheeprl_tpu.ops import pallas_cnn
+from sheeprl_tpu.ops import pallas_kernels as pk
+
+
+@pytest.fixture
+def pallas_interpret():
+    pk.set_pallas(True, interpret=True)
+    yield
+    pk.set_pallas(None, interpret=False)
+
+
+def _enc_reference(x, w, scale, offset, eps):
+    pre = pallas_cnn._enc_conv(x, w).astype(jnp.float32)
+    mean = jnp.mean(pre, axis=-1, keepdims=True)
+    var = jnp.var(pre, axis=-1, keepdims=True)
+    z = (pre - mean) * jax.lax.rsqrt(var + eps) * scale + offset
+    return (z * jax.nn.sigmoid(z)).astype(x.dtype)
+
+
+def _dec_reference(x, k, scale, offset, eps):
+    pre = pallas_cnn._dec_deconv(x, k).astype(jnp.float32)
+    mean = jnp.mean(pre, axis=-1, keepdims=True)
+    var = jnp.var(pre, axis=-1, keepdims=True)
+    z = (pre - mean) * jax.lax.rsqrt(var + eps) * scale + offset
+    return (z * jax.nn.sigmoid(z)).astype(x.dtype)
+
+
+def _stage_args(rng, n, h, w, cin, cout):
+    return (
+        jnp.asarray(rng.normal(size=(n, h, w, cin)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(4, 4, cin, cout)).astype(np.float32) * 0.2),
+        jnp.asarray(rng.normal(size=(cout,)).astype(np.float32) + 1.0),
+        jnp.asarray(rng.normal(size=(cout,)).astype(np.float32) * 0.1),
+    )
+
+
+@pytest.mark.parametrize("n,h,cin,cout", [(3, 8, 3, 8), (2, 16, 4, 6)])
+def test_conv_ln_silu_matches_reference(pallas_interpret, n, h, cin, cout):
+    x, w, scale, offset = _stage_args(np.random.default_rng(0), n, h, h, cin, cout)
+    got = pallas_cnn.conv_ln_silu(x, w, scale, offset, 1e-3)
+    want = _enc_reference(x, w, scale, offset, 1e-3)
+    assert got.shape == (n, h // 2, h // 2, cout)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_conv_ln_silu_gradients(pallas_interpret):
+    args = _stage_args(np.random.default_rng(1), 2, 8, 8, 3, 6)
+    g_kernel = jax.grad(
+        lambda *a: pallas_cnn.conv_ln_silu(*a, 1e-3).sum(), argnums=(0, 1, 2, 3)
+    )(*args)
+    g_ref = jax.grad(
+        lambda *a: _enc_reference(*a, 1e-3).sum(), argnums=(0, 1, 2, 3)
+    )(*args)
+    for gk, gr in zip(g_kernel, g_ref):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=2e-4)
+
+
+@pytest.mark.parametrize("n,h,cin,cout", [(3, 4, 8, 4), (2, 8, 6, 3)])
+def test_deconv_ln_silu_matches_reference(pallas_interpret, n, h, cin, cout):
+    x, k, scale, offset = _stage_args(np.random.default_rng(2), n, h, h, cin, cout)
+    got = pallas_cnn.deconv_ln_silu(x, k, scale, offset, 1e-3)
+    want = _dec_reference(x, k, scale, offset, 1e-3)
+    assert got.shape == (n, 2 * h, 2 * h, cout)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_deconv_ln_silu_gradients(pallas_interpret):
+    args = _stage_args(np.random.default_rng(3), 2, 4, 4, 5, 3)
+    g_kernel = jax.grad(
+        lambda *a: pallas_cnn.deconv_ln_silu(*a, 1e-3).sum(), argnums=(0, 1, 2, 3)
+    )(*args)
+    g_ref = jax.grad(
+        lambda *a: _dec_reference(*a, 1e-3).sum(), argnums=(0, 1, 2, 3)
+    )(*args)
+    for gk, gr in zip(g_kernel, g_ref):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=2e-4)
+
+
+def test_cnn_block_pallas_path_matches_plain():
+    """The Dreamer encoder stack (k4/s2/SAME + LN + SiLU, no bias) through
+    the CNN block: kernels on vs off must agree."""
+    cnn = CNN.init(
+        jax.random.PRNGKey(0), 3,
+        channels=[4, 8], kernel_sizes=[4, 4], strides=[2, 2],
+        act="silu", layer_norm=True, use_bias=False, norm_eps=1e-3,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+    pk.set_pallas(False)
+    plain = cnn(x)
+    pk.set_pallas(True, interpret=True)
+    fused = cnn(x)
+    pk.set_pallas(None, interpret=False)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(plain), atol=1e-5)
+
+
+def test_decnn_block_pallas_path_matches_plain():
+    """The Dreamer decoder stack through DeCNN (last layer un-normed and
+    un-activated — must stay on the plain path)."""
+    dec = DeCNN.init(
+        jax.random.PRNGKey(0), 8,
+        channels=[4, 3], kernel_sizes=[4, 4], strides=[2, 2],
+        act="silu", layer_norm=True, use_bias=False, norm_eps=1e-3,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 4, 8))
+    pk.set_pallas(False)
+    plain = dec(x)
+    pk.set_pallas(True, interpret=True)
+    fused = dec(x)
+    pk.set_pallas(None, interpret=False)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(plain), atol=1e-5)
+
+
+def test_sequence_batch_fold_through_cnn():
+    """[T, B, H, W, C] inputs (batch-major fold) agree with per-frame calls."""
+    cnn = CNN.init(
+        jax.random.PRNGKey(0), 3,
+        channels=[4], kernel_sizes=[4], strides=[2],
+        act="silu", layer_norm=True, use_bias=False, norm_eps=1e-3,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 2, 8, 8, 3))
+    seq = cnn(x)
+    per_frame = jnp.stack([
+        jnp.stack([cnn(x[t, b]) for b in range(2)]) for t in range(3)
+    ])
+    np.testing.assert_allclose(np.asarray(seq), np.asarray(per_frame), atol=1e-5)

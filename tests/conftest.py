@@ -39,3 +39,48 @@ def _assert_cpu_backend() -> None:
 
 
 _assert_cpu_backend()
+
+
+# ---------------------------------------------------------------------------
+# Budget enforcement for the `timeout` marker. pytest-timeout is not in this
+# image, so budgets are enforced with SIGALRM: the handler fires between
+# Python bytecodes, which catches runaway Python loops, hung subprocess
+# waits (EINTR) and stuck env workers. A single long-running C call (one XLA
+# compile) defers the alarm until it returns — an accepted limitation, noted
+# here so nobody mistakes this for a hard kill.
+# ---------------------------------------------------------------------------
+import signal
+
+import pytest
+
+
+class TestBudgetExceeded(BaseException):
+    """BaseException so a library's broad `except Exception` cannot swallow
+    the budget signal."""
+
+
+@pytest.fixture(autouse=True)
+def _enforce_timeout_marker(request):
+    marker = request.node.get_closest_marker("timeout")
+    if marker is None or not marker.args or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    seconds = int(marker.args[0])
+
+    def _expired(signum, frame):
+        # re-arm before raising: if anything on the stack still manages to
+        # absorb a BaseException, the budget keeps firing
+        signal.alarm(30)
+        raise TestBudgetExceeded(
+            f"test exceeded its {seconds}s timeout budget"
+        )
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(seconds)
+    try:
+        yield
+    except TestBudgetExceeded:
+        pytest.fail(f"test exceeded its {seconds}s timeout budget", pytrace=False)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

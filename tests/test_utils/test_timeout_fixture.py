@@ -1,0 +1,20 @@
+"""Meta-test for the SIGALRM budget fixture (tests/conftest.py): the budget
+must fire, and a library's broad `except Exception` must not swallow it
+(code-review r3 finding: pytest.Failed is an Exception, so a retry loop
+could eat the one-shot alarm and run unbounded)."""
+
+import time
+
+import pytest
+
+from tests.conftest import TestBudgetExceeded
+
+
+@pytest.mark.timeout(2)
+def test_budget_fires_through_broad_except():
+    with pytest.raises(TestBudgetExceeded):
+        try:
+            for _ in range(200):
+                time.sleep(0.1)
+        except Exception:  # the swallow-everything pattern under test
+            pytest.fail("budget signal was absorbed by `except Exception`")

@@ -375,11 +375,16 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     else:
         _set_kernel_families(None)
         pk.set_pallas(False, interpret=False)
-    # bf16 compute (--precision bfloat16) on top of the winning kernel config
-    args.precision = "bfloat16"
-    bf16_sps = _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail)
-    bf16_win = bf16_sps > candidates[best_fams]
-    args.precision = "bfloat16" if bf16_win else "float32"
+    # bf16 compute (--precision bfloat16) on top of the winning kernel
+    # config. Skipped in --tiny: it adds a full train-step compile to the
+    # CPU smoke for a path test_precision.py already covers
+    if tiny:
+        bf16_sps, bf16_win = 0.0, False
+    else:
+        args.precision = "bfloat16"
+        bf16_sps = _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail)
+        bf16_win = bf16_sps > candidates[best_fams]
+        args.precision = "bfloat16" if bf16_win else "float32"
     duty_sps = max(max(candidates.values()), bf16_sps)
     e2e_sps = _measure_guarded(_dv3_e2e_sps, args, state, opts, *tail)
 

@@ -39,7 +39,10 @@ DV3_TINY = [
 
 
 @pytest.mark.timeout(300)
-@pytest.mark.parametrize("num_devices", [2, 4])
+@pytest.mark.parametrize(
+    "num_devices",
+    [2, pytest.param(4, marks=pytest.mark.slow)],  # same path, more devices
+)
 def test_ppo_multidevice(tmp_path, num_devices):
     tasks["ppo"]([
         "--env_id", "discrete_dummy",
@@ -75,7 +78,10 @@ def test_ppo_indivisible_rollout_raises(tmp_path):
 
 
 @pytest.mark.timeout(300)
-@pytest.mark.parametrize("num_devices", [2, 4])
+@pytest.mark.parametrize(
+    "num_devices",
+    [2, pytest.param(4, marks=pytest.mark.slow)],  # same path, more devices
+)
 def test_sac_multidevice(tmp_path, num_devices):
     tasks["sac"]([
         "--env_id", "Pendulum-v1",
@@ -95,7 +101,10 @@ def test_sac_multidevice(tmp_path, num_devices):
 
 
 @pytest.mark.timeout(600)
-@pytest.mark.parametrize("num_devices", [2, 4])
+@pytest.mark.parametrize(
+    "num_devices",
+    [2, pytest.param(4, marks=pytest.mark.slow)],  # same path, more devices
+)
 def test_dreamer_v3_multidevice(tmp_path, num_devices):
     tasks["dreamer_v3"](
         DV3_TINY

@@ -184,6 +184,7 @@ def test_seq_devices_must_divide_device_count():
         make_mesh(8, seq_devices=3)
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(600)
 def test_dreamer_v2_seq_parallel_matches_single_device():
     """The DreamerV2 context-parallel step must be metric-equivalent too."""
@@ -230,6 +231,7 @@ def test_dreamer_v2_seq_parallel_matches_single_device():
     _assert_metrics_match(metrics_ref, metrics_sp, "DV2")
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(900)
 def test_p2e_dv2_seq_parallel_e2e(tmp_path):
     """P2E-DV2 dual-AC + ensemble under the mesh (whole Dreamer family)."""
@@ -239,12 +241,14 @@ def test_p2e_dv2_seq_parallel_e2e(tmp_path):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(600)
 def test_dreamer_v2_seq_parallel_e2e(tmp_path):
     """The DV2 main-loop wiring (shard_time_batch + divisibility asserts)."""
     _run_seq_parallel_e2e("dreamer_v2", tmp_path)
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(900)
 def test_p2e_dv2_seq_parallel_matches_single_device():
     """The exploring-phase P2E-DV2 step (ensemble loss over time-shifted
@@ -349,11 +353,13 @@ def test_dreamer_v1_seq_parallel_matches_single_device():
     _assert_metrics_match(metrics_ref, metrics_sp, "DV1")
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(600)
 def test_dreamer_v1_seq_parallel_e2e(tmp_path):
     _run_seq_parallel_e2e("dreamer_v1", tmp_path)
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(900)
 def test_p2e_dv1_seq_parallel_e2e(tmp_path):
     _run_seq_parallel_e2e(

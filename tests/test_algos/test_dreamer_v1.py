@@ -29,7 +29,10 @@ TINY = [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.slow)],
+)
 def test_dreamer_v1_dry_run(tmp_path, env_id):
     main(
         TINY

@@ -32,7 +32,10 @@ TINY = [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.slow)],
+)
 @pytest.mark.parametrize("buffer_type", ["sequential", "episode"])
 def test_dreamer_v2_dry_run(tmp_path, env_id, buffer_type):
     main(

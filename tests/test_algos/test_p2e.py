@@ -46,7 +46,10 @@ def _latest_ckpt(tmp_path):
     return os.path.join(ckpt_dir, ckpts[-1])
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.slow)],
+)
 def test_p2e_dv1_dry_run(tmp_path, env_id):
     from sheeprl_tpu.algos.p2e_dv1.p2e_dv1 import main
 
@@ -84,7 +87,10 @@ def test_p2e_dv1_checkpoint_contract_and_resume(tmp_path):
     main([f"--checkpoint_path={ckpt}"])
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.slow)],
+)
 def test_p2e_dv2_dry_run(tmp_path, env_id):
     from sheeprl_tpu.algos.p2e_dv2.p2e_dv2 import main
 

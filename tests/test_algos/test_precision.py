@@ -197,6 +197,7 @@ def _run_one_dv2_step(precision, continuous=False, remat=False):
     return {k: float(v) for k, v in metrics.items()}
 
 
+@pytest.mark.slow
 def test_dv2_bfloat16_step_finite_and_close_to_f32():
     m_bf = _run_one_dv2_step("bfloat16")
     m_f32 = _run_one_dv2_step("float32")
@@ -208,6 +209,7 @@ def test_dv2_bfloat16_step_finite_and_close_to_f32():
         )
 
 
+@pytest.mark.slow
 def test_dv2_remat_step_matches_plain():
     # remat changes memory usage, not numerics (now covers the DV2 RSSM scan
     # AND the imagination scan)
@@ -377,6 +379,7 @@ def test_dv1_remat_step_matches_plain():
         np.testing.assert_allclose(m_remat[name], m_plain[name], rtol=1e-3)
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(600)
 def test_p2e_dv1_exploring_step_remat_matches_plain():
     """P2E-DV1's EXPLORING step under remat (ensemble fit + disagreement

@@ -93,7 +93,10 @@ def _tiny_batch(args):
 
 
 @pytest.mark.timeout(900)
-@pytest.mark.parametrize("batch_size", [4, 8])
+@pytest.mark.parametrize(
+    "batch_size",
+    [4, pytest.param(8, marks=pytest.mark.slow)],  # same layout regime now
+)
 def test_seq_parallel_matches_single_device(batch_size):
     """Both sizes run the replicated-scan layout (scan batch over "data",
     seq groups replicating the scan — see scan_batch_spec for why the

@@ -709,6 +709,7 @@ class _AsyncEnvView:
 
     @property
     def buffer(self):
+        self._parent._flush_staged()
         store = self._parent._store
         if store is None:
             return None
@@ -746,6 +747,7 @@ class AsyncReplayBuffer:
         obs_keys: Sequence[str] = ("observations",),
         seed: int = 0,
         split: str = "even",
+        stage_rows: int | None = None,
     ):
         if buffer_size <= 0:
             raise ValueError(f"buffer size must be > 0, got {buffer_size}")
@@ -769,11 +771,28 @@ class AsyncReplayBuffer:
         self._upos = np.zeros(n_envs, dtype=np.int64)
         self._ufull = np.zeros(n_envs, dtype=bool)
         self._key = jax.random.PRNGKey(seed)
+        # device path: optional host-side staging of full-width adds —
+        # staged rows flush as ONE batched scatter (one transfer per key
+        # per flush) at the next sample/surgery/checkpoint access, instead
+        # of one transfer per key per step. OFF by default (stage_rows=0):
+        # measured on the round-3 chip, the batched flush sits on the
+        # sample critical path and loses ~25% e2e vs per-step adds that
+        # overlap with policy-step compute (BENCHES.md "staging receipt").
+        # Opt in via stage_rows or SHEEPRL_TPU_REPLAY_STAGE_ROWS.
+        if stage_rows is None:
+            stage_rows = int(os.environ.get("SHEEPRL_TPU_REPLAY_STAGE_ROWS", "0"))
+        self._staged: list[dict[str, np.ndarray]] = []
+        self._staged_rows = 0
+        self._stage_start: np.ndarray | None = None
+        # no clamp to buffer_size: _flush_staged trims over-capacity batches
+        # to the last buffer_size rows with the correct start adjustment, so
+        # a larger cap just means fewer flushes (the point of the feature)
+        self._stage_cap = stage_rows
 
     @property
     def buffer(self):
         if self._storage_kind == "device":
-            if self._store is None:
+            if self._store is None and not self._staged:
                 return None
             return tuple(_AsyncEnvView(self, e) for e in range(self._n_envs))
         return tuple(self._buf) if self._buf is not None else None
@@ -789,7 +808,7 @@ class AsyncReplayBuffer:
     @property
     def full(self):
         if self._storage_kind == "device":
-            if self._store is None:
+            if self._store is None and not self._staged:
                 return None
             return tuple(bool(f) for f in self._ufull)
         if self._buf is None:
@@ -842,7 +861,34 @@ class AsyncReplayBuffer:
             for k in store
         }
 
+    def _flush_staged(self) -> None:
+        """Write all staged full-width rows with one scatter. Bookkeeping
+        (`_upos`/`_ufull`) already advanced at stage time; rows are computed
+        from the position snapshot taken when staging began."""
+        if not self._staged:
+            return
+        staged, self._staged = self._staged, []
+        start = self._stage_start
+        self._stage_start = None
+        self._staged_rows = 0
+        data = {k: np.concatenate([d[k] for d in staged], axis=0) for k in staged[0]}
+        total = next(iter(data.values())).shape[0]
+        if total > self._buffer_size:
+            start = (start + (total - self._buffer_size)) % self._buffer_size
+            data = {k: v[-self._buffer_size :] for k, v in data.items()}
+            total = self._buffer_size
+        if self._store is None:
+            self._allocate_store(data)
+        rows = (start[None, :] + np.arange(total)[:, None]) % self._buffer_size
+        self._store = self._store_add(
+            self._store,
+            {k: jnp.asarray(v) for k, v in data.items()},
+            jnp.asarray(rows),
+            jnp.asarray(np.arange(self._n_envs, dtype=np.int64)),
+        )
+
     def _set_at(self, env: int, key: str, time_idx: int, value) -> None:
+        self._flush_staged()
         if self._store is None:
             raise RuntimeError("buffer not initialized; add data first")
         item = jnp.asarray(value).reshape(self._store[key].shape[2:])
@@ -870,6 +916,28 @@ class AsyncReplayBuffer:
         if data_len > self._buffer_size:
             data = {k: v[-self._buffer_size :] for k, v in data.items()}
             data_len = self._buffer_size
+        if (
+            self._stage_cap > 0
+            and cols.size == self._n_envs
+            and np.array_equal(cols, np.arange(self._n_envs))
+            and all(isinstance(v, np.ndarray) for v in data.values())
+        ):
+            if self._staged and set(data) != set(self._staged[0]):
+                self._flush_staged()
+            if not self._staged:
+                self._stage_start = self._upos.copy()
+            # copy: add() has copy-in semantics (the unstaged path reads via
+            # jnp.asarray immediately); callers mutate step rows in place
+            # after add, which must not reach the deferred flush
+            self._staged.append({k: np.array(v) for k, v in data.items()})
+            self._staged_rows += data_len
+            starts = self._upos
+            self._ufull |= starts + data_len >= self._buffer_size
+            self._upos = (starts + data_len) % self._buffer_size
+            if self._staged_rows >= self._stage_cap:
+                self._flush_staged()
+            return
+        self._flush_staged()
         if self._store is None:
             self._allocate_store(data)
         starts = self._upos[cols]
@@ -973,6 +1041,7 @@ class AsyncReplayBuffer:
             return self._sample_host(
                 batch_size, sample_next_obs, sequence_length, n_samples
             )
+        self._flush_staged()
         if self._store is None:
             raise RuntimeError("no samples in buffer; call add() first")
         if self._sequential and sequence_length > self._buffer_size:
@@ -1038,6 +1107,7 @@ class AsyncReplayBuffer:
         """Per-env state list — one format for both storage backends (the
         device store serializes as per-env column slices)."""
         if self._storage_kind == "device":
+            self._flush_staged()
             if self._store is None:
                 empty = {
                     "buf": None, "pos": 0, "full": False,
@@ -1061,6 +1131,7 @@ class AsyncReplayBuffer:
         return {"buffers": [b.to_state_dict() for b in self._buf]}
 
     def load_state_dict(self, state: dict) -> None:
+        self._flush_staged()
         buffers = state["buffers"]
         if len(buffers) != self._n_envs:
             raise ValueError("checkpointed buffer n_envs mismatch")

@@ -8,19 +8,31 @@ Decoder stage = ConvTranspose2d(k4, s2, SAME, no bias) -> LayerNorm(C) -> SiLU,
 computed in the subpixel formulation (dense 2x2 conv + depth-to-space, the
 same regrouping as nn.layers.ConvTranspose2d._subpixel_k4s2).
 
-What the fusion buys: one kernel per stage keeps the im2col patch matrix,
-the conv pre-activation, the LayerNorm moments and the SiLU entirely in
-VMEM — XLA stages the conv output through HBM before the channel-reduction
-LayerNorm can run. The convolution itself becomes a single MXU matmul
-(strided parity slices build the patch matrix in registers; for s=2 every
-input pixel appears in exactly 4 patches, so the patch matrix is 4x the
-input — it lives and dies inside VMEM).
+What the fusion buys: the conv pre-activation, the LayerNorm moments and the
+SiLU stay entirely in VMEM — XLA stages the conv output through HBM before
+the channel-reduction LayerNorm can run.
+
+Kernel shape discipline (learned against real-Mosaic, not interpret mode):
+strided vector slices, concatenation of offset slices, minor-dim slicing and
+non-tile-aligned reshapes are all rejected or fragile in Mosaic, so the
+kernels see only 2-D row-block matmuls and leading-axis indexing:
+
+  - the caller space-to-depth-packs the padded input (k4/s2 -> k2/s1 over
+    phases) and pre-flattens the four 2x2-window tap matrices to
+    [rows, Cin'] in XLA;
+  - the kernel computes the conv as a sum of four 2-D matmuls (one per
+    tap; weights arrive as leading-indexed [4|16, Cin', Cout] blocks),
+    then LayerNorm+SiLU on the [rows, Cout] block;
+  - for the decoder, LN/SiLU apply per-phase (each output pixel maps to
+    exactly one phase, LN is per-pixel over channels), and the subpixel
+    interleave happens XLA-side after the kernel.
 
 Differentiation follows the GRU kernel's policy (pallas_kernels.py): the
-forward-with-residuals kernel additionally emits the normalized activations
-and inverse stddev; the backward is plain XLA — elementwise LN/SiLU math
-from the residuals plus XLA's own conv VJP for dx/dW — so training numerics
-are exactly those of the unfused path.
+forward-with-residuals kernel additionally emits the raw conv
+pre-activation; the backward is plain XLA — it recomputes the LN stats
+from the pre-activation with the forward's exact ops, then elementwise
+LN/SiLU math plus XLA's own conv VJP for dx/dW — so training numerics are
+exactly those of the unfused path.
 
 Keep-decision: bench.py measures duty cycles with the family toggled via
 SHEEPRL_TPU_PALLAS_CNN and keeps the winner, like every other family.
@@ -39,9 +51,23 @@ from .pallas_kernels import _VMEM, _cdiv, _interpret_mode, use_pallas
 __all__ = ["conv_ln_silu", "deconv_ln_silu", "cnn_stage_supported"]
 
 
-# pixels of conv output aimed at one grid step (M dimension of the MXU
-# matmul); the batch tile adapts so bn * ho * wo stays near this
-_ROWS_TARGET = 2048
+# rows of conv output aimed at one grid step (M dimension of the MXU matmul)
+_ROWS_BLOCK = 2048
+# VMEM budget for one grid step's tap + output blocks (bytes); Mosaic's
+# scoped-vmem limit is 16 MiB and blocks are double-buffered across steps
+_VMEM_ROW_BUDGET = 4 * 1024 * 1024
+
+
+def _pad128(c: int) -> int:
+    return -(-c // 128) * 128
+
+
+def _pick_blk(rows: int, row_bytes: int) -> int:
+    """Row-block size: target _ROWS_BLOCK, shrink to the VMEM budget
+    (row_bytes = f32 bytes per row across all tap and output blocks,
+    lane-padding included), keep a sublane multiple."""
+    blk = min(rows, _ROWS_BLOCK, max(_VMEM_ROW_BUDGET // max(row_bytes, 1), 8))
+    return max(8 * (blk // 8), min(rows, 8))
 
 
 def cnn_stage_supported(kernel_shape, stride, padding, has_norm, act) -> bool:
@@ -61,89 +87,119 @@ def _silu(z):
     return z * jax.nn.sigmoid(z)
 
 
+def _ln_stats(pre, eps):
+    """LN normalized activations + inverse stddev — the ONE definition both
+    the forward kernels and the XLA backward recompute from, so their
+    numerics cannot de-sync."""
+    mean = jnp.mean(pre, axis=-1, keepdims=True)
+    centered = pre - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    return centered * rstd, rstd
+
+
+def _ln_silu(pre, scale, offset, eps):
+    """LayerNorm + SiLU on a [rows, C] block, f32 moments."""
+    hat, _ = _ln_stats(pre, eps)
+    return _silu(hat * scale + offset)
+
+
 # =============================================================================
 # encoder stage: conv k4/s2/SAME + LayerNorm + SiLU
 # =============================================================================
 
 
-def _enc_kernel(xp_ref, w_ref, scale_ref, offset_ref, y_ref, *, eps, ho, wo,
-                residuals=False, hat_ref=None, rstd_ref=None):
-    xp = xp_ref[:]  # [bn, H+2, W+2, Cin], pre-padded
-    bn, cin = xp.shape[0], xp.shape[-1]
-    cout = w_ref.shape[-1]
-    # im2col via 16 strided parity slices: out pixel (i, j) reads padded rows
-    # 2i+kh, cols 2j+kw — slice start kh, stride 2, length ho
-    cols = [
-        jax.lax.slice(
-            xp,
-            (0, kh, kw, 0),
-            (bn, kh + 2 * ho - 1, kw + 2 * wo - 1, cin),
-            (1, 2, 2, 1),
-        )
-        for kh in range(4)
-        for kw in range(4)
-    ]
-    patches = jnp.concatenate(cols, axis=-1).reshape(bn * ho * wo, 16 * cin)
-    pre = jnp.dot(patches, w_ref[:], preferred_element_type=jnp.float32)
-    mean = jnp.mean(pre, axis=-1, keepdims=True)
-    centered = pre - mean
-    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(var + eps)
-    hat = centered * rstd
-    z = hat * scale_ref[:] + offset_ref[:]
-    y = _silu(z)
-    y_ref[:] = y.reshape(bn, ho, wo, cout).astype(y_ref.dtype)
+def _enc_kernel(t0, t1, t2, t3, w_ref, scale_ref, offset_ref, y_ref, *, eps,
+                residuals=False, pre_ref=None):
+    """One [rows, Cout] block: sum of four 2-D tap matmuls + LN + SiLU.
+    With residuals, the raw pre-activation is the single saved tensor (the
+    backward recomputes the LN stats from it — one output instead of a
+    [rows, Cout] + a 128-lane-padded [rows, 1])."""
+    pre = None
+    for uv, tap in enumerate((t0, t1, t2, t3)):
+        d = jnp.dot(tap[:], w_ref[uv], preferred_element_type=jnp.float32)
+        pre = d if pre is None else pre + d
+    y_ref[:] = _ln_silu(pre, scale_ref[:], offset_ref[:], eps).astype(y_ref.dtype)
     if residuals:
-        hat_ref[:] = hat.reshape(bn, ho, wo, cout)
-        rstd_ref[:] = rstd.reshape(bn, ho, wo, 1)
+        pre_ref[:] = pre
 
 
-def _enc_call(x, wmat, scale, offset, eps, residuals):
+def _enc_taps(x):
+    """Pad for SAME k4/s2, space-to-depth-pack the 2x2 phases into channels
+    (k4/s2 -> k2/s1 over the phase grid), and flatten the four 2x2-window
+    taps to [N*Ho*Wo, 4*Cin] row matrices — all XLA-side."""
     n, h, w, cin = x.shape
     ho, wo = h // 2, w // 2
-    cout = wmat.shape[-1]
     xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
-    bn = max(1, min(n, _ROWS_TARGET // max(ho * wo, 1)))
-    out_shape = [jax.ShapeDtypeStruct((n, ho, wo, cout), x.dtype)]
-    out_specs = [
-        pl.BlockSpec((bn, ho, wo, cout), lambda i: (i, 0, 0, 0), memory_space=_VMEM)
-    ]
-    if residuals:
-        out_shape += [
-            jax.ShapeDtypeStruct((n, ho, wo, cout), jnp.float32),
-            jax.ShapeDtypeStruct((n, ho, wo, 1), jnp.float32),
-        ]
-        out_specs += [
-            pl.BlockSpec(
-                (bn, ho, wo, cout), lambda i: (i, 0, 0, 0), memory_space=_VMEM
-            ),
-            pl.BlockSpec((bn, ho, wo, 1), lambda i: (i, 0, 0, 0), memory_space=_VMEM),
-        ]
-    kernel = functools.partial(
-        _enc_kernel, eps=eps, ho=ho, wo=wo, residuals=residuals
+    # H+2 = 2*(ho+1): the padded grid splits into phases exactly
+    xp = (
+        xp.reshape(n, ho + 1, 2, wo + 1, 2, cin)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(n, ho + 1, wo + 1, 4 * cin)
     )
+    return [
+        jax.lax.slice(xp, (0, u, v, 0), (n, u + ho, v + wo, 4 * cin)).reshape(
+            n * ho * wo, 4 * cin
+        )
+        for u in range(2)
+        for v in range(2)
+    ]
+
+
+def _enc_call(x, w3, scale, offset, eps, residuals):
+    n, h, w, cin = x.shape
+    ho, wo = h // 2, w // 2
+    cout = w3.shape[-1]
+    taps = _enc_taps(x)
+    rows = n * ho * wo
+    itemsize = 2 if x.dtype == jnp.bfloat16 else 4
+    row_bytes = (
+        4 * _pad128(4 * cin) * itemsize  # taps
+        + _pad128(cout) * itemsize  # y
+        + residuals * _pad128(cout) * 4  # saved pre-activation (f32)
+    )
+    blk = _pick_blk(rows, row_bytes)
+    tap_spec = pl.BlockSpec((blk, 4 * cin), lambda i: (i, 0), memory_space=_VMEM)
+    out_shape = [jax.ShapeDtypeStruct((rows, cout), x.dtype)]
+    out_specs = [pl.BlockSpec((blk, cout), lambda i: (i, 0), memory_space=_VMEM)]
     if residuals:
-        body = lambda xr, wr, sr, or_, yr, hr, rr: kernel(
-            xr, wr, sr, or_, yr, hat_ref=hr, rstd_ref=rr
+        out_shape.append(jax.ShapeDtypeStruct((rows, cout), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((blk, cout), lambda i: (i, 0), memory_space=_VMEM)
+        )
+    kernel = functools.partial(_enc_kernel, eps=eps, residuals=residuals)
+    if residuals:
+        body = lambda a, b, c, d, wr, sr, or_, yr, pr: kernel(
+            a, b, c, d, wr, sr, or_, yr, pre_ref=pr
         )
     else:
         body = kernel
     out = pl.pallas_call(
         body,
-        grid=(_cdiv(n, bn),),
+        grid=(_cdiv(rows, blk),),
         out_shape=tuple(out_shape) if residuals else out_shape[0],
-        in_specs=[
-            pl.BlockSpec(
-                (bn, h + 2, w + 2, cin), lambda i: (i, 0, 0, 0), memory_space=_VMEM
-            ),
-            pl.BlockSpec(wmat.shape, lambda i: (0, 0), memory_space=_VMEM),
+        in_specs=[tap_spec] * 4
+        + [
+            pl.BlockSpec(w3.shape, lambda i: (0, 0, 0), memory_space=_VMEM),
             pl.BlockSpec(scale.shape, lambda i: (0,), memory_space=_VMEM),
             pl.BlockSpec(offset.shape, lambda i: (0,), memory_space=_VMEM),
         ],
         out_specs=tuple(out_specs) if residuals else out_specs[0],
         interpret=_interpret_mode(),
-    )(xp, wmat, scale, offset)
-    return out
+    )(*taps, w3, scale, offset)
+    if residuals:
+        y, pre = out
+        return y.reshape(n, ho, wo, cout), pre.reshape(n, ho, wo, cout)
+    return out.reshape(n, ho, wo, cout)
+
+
+def _enc_w3(w):
+    """[4, 4, Cin, Cout] conv kernel -> [4, 4*Cin, Cout] leading-indexed tap
+    blocks matching _enc_taps' layout: tap (u, v) outer, space-to-depth
+    phase (a, b) + channel minor (kh = 2u+a, kw = 2v+b)."""
+    cin, cout = w.shape[2], w.shape[3]
+    kk = w.reshape(2, 2, 2, 2, cin, cout)  # [u, a, v, b, cin, cout]
+    return kk.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4 * cin, cout)
 
 
 def _enc_conv(x, w):
@@ -157,10 +213,11 @@ def _enc_conv(x, w):
     )
 
 
-def _ln_silu_bwd(dy, hat, rstd, scale, offset):
-    """Grad of SiLU(LayerNorm(pre)) wrt pre / scale / offset from the saved
-    normalized activations and inverse stddev."""
+def _ln_silu_bwd(dy, pre, scale, offset, eps):
+    """Grad of SiLU(LayerNorm(pre)) wrt pre / scale / offset. Recomputes the
+    LN stats from the saved pre-activation via the forward's _ln_stats."""
     dy = dy.astype(jnp.float32)
+    hat, rstd = _ln_stats(pre, eps)
     z = hat * scale + offset
     sig = jax.nn.sigmoid(z)
     dz = dy * (sig * (1.0 + z * (1.0 - sig)))  # SiLU'
@@ -179,19 +236,17 @@ def _ln_silu_bwd(dy, hat, rstd, scale, offset):
 def conv_ln_silu(x, w, scale, offset, eps=1e-3):
     """Fused Dreamer encoder stage. x: [N, H, W, Cin] (H, W even),
     w: [4, 4, Cin, Cout] conv kernel, scale/offset: LayerNorm affine."""
-    cin, cout = w.shape[2], w.shape[3]
-    return _enc_call(x, w.reshape(16 * cin, cout), scale, offset, eps, False)
+    return _enc_call(x, _enc_w3(w), scale, offset, eps, False)
 
 
 def _conv_ln_silu_fwd(x, w, scale, offset, eps):
-    cin, cout = w.shape[2], w.shape[3]
-    y, hat, rstd = _enc_call(x, w.reshape(16 * cin, cout), scale, offset, eps, True)
-    return y, (x, w, scale, offset, hat, rstd)
+    y, pre = _enc_call(x, _enc_w3(w), scale, offset, eps, True)
+    return y, (x, w, scale, offset, pre)
 
 
 def _conv_ln_silu_bwd(eps, res, dy):
-    x, w, scale, offset, hat, rstd = res
-    dpre, dscale, doffset = _ln_silu_bwd(dy, hat, rstd, scale, offset)
+    x, w, scale, offset, pre = res
+    dpre, dscale, doffset = _ln_silu_bwd(dy, pre, scale, offset, eps)
     _, conv_vjp = jax.vjp(_enc_conv, x, w)
     dx, dw = conv_vjp(dpre.astype(x.dtype))
     return dx, dw.astype(w.dtype), dscale.astype(scale.dtype), doffset.astype(offset.dtype)
@@ -205,93 +260,113 @@ conv_ln_silu.defvjp(_conv_ln_silu_fwd, _conv_ln_silu_bwd)
 # =============================================================================
 
 
-def _dec_kernel(xp_ref, w_ref, scale_ref, offset_ref, y_ref, *, eps, h, w,
-                residuals=False, hat_ref=None, rstd_ref=None):
-    xp = xp_ref[:]  # [bn, h+2, w+2, Cin], pre-padded
-    bn, cin = xp.shape[0], xp.shape[-1]
-    cout4 = w_ref.shape[-1]
-    cout = cout4 // 4
-    # dense 2x2 conv over the padded grid -> per-pixel 2x2 output phases
-    cols = [
-        jax.lax.slice(xp, (0, a, b, 0), (bn, a + h + 1, b + w + 1, cin))
+def _dec_kernel(t0, t1, t2, t3, w_ref, scale_ref, offset_ref, y_ref, *, eps,
+                residuals=False, pre_ref=None):
+    """Four output phases, each a sum of four 2-D tap matmuls + LN + SiLU
+    (w_ref: [16, Cin, Cout] blocks indexed p*4 + ab). LN/SiLU apply in
+    phase layout — each output pixel maps to exactly one phase — and the
+    subpixel interleave happens XLA-side after."""
+    taps = (t0[:], t1[:], t2[:], t3[:])
+    for p in range(4):  # output phase (dh, dw) = divmod(p, 2)
+        pre = None
+        for ab in range(4):
+            d = jnp.dot(
+                taps[ab], w_ref[p * 4 + ab], preferred_element_type=jnp.float32
+            )
+            pre = d if pre is None else pre + d
+        y_ref[p] = _ln_silu(pre, scale_ref[:], offset_ref[:], eps).astype(
+            y_ref.dtype
+        )
+        if residuals:
+            pre_ref[p] = pre
+
+
+def _dec_taps(x):
+    """Pad and flatten the four 2x2-window taps of the dense phase conv to
+    [N*(H+1)*(W+1), Cin] row matrices — all XLA-side."""
+    n, h, w, cin = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    return [
+        jax.lax.slice(xp, (0, a, b, 0), (n, a + h + 1, b + w + 1, cin)).reshape(
+            n * (h + 1) * (w + 1), cin
+        )
         for a in range(2)
         for b in range(2)
     ]
-    patches = jnp.concatenate(cols, axis=-1).reshape(bn * (h + 1) * (w + 1), 4 * cin)
-    ph = jnp.dot(patches, w_ref[:], preferred_element_type=jnp.float32)
-    ph = ph.reshape(bn, h + 1, w + 1, 2, 2, cout)
-    # subpixel interleave (same phase selection as ConvTranspose2d._subpixel_k4s2)
-    row0 = jnp.stack([ph[:, :h, :w, 0, 0], ph[:, :h, 1:, 0, 1]], axis=3)
-    row1 = jnp.stack([ph[:, 1:, :w, 1, 0], ph[:, 1:, 1:, 1, 1]], axis=3)
-    pre = jnp.stack([row0, row1], axis=2).reshape(bn * 2 * h * 2 * w, cout)
-    mean = jnp.mean(pre, axis=-1, keepdims=True)
-    centered = pre - mean
-    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(var + eps)
-    hat = centered * rstd
-    z = hat * scale_ref[:] + offset_ref[:]
-    y = _silu(z)
-    y_ref[:] = y.reshape(bn, 2 * h, 2 * w, cout).astype(y_ref.dtype)
-    if residuals:
-        hat_ref[:] = hat.reshape(bn, 2 * h, 2 * w, cout)
-        rstd_ref[:] = rstd.reshape(bn, 2 * h, 2 * w, 1)
 
 
-def _dec_call(x, wmat, scale, offset, eps, residuals):
+def _interleave_phases(ph, n, h, w):
+    """[4, N*(h+1)*(w+1), C] phase rows -> [N, 2h, 2w, C] subpixel output
+    (phase p = dh*2+dw; same selection as ConvTranspose2d._subpixel_k4s2)."""
+    c = ph.shape[-1]
+    ph = ph.reshape(4, n, h + 1, w + 1, c)
+    row0 = jnp.stack([ph[0][:, :h, :w], ph[1][:, :h, 1:]], axis=3)
+    row1 = jnp.stack([ph[2][:, 1:, :w], ph[3][:, 1:, 1:]], axis=3)
+    return jnp.stack([row0, row1], axis=2).reshape(n, 2 * h, 2 * w, c)
+
+
+def _dec_call(x, w3, scale, offset, eps, residuals):
     n, h, w, cin = x.shape
-    cout = wmat.shape[-1] // 4
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
-    bn = max(1, min(n, _ROWS_TARGET // max(4 * h * w, 1)))
-    out_shape = [jax.ShapeDtypeStruct((n, 2 * h, 2 * w, cout), x.dtype)]
+    cout = w3.shape[-1]
+    taps = _dec_taps(x)
+    rows = n * (h + 1) * (w + 1)
+    itemsize = 2 if x.dtype == jnp.bfloat16 else 4
+    row_bytes = 4 * _pad128(cin) * itemsize + 4 * _pad128(cout) * (
+        itemsize + 4 * residuals
+    )
+    blk = _pick_blk(rows, row_bytes)
+    tap_spec = pl.BlockSpec((blk, cin), lambda i: (i, 0), memory_space=_VMEM)
+    out_shape = [jax.ShapeDtypeStruct((4, rows, cout), x.dtype)]
     out_specs = [
-        pl.BlockSpec(
-            (bn, 2 * h, 2 * w, cout), lambda i: (i, 0, 0, 0), memory_space=_VMEM
-        )
+        pl.BlockSpec((4, blk, cout), lambda i: (0, i, 0), memory_space=_VMEM)
     ]
     if residuals:
-        out_shape += [
-            jax.ShapeDtypeStruct((n, 2 * h, 2 * w, cout), jnp.float32),
-            jax.ShapeDtypeStruct((n, 2 * h, 2 * w, 1), jnp.float32),
-        ]
-        out_specs += [
-            pl.BlockSpec(
-                (bn, 2 * h, 2 * w, cout), lambda i: (i, 0, 0, 0), memory_space=_VMEM
-            ),
-            pl.BlockSpec(
-                (bn, 2 * h, 2 * w, 1), lambda i: (i, 0, 0, 0), memory_space=_VMEM
-            ),
-        ]
-    kernel = functools.partial(_dec_kernel, eps=eps, h=h, w=w, residuals=residuals)
+        out_shape.append(jax.ShapeDtypeStruct((4, rows, cout), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((4, blk, cout), lambda i: (0, i, 0), memory_space=_VMEM)
+        )
+    kernel = functools.partial(_dec_kernel, eps=eps, residuals=residuals)
     if residuals:
-        body = lambda xr, wr, sr, or_, yr, hr, rr: kernel(
-            xr, wr, sr, or_, yr, hat_ref=hr, rstd_ref=rr
+        body = lambda a, b, c, d, wr, sr, or_, yr, pr: kernel(
+            a, b, c, d, wr, sr, or_, yr, pre_ref=pr
         )
     else:
         body = kernel
-    return pl.pallas_call(
+    out = pl.pallas_call(
         body,
-        grid=(_cdiv(n, bn),),
+        grid=(_cdiv(rows, blk),),
         out_shape=tuple(out_shape) if residuals else out_shape[0],
-        in_specs=[
-            pl.BlockSpec(
-                (bn, h + 2, w + 2, cin), lambda i: (i, 0, 0, 0), memory_space=_VMEM
-            ),
-            pl.BlockSpec(wmat.shape, lambda i: (0, 0), memory_space=_VMEM),
+        in_specs=[tap_spec] * 4
+        + [
+            pl.BlockSpec(w3.shape, lambda i: (0, 0, 0), memory_space=_VMEM),
             pl.BlockSpec(scale.shape, lambda i: (0,), memory_space=_VMEM),
             pl.BlockSpec(offset.shape, lambda i: (0,), memory_space=_VMEM),
         ],
         out_specs=tuple(out_specs) if residuals else out_specs[0],
         interpret=_interpret_mode(),
-    )(xp, wmat, scale, offset)
+    )(*taps, w3, scale, offset)
+    if residuals:
+        y, pre = out
+        return _interleave_phases(y, n, h, w), _interleave_phases(pre, n, h, w)
+    return _interleave_phases(out, n, h, w)
 
 
 def _dec_wmat(k):
     """[4, 4, Cin, Cout] transposed-conv kernel -> [4*Cin, 4*Cout] dense 2x2
-    phase matrix, ordering matched to _dec_kernel's cols/phases (identical to
+    phase matrix, ordering matched to _dec_deconv's cols/phases (identical to
     ConvTranspose2d._subpixel_k4s2's regrouping)."""
     cin, cout = k.shape[2], k.shape[3]
     kk = k.reshape(2, 2, 2, 2, cin, cout)  # [a, dh, b, dw, cin, cout]
     return kk.transpose(0, 2, 4, 1, 3, 5).reshape(4 * cin, 4 * cout)
+
+
+def _dec_w3(k):
+    """[4, 4, Cin, Cout] transposed-conv kernel -> [16, Cin, Cout] blocks
+    indexed p*4 + ab (p = output phase dh*2+dw, ab = tap a*2+b) — the
+    leading-indexed layout _dec_kernel consumes (no minor-dim slicing)."""
+    cin, cout = k.shape[2], k.shape[3]
+    kk = k.reshape(2, 2, 2, 2, cin, cout)  # [a, dh, b, dw, cin, cout]
+    return kk.transpose(1, 3, 0, 2, 4, 5).reshape(16, cin, cout)
 
 
 def _dec_deconv(x, k):
@@ -313,17 +388,17 @@ def _dec_deconv(x, k):
 def deconv_ln_silu(x, k, scale, offset, eps=1e-3):
     """Fused Dreamer decoder stage. x: [N, H, W, Cin],
     k: [4, 4, Cin, Cout] transposed-conv kernel, scale/offset: LN affine."""
-    return _dec_call(x, _dec_wmat(k), scale, offset, eps, False)
+    return _dec_call(x, _dec_w3(k), scale, offset, eps, False)
 
 
 def _deconv_ln_silu_fwd(x, k, scale, offset, eps):
-    y, hat, rstd = _dec_call(x, _dec_wmat(k), scale, offset, eps, True)
-    return y, (x, k, scale, offset, hat, rstd)
+    y, pre = _dec_call(x, _dec_w3(k), scale, offset, eps, True)
+    return y, (x, k, scale, offset, pre)
 
 
 def _deconv_ln_silu_bwd(eps, res, dy):
-    x, k, scale, offset, hat, rstd = res
-    dpre, dscale, doffset = _ln_silu_bwd(dy, hat, rstd, scale, offset)
+    x, k, scale, offset, pre = res
+    dpre, dscale, doffset = _ln_silu_bwd(dy, pre, scale, offset, eps)
     _, vjp = jax.vjp(_dec_deconv, x, k)
     dx, dk = vjp(dpre.astype(x.dtype))
     return dx, dk.astype(k.dtype), dscale.astype(scale.dtype), doffset.astype(offset.dtype)

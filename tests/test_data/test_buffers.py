@@ -323,6 +323,67 @@ class TestAsyncUnifiedDeviceStore:
         with pytest.raises(ValueError, match="too long sequence_length"):
             arb.sample(4, sequence_length=4, n_samples=1)
 
+    def test_staged_adds_match_unstaged(self):
+        # full-width adds stage host-side and flush as one scatter; the
+        # store contents must be identical to per-add scatters across
+        # interleaved full/subset adds, wrap-around and row surgery
+        def run(stage_cap):
+            arb = AsyncReplayBuffer(8, n_envs=3, storage="device",
+                                    sequential=True, seed=7,
+                                    stage_rows=stage_cap)
+            step = 0
+            for _ in range(5):  # 15 rows through an 8-ring: wraps twice
+                for _ in range(3):
+                    row = np.full((1, 3, 1), step, np.float32) + np.arange(
+                        3, dtype=np.float32
+                    ).reshape(1, 3, 1) * 100.0
+                    arb.add({"observations": row})
+                    step += 1
+                arb.add(
+                    {"observations": np.full((1, 1, 1), 999.0, np.float32)},
+                    indices=[1],
+                )
+            arb.buffer[2].set_at("observations", 3, np.float32(-5.0))
+            st = arb.to_state_dict()
+            return [
+                (s["pos"], s["full"], np.asarray(s["buf"]["observations"]))
+                for s in st["buffers"]
+            ]
+
+        staged, unstaged = run(64), run(0)  # 0 == staging off (direct path)
+        for (p_a, f_a, b_a), (p_b, f_b, b_b) in zip(staged, unstaged):
+            assert p_a == p_b and f_a == f_b
+            np.testing.assert_array_equal(b_a, b_b)
+
+    def test_staging_flush_bounds_and_overflow(self):
+        # a single flush holding more rows than the ring must keep only the
+        # last buffer_size rows AND land them at the slots sequential
+        # per-add scatters would have used (the flush trims + advances its
+        # start positions; reachable only when multi-row adds push one
+        # staged batch past capacity)
+        arb = AsyncReplayBuffer(4, n_envs=2, storage="device", sequential=False,
+                                stage_rows=4)
+        for base in (0.0, 3.0):  # two 3-row adds: one flush of 6 rows > 4
+            rows = (base + np.arange(3, dtype=np.float32)).reshape(3, 1, 1)
+            arb.add({"observations": np.broadcast_to(rows, (3, 2, 1))})
+        assert arb._staged_rows == 0  # cap (=buffer_size) forced the flush
+        assert [b.pos for b in arb.buffer] == [2, 2]
+        assert arb.full == (True, True)
+        ring = np.asarray(arb.buffer[0].buffer["observations"])[:, 0, 0]
+        # rows 2..5 survive; ring slot = step % 4 -> [4, 5, 2, 3]
+        assert ring.tolist() == [4.0, 5.0, 2.0, 3.0]
+
+    def test_staged_rows_copy_on_add(self):
+        # add() has copy-in semantics: mutating the caller's array after
+        # add must not change what a later flush writes
+        arb = AsyncReplayBuffer(8, n_envs=1, storage="device", sequential=False,
+                                stage_rows=64)
+        row = np.full((1, 1, 1), 7.0, np.float32)
+        arb.add({"observations": row})
+        row[:] = -1.0  # mutate before any flush
+        ring = np.asarray(arb.buffer[0].buffer["observations"])
+        assert ring[0, 0, 0] == 7.0
+
     def test_cross_storage_checkpoint_roundtrip(self):
         # host-saved rings restore into a device store and vice versa
         src = AsyncReplayBuffer(8, n_envs=2, storage="host", sequential=True)

@@ -135,11 +135,16 @@ def _dv3_player_fns(args, actions_dim, is_continuous):
             compute_dtype=args.precision,
         )
 
-    # same signature the real main jits (dreamer_v3.py:569-573): the mask is
-    # the MineDojo action-validity dict, None for unmasked envs
+    # same signature the real main jits (dreamer_v3.py:573-581): the mask is
+    # the MineDojo action-validity dict, None for unmasked envs. The policy
+    # obs contract matches the main: RAW puts (uint8 pixels), normalization
+    # inside the jit via the shared helper
+    from sheeprl_tpu.algos.dreamer_v3.utils import make_device_preprocess
+
+    _prep = make_device_preprocess(args.cnn_keys)
     player_step = jax.jit(
         lambda p, s, o, k, mask: p.step(
-            s, o, k, jnp.float32(0.0), is_training=True, mask=mask
+            s, _prep(o), k, jnp.float32(0.0), is_training=True, mask=mask
         )
     )
     return make_player, player_step
@@ -180,14 +185,9 @@ def _dv3_synth_data(args, actions_dim, obs_space):
         dones=jnp.zeros((T, B, 1), jnp.float32),
         is_first=jnp.zeros((T, B, 1), jnp.float32),
     )
-    obs = {}
-    for k in (*args.cnn_keys, *args.mlp_keys):
-        v = synth(k, (args.num_envs,))
-        obs[k] = (
-            jnp.asarray(v).astype(jnp.float32) / 255.0
-            if k in args.cnn_keys
-            else jnp.asarray(v)
-        )
+    # RAW policy obs (uint8 pixels): the player step normalizes inside the
+    # jit (make_device_preprocess), same contract as the real main
+    obs = {k: jnp.asarray(synth(k, (args.num_envs,))) for k in (*args.cnn_keys, *args.mlp_keys)}
     mask = {k: v for k, v in obs.items() if k.startswith("mask")} or None
     return sample_batch, obs, mask
 
@@ -272,6 +272,8 @@ def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
         return rng.integers(0, 255, (n_envs, 64, 64, 3), dtype=np.uint8)
 
     def add_step(obs_u8):
+        # obs_u8 may be a device array (the policy step's put, reused —
+        # zero extra transfers) or host numpy (prefill)
         rb.add(
             {
                 "rgb": obs_u8[None],
@@ -293,10 +295,11 @@ def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
         player = make_player(state)
         for _ in range(args.train_every):
             obs_u8 = fake_env_obs()
-            dev_obs = {"rgb": jnp.asarray(obs_u8).astype(jnp.float32) / 255.0}
+            dev_u8 = jnp.asarray(obs_u8)  # the ONE obs put per step
             key, sk = jax.random.split(key)
-            player_state, _ = player_step(player, player_state, dev_obs, sk, None)
-            add_step(obs_u8)
+            player_state, _ = player_step(player, player_state, {"rgb": dev_u8}, sk, None)
+            # staged/host buffers want host rows; device buffers reuse the put
+            add_step(obs_u8 if rb.prefers_host_adds else dev_u8)
         local_data = rb.sample(B, sequence_length=T, n_samples=1)
         staged = stage_batch(local_data)
         sample = {k: v[0] for k, v in staged.items()}
@@ -350,22 +353,43 @@ def _set_kernel_families(enabled: dict | None) -> None:
             os.environ[var] = "1" if enabled.get(fam, False) else "0"
 
 
+def _plausible(sps: float, discards: list, tiny: bool = False) -> float:
+    """Zero a duty-cycle measurement whose implied TFLOP/s exceeds the
+    physical cap (the 0.0 failed-measurement sentinel), so a lying-tunnel
+    run can never win the keep-decision or become the headline — the r3c
+    artifact recorded an implied ~204 TF/s 'measurement' on a chip whose
+    f32 peak is ~98. Discards are counted in the artifact. `tiny` skips the
+    filter: the cap is calibrated to the full-scale model's FLOPs and would
+    falsely discard a fast CPU smoke."""
+    if not tiny and sps / 20.0 * DV3_TFLOPS_PER_20_STEPS > PLAUSIBLE_TFLOPS_CAP:
+        discards.append(round(sps, 1))
+        return 0.0
+    return sps
+
+
 def bench_dreamer_v3(tiny: bool = False) -> None:
     from sheeprl_tpu.ops import pallas_kernels as pk
 
     args, state, opts, actions_dim, is_continuous, _ = _dv3_setup(tiny)
     tail = (actions_dim, is_continuous, tiny)
+    discards: list = []
 
     _set_kernel_families(None)
     pk.set_pallas(False)
-    off_sps = _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail)
+    off_sps = _plausible(
+        _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
+        discards, tiny,
+    )
     # the kernels-on cycle runs in --tiny too: it is the only train-step-
     # level coverage of the pallas-enable wiring (op/block numerics live in
     # tests/test_ops/test_pallas*.py, but a regression in the set_pallas /
     # env-switch integration inside the DV3 step would otherwise only
     # surface on a real chip behind the flaky tunnel)
     pk.set_pallas(True, interpret=not pk._backend_is_tpu())
-    on_sps = _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail)
+    on_sps = _plausible(
+        _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
+        discards, tiny,
+    )
 
     # per-kernel attribution (VERDICT r2 #6): one run per family with only
     # that family enabled, so a losing kernel can't hide behind a winning
@@ -374,8 +398,9 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     if not tiny:
         for fam in _PALLAS_FAMILIES:
             _set_kernel_families({fam: True})
-            fam_sps[fam] = _measure_guarded(
-                _dv3_duty_cycle_sps, args, state, opts, *tail
+            fam_sps[fam] = _plausible(
+                _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
+                discards,
             )
         _set_kernel_families(None)
 
@@ -387,15 +412,22 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     candidates: dict[tuple, float] = {(): off_sps, tuple(_PALLAS_FAMILIES): on_sps}
     for fam, sps in fam_sps.items():
         candidates[(fam,)] = sps
-    solo_winners = tuple(f for f in _PALLAS_FAMILIES if fam_sps.get(f, 0.0) > off_sps)
+    # a discarded/failed all-off run (0.0) is not a baseline: without it no
+    # solo "win" is meaningful, so skip the joint run and keep kernels off
+    solo_winners = (
+        tuple(f for f in _PALLAS_FAMILIES if fam_sps.get(f, 0.0) > off_sps)
+        if off_sps > 0.0
+        else ()
+    )
     if len(solo_winners) >= 2 and solo_winners not in candidates:
         _set_kernel_families({f: True for f in solo_winners})
-        candidates[solo_winners] = _measure_guarded(
-            _dv3_duty_cycle_sps, args, state, opts, *tail
+        candidates[solo_winners] = _plausible(
+            _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
+            discards,
         )
         _set_kernel_families(None)
     best_fams = max(candidates, key=candidates.get)
-    kernels_win = bool(best_fams) and candidates[best_fams] > 0.0
+    kernels_win = off_sps > 0.0 and bool(best_fams) and candidates[best_fams] > 0.0
     if kernels_win and pk._backend_is_tpu():
         _set_kernel_families({f: True for f in best_fams})
         pk.set_pallas(True, interpret=False)
@@ -410,20 +442,29 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         bf16_sps, bf16_win = None, False
     else:
         args.precision = "bfloat16"
-        bf16_sps = _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail)
+        bf16_sps = _plausible(
+            _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
+            discards,
+        )
         bf16_win = bf16_sps > candidates[best_fams]
         args.precision = "bfloat16" if bf16_win else "float32"
     duty_sps = max(max(candidates.values()), bf16_sps or 0.0)
     implied_tflops = duty_sps / 20.0 * DV3_TFLOPS_PER_20_STEPS
+    # individual candidates are already filtered by _plausible; this flag
+    # can only fire if the cap itself is later raised past a lie
     suspect_timing = bool(implied_tflops > PLAUSIBLE_TFLOPS_CAP)
     # e2e gets its own precision keep-decision: the replay/transfer mix can
     # invert the duty-cycle winner (bf16 wins the duty cycle but pays extra
     # host->device cast latency in the end-to-end loop on the round-3 chip)
-    e2e_sps = _measure_guarded(_dv3_e2e_sps, args, state, opts, *tail)
+    e2e_sps = _plausible(
+        _measure_guarded(_dv3_e2e_sps, args, state, opts, *tail), discards, tiny
+    )
     e2e_precision = args.precision
     if not tiny and bf16_win:
         args.precision = "float32"
-        e2e_f32 = _measure_guarded(_dv3_e2e_sps, args, state, opts, *tail)
+        e2e_f32 = _plausible(
+            _measure_guarded(_dv3_e2e_sps, args, state, opts, *tail), discards, tiny
+        )
         if e2e_f32 > e2e_sps:
             e2e_sps, e2e_precision = e2e_f32, "float32"
         else:
@@ -456,6 +497,7 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
                 "e2e_precision": e2e_precision,
                 "implied_tflops": round(implied_tflops, 1),
                 "suspect_timing": suspect_timing,
+                "implausible_discards": discards,
                 "baseline_note": BASELINE_NOTE,
             }
         )

@@ -69,7 +69,7 @@ from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from .agent import PlayerDV3, WorldModel, build_models
 from .args import DreamerV3Args
 from .loss import reconstruction_loss
-from .utils import preprocess_obs, test
+from .utils import make_device_preprocess, test
 
 
 class DV3TrainState(nn.Module):
@@ -570,9 +570,15 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
 
     player = make_player(state)
+
+    # pixels normalize INSIDE the jit: the host puts raw obs (uint8 -> 4x
+    # less transfer volume than pre-normalized f32) and the same device
+    # array is reused by rb.add below — one obs transfer per env step total
+    _dev_preprocess = make_device_preprocess(cnn_keys)
+
     player_step = jax.jit(
         lambda p, s, o, k, expl, mask: p.step(
-            s, o, k, expl, is_training=True, mask=mask
+            s, _dev_preprocess(o), k, expl, is_training=True, mask=mask
         )
     )
 
@@ -633,6 +639,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     step_data["rewards"] = np.zeros((args.num_envs, 1), np.float32)
     step_data["is_first"] = np.ones((args.num_envs, 1), np.float32)
     player_state = player.init_states(args.num_envs)
+    device_step_obs = None  # the policy step's obs puts, reused by rb.add
 
     gradient_steps = 0
     start_time = time.perf_counter()
@@ -650,10 +657,9 @@ def main(argv: Sequence[str] | None = None) -> None:
             actions = np.stack([p[0] for p in pairs])
             env_actions = [p[1] for p in pairs]
         else:
-            device_obs = {
-                k: jnp.asarray(v)
-                for k, v in preprocess_obs(obs, cnn_keys, mlp_keys).items()
-            }
+            # raw puts (uint8 for pixels): normalization happens inside the
+            # jitted player step, and these same device arrays feed rb.add
+            device_obs = {k: jnp.asarray(np.asarray(obs[k])) for k in obs_keys}
             mask = {k: v for k, v in device_obs.items() if k.startswith("mask")} or None
             key, step_key = jax.random.split(key)
             player_state, actions_dev = player_step(
@@ -663,9 +669,17 @@ def main(argv: Sequence[str] | None = None) -> None:
             actions = np.asarray(actions_dev)
             env_acts = one_hot_to_env_actions(actions, actions_dim, is_continuous)
             env_actions = list(env_acts)
+            device_step_obs = device_obs
 
         step_data["actions"] = actions.astype(np.float32)
-        rb.add({k: v[None] for k, v in step_data.items()})
+        add_data = {k: v[None] for k, v in step_data.items()}
+        if device_step_obs is not None and not rb.prefers_host_adds:
+            # reuse the policy step's obs puts instead of re-transferring
+            # (host/memmap storage and staged buffers want host numpy)
+            for k in obs_keys:
+                add_data[k] = device_step_obs[k][None]
+        rb.add(add_data)
+        device_step_obs = None
 
         next_obs, rewards, terms, truncs, infos = envs.step(env_actions)
         dones = np.logical_or(terms, truncs).astype(np.float32)

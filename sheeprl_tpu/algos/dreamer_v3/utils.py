@@ -11,7 +11,7 @@ import numpy as np
 from ...utils.env import make_dict_env
 from ..ppo.agent import one_hot_to_env_actions
 
-__all__ = ["preprocess_obs", "test"]
+__all__ = ["preprocess_obs", "make_device_preprocess", "test"]
 
 
 def preprocess_obs(obs: dict, cnn_keys, mlp_keys) -> dict:
@@ -23,6 +23,30 @@ def preprocess_obs(obs: dict, cnn_keys, mlp_keys) -> dict:
     for k in mlp_keys:
         out[k] = np.asarray(obs[k], dtype=np.float32)
     return out
+
+
+def make_device_preprocess(cnn_keys, offset: float = 0.0):
+    """jit-safe twin of `preprocess_obs`: the host puts RAW obs (uint8 for
+    pixels — 4x less transfer than pre-normalized f32, and reusable by the
+    replay add) and normalization runs inside the jitted policy step.
+    Key-based like the host version and the train step (dreamer_v3.py:155),
+    NOT dtype-based, so float-pixel envs normalize identically everywhere.
+    `offset=0.5` gives the V2 [-0.5, 0.5] convention (dreamer_v2.py:623)."""
+    import jax.numpy as jnp
+
+    cnn = frozenset(cnn_keys)
+
+    def prep(o):
+        return {
+            k: (
+                v.astype(jnp.float32) / 255.0 - offset
+                if k in cnn
+                else v.astype(jnp.float32)
+            )
+            for k, v in o.items()
+        }
+
+    return prep
 
 
 def test(

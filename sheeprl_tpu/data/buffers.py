@@ -806,6 +806,15 @@ class AsyncReplayBuffer:
         return self._n_envs
 
     @property
+    def prefers_host_adds(self) -> bool:
+        """True when `add` wants host numpy values: host/memmap storage
+        (device arrays would force a blocking device->host pull per key),
+        or opt-in staging (which batches HOST rows and skips any add that
+        carries a device array). The mains consult this before reusing the
+        policy step's device obs puts in `add`."""
+        return self._storage_kind != "device" or self._stage_cap > 0
+
+    @property
     def full(self):
         if self._storage_kind == "device":
             if self._store is None and not self._staged:
@@ -851,11 +860,26 @@ class AsyncReplayBuffer:
         return sub
 
     @staticmethod
-    @partial(jax.jit, donate_argnums=0)
-    def _store_add(store, data, rows, cols):
-        """Scatter `[T, n]`-column data at per-env write heads: one dispatch
-        for all envs and keys (rows [T, n] absolute ring indices, cols [n]
-        env columns)."""
+    @partial(jax.jit, donate_argnums=0, static_argnums=(4, 5))
+    def _store_add_packed(store, direct, packed, idx, layout, data_len):
+        """Per-step scatter fed by ONE host->device transfer per dtype group
+        (plus the write-head/env indices riding the int32 group) instead of
+        one per key. On a tunneled backend every `device_put` is a host
+        round-trip, so the per-step add cost is transfer *count*, not bytes —
+        this is what closed the duty-vs-e2e gap (BENCHES.md round 3).
+
+        `direct` holds values already resident on device (the training loops
+        reuse the policy step's obs put); `packed[dtype]` is the flat
+        concatenation of the host values of that dtype, unpacked here by the
+        static `layout` of `(key, dtype_str, shape, offset, size)` rows.
+        `idx` is `concat(starts, cols)` as int32."""
+        capacity = next(iter(store.values())).shape[0]
+        n_sel = idx.shape[0] // 2
+        starts, cols = idx[:n_sel], idx[n_sel:]
+        rows = (starts[None, :] + jnp.arange(data_len)[:, None]) % capacity
+        data = dict(direct)
+        for k, ds, shape, off, size in layout:
+            data[k] = packed[ds][off : off + size].reshape(shape)
         return {
             k: store[k].at[rows, cols[None, :]].set(data[k].astype(store[k].dtype))
             for k in store
@@ -879,12 +903,8 @@ class AsyncReplayBuffer:
             total = self._buffer_size
         if self._store is None:
             self._allocate_store(data)
-        rows = (start[None, :] + np.arange(total)[:, None]) % self._buffer_size
-        self._store = self._store_add(
-            self._store,
-            {k: jnp.asarray(v) for k, v in data.items()},
-            jnp.asarray(rows),
-            jnp.asarray(np.arange(self._n_envs, dtype=np.int64)),
+        self._store = self._packed_scatter(
+            data, start, np.arange(self._n_envs, dtype=np.int64), total
         )
 
     def _set_at(self, env: int, key: str, time_idx: int, value) -> None:
@@ -941,15 +961,35 @@ class AsyncReplayBuffer:
         if self._store is None:
             self._allocate_store(data)
         starts = self._upos[cols]
-        rows = (starts[None, :] + np.arange(data_len)[:, None]) % self._buffer_size
-        self._store = self._store_add(
-            self._store,
-            {k: jnp.asarray(v) for k, v in data.items()},
-            jnp.asarray(rows),
-            jnp.asarray(cols),
-        )
+        self._store = self._packed_scatter(data, starts, cols, data_len)
         self._ufull[cols] |= starts + data_len >= self._buffer_size
         self._upos[cols] = (starts + data_len) % self._buffer_size
+
+    def _packed_scatter(self, data, starts, cols, data_len):
+        """Pack host values into one transfer per dtype and scatter; values
+        already on device (e.g. the policy step's obs put, reused by the
+        mains) go straight into the scatter without another round-trip."""
+        direct: dict[str, jax.Array] = {}
+        groups: dict[str, list[np.ndarray]] = {}
+        offsets: dict[str, int] = {}
+        layout: list[tuple] = []
+        for k, v in data.items():
+            if isinstance(v, jax.Array):
+                direct[k] = v
+                continue
+            v = np.asarray(v)
+            ds = v.dtype.str
+            off = offsets.get(ds, 0)
+            groups.setdefault(ds, []).append(v.reshape(-1))
+            layout.append((k, ds, v.shape, off, v.size))
+            offsets[ds] = off + v.size
+        packed = {
+            ds: jnp.asarray(np.concatenate(parts)) for ds, parts in groups.items()
+        }
+        idx = jnp.asarray(np.concatenate([starts, cols]).astype(np.int32))
+        return self._store_add_packed(
+            self._store, direct, packed, idx, tuple(layout), data_len
+        )
 
     # -- sampling -------------------------------------------------------------
     def _partition(self, batch_size: int) -> np.ndarray:
@@ -989,14 +1029,23 @@ class AsyncReplayBuffer:
         static_argnames=("n_samples", "seq_len", "sequential", "sample_next_obs", "obs_keys"),
     )
     def _store_sample(
-        store, key, env_idx, first, n_valid, pos,
+        store, key, packed_idx,
         n_samples, seq_len, sequential, sample_next_obs, obs_keys,
     ):
         """One gather for the whole batch: each output row draws a start
         index inside its env's validity window, windows index the ring
-        modulo capacity, and the env column selects the ring."""
-        capacity = next(iter(store.values())).shape[0]
-        bd = env_idx.shape[0]
+        modulo capacity, and the env column selects the ring. `packed_idx`
+        is `concat(env_idx, first, n_valid, pos)` as int32 — one transfer
+        for all four index vectors (transfer count, not bytes, is the cost
+        on a tunneled backend)."""
+        capacity, n_envs = next(iter(store.values())).shape[:2]
+        bd = packed_idx.shape[0] - 3 * n_envs
+        env_idx = packed_idx[:bd]
+        first, n_valid, pos = (
+            packed_idx[bd : bd + n_envs],
+            packed_idx[bd + n_envs : bd + 2 * n_envs],
+            packed_idx[bd + 2 * n_envs :],
+        )
         nv = n_valid[env_idx]
         # exact integer sampling (matching the base ReplayBuffer paths):
         # float32-uniform scaling biases windows approaching 2^24 entries and
@@ -1066,10 +1115,11 @@ class AsyncReplayBuffer:
         return self._store_sample(
             self._store,
             self._next_key(),
-            jnp.asarray(env_idx),
-            jnp.asarray(first.astype(np.int32)),
-            jnp.asarray(n_valid.astype(np.int32)),
-            jnp.asarray(self._upos.astype(np.int32)),
+            jnp.asarray(
+                np.concatenate(
+                    [env_idx, first, n_valid, self._upos]
+                ).astype(np.int32)
+            ),
             n_samples,
             seq_len,
             self._sequential,

@@ -3,6 +3,7 @@
 oversized inserts, sample-validity windows, memmap variants — for both the
 HBM (device) and host storage backends."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -413,3 +414,56 @@ class TestAsyncUnifiedDeviceStore:
         assert col.shape == (8, 1, 1)
         assert np.asarray(col)[:4, 0, 0].tolist() == [0.0, 1.0, 2.0, 3.0]
         assert np.asarray(dst.buffer[1].buffer["observations"]).max() == 0.0
+
+
+class TestPackedDeviceAdds:
+    """Round-3 transfer packing: the device add ships ONE host->device
+    transfer per dtype group (plus packed indices), and values already on
+    device (the mains reuse the policy step's obs put) scatter directly."""
+
+    def test_device_resident_values_scatter_directly(self):
+        arb = AsyncReplayBuffer(8, n_envs=2, storage="device", sequential=True,
+                                obs_keys=("rgb",))
+        rgb = np.arange(2 * 4, dtype=np.uint8).reshape(1, 2, 4)
+        arb.add({
+            "rgb": jnp.asarray(rgb),  # device-resident (direct path)
+            "rewards": np.ones((1, 2, 1), np.float32),  # host (packed path)
+        })
+        ring = np.asarray(arb.buffer[0].buffer["rgb"])
+        assert ring.dtype == np.uint8
+        assert ring[0, 0].tolist() == rgb[0, 0].tolist()
+        assert np.asarray(arb.buffer[1].buffer["rewards"])[0, 0, 0] == 1.0
+
+    def test_mixed_dtype_groups_pack_and_unpack(self):
+        arb = AsyncReplayBuffer(8, n_envs=3, storage="device", sequential=True,
+                                obs_keys=("rgb",))
+        rng = np.random.default_rng(0)
+        data = {
+            "rgb": rng.integers(0, 255, (2, 3, 5), dtype=np.uint8),
+            "vec": rng.normal(size=(2, 3, 4)).astype(np.float32),
+            "rewards": rng.normal(size=(2, 3, 1)).astype(np.float32),
+        }
+        arb.add(data)
+        for k, v in data.items():
+            ring = np.stack(
+                [np.asarray(arb.buffer[e].buffer[k])[:2, 0] for e in range(3)],
+                axis=1,
+            )
+            np.testing.assert_array_equal(ring, v)
+
+    def test_subset_indices_through_packed_path(self):
+        arb = AsyncReplayBuffer(8, n_envs=3, storage="device", sequential=True)
+        arb.add({"observations": np.zeros((1, 3, 1), np.float32)})
+        arb.add({"observations": np.full((1, 2, 1), 9.0, np.float32)},
+                indices=[0, 2])
+        assert [b.pos for b in arb.buffer] == [2, 1, 2]
+        assert np.asarray(arb.buffer[2].buffer["observations"])[1, 0, 0] == 9.0
+        assert np.asarray(arb.buffer[1].buffer["observations"])[1, 0, 0] == 0.0
+
+    def test_prefers_host_adds(self):
+        dev = AsyncReplayBuffer(8, n_envs=1, storage="device")
+        host = AsyncReplayBuffer(8, n_envs=1, storage="host")
+        staged = AsyncReplayBuffer(8, n_envs=1, storage="device", stage_rows=16)
+        assert not dev.prefers_host_adds
+        assert host.prefers_host_adds
+        assert staged.prefers_host_adds

@@ -1,0 +1,178 @@
+"""Interleaved A/B probe for the replay-transfer packing (round 3).
+
+The tunneled TPU backend's throughput drifts on a scale of minutes, so
+sequential before/after runs confound the code change with tunnel weather.
+This probe alternates the two variants ABAB... inside ONE process against
+the same model state, so each pair of adjacent trials sees the same tunnel:
+
+  legacy: pre-normalized f32 obs put per policy step + per-key transfers in
+          the replay add (the round-2 path, emulated via `_store_add`);
+  packed: raw uint8 obs put normalized inside the jit, the same device
+          array reused by the add, and one transfer per dtype group in the
+          add (the round-3 path: AsyncReplayBuffer._store_add_packed).
+
+Usage: python tools/e2e_ab_probe.py [--trials 8] [--cycles 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--trials", type=int, default=8)
+    p.add_argument("--cycles", type=int, default=5)
+    p.add_argument("--tiny", action="store_true")
+    a = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.data import AsyncReplayBuffer, stage_batch
+
+    print(f"backend: {jax.devices()}", file=sys.stderr)
+    args, state, opts, actions_dim, is_continuous, _ = bench._dv3_setup(a.tiny)
+    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
+    n_envs = args.num_envs
+    world_opt, actor_opt, critic_opt = opts
+    train_step = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim, is_continuous
+    )
+    make_player, player_step = bench._dv3_player_fns(args, actions_dim, is_continuous)
+    # round-2 player contract: obs arrive pre-normalized, no in-jit prep
+    legacy_player_step = jax.jit(
+        lambda p, s, o, k, mask: p.step(
+            s, o, k, jnp.float32(0.0), is_training=True, mask=mask
+        )
+    )
+
+    rng = np.random.default_rng(0)
+
+    def fake_env_obs():
+        return rng.integers(0, 255, (n_envs, 64, 64, 3), dtype=np.uint8)
+
+    def host_step_data(obs_u8):
+        return {
+            "rgb": obs_u8[None],
+            "actions": np.eye(6, dtype=np.float32)[rng.integers(0, 6, (n_envs,))][None],
+            "rewards": rng.normal(size=(1, n_envs, 1)).astype(np.float32),
+            "dones": np.zeros((1, n_envs, 1), np.float32),
+            "is_first": np.zeros((1, n_envs, 1), np.float32),
+        }
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=0)
+    def _legacy_store_add(store, data, rows, cols):
+        # the round-2 scatter (removed from buffers.py when packing landed)
+        return {
+            k: store[k].at[rows, cols[None, :]].set(data[k].astype(store[k].dtype))
+            for k in store
+        }
+
+    def legacy_add(rb, data):
+        """The round-2 device add: one host->device transfer PER KEY plus
+        rows/cols index puts (buffers.py pre-packing)."""
+        cols = np.arange(rb._n_envs, dtype=np.int64)
+        starts = rb._upos[cols]
+        data_len = 1
+        rows = (starts[None, :] + np.arange(data_len)[:, None]) % rb._buffer_size
+        rb._store = _legacy_store_add(
+            rb._store,
+            {k: jnp.asarray(v) for k, v in data.items()},
+            jnp.asarray(rows),
+            jnp.asarray(cols),
+        )
+        rb._ufull[cols] |= starts + data_len >= rb._buffer_size
+        rb._upos[cols] = (starts + data_len) % rb._buffer_size
+
+    def make_variant(packed: bool):
+        rb = AsyncReplayBuffer(
+            max(4 * T, 64), n_envs, storage="device", sequential=True,
+            obs_keys=("rgb",), seed=0,
+        )
+        for _ in range(2 * T + 8):
+            rb.add(host_step_data(fake_env_obs()))
+        st = jax.tree_util.tree_map(jnp.copy, state)
+        ps = make_player(st).init_states(n_envs)
+        key = jax.random.PRNGKey(1)
+        box = {"state": st, "ps": ps, "key": key}
+
+        def one_cycle():
+            player = make_player(box["state"])
+            for _ in range(args.train_every):
+                obs_u8 = fake_env_obs()
+                box["key"], sk = jax.random.split(box["key"])
+                if packed:
+                    dev_u8 = jnp.asarray(obs_u8)
+                    box["ps"], _ = player_step(
+                        player, box["ps"], {"rgb": dev_u8}, sk, None
+                    )
+                    step = host_step_data(obs_u8)
+                    step["rgb"] = dev_u8[None]
+                    rb.add(step)
+                else:
+                    # faithful round-2 path: HOST-side normalize, f32 put
+                    # (4x the bytes), then per-key transfers in the add
+                    dev_obs = {
+                        "rgb": jnp.asarray(
+                            np.asarray(obs_u8, dtype=np.float32) / 255.0
+                        )
+                    }
+                    box["ps"], _ = legacy_player_step(player, box["ps"], dev_obs, sk, None)
+                    legacy_add(rb, host_step_data(obs_u8))
+            local = rb.sample(B, sequence_length=T, n_samples=1)
+            staged = stage_batch(local)
+            sample = {k: v[0] for k, v in staged.items()}
+            box["key"], tk = jax.random.split(box["key"])
+            box["state"], metrics = train_step(
+                box["state"], sample, tk, jnp.float32(0.02)
+            )
+            float(jax.device_get(metrics["Loss/reconstruction_loss"]))
+
+        return one_cycle
+
+    variants = {"legacy": make_variant(False), "packed": make_variant(True)}
+    for name, cyc in variants.items():  # compile both before timing
+        cyc()
+        print(f"compiled {name}", file=sys.stderr)
+
+    results: dict[str, list[float]] = {"legacy": [], "packed": []}
+    order = ["legacy", "packed"]
+    for trial in range(a.trials):
+        name = order[trial % 2]
+        t0 = time.perf_counter()
+        for _ in range(a.cycles):
+            variants[name]()
+        dt = time.perf_counter() - t0
+        sps = a.cycles * args.train_every * n_envs / dt
+        results[name].append(round(sps, 1))
+        print(f"trial {trial} {name}: {sps:.1f} sps", file=sys.stderr)
+
+    med = {k: float(np.median(v)) for k, v in results.items()}
+    print(
+        json.dumps(
+            {
+                "runs": results,
+                "median": med,
+                "packed_over_legacy": round(med["packed"] / med["legacy"], 3)
+                if med["legacy"]
+                else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

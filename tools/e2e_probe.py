@@ -1,0 +1,48 @@
+"""One-off probe: time the honest DV3 e2e loop (bench._dv3_e2e_sps) on the
+current backend, in isolation from the full bench sweep. Used to A/B the
+replay-transfer packing work (round 3) without paying the full artifact run.
+
+Usage: python tools/e2e_probe.py [--tiny] [--repeats N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--repeats", type=int, default=3)
+    a = p.parse_args()
+
+    import jax
+
+    import bench
+
+    print(f"backend: {jax.devices()}", file=sys.stderr)
+    args, state, opts, actions_dim, is_continuous, obs_space = bench._dv3_setup(
+        a.tiny
+    )
+    results = []
+    for i in range(a.repeats):
+        t0 = time.perf_counter()
+        sps = bench._measure_guarded(
+            bench._dv3_e2e_sps, args, state, opts, actions_dim, is_continuous, a.tiny
+        )
+        results.append(round(sps, 1))
+        print(
+            f"run {i}: e2e_sps={sps:.1f} ({time.perf_counter() - t0:.1f}s wall)",
+            file=sys.stderr,
+        )
+    print(json.dumps({"e2e_sps_runs": results, "best": max(results)}))
+
+
+if __name__ == "__main__":
+    main()

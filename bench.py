@@ -446,7 +446,9 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
             _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
             discards,
         )
-        bf16_win = bf16_sps > candidates[best_fams]
+        # same valid-baseline guard as kernels_win: a zeroed f32 baseline
+        # (all candidates discarded/failed) must not hand bf16 a free win
+        bf16_win = candidates[best_fams] > 0.0 and bf16_sps > candidates[best_fams]
         args.precision = "bfloat16" if bf16_win else "float32"
     duty_sps = max(max(candidates.values()), bf16_sps or 0.0)
     implied_tflops = duty_sps / 20.0 * DV3_TFLOPS_PER_20_STEPS

@@ -45,7 +45,7 @@ from ...utils.registry import register_algorithm
 from ..args import require_float32
 from ..ppo.agent import one_hot_to_env_actions
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
-from ..dreamer_v2.utils import preprocess_obs, test
+from ..dreamer_v2.utils import make_device_preprocess, substitute_step_obs, test
 from ..dreamer_v3.agent import WorldModel
 from ..dreamer_v3.dreamer_v3 import _random_actions
 from .agent import PlayerDV1, build_models
@@ -404,9 +404,16 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
 
     player = make_player(state)
+
+    # raw obs puts (uint8 pixels), normalized inside the jit; the same
+    # device arrays feed rb.add (see dreamer_v3.py — V2 row layout here:
+    # the stored obs is real_next_obs, which equals the NEXT policy obs
+    # whenever no env finished, so the put is shared across both uses)
+    _dev_preprocess = make_device_preprocess(cnn_keys)
+
     player_step = jax.jit(
         lambda p, s, o, k, expl, mask: p.step(
-            s, o, k, expl, is_training=True, mask=mask
+            s, _dev_preprocess(o), k, expl, is_training=True, mask=mask
         )
     )
     train_step = make_train_step(
@@ -414,6 +421,12 @@ def main(argv: Sequence[str] | None = None) -> None:
         mlp_keys, mesh=mesh,
     )
 
+    if args.dry_run:
+        # the dry run adds ~2 rows before its single update fires
+        # (step_before_training=0): clamp the sampled window so the smoke
+        # runs on DEFAULT flags instead of raising "too long
+        # sequence_length" from a 2-row ring
+        args.per_rank_sequence_length = min(args.per_rank_sequence_length, 2)
     buffer_size = args.buffer_size // (args.num_envs * world) if not args.dry_run else 4
     rb = AsyncReplayBuffer(
         max(buffer_size, args.per_rank_sequence_length),
@@ -460,6 +473,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     step_data["rewards"] = np.zeros((args.num_envs, 1), np.float32)
     rb.add({k: v[None] for k, v in step_data.items()})
     player_state = player.init_states(args.num_envs)
+    device_next_obs = None  # this step's obs put, shared policy<->rb.add
 
     gradient_steps = 0
     start_time = time.perf_counter()
@@ -476,10 +490,11 @@ def main(argv: Sequence[str] | None = None) -> None:
             actions = np.stack([p[0] for p in pairs])
             env_actions = [p[1] for p in pairs]
         else:
-            device_obs = {
-                k: jnp.asarray(v)
-                for k, v in preprocess_obs(obs, cnn_keys, mlp_keys).items()
-            }
+            if device_next_obs is None:
+                device_next_obs = {
+                    k: jnp.asarray(np.asarray(obs[k])) for k in obs_keys
+                }
+            device_obs = device_next_obs
             mask = {k: v for k, v in device_obs.items() if k.startswith("mask")} or None
             key, step_key = jax.random.split(key)
             player_state, actions_dev = player_step(
@@ -513,7 +528,11 @@ def main(argv: Sequence[str] | None = None) -> None:
         step_data["rewards"] = (
             np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
         ).astype(np.float32)
-        rb.add({k: v[None] for k, v in step_data.items()})
+        add_data = {k: v[None] for k, v in step_data.items()}
+        # one put for this step's obs: the add consumes it now and the
+        # next policy step reuses it (unless an env resets below)
+        device_next_obs = substitute_step_obs(add_data, rb, real_next_obs, obs_keys)
+        rb.add(add_data)
 
         dones_idxes = np.nonzero(dones)[0].tolist()
         if dones_idxes:
@@ -525,6 +544,9 @@ def main(argv: Sequence[str] | None = None) -> None:
             )
             reset_data["rewards"] = np.zeros((n_reset, 1), np.float32)
             rb.add({k: v[None] for k, v in reset_data.items()}, dones_idxes)
+            # finished envs observe their RESET obs next, not the stored
+            # final obs: drop the shared put and re-put next iteration
+            device_next_obs = None
             step_data["dones"][dones_idxes] = 0.0
             reset_mask = np.zeros((args.num_envs,), np.float32)
             reset_mask[dones_idxes] = 1.0

@@ -61,7 +61,7 @@ from ..dreamer_v3.dreamer_v3 import _random_actions
 from .agent import PlayerDV2, build_models
 from .args import DreamerV2Args
 from .loss import reconstruction_loss
-from .utils import preprocess_obs, test
+from .utils import make_device_preprocess, substitute_step_obs, test
 
 
 class DV2TrainState(nn.Module):
@@ -510,9 +510,16 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
 
     player = make_player(state)
+
+    # raw obs puts (uint8 pixels), normalized inside the jit in the V2
+    # [-0.5, 0.5] convention; with the sequential buffer the same device
+    # arrays feed rb.add (V2 row layout: the stored obs is real_next_obs,
+    # which equals the NEXT policy obs whenever no env finished)
+    _dev_preprocess = make_device_preprocess(cnn_keys)
+
     player_step = jax.jit(
         lambda p, s, o, k, expl, mask: p.step(
-            s, o, k, expl, is_training=True, mask=mask
+            s, _dev_preprocess(o), k, expl, is_training=True, mask=mask
         )
     )
     train_step = make_train_step(
@@ -527,6 +534,12 @@ def main(argv: Sequence[str] | None = None) -> None:
         mesh=mesh,
     )
 
+    if args.dry_run:
+        # the dry run adds ~2 rows before its single update fires
+        # (step_before_training=0): clamp the sampled window so the smoke
+        # runs on DEFAULT flags instead of raising "too long
+        # sequence_length" from a 2-row ring
+        args.per_rank_sequence_length = min(args.per_rank_sequence_length, 2)
     buffer_size = args.buffer_size // (args.num_envs * world) if not args.dry_run else 4
     buffer_type = args.buffer_type.lower()
     if buffer_type == "sequential":
@@ -595,6 +608,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         for i in range(args.num_envs):
             episode_steps[i].append({k: v[i] for k, v in step_data.items()})
     player_state = player.init_states(args.num_envs)
+    device_next_obs = None  # this step's obs put, shared policy<->rb.add
 
     gradient_steps = 0
     start_time = time.perf_counter()
@@ -612,10 +626,11 @@ def main(argv: Sequence[str] | None = None) -> None:
             actions = np.stack([p[0] for p in pairs])
             env_actions = [p[1] for p in pairs]
         else:
-            device_obs = {
-                k: jnp.asarray(v)
-                for k, v in preprocess_obs(obs, cnn_keys, mlp_keys).items()
-            }
+            if device_next_obs is None:
+                device_next_obs = {
+                    k: jnp.asarray(np.asarray(obs[k])) for k in obs_keys
+                }
+            device_obs = device_next_obs
             mask = {k: v for k, v in device_obs.items() if k.startswith("mask")} or None
             key, step_key = jax.random.split(key)
             player_state, actions_dev = player_step(
@@ -655,8 +670,14 @@ def main(argv: Sequence[str] | None = None) -> None:
             np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
         ).astype(np.float32)
         if buffer_type == "sequential":
-            rb.add({k: v[None] for k, v in step_data.items()})
+            add_data = {k: v[None] for k, v in step_data.items()}
+            # one put for this step's obs: the add consumes it now and the
+            # next policy step reuses it (unless an env resets below)
+            device_next_obs = substitute_step_obs(add_data, rb, real_next_obs, obs_keys)
+            rb.add(add_data)
         else:
+            # the episode accumulator keeps host rows; re-put next step
+            device_next_obs = None
             for i in range(args.num_envs):
                 episode_steps[i].append({k: v[i] for k, v in step_data.items()})
 
@@ -683,6 +704,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                     ]
             else:
                 rb.add({k: v[None] for k, v in reset_data.items()}, dones_idxes)
+            # finished envs observe their RESET obs next, not the stored
+            # final obs: drop the shared put and re-put next iteration
+            device_next_obs = None
             step_data["dones"][dones_idxes] = 0.0
             reset_mask = np.zeros((args.num_envs,), np.float32)
             reset_mask[dones_idxes] = 1.0

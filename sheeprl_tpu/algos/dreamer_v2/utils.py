@@ -11,7 +11,7 @@ import numpy as np
 from ...utils.env import make_dict_env
 from ..ppo.agent import one_hot_to_env_actions
 
-__all__ = ["preprocess_obs", "test"]
+__all__ = ["preprocess_obs", "make_device_preprocess", "substitute_step_obs", "test"]
 
 
 def preprocess_obs(obs: dict, cnn_keys, mlp_keys) -> dict:
@@ -23,6 +23,30 @@ def preprocess_obs(obs: dict, cnn_keys, mlp_keys) -> dict:
     for k in mlp_keys:
         out[k] = np.asarray(obs[k], dtype=np.float32)
     return out
+
+
+def make_device_preprocess(cnn_keys):
+    """jit-safe twin of `preprocess_obs` in the V2 [-0.5, 0.5] convention:
+    raw host puts (uint8 pixels), normalization inside the jitted policy
+    step. See dreamer_v3.utils.make_device_preprocess."""
+    from ..dreamer_v3.utils import make_device_preprocess as _mk
+
+    return _mk(cnn_keys, offset=0.5)
+
+
+def substitute_step_obs(add_data, rb, real_next_obs, obs_keys):
+    """Share ONE device put of this step's stored obs between `rb.add` and
+    the next policy step (V2 row layout: the stored obs is `real_next_obs`,
+    which IS the next policy obs whenever no env finished — callers must
+    drop the returned dict on env resets). Overwrites `add_data`'s obs keys
+    in place and returns the put, or None when the buffer wants host rows
+    (host/memmap storage, opt-in staging)."""
+    if rb.prefers_host_adds:
+        return None
+    dev = {k: jax.numpy.asarray(real_next_obs[k]) for k in obs_keys}
+    for k in obs_keys:
+        add_data[k] = dev[k][None]
+    return dev
 
 
 def test(

@@ -392,6 +392,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     obs, _ = envs.reset(seed=args.seed)
     obs = {k: np.asarray(obs[k]) for k in obs_keys}
+    device_obs = None  # this step's obs put, reused by rb.add's row
     start_time = time.perf_counter()
 
     for global_step in range(start_step, num_updates + 1):
@@ -401,7 +402,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             )
         else:
             key, step_key = jax.random.split(key)
-            device_obs = {k: jnp.asarray(v) for k, v in obs.items()}
+            if device_obs is None:
+                device_obs = {k: jnp.asarray(v) for k, v in obs.items()}
             actions = np.asarray(
                 policy_step(
                     state.agent.actor, state.agent.critic.encoder, device_obs, step_key
@@ -411,17 +413,40 @@ def main(argv: Sequence[str] | None = None) -> None:
         dones = np.logical_or(terms, truncs).astype(np.float32)
 
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+        any_final = False
         for i, info in enumerate(infos):
             if "final_observation" in info:
+                any_final = True
                 for k in obs_keys:
                     real_next_obs[k][i] = info["final_observation"][k]
             if "episode" in info:
                 aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
                 aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
 
-        row = {k: obs[k][None] for k in obs_keys}
+        # the row's obs reuses this step's policy put; next_obs is put once
+        # and (when no env finished) reused as the NEXT policy step's obs —
+        # one obs transfer per env step instead of three. Host/memmap
+        # buffers get host rows (a device array would force a blocking
+        # device->host pull per key)
+        reuse_put = device_obs is not None and not rb.prefers_host_adds
+        row = {
+            k: (device_obs[k][None] if reuse_put else obs[k][None])
+            for k in obs_keys
+        }
+        device_next = None
+        if not rb.prefers_host_adds:
+            device_next = {k: jnp.asarray(real_next_obs[k]) for k in obs_keys}
         if not args.sample_next_obs:
-            row.update({f"next_{k}": real_next_obs[k][None] for k in obs_keys})
+            row.update(
+                {
+                    f"next_{k}": (
+                        device_next[k][None]
+                        if device_next is not None
+                        else real_next_obs[k][None]
+                    )
+                    for k in obs_keys
+                }
+            )
         row.update(
             actions=actions.reshape(args.num_envs, -1)[None].astype(np.float32),
             rewards=rewards.reshape(args.num_envs, 1)[None],
@@ -429,6 +454,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
         rb.add(row)
         obs = {k: np.asarray(next_obs[k]) for k in obs_keys}
+        # finished envs observe their RESET obs next, not the stored final
+        # obs; re-put next iteration in that case
+        device_obs = device_next if not any_final else None
 
         if global_step >= learning_starts - 1 and rb.can_sample(args.sample_next_obs):
             training_steps = (

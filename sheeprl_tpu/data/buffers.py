@@ -97,6 +97,41 @@ def _as_time_env(data: Mapping[str, np.ndarray]) -> Batch:
     return d
 
 
+def _pack_host_values(data: Mapping[str, "np.ndarray | jax.Array"]):
+    """Split an add batch into device-resident values (`direct` — e.g. the
+    policy step's obs put, reused by the mains) and host values packed into
+    ONE flat array per dtype. On a tunneled backend every `device_put` is a
+    host round-trip, so the per-step add cost is transfer *count*, not
+    bytes. Returns `(direct, packed, layout)`; the static `layout` of
+    `(key, dtype_str, shape, offset, size)` rows unpacks on device."""
+    direct: dict[str, jax.Array] = {}
+    groups: dict[str, list[np.ndarray]] = {}
+    offsets: dict[str, int] = {}
+    layout: list[tuple] = []
+    for k, v in data.items():
+        if isinstance(v, jax.Array):
+            direct[k] = v
+            continue
+        v = np.asarray(v)
+        ds = v.dtype.str
+        off = offsets.get(ds, 0)
+        groups.setdefault(ds, []).append(v.reshape(-1))
+        layout.append((k, ds, v.shape, off, v.size))
+        offsets[ds] = off + v.size
+    packed = {
+        ds: jnp.asarray(np.concatenate(parts)) for ds, parts in groups.items()
+    }
+    return direct, packed, tuple(layout)
+
+
+def _unpack_values(direct, packed, layout):
+    """Device-side inverse of `_pack_host_values` (runs inside jit)."""
+    data = dict(direct)
+    for k, ds, shape, off, size in layout:
+        data[k] = packed[ds][off : off + size].reshape(shape)
+    return data
+
+
 class ReplayBuffer:
     """Circular buffer `[capacity, n_envs]`; uniform sampling."""
 
@@ -132,6 +167,14 @@ class ReplayBuffer:
     @property
     def buffer(self):
         return self._buf
+
+    @property
+    def prefers_host_adds(self) -> bool:
+        """True when `add` wants host numpy values (host/memmap storage:
+        device arrays would force a blocking device->host pull per key).
+        The mains consult this before reusing the policy step's device obs
+        puts in `add`."""
+        return self._storage_kind != "device"
 
     @property
     def buffer_size(self) -> int:
@@ -214,11 +257,13 @@ class ReplayBuffer:
 
     # -- add -----------------------------------------------------------------
     @staticmethod
-    @partial(jax.jit, donate_argnums=0)
-    def _device_add(buf, data, pos):
-        data_len = next(iter(data.values())).shape[0]
+    @partial(jax.jit, donate_argnums=0, static_argnums=(4, 5))
+    def _device_add(buf, direct, packed, pos, layout, data_len):
+        """Append at the write head with ONE host->device transfer per dtype
+        group (see `_pack_host_values`); `pos` rides as a scalar put."""
         capacity = next(iter(buf.values())).shape[0]
         idxes = (pos + jnp.arange(data_len)) % capacity
+        data = _unpack_values(direct, packed, layout)
         return {k: buf[k].at[idxes].set(data[k].astype(buf[k].dtype)) for k in buf}
 
     def add(self, data: Mapping[str, np.ndarray] | "ReplayBuffer") -> None:
@@ -241,8 +286,10 @@ class ReplayBuffer:
         if self._buf is None:
             self._allocate(data)
         if self._storage_kind == "device":
+            direct, packed, layout = _pack_host_values(data)
             self._buf = self._device_add(
-                self._buf, {k: jnp.asarray(v) for k, v in data.items()}, self._pos
+                self._buf, direct, packed,
+                jnp.asarray(np.int32(self._pos)), layout, data_len,
             )
         else:
             idxes = (self._pos + np.arange(data_len)) % self._buffer_size
@@ -276,9 +323,12 @@ class ReplayBuffer:
     @staticmethod
     @partial(jax.jit, static_argnames=("batch_size", "n_envs", "sample_next_obs", "obs_keys"))
     def _device_sample(
-        buf, key, batch_size, n_envs, first, n_valid, pos, sample_next_obs, obs_keys
+        buf, key, batch_size, n_envs, fnp, sample_next_obs, obs_keys
     ):
+        """`fnp` packs (first, n_valid, pos) as one int32 put — transfer
+        count, not bytes, is the cost on a tunneled backend."""
         capacity = next(iter(buf.values())).shape[0]
+        first, n_valid, pos = fnp[0], fnp[1], fnp[2]
         k1, k2 = jax.random.split(key)
         r = jax.random.randint(k1, (batch_size,), 0, n_valid)
         idx = jnp.where(r < first, r, r - first + pos)
@@ -319,9 +369,7 @@ class ReplayBuffer:
                 self._next_key(),
                 batch_size,
                 self._n_envs,
-                first,
-                n_valid,
-                self._pos,
+                jnp.asarray(np.array([first, n_valid, self._pos], np.int32)),
                 sample_next_obs,
                 self.obs_keys if sample_next_obs else (),
             )
@@ -410,10 +458,12 @@ class SequentialReplayBuffer(ReplayBuffer):
         static_argnames=("batch_size", "n_samples", "seq_len", "n_envs", "sample_next_obs", "obs_keys"),
     )
     def _device_sample_seq(
-        buf, key, batch_size, n_samples, seq_len, n_envs, first, n_valid, pos,
+        buf, key, batch_size, n_samples, seq_len, n_envs, fnp,
         sample_next_obs, obs_keys,
     ):
+        """`fnp` packs (first, n_valid, pos) as one int32 put."""
         capacity = next(iter(buf.values())).shape[0]
+        first, n_valid, pos = fnp[0], fnp[1], fnp[2]
         batch_dim = batch_size * n_samples
         k1, k2 = jax.random.split(key)
         r = jax.random.randint(k1, (batch_dim,), 0, n_valid)
@@ -459,9 +509,7 @@ class SequentialReplayBuffer(ReplayBuffer):
                 n_samples,
                 sequence_length,
                 self._n_envs,
-                first,
-                n_valid,
-                self._pos,
+                jnp.asarray(np.array([first, n_valid, self._pos], np.int32)),
                 sample_next_obs,
                 self.obs_keys if sample_next_obs else (),
             )
@@ -877,9 +925,7 @@ class AsyncReplayBuffer:
         n_sel = idx.shape[0] // 2
         starts, cols = idx[:n_sel], idx[n_sel:]
         rows = (starts[None, :] + jnp.arange(data_len)[:, None]) % capacity
-        data = dict(direct)
-        for k, ds, shape, off, size in layout:
-            data[k] = packed[ds][off : off + size].reshape(shape)
+        data = _unpack_values(direct, packed, layout)
         return {
             k: store[k].at[rows, cols[None, :]].set(data[k].astype(store[k].dtype))
             for k in store
@@ -969,26 +1015,10 @@ class AsyncReplayBuffer:
         """Pack host values into one transfer per dtype and scatter; values
         already on device (e.g. the policy step's obs put, reused by the
         mains) go straight into the scatter without another round-trip."""
-        direct: dict[str, jax.Array] = {}
-        groups: dict[str, list[np.ndarray]] = {}
-        offsets: dict[str, int] = {}
-        layout: list[tuple] = []
-        for k, v in data.items():
-            if isinstance(v, jax.Array):
-                direct[k] = v
-                continue
-            v = np.asarray(v)
-            ds = v.dtype.str
-            off = offsets.get(ds, 0)
-            groups.setdefault(ds, []).append(v.reshape(-1))
-            layout.append((k, ds, v.shape, off, v.size))
-            offsets[ds] = off + v.size
-        packed = {
-            ds: jnp.asarray(np.concatenate(parts)) for ds, parts in groups.items()
-        }
+        direct, packed, layout = _pack_host_values(data)
         idx = jnp.asarray(np.concatenate([starts, cols]).astype(np.int32))
         return self._store_add_packed(
-            self._store, direct, packed, idx, tuple(layout), data_len
+            self._store, direct, packed, idx, layout, data_len
         )
 
     # -- sampling -------------------------------------------------------------

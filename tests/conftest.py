@@ -22,14 +22,20 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("SHEEPRL_TPU_TEST", "1")
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# importing the package wires the persistent XLA compilation cache (honoring
+# SHEEPRL_TPU_XLA_CACHE=0) and exports JAX_COMPILATION_CACHE_DIR so test
+# SUBPROCESSES — bench smoke, CLI dry runs — share one cache with the pytest
+# process; identical-HLO graphs compile once per box, not once per process
+import sheeprl_tpu  # noqa: F401
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def _assert_cpu_backend() -> None:

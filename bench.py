@@ -374,6 +374,12 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     tail = (actions_dim, is_continuous, tiny)
     discards: list = []
 
+    import os as _os_mod
+
+    # every keep-decision baseline must measure the PLAIN configuration: an
+    # inherited unroll override would make the headline unrolled while
+    # scan_unroll_kept reports 1 (the sweep below owns this knob)
+    _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
     _set_kernel_families(None)
     pk.set_pallas(False)
     off_sps = _plausible(
@@ -451,6 +457,29 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         bf16_win = candidates[best_fams] > 0.0 and bf16_sps > candidates[best_fams]
         args.precision = "bfloat16" if bf16_win else "float32"
     duty_sps = max(max(candidates.values()), bf16_sps or 0.0)
+    # scan-unroll sweep on the winning kernel/precision config: the RSSM +
+    # imagination scans have tiny step bodies where XLA's while-loop
+    # per-iteration overhead competes with compute (ops/scan.py). Skipped
+    # in --tiny (two extra full compiles). Keep-decision against the
+    # current best duty cycle; requires a valid baseline like the others.
+    unroll_sps: dict[int, float] = {}
+    if not tiny and duty_sps > 0.0:
+        for u in (4, 8):
+            _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(u)
+            unroll_sps[u] = _plausible(
+                _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
+                discards,
+            )
+        best_u = max(unroll_sps, key=unroll_sps.get)
+        if unroll_sps[best_u] > duty_sps:
+            unroll_kept, duty_sps = best_u, unroll_sps[best_u]
+            _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(best_u)
+        else:
+            unroll_kept = 1
+            _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
+    else:
+        unroll_kept = 1
+        _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
     implied_tflops = duty_sps / 20.0 * DV3_TFLOPS_PER_20_STEPS
     # individual candidates are already filtered by _plausible; this flag
     # can only fire if the cap itself is later raised past a lie
@@ -495,6 +524,11 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
                 },
                 "bf16_sps": None if bf16_sps is None else round(bf16_sps, 1),
                 "bf16_kept": bool(bf16_win),
+                **{
+                    f"scan_unroll_{u}_sps": round(sps, 1)
+                    for u, sps in unroll_sps.items()
+                },
+                "scan_unroll_kept": unroll_kept,
                 "e2e_sps": round(e2e_sps, 1),
                 "e2e_precision": e2e_precision,
                 "implied_tflops": round(implied_tflops, 1),

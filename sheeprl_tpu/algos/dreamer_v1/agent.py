@@ -22,6 +22,7 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
+from ...ops.scan import scan_unroll
 from ... import nn
 from ...nn.inits import init_kaiming_normal
 from ..dreamer_v3.agent import (
@@ -134,7 +135,10 @@ class RSSMV1(nn.Module):
         if remat:
             step = jax.checkpoint(step, prevent_cse=False)
         _, outs = jax.lax.scan(
-            step, (posterior0, recurrent0), (actions, embedded_obs, keys)
+            step,
+            (posterior0, recurrent0),
+            (actions, embedded_obs, keys),
+            unroll=scan_unroll(),
         )
         return outs
 

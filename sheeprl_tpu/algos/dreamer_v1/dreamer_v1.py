@@ -197,7 +197,8 @@ def make_train_step(
             # H imagination steps; trajectory entries are the POST-step
             # latents (reference dreamer_v1.py:252-258 — no entry for z0)
             _, imagined_trajectories = jax.lax.scan(
-                img_step, (imagined_prior0, recurrent0), img_keys
+                img_step, (imagined_prior0, recurrent0), img_keys,
+                unroll=ops.scan_unroll(),
             )  # [H, T*B, L]
 
             predicted_values = state.critic(imagined_trajectories)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...ops.scan import scan_unroll
 from ... import nn, ops
 from ...nn.inits import init_xavier
 from ...ops.distributions import (
@@ -444,7 +445,10 @@ class RSSM(nn.Module):
             # already blocks the CSE that flag guards against
             step = jax.checkpoint(step, prevent_cse=False)
         _, outs = jax.lax.scan(
-            step, (posterior0, recurrent0), (actions, embedded_obs, is_first, keys)
+            step,
+            (posterior0, recurrent0),
+            (actions, embedded_obs, is_first, keys),
+            unroll=scan_unroll(),
         )
         return outs
 

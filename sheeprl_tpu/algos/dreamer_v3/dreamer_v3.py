@@ -287,7 +287,10 @@ def make_train_step(
             # latent/action pair outside the scan: H+1 trajectory entries from
             # exactly H RSSM transitions (reference loop, dreamer_v3.py:217-223)
             (prior_h, recurrent_h), (latents, actions_h) = jax.lax.scan(
-                img_step, (imagined_prior0, recurrent0), img_keys[:horizon]
+                img_step,
+                (imagined_prior0, recurrent0),
+                img_keys[:horizon],
+                unroll=ops.scan_unroll(),
             )
             latent_h = jnp.concatenate([prior_h, recurrent_h], axis=-1)
             last_acts, _ = actor(jax.lax.stop_gradient(latent_h), key=img_keys[horizon])

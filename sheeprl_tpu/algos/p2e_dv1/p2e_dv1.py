@@ -141,7 +141,8 @@ def make_train_step(
             if args.remat:
                 img_step = jax.checkpoint(img_step, prevent_cse=False)
             _, (imagined_trajectories, imagined_actions) = jax.lax.scan(
-                img_step, (imagined_prior0, recurrent0), img_keys
+                img_step, (imagined_prior0, recurrent0), img_keys,
+                unroll=ops.scan_unroll(),
             )  # [H, T*B, L] / [H, T*B, A]
             predicted_values = critic(imagined_trajectories)
             rewards = reward_fn(imagined_trajectories, imagined_actions)

@@ -152,7 +152,8 @@ def make_train_step(
             if args.remat:
                 img_step = jax.checkpoint(img_step, prevent_cse=False)
             _, (new_latents, actions_h) = jax.lax.scan(
-                img_step, (imagined_prior0, recurrent0), img_keys
+                img_step, (imagined_prior0, recurrent0), img_keys,
+                unroll=ops.scan_unroll(),
             )
             imagined_trajectories = jnp.concatenate([latent0[None], new_latents], axis=0)
             imagined_actions = jnp.concatenate(

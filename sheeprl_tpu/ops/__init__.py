@@ -10,6 +10,7 @@ from .math import (
     two_hot,
 )
 from .moments import Moments
+from .scan import scan_unroll
 from . import distributions
 
 __all__ = [
@@ -23,5 +24,6 @@ __all__ = [
     "symlog",
     "two_hot",
     "Moments",
+    "scan_unroll",
     "distributions",
 ]

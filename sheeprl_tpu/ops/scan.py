@@ -1,0 +1,31 @@
+"""Trace-time knob for `lax.scan` unrolling on the time/horizon recurrences.
+
+The Dreamer-family train step is dominated by sequential scans with TINY
+step bodies (RSSM dynamic: T=64 steps of [B=16]-row matmuls through
+512-wide layers; imagination: horizon 15 of the same shapes). XLA lowers
+`lax.scan` to a while-loop with per-iteration control overhead that rivals
+the step's compute at these shapes, so modest unrolls (4-8) can win real
+throughput — at the cost of compile time and code size, which is why the
+factor is a knob with a bench keep-decision (BENCHES.md) rather than a
+hardcoded value.
+
+Read at trace time like the Pallas kernel switches
+(`ops/pallas_kernels.py`): flipping `SHEEPRL_TPU_SCAN_UNROLL` between
+measurements re-traces with the new factor.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["scan_unroll"]
+
+
+def scan_unroll() -> int:
+    """Unroll factor for the framework's time/horizon scans (default 1 =
+    plain while-loop). Set `SHEEPRL_TPU_SCAN_UNROLL=k` to unroll k steps
+    per loop iteration; `lax.scan` handles non-divisible lengths."""
+    try:
+        return max(1, int(os.environ.get("SHEEPRL_TPU_SCAN_UNROLL", "1")))
+    except ValueError:
+        return 1

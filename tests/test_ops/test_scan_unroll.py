@@ -1,0 +1,43 @@
+"""SHEEPRL_TPU_SCAN_UNROLL changes scheduling, not numerics: the unrolled
+RSSM dynamic + imagination scans must produce the SAME losses and updated
+parameters as the plain while-loop on the same batch and seeds (the bench
+keep-decision relies on the configs being interchangeable,
+ops/scan.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ops.scan import scan_unroll
+from tests.test_algos.test_precision import _run_one_step
+
+
+def test_scan_unroll_env_parsing(monkeypatch):
+    monkeypatch.delenv("SHEEPRL_TPU_SCAN_UNROLL", raising=False)
+    assert scan_unroll() == 1
+    monkeypatch.setenv("SHEEPRL_TPU_SCAN_UNROLL", "4")
+    assert scan_unroll() == 4
+    monkeypatch.setenv("SHEEPRL_TPU_SCAN_UNROLL", "0")
+    assert scan_unroll() == 1  # floor
+    monkeypatch.setenv("SHEEPRL_TPU_SCAN_UNROLL", "junk")
+    assert scan_unroll() == 1  # unparseable -> plain loop
+
+
+@pytest.mark.timeout(300)
+def test_unrolled_step_matches_plain(monkeypatch):
+    # unroll=2 against T=5, horizon=4: exercises both the non-divisible
+    # remainder path (5 % 2) and the divisible one (4 % 2)
+    monkeypatch.delenv("SHEEPRL_TPU_SCAN_UNROLL", raising=False)
+    state_plain, m_plain = _run_one_step("float32")
+    monkeypatch.setenv("SHEEPRL_TPU_SCAN_UNROLL", "2")
+    state_unrolled, m_unrolled = _run_one_step("float32")
+
+    for name in m_plain:
+        np.testing.assert_allclose(
+            m_unrolled[name], m_plain[name], rtol=1e-4, atol=1e-5, err_msg=name
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state_plain.world_model),
+        jax.tree_util.tree_leaves(state_unrolled.world_model),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)

@@ -299,11 +299,21 @@ def main(argv: Sequence[str] | None = None) -> None:
             env_actions = one_hot_to_env_actions(actions, actions_dim, is_continuous)
             next_obs, rewards, terms, truncs, infos = envs.step(list(env_actions))
             dones = (terms | truncs).astype(np.float32)
-            row = {k: np.asarray(obs[k])[None] for k in obs_keys}
+            # device ring: the policy's obs put and its outputs scatter
+            # straight into HBM — no device->host pull of logprob/value and
+            # no second obs transfer (the only d2h per step is the env
+            # actions fetch inside one_hot_to_env_actions). Host/memmap
+            # rings get numpy rows instead.
+            host = rb.prefers_host_adds
+            conv = np.asarray if host else (lambda x: x)
+            row = {
+                k: (np.asarray(obs[k]) if host else device_obs[k])[None]
+                for k in obs_keys
+            }
             row.update(
-                actions=np.asarray(actions)[None],
-                logprobs=np.asarray(logprob)[None],
-                values=np.asarray(value)[None],
+                actions=conv(actions)[None],
+                logprobs=conv(logprob)[None],
+                values=conv(value)[None],
                 rewards=rewards[None, :, None],
                 dones=next_done[None, :, None],
             )

@@ -282,24 +282,31 @@ def main(argv: Sequence[str] | None = None) -> None:
         # ---- rollout hot loop ------------------------------------------------
         for _ in range(args.rollout_steps):
             key, step_key = jax.random.split(key)
+            dev_obs = jnp.asarray(next_obs)
+            # device ring: the policy's obs put and the device-resident LSTM
+            # states scatter straight into HBM — no per-step device->host
+            # pull of recurrent state/logprob/value (the only d2h is the env
+            # actions fetch). Host/memmap rings get numpy rows instead.
+            host = rb.prefers_host_adds
+            conv = np.asarray if host else (lambda x: x)
             row = {
-                "observations": next_obs[None],
+                "observations": (next_obs if host else dev_obs)[None],
                 "dones": next_done[None],
-                "actor_hxs": np.asarray(agent_state[0][0])[None],
-                "actor_cxs": np.asarray(agent_state[0][1])[None],
-                "critic_hxs": np.asarray(agent_state[1][0])[None],
-                "critic_cxs": np.asarray(agent_state[1][1])[None],
+                "actor_hxs": conv(agent_state[0][0])[None],
+                "actor_cxs": conv(agent_state[0][1])[None],
+                "critic_hxs": conv(agent_state[1][0])[None],
+                "critic_cxs": conv(agent_state[1][1])[None],
             }
             action, logprob, value, new_state = policy_step(
-                state.agent, jnp.asarray(next_obs), agent_state, step_key
+                state.agent, dev_obs, agent_state, step_key
             )
             env_actions = [int(a) for a in np.asarray(action)]
             obs, rewards, terms, truncs, infos = envs.step(env_actions)
             dones = np.logical_or(terms, truncs).astype(np.float32)
             row.update(
-                actions=np.asarray(action, np.float32)[None, :, None],
-                logprobs=np.asarray(logprob)[None],
-                values=np.asarray(value)[None],
+                actions=conv(action.astype(jnp.float32))[None, :, None],
+                logprobs=conv(logprob)[None],
+                values=conv(value)[None],
                 rewards=rewards[None, :, None],
             )
             rb.add(row)

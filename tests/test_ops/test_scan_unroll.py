@@ -23,6 +23,7 @@ def test_scan_unroll_env_parsing(monkeypatch):
     assert scan_unroll() == 1  # unparseable -> plain loop
 
 
+@pytest.mark.slow  # two full DV3 train-step compiles; runs per round
 @pytest.mark.timeout(300)
 def test_unrolled_step_matches_plain(monkeypatch):
     # unroll=2 against T=5, horizon=4: exercises both the non-divisible

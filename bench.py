@@ -238,33 +238,19 @@ def _dv3_duty_cycle_sps(
     return n_cycles * args.train_every * args.num_envs / dt
 
 
-def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
-    """Honest end-to-end loop: the real AsyncReplayBuffer in the cycle —
-    per-step rb.add, rb.sample, dtype cast, host->device transfer, update
-    (only gym env stepping excluded; mirrors dreamer_v3.py:628-660)."""
-    import jax
-    import jax.numpy as jnp
+def _dv3_replay_harness(args):
+    """Shared e2e scaffold: the real AsyncReplayBuffer, the synthetic pixel
+    env-obs source, the per-step replay row, and the prefill — factored so
+    the coupled and decoupled e2e loops stay step-for-step mirrors (their
+    ratio must compare topologies, not workloads)."""
     import numpy as np
 
-    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
-    from sheeprl_tpu.data import AsyncReplayBuffer, stage_batch
+    from sheeprl_tpu.data import AsyncReplayBuffer
 
-    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
-    n_envs = args.num_envs
-    world_opt, actor_opt, critic_opt = opts
-    train_step = make_train_step(
-        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim, is_continuous
-    )
-    make_player, player_step = _dv3_player_fns(args, actions_dim, is_continuous)
-    player_state = make_player(state).init_states(n_envs)
-
+    T, n_envs = args.per_rank_sequence_length, args.num_envs
     rb = AsyncReplayBuffer(
-        max(4 * T, 64),
-        n_envs,
-        storage="device",
-        sequential=True,
-        obs_keys=("rgb",),
-        seed=0,
+        max(4 * T, 64), n_envs, storage="device", sequential=True,
+        obs_keys=("rgb",), seed=0,
     )
     rng = np.random.default_rng(0)
 
@@ -288,6 +274,40 @@ def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
 
     for _ in range(2 * T + 8):  # prefill to make T-sequences sampleable
         add_step(fake_env_obs())
+    return rb, fake_env_obs, add_step
+
+
+def _dv3_e2e_sps(
+    args, state, opts, actions_dim, is_continuous, tiny, n_mesh_devices=0
+):
+    """Honest end-to-end loop: the real AsyncReplayBuffer in the cycle —
+    per-step rb.add, rb.sample, dtype cast, host->device transfer, update
+    (only gym env stepping excluded; mirrors dreamer_v3.py:628-660).
+    `n_mesh_devices > 0` runs the update data-parallel over that many
+    devices (batch sharded, params replicated) — the coupled side of the
+    decoupled comparison, so both topologies pay their collectives."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.data import AsyncReplayBuffer, stage_batch
+    from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
+
+    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
+    n_envs = args.num_envs
+    world_opt, actor_opt, critic_opt = opts
+    mesh = make_mesh(n_mesh_devices) if n_mesh_devices > 0 else None
+    if mesh is not None:
+        state = replicate(state, mesh)
+    train_step = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim,
+        is_continuous, mesh=mesh,
+    )
+    make_player, player_step = _dv3_player_fns(args, actions_dim, is_continuous)
+    player_state = make_player(state).init_states(n_envs)
+
+    rb, fake_env_obs, add_step = _dv3_replay_harness(args)
 
     key = jax.random.PRNGKey(1)
 
@@ -303,6 +323,8 @@ def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
         local_data = rb.sample(B, sequence_length=T, n_samples=1)
         staged = stage_batch(local_data)
         sample = {k: v[0] for k, v in staged.items()}
+        if mesh is not None:
+            sample = shard_time_batch(sample, mesh, time_axis=0, batch_axis=1)
         key, tk = jax.random.split(key)
         state, metrics = train_step(state, sample, tk, jnp.float32(0.02))
         # host scalar pull (see _dv3_duty_cycle_sps: readiness can lie)
@@ -316,6 +338,168 @@ def _dv3_e2e_sps(args, state, opts, actions_dim, is_continuous, tiny):
         state, player_state, key = one_cycle(state, player_state, key)
     dt = time.perf_counter() - t0
     return n_cycles * args.train_every * n_envs / dt
+
+
+def _fair_n_train(batch_size: int) -> int:
+    """Largest trainer count that divides the batch and leaves a device for
+    the player — the decoupled comparison's mesh sizing (both sides train
+    on this many devices)."""
+    import jax
+
+    avail = len(jax.devices())
+    return max(
+        d for d in range(1, max(min(avail - 1, batch_size), 1) + 1)
+        if batch_size % d == 0
+    )
+
+
+def _dv3_e2e_decoupled_sps(args, state, opts, actions_dim, is_continuous, tiny):
+    """The honest e2e loop in the DECOUPLED topology (player device runs
+    PlayerDV3 + the replay ring; the trainer mesh runs the update on the
+    shipped [n_samples, T, B] block; refreshed encoder/RSSM/actor weights
+    stream back asynchronously) — mirrors _dv3_e2e_sps step for step so the
+    two numbers compare the topologies, not the workloads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.algos.dreamer_v3.utils import make_device_preprocess
+    from sheeprl_tpu.data import stage_batch
+    from sheeprl_tpu.parallel.decoupled import make_decoupled_meshes
+
+    T, B = args.per_rank_sequence_length, args.per_rank_batch_size
+    n_envs = args.num_envs
+    world_opt, actor_opt, critic_opt = opts
+    # trainer count = the coupled side's device count (_fair_n_train): the
+    # comparison holds TRAINING devices equal and asks what the topology
+    # machinery (block ship, weight return) costs for its extra player
+    # device; an indivisible batch would wrap-pad in to_trainers and charge
+    # the decoupled side phantom FLOPs
+    meshes = make_decoupled_meshes(_fair_n_train(B) + 1)
+    train_step = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim,
+        is_continuous, mesh=meshes.trainer_mesh,
+    )
+    state = meshes.replicated_on_trainers(state)
+    player_weights = meshes.to_player(
+        (state.world_model.encoder, state.world_model.rssm, state.actor)
+    )
+
+    def make_player(weights):
+        encoder, rssm, p_actor = weights
+        return PlayerDV3(
+            encoder=encoder, rssm=rssm, actor=p_actor,
+            actions_dim=tuple(actions_dim),
+            stochastic_size=args.stochastic_size,
+            discrete_size=args.discrete_size,
+            recurrent_state_size=args.recurrent_state_size,
+            is_continuous=is_continuous,
+            compute_dtype=args.precision,
+        )
+
+    _prep = make_device_preprocess(args.cnn_keys)
+    player_step = jax.jit(
+        lambda p, s, o, k, mask: p.step(
+            s, _prep(o), k, jnp.float32(0.0), is_training=True, mask=mask
+        )
+    )
+    player_state = make_player(player_weights).init_states(n_envs)
+
+    rb, fake_env_obs, add_step = _dv3_replay_harness(args)
+
+    key = jax.random.PRNGKey(1)
+    box = {
+        "state": state,
+        "weights": player_weights,
+        "pending": None,
+        "ps": player_state,
+        "key": key,
+    }
+
+    def one_cycle():
+        if box["pending"] is not None:
+            leaves = jax.tree_util.tree_leaves(box["pending"])
+            if all(leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready")):
+                box["weights"], box["pending"] = box["pending"], None
+        player = make_player(box["weights"])
+        for _ in range(args.train_every):
+            obs_u8 = fake_env_obs()
+            dev_u8 = jnp.asarray(obs_u8)
+            box["key"], sk = jax.random.split(box["key"])
+            box["ps"], _ = player_step(player, box["ps"], {"rgb": dev_u8}, sk, None)
+            add_step(obs_u8 if rb.prefers_host_adds else dev_u8)
+        local = rb.sample(B, sequence_length=T, n_samples=1)
+        staged = stage_batch(local)
+        staged = meshes.to_trainers(staged, axis=2)
+        sample = {k: v[0] for k, v in staged.items()}
+        box["key"], tk = jax.random.split(box["key"])
+        box["state"], metrics = train_step(
+            box["state"], sample, tk, jnp.float32(0.02)
+        )
+        box["pending"] = meshes.to_player(
+            (
+                box["state"].world_model.encoder,
+                box["state"].world_model.rssm,
+                box["state"].actor,
+            )
+        )
+        # host scalar pull (see _dv3_duty_cycle_sps: readiness can lie)
+        float(jax.device_get(metrics["Loss/reconstruction_loss"]))
+
+    one_cycle()  # compile
+    n_cycles = 3 if tiny else 10
+    t0 = time.perf_counter()
+    for _ in range(n_cycles):
+        one_cycle()
+    dt = time.perf_counter() - t0
+    return n_cycles * args.train_every * n_envs / dt
+
+
+def bench_dreamer_v3_decoupled(tiny: bool = False) -> None:
+    """Decoupled vs coupled DreamerV3 on the same device set — the receipt
+    for the flagship's decoupled topology (a capability beyond the
+    reference). On the virtual CPU mesh (ONE physical core multiplexed) the
+    overlap cannot win wall-clock; the receipt is that the decoupled
+    machinery (block ship, async weight return) is not materially slower.
+    On real multi-chip hardware the player/trainer overlap is the win."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        # make the capacity constraint an explicit artifact, not a
+        # misleading decoupled_sps=0.0 from a swallowed RuntimeError
+        print(
+            _failure_line(
+                "dreamer_v3_decoupled_vs_coupled_env_steps_per_sec",
+                "env-steps/sec",
+                "insufficient_devices",
+            )
+        )
+        return
+    args, state, opts, actions_dim, is_continuous, _ = _dv3_setup(tiny)
+    tail = (actions_dim, is_continuous, tiny)
+    # equal TRAINING devices on both sides (coupled: N-device data-parallel
+    # update paying its gradient all-reduce; decoupled: the same N trainers
+    # plus one player device paying the block ship + weight return)
+    n_train = _fair_n_train(args.per_rank_batch_size)
+    coupled = _measure_guarded(
+        _dv3_e2e_sps, args, state, opts, *tail, n_train
+    )
+    decoupled = _measure_guarded(_dv3_e2e_decoupled_sps, args, state, opts, *tail)
+    print(
+        json.dumps(
+            {
+                "metric": "dreamer_v3_decoupled_vs_coupled_env_steps_per_sec",
+                "value": round(decoupled, 1),
+                "unit": "env-steps/sec",
+                "vs_baseline": round(decoupled / max(coupled, 1e-9), 3),
+                "coupled_sps": round(coupled, 1),
+                "decoupled_sps": round(decoupled, 1),
+                "baseline_note": "vs_baseline here is decoupled/coupled on the same device set",
+            }
+        )
+    )
 
 
 def _measure_guarded(fn, args_, state_, *fn_args):
@@ -752,6 +936,10 @@ _METRIC_OF_ALGO = {
         "dreamer_v3_minedojo_env_steps_per_sec",
         "env-steps/sec/chip",
     ),
+    "dreamer_v3_decoupled": (
+        "dreamer_v3_decoupled_vs_coupled_env_steps_per_sec",
+        "env-steps/sec",
+    ),
 }
 
 
@@ -1082,6 +1270,8 @@ def main() -> None:
         bench_ppo_decoupled_pixel()
     elif opts.algo == "dreamer_v3_minedojo":
         bench_dreamer_v3_minedojo(tiny=opts.tiny)
+    elif opts.algo == "dreamer_v3_decoupled":
+        bench_dreamer_v3_decoupled(tiny=opts.tiny)
     else:
         bench_dreamer_v3(tiny=opts.tiny)
 

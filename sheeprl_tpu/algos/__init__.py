@@ -14,6 +14,7 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",
     "sheeprl_tpu.algos.dreamer_v2.dreamer_v2",
     "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
+    "sheeprl_tpu.algos.dreamer_v3.dreamer_v3_decoupled",
     "sheeprl_tpu.algos.p2e_dv1.p2e_dv1",
     "sheeprl_tpu.algos.p2e_dv2.p2e_dv2",
 ]

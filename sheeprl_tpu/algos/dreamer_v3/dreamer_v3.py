@@ -597,6 +597,15 @@ def main(argv: Sequence[str] | None = None) -> None:
         mesh=mesh,
     )
 
+    if args.dry_run:
+        # the V3 row layout has no pre-loop add, so the first (and in a dry
+        # run only) training fires with exactly step_before_training rows
+        # per env ring: clamp the sampled window so the smoke runs on
+        # DEFAULT flags instead of raising "too long sequence_length"
+        args.per_rank_sequence_length = min(
+            args.per_rank_sequence_length,
+            max(args.train_every // args.num_envs, 1),
+        )
     buffer_size = (
         args.buffer_size // (args.num_envs * world) if not args.dry_run else 2
     )

@@ -87,3 +87,72 @@ def test_sac_decoupled_requires_two_devices(tmp_path):
                 "--run_name=test",
             ]
         )
+
+
+def test_dreamer_v3_decoupled_dry_run(tmp_path):
+    # the flagship task in the decoupled topology (a capability beyond the
+    # reference, which decouples only PPO/SAC): player device runs
+    # PlayerDV3 + the replay ring, the 7-trainer mesh runs the single-jit
+    # DV3 update on the shipped [n_samples, T, B] block, refreshed
+    # encoder/RSSM/actor weights stream back asynchronously
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3_decoupled import main
+
+    main(
+        [
+            "--dry_run",
+            "--env_id=discrete_dummy",
+            "--num_envs=1",
+            "--sync_env",
+            "--per_rank_batch_size=2",
+            "--per_rank_sequence_length=1",
+            "--buffer_size=4",
+            "--learning_starts=0",
+            "--gradient_steps=1",
+            "--horizon=4",
+            "--dense_units=8",
+            "--cnn_channels_multiplier=2",
+            "--recurrent_state_size=8",
+            "--hidden_size=8",
+            "--stochastic_size=4",
+            "--discrete_size=4",
+            "--mlp_layers=1",
+            "--train_every=1",
+            "--checkpoint_every=1",
+            "--cnn_keys", "rgb",
+            f"--root_dir={tmp_path}",
+            "--run_name=test",
+        ]
+    )
+    ckpt_dir = os.path.join(tmp_path, "test", "checkpoints")
+    assert os.path.isdir(ckpt_dir)
+    assert any(e.startswith("ckpt_") for e in sorted(os.listdir(ckpt_dir)))
+
+
+def test_dreamer_v3_decoupled_requires_two_devices(tmp_path):
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3_decoupled import main
+
+    with pytest.raises(RuntimeError, match="at least 2 devices"):
+        main(
+            [
+                "--dry_run",
+                "--num_devices=1",
+                "--env_id=discrete_dummy",
+                f"--root_dir={tmp_path}",
+                "--run_name=test",
+            ]
+        )
+
+
+def test_dreamer_v3_decoupled_rejects_seq_devices(tmp_path):
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3_decoupled import main
+
+    with pytest.raises(ValueError, match="seq_devices"):
+        main(
+            [
+                "--dry_run",
+                "--seq_devices=2",
+                "--env_id=discrete_dummy",
+                f"--root_dir={tmp_path}",
+                "--run_name=test",
+            ]
+        )

@@ -156,3 +156,41 @@ def test_dreamer_v3_decoupled_rejects_seq_devices(tmp_path):
                 "--run_name=test",
             ]
         )
+
+
+def test_dreamer_v3_decoupled_resume(tmp_path):
+    # checkpoint contract + resume through the decoupled main (restores
+    # args from the checkpoint like the coupled task)
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3_decoupled import main
+
+    tiny = [
+        "--dry_run",
+        "--env_id=discrete_dummy",
+        "--num_envs=1",
+        "--sync_env",
+        "--per_rank_batch_size=2",
+        "--per_rank_sequence_length=1",
+        "--buffer_size=4",
+        "--learning_starts=0",
+        "--gradient_steps=1",
+        "--horizon=4",
+        "--dense_units=8",
+        "--cnn_channels_multiplier=2",
+        "--recurrent_state_size=8",
+        "--hidden_size=8",
+        "--stochastic_size=4",
+        "--discrete_size=4",
+        "--mlp_layers=1",
+        "--train_every=1",
+        "--checkpoint_every=1",
+        "--checkpoint_buffer",
+        "--cnn_keys", "rgb",
+        f"--root_dir={tmp_path}",
+        "--run_name=test",
+    ]
+    main(tiny)
+    ckpt_dir = os.path.join(tmp_path, "test", "checkpoints")
+    ckpts = sorted(e for e in os.listdir(ckpt_dir) if e.endswith(".args.json"))
+    assert ckpts
+    ckpt = os.path.join(ckpt_dir, ckpts[-1].replace(".args.json", ""))
+    main([f"--checkpoint_path={ckpt}", f"--root_dir={tmp_path}", "--run_name=resume"])

@@ -64,7 +64,11 @@ from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..ppo.agent import one_hot_to_env_actions
+from ..ppo.agent import (
+    buffer_actions,
+    env_action_indices,
+    indices_to_env_actions,
+)
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from .agent import PlayerDV3, WorldModel, build_models
 from .args import DreamerV3Args
@@ -579,11 +583,15 @@ def main(argv: Sequence[str] | None = None) -> None:
     # array is reused by rb.add below — one obs transfer per env step total
     _dev_preprocess = make_device_preprocess(cnn_keys)
 
-    player_step = jax.jit(
-        lambda p, s, o, k, expl, mask: p.step(
+    def _player_step(p, s, o, k, expl, mask):
+        new_s, acts = p.step(
             s, _dev_preprocess(o), k, expl, is_training=True, mask=mask
         )
-    )
+        # per-head env indices computed on device: the per-step d2h pull is
+        # a few ints; the one-hot stays device-resident for rb.add
+        return new_s, acts, env_action_indices(acts, actions_dim, is_continuous)
+
+    player_step = jax.jit(_player_step)
 
     train_step = make_train_step(
         args,
@@ -674,16 +682,24 @@ def main(argv: Sequence[str] | None = None) -> None:
             device_obs = {k: jnp.asarray(np.asarray(obs[k])) for k in obs_keys}
             mask = {k: v for k, v in device_obs.items() if k.startswith("mask")} or None
             key, step_key = jax.random.split(key)
-            player_state, actions_dev = player_step(
+            player_state, actions_dev, env_idx_dev = player_step(
                 player, player_state, device_obs, step_key,
                 jnp.float32(expl_amount), mask,
             )
-            actions = np.asarray(actions_dev)
-            env_acts = one_hot_to_env_actions(actions, actions_dim, is_continuous)
-            env_actions = list(env_acts)
+            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
+            env_actions = list(
+                indices_to_env_actions(env_idx, actions_dim, is_continuous)
+            )
             device_step_obs = device_obs
+            actions = buffer_actions(
+                env_idx, actions_dev, actions_dim, is_continuous,
+                host=rb.prefers_host_adds,
+            )
 
-        step_data["actions"] = actions.astype(np.float32)
+        step_data["actions"] = (
+            actions if isinstance(actions, jax.Array)
+            else np.asarray(actions, np.float32)
+        )
         add_data = {k: v[None] for k, v in step_data.items()}
         if device_step_obs is not None and not rb.prefers_host_adds:
             # reuse the policy step's obs puts instead of re-transferring

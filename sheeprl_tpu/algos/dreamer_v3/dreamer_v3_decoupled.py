@@ -42,7 +42,11 @@ from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.profiler import StepProfiler
 from ...utils.registry import register_algorithm
-from ..ppo.agent import one_hot_to_env_actions
+from ..ppo.agent import (
+    buffer_actions,
+    env_action_indices,
+    indices_to_env_actions,
+)
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from .agent import PlayerDV3, build_models
 from .args import DreamerV3Args
@@ -180,11 +184,16 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
 
     _dev_preprocess = make_device_preprocess(cnn_keys)
-    player_step = jax.jit(
-        lambda p, s, o, k, expl, mask: p.step(
+
+    def _player_step(p, s, o, k, expl, mask):
+        new_s, acts = p.step(
             s, _dev_preprocess(o), k, expl, is_training=True, mask=mask
         )
-    )
+        # per-head env indices computed on device: the per-step d2h pull is
+        # a few ints (see dreamer_v3.py)
+        return new_s, acts, env_action_indices(acts, actions_dim, is_continuous)
+
+    player_step = jax.jit(_player_step)
 
     train_step = make_train_step(
         args,
@@ -286,13 +295,18 @@ def main(argv: Sequence[str] | None = None) -> None:
             device_obs = {k: jnp.asarray(np.asarray(obs[k])) for k in obs_keys}
             mask = {k: v for k, v in device_obs.items() if k.startswith("mask")} or None
             key, step_key = jax.random.split(key)
-            player_state, actions_dev = player_step(
+            player_state, actions_dev, env_idx_dev = player_step(
                 player, player_state, device_obs, step_key,
                 jnp.float32(expl_amount), mask,
             )
-            actions = np.asarray(actions_dev)
+            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
             env_actions = list(
-                one_hot_to_env_actions(actions, actions_dim, is_continuous)
+                indices_to_env_actions(env_idx, actions_dim, is_continuous)
+            )
+            # host rows throughout (see rb.add below): rebuilt from the
+            # tiny index pull instead of pulling the full one-hot
+            actions = buffer_actions(
+                env_idx, actions_dev, actions_dim, is_continuous, host=True
             )
 
         step_data["actions"] = actions.astype(np.float32)

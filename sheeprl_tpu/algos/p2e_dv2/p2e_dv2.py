@@ -48,7 +48,11 @@ from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..ppo.agent import one_hot_to_env_actions
+from ..ppo.agent import (
+    buffer_actions,
+    env_action_indices,
+    indices_to_env_actions,
+)
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from ..dreamer_v2.agent import PlayerDV2
 from ..dreamer_v2.loss import reconstruction_loss
@@ -645,11 +649,15 @@ def main(argv: Sequence[str] | None = None) -> None:
     # rb.add (V2 row layout — see dreamer_v2.py)
     _dev_preprocess = make_device_preprocess(cnn_keys)
 
-    player_step = jax.jit(
-        lambda p, s, o, k, expl, mask: p.step(
+    def _player_step(p, s, o, k, expl, mask):
+        new_s, acts = p.step(
             s, _dev_preprocess(o), k, expl, is_training=True, mask=mask
         )
-    )
+        # per-head env indices computed on device: the per-step d2h pull is
+        # a few ints; the one-hot stays device-resident for rb.add
+        return new_s, acts, env_action_indices(acts, actions_dim, is_continuous)
+
+    player_step = jax.jit(_player_step)
     train_step_exploring = make_train_step(
         args, optimizers, cnn_keys, mlp_keys, actions_dim, is_continuous,
         exploring=True, mesh=mesh,
@@ -767,13 +775,17 @@ def main(argv: Sequence[str] | None = None) -> None:
             device_obs = device_next_obs
             mask = {k: v for k, v in device_obs.items() if k.startswith("mask")} or None
             key, step_key = jax.random.split(key)
-            player_state, actions_dev = player_step(
+            player_state, actions_dev, env_idx_dev = player_step(
                 player, player_state, device_obs, step_key,
                 jnp.float32(expl_amount), mask,
             )
-            actions = np.asarray(actions_dev)
+            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
             env_actions = list(
-                one_hot_to_env_actions(actions, actions_dim, is_continuous)
+                indices_to_env_actions(env_idx, actions_dim, is_continuous)
+            )
+            actions = buffer_actions(
+                env_idx, actions_dev, actions_dim, is_continuous,
+                host=buffer_type == "episode" or rb.prefers_host_adds,
             )
 
         step_data["is_first"] = step_data["dones"].copy()
@@ -797,7 +809,10 @@ def main(argv: Sequence[str] | None = None) -> None:
             step_data[k] = real_next_obs[k]
         obs = next_obs
         step_data["dones"] = dones[:, None]
-        step_data["actions"] = actions.astype(np.float32)
+        step_data["actions"] = (
+            actions if isinstance(actions, jax.Array)
+            else np.asarray(actions, np.float32)
+        )
         step_data["rewards"] = (
             np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
         ).astype(np.float32)

@@ -218,3 +218,62 @@ def one_hot_to_env_actions(actions: jax.Array, actions_dim: Sequence[int], is_co
     if len(actions_dim) == 1:  # plain Discrete: env wants a scalar per env
         return stacked[..., 0]
     return stacked
+
+
+def env_action_indices(actions: jax.Array, actions_dim: Sequence[int], is_continuous: bool):
+    """Jit-side twin of `one_hot_to_env_actions`: per-head argmax indices
+    (int32, `[..., n_heads]`) computed ON DEVICE inside the policy-step jit,
+    so the per-step device->host pull is a few ints instead of the full
+    one-hot concat — the one-hot itself stays on device and feeds `rb.add`
+    without a round trip. Continuous actions pass through unchanged (the
+    env needs the raw floats either way)."""
+    if is_continuous:
+        return actions
+    out, start = [], 0
+    for dim in actions_dim:
+        out.append(jnp.argmax(actions[..., start : start + dim], axis=-1))
+        start += dim
+    return jnp.stack(out, axis=-1).astype(jnp.int32)
+
+
+def indices_to_env_actions(idx, actions_dim: Sequence[int], is_continuous: bool):
+    """Host-side partner of `env_action_indices`: shape the pulled index
+    array the way env.step expects (scalar per env for a single Discrete
+    head, `[..., n_heads]` otherwise; continuous passes through)."""
+    import numpy as np
+
+    idx = np.asarray(idx)
+    if is_continuous:
+        return idx
+    if len(actions_dim) == 1:
+        return idx[..., 0]
+    return idx
+
+
+def indices_to_one_hot(idx, actions_dim: Sequence[int]):
+    """Host-side one-hot reconstruction from per-head indices — for buffer
+    backends that want host rows (memmap/staged), where re-building the
+    one-hot from the tiny index pull is cheaper than pulling the full
+    one-hot from device."""
+    import numpy as np
+
+    idx = np.asarray(idx)
+    return np.concatenate(
+        [np.eye(d, dtype=np.float32)[idx[..., i]] for i, d in enumerate(actions_dim)],
+        axis=-1,
+    )
+
+
+def buffer_actions(env_idx, actions_dev, actions_dim: Sequence[int], is_continuous: bool, host: bool):
+    """The replay-row action representation, shared by every main's hot
+    loop: device buffers take the policy step's one-hot/continuous output
+    as-is (it scatters into the ring without a round trip); host/memmap
+    rows are rebuilt from the tiny index pull instead of pulling the full
+    one-hot from device."""
+    import numpy as np
+
+    if not host:
+        return actions_dev
+    if is_continuous:
+        return np.asarray(env_idx, np.float32)
+    return indices_to_one_hot(env_idx, actions_dim)

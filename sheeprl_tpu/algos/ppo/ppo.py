@@ -51,7 +51,13 @@ from ...utils.profiler import StepProfiler
 from ...utils.registry import register_algorithm
 from ..args import require_float32
 from ...utils.parser import DataclassArgumentParser
-from .agent import PPOAgent, one_hot_to_env_actions
+from .agent import (
+    PPOAgent,
+    buffer_actions,
+    env_action_indices,
+    indices_to_env_actions,
+    one_hot_to_env_actions,
+)
 from .args import PPOArgs
 from .loss import entropy_loss, policy_loss, value_loss
 
@@ -101,7 +107,11 @@ def make_optimizer(args: PPOArgs) -> optax.GradientTransformation:
 @partial(jax.jit, static_argnames=("use_key",))
 def policy_step(agent: PPOAgent, obs: dict, key, use_key: bool = True):
     actions, logprob, _, value = agent(obs, key=key if use_key else None)
-    return actions, logprob, value
+    # per-head env indices computed on device: the rollout's only required
+    # per-step d2h pull shrinks to a few ints (the one-hot stays on device
+    # and scatters straight into the HBM rollout ring)
+    env_idx = env_action_indices(actions, agent.actions_dim, agent.is_continuous)
+    return actions, logprob, value, env_idx
 
 
 def make_train_step(args: PPOArgs, optimizer, num_minibatches: int):
@@ -295,25 +305,34 @@ def main(argv: Sequence[str] | None = None) -> None:
         for _ in range(args.rollout_steps):
             key, step_key = jax.random.split(key)
             device_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
-            actions, logprob, value = policy_step(state.agent, device_obs, step_key)
-            env_actions = one_hot_to_env_actions(actions, actions_dim, is_continuous)
+            actions, logprob, value, env_idx = policy_step(
+                state.agent, device_obs, step_key
+            )
+            env_idx_np = np.asarray(env_idx)  # the only required d2h per step
+            env_actions = indices_to_env_actions(
+                env_idx_np, actions_dim, is_continuous
+            )
             next_obs, rewards, terms, truncs, infos = envs.step(list(env_actions))
             dones = (terms | truncs).astype(np.float32)
             # device ring: the policy's obs put and its outputs scatter
-            # straight into HBM — no device->host pull of logprob/value and
-            # no second obs transfer (the only d2h per step is the env
-            # actions fetch inside one_hot_to_env_actions). Host/memmap
-            # rings get numpy rows instead.
+            # straight into HBM — no device->host pull of logprob/value/
+            # one-hot and no second obs transfer. Host/memmap rings rebuild
+            # the one-hot from the index pull and take logprob+value as ONE
+            # merged pull instead of two.
             host = rb.prefers_host_adds
-            conv = np.asarray if host else (lambda x: x)
             row = {
                 k: (np.asarray(obs[k]) if host else device_obs[k])[None]
                 for k in obs_keys
             }
+            if host:
+                lv = np.asarray(jnp.concatenate([logprob, value], axis=-1))
+                logprob, value = lv[:, :1], lv[:, 1:]
             row.update(
-                actions=conv(actions)[None],
-                logprobs=conv(logprob)[None],
-                values=conv(value)[None],
+                actions=buffer_actions(
+                    env_idx_np, actions, actions_dim, is_continuous, host=host
+                )[None],
+                logprobs=logprob[None],
+                values=value[None],
                 rewards=rewards[None, :, None],
                 dones=next_done[None, :, None],
             )

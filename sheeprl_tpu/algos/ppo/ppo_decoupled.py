@@ -34,7 +34,7 @@ from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
 from ..args import require_float32
-from .agent import PPOAgent, one_hot_to_env_actions
+from .agent import PPOAgent, buffer_actions, indices_to_env_actions
 from .args import PPOArgs
 from .ppo import (
     TrainState,
@@ -165,15 +165,23 @@ def main(argv: Sequence[str] | None = None) -> None:
                 k: jax.device_put(jnp.asarray(obs[k]), meshes.player_device)
                 for k in obs_keys
             }
-            actions, logprob, value = policy_step(player_agent, device_obs, step_key)
-            env_actions = one_hot_to_env_actions(actions, actions_dim, is_continuous)
+            actions, logprob, value, env_idx_dev = policy_step(
+                player_agent, device_obs, step_key
+            )
+            env_idx = np.asarray(env_idx_dev)
+            env_actions = indices_to_env_actions(env_idx, actions_dim, is_continuous)
             next_obs, rewards, terms, truncs, infos = envs.step(list(env_actions))
             dones = (terms | truncs).astype(np.float32)
+            # host rows: one-hot rebuilt from the tiny index pull; logprob
+            # and value ride ONE pull instead of two
+            lv = np.asarray(jnp.concatenate([logprob, value], axis=-1))
             row = {k: np.asarray(obs[k])[None] for k in obs_keys}
             row.update(
-                actions=np.asarray(actions)[None],
-                logprobs=np.asarray(logprob)[None],
-                values=np.asarray(value)[None],
+                actions=buffer_actions(
+                    env_idx, actions, actions_dim, is_continuous, host=True
+                )[None],
+                logprobs=lv[:, :1][None],
+                values=lv[:, 1:][None],
                 rewards=rewards[None, :, None],
                 dones=next_done[None, :, None],
             )

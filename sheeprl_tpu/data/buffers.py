@@ -97,12 +97,21 @@ def _as_time_env(data: Mapping[str, np.ndarray]) -> Batch:
     return d
 
 
+_WIDTH_GROUP = {1: "w1", 2: "w2", 4: "w4"}
+_GROUP_VIEW = {"w1": np.uint8, "w2": np.uint16, "w4": np.float32}
+
+
 def _pack_host_values(data: Mapping[str, "np.ndarray | jax.Array"]):
     """Split an add batch into device-resident values (`direct` — e.g. the
     policy step's obs put, reused by the mains) and host values packed into
-    ONE flat array per dtype. On a tunneled backend every `device_put` is a
-    host round-trip, so the per-step add cost is transfer *count*, not
-    bytes. Returns `(direct, packed, layout)`; the static `layout` of
+    ONE flat array per itemsize class: all 4-byte dtypes bit-viewed as
+    float32, 1-byte as uint8, 2-byte as uint16 (64-bit values are cast to
+    their 32-bit counterpart first — matching what the x64-disabled device
+    store holds anyway). On a tunneled backend every `device_put` is a host
+    round-trip, so the per-step add cost is transfer *count*, not bytes; in
+    the training loops' add path everything is float32/int32/uint8, so the
+    whole row (indices included) rides at most two transfers, usually one.
+    Returns `(direct, packed, layout)`; the static `layout` of
     `(key, dtype_str, shape, offset, size)` rows unpacks on device."""
     direct: dict[str, jax.Array] = {}
     groups: dict[str, list[np.ndarray]] = {}
@@ -113,22 +122,33 @@ def _pack_host_values(data: Mapping[str, "np.ndarray | jax.Array"]):
             direct[k] = v
             continue
         v = np.asarray(v)
+        if v.dtype.itemsize == 8:  # x64 is disabled on device; match the store
+            v = v.astype(np.float32 if v.dtype.kind == "f" else np.int32)
         ds = v.dtype.str
-        off = offsets.get(ds, 0)
-        groups.setdefault(ds, []).append(v.reshape(-1))
+        g = _WIDTH_GROUP[v.dtype.itemsize]
+        view = np.ascontiguousarray(v.reshape(-1)).view(_GROUP_VIEW[g])
+        off = offsets.get(g, 0)
+        groups.setdefault(g, []).append(view)
         layout.append((k, ds, v.shape, off, v.size))
-        offsets[ds] = off + v.size
+        offsets[g] = off + v.size
     packed = {
-        ds: jnp.asarray(np.concatenate(parts)) for ds, parts in groups.items()
+        g: jnp.asarray(np.concatenate(parts)) for g, parts in groups.items()
     }
     return direct, packed, tuple(layout)
 
 
 def _unpack_values(direct, packed, layout):
-    """Device-side inverse of `_pack_host_values` (runs inside jit)."""
+    """Device-side inverse of `_pack_host_values` (runs inside jit): slice
+    each value out of its width-class blob and bitcast back to its true
+    dtype — an exact bit-level roundtrip (bitcasts preserve arbitrary NaN
+    payloads; transfers are raw bytes)."""
     data = dict(direct)
     for k, ds, shape, off, size in layout:
-        data[k] = packed[ds][off : off + size].reshape(shape)
+        dt = np.dtype(ds)
+        seg = packed[_WIDTH_GROUP[dt.itemsize]][off : off + size]
+        if seg.dtype != dt:
+            seg = seg != 0 if dt == np.bool_ else jax.lax.bitcast_convert_type(seg, dt)
+        data[k] = seg.reshape(shape)
     return data
 
 
@@ -257,13 +277,15 @@ class ReplayBuffer:
 
     # -- add -----------------------------------------------------------------
     @staticmethod
-    @partial(jax.jit, donate_argnums=0, static_argnums=(4, 5))
-    def _device_add(buf, direct, packed, pos, layout, data_len):
-        """Append at the write head with ONE host->device transfer per dtype
-        group (see `_pack_host_values`); `pos` rides as a scalar put."""
+    @partial(jax.jit, donate_argnums=0, static_argnums=(3, 4))
+    def _device_add(buf, direct, packed, layout, data_len):
+        """Append at the write head with ONE host->device transfer per width
+        class (see `_pack_host_values`); the write position rides inside the
+        packed group as `__pos__` instead of its own scalar put."""
         capacity = next(iter(buf.values())).shape[0]
-        idxes = (pos + jnp.arange(data_len)) % capacity
         data = _unpack_values(direct, packed, layout)
+        pos = data.pop("__pos__").reshape(())
+        idxes = (pos + jnp.arange(data_len)) % capacity
         return {k: buf[k].at[idxes].set(data[k].astype(buf[k].dtype)) for k in buf}
 
     def add(self, data: Mapping[str, np.ndarray] | "ReplayBuffer") -> None:
@@ -286,10 +308,11 @@ class ReplayBuffer:
         if self._buf is None:
             self._allocate(data)
         if self._storage_kind == "device":
-            direct, packed, layout = _pack_host_values(data)
+            direct, packed, layout = _pack_host_values(
+                {**data, "__pos__": np.int32(self._pos)}
+            )
             self._buf = self._device_add(
-                self._buf, direct, packed,
-                jnp.asarray(np.int32(self._pos)), layout, data_len,
+                self._buf, direct, packed, layout, data_len
             )
         else:
             idxes = (self._pos + np.arange(data_len)) % self._buffer_size
@@ -908,24 +931,26 @@ class AsyncReplayBuffer:
         return sub
 
     @staticmethod
-    @partial(jax.jit, donate_argnums=0, static_argnums=(4, 5))
-    def _store_add_packed(store, direct, packed, idx, layout, data_len):
-        """Per-step scatter fed by ONE host->device transfer per dtype group
-        (plus the write-head/env indices riding the int32 group) instead of
-        one per key. On a tunneled backend every `device_put` is a host
-        round-trip, so the per-step add cost is transfer *count*, not bytes —
-        this is what closed the duty-vs-e2e gap (BENCHES.md round 3).
+    @partial(jax.jit, donate_argnums=0, static_argnums=(3, 4))
+    def _store_add_packed(store, direct, packed, layout, data_len):
+        """Per-step scatter fed by ONE host->device transfer per width class
+        (the write-head/env indices ride inside the packed group as
+        `__idx__`) instead of one per key. On a tunneled backend every
+        `device_put` is a host round-trip, so the per-step add cost is
+        transfer *count*, not bytes — in the hot loop the whole add is a
+        single transfer plus the reused policy obs put (BENCHES.md round 3).
 
         `direct` holds values already resident on device (the training loops
-        reuse the policy step's obs put); `packed[dtype]` is the flat
-        concatenation of the host values of that dtype, unpacked here by the
-        static `layout` of `(key, dtype_str, shape, offset, size)` rows.
-        `idx` is `concat(starts, cols)` as int32."""
+        reuse the policy step's obs put and its action output); `packed[g]`
+        is the flat byte-view concatenation of the host values of width
+        class `g`, unpacked by the static `layout` of
+        `(key, dtype_str, shape, offset, size)` rows."""
         capacity = next(iter(store.values())).shape[0]
+        data = _unpack_values(direct, packed, layout)
+        idx = data.pop("__idx__")
         n_sel = idx.shape[0] // 2
         starts, cols = idx[:n_sel], idx[n_sel:]
         rows = (starts[None, :] + jnp.arange(data_len)[:, None]) % capacity
-        data = _unpack_values(direct, packed, layout)
         return {
             k: store[k].at[rows, cols[None, :]].set(data[k].astype(store[k].dtype))
             for k in store
@@ -1012,13 +1037,14 @@ class AsyncReplayBuffer:
         self._upos[cols] = (starts + data_len) % self._buffer_size
 
     def _packed_scatter(self, data, starts, cols, data_len):
-        """Pack host values into one transfer per dtype and scatter; values
-        already on device (e.g. the policy step's obs put, reused by the
-        mains) go straight into the scatter without another round-trip."""
-        direct, packed, layout = _pack_host_values(data)
-        idx = jnp.asarray(np.concatenate([starts, cols]).astype(np.int32))
+        """Pack host values into one transfer per width class and scatter;
+        values already on device (e.g. the policy step's obs put, reused by
+        the mains) go straight into the scatter without another round-trip.
+        The scatter indices ride the packed transfer as `__idx__`."""
+        idx = np.concatenate([starts, cols]).astype(np.int32)
+        direct, packed, layout = _pack_host_values({**data, "__idx__": idx})
         return self._store_add_packed(
-            self._store, direct, packed, idx, layout, data_len
+            self._store, direct, packed, layout, data_len
         )
 
     # -- sampling -------------------------------------------------------------

@@ -1,0 +1,55 @@
+"""The on-device env-action index path: `env_action_indices` (jit-side
+argmax, the tiny per-step d2h payload) must agree with the host-side
+`one_hot_to_env_actions` it replaces, and `indices_to_one_hot` must invert
+it exactly (host/memmap buffer rows are rebuilt from the index pull)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.algos.ppo.agent import (
+    env_action_indices,
+    indices_to_env_actions,
+    indices_to_one_hot,
+    one_hot_to_env_actions,
+)
+
+
+def _random_one_hot(rng, n, actions_dim):
+    parts = []
+    for d in actions_dim:
+        idx = rng.integers(0, d, n)
+        parts.append(np.eye(d, dtype=np.float32)[idx])
+    return np.concatenate(parts, axis=-1)
+
+
+@pytest.mark.parametrize("actions_dim", [(4,), (6,), (3, 5, 2)])
+def test_indices_match_host_argmax(actions_dim):
+    rng = np.random.default_rng(0)
+    one_hot = _random_one_hot(rng, 8, actions_dim)
+    idx = jax.jit(
+        lambda a: env_action_indices(a, actions_dim, False)
+    )(jnp.asarray(one_hot))
+    env_from_idx = indices_to_env_actions(np.asarray(idx), actions_dim, False)
+    env_from_onehot = one_hot_to_env_actions(one_hot, actions_dim, False)
+    np.testing.assert_array_equal(env_from_idx, env_from_onehot)
+    # single Discrete head: env.step wants a scalar per env
+    assert env_from_idx.shape == ((8,) if len(actions_dim) == 1 else (8, len(actions_dim)))
+
+
+@pytest.mark.parametrize("actions_dim", [(4,), (3, 5, 2)])
+def test_one_hot_roundtrip(actions_dim):
+    rng = np.random.default_rng(1)
+    one_hot = _random_one_hot(rng, 5, actions_dim)
+    idx = np.asarray(env_action_indices(jnp.asarray(one_hot), actions_dim, False))
+    np.testing.assert_array_equal(indices_to_one_hot(idx, actions_dim), one_hot)
+
+
+def test_continuous_passthrough():
+    acts = np.random.default_rng(2).normal(size=(4, 3)).astype(np.float32)
+    out = env_action_indices(jnp.asarray(acts), (3,), True)
+    np.testing.assert_allclose(np.asarray(out), acts)
+    np.testing.assert_allclose(
+        indices_to_env_actions(np.asarray(out), (3,), True), acts
+    )

@@ -451,6 +451,39 @@ class TestPackedDeviceAdds:
             )
             np.testing.assert_array_equal(ring, v)
 
+    def test_width_class_packing_is_bit_exact(self):
+        # the packed transfer bit-views int32 as float32 (one transfer per
+        # width class, not per dtype) — the roundtrip must preserve every
+        # bit pattern, including ones that alias NaNs/infs/subnormals
+        from sheeprl_tpu.data.buffers import _pack_host_values, _unpack_values
+
+        evil_i32 = np.array(
+            [0, -1, 2**31 - 1, -(2**31), 0x7F800001, 0x7FC00000],
+            np.int32,
+        )
+        evil_f32 = np.array(
+            [0.0, -0.0, np.inf, -np.inf, np.nan, np.float32(1e-45)], np.float32
+        )
+        data = {
+            "i": evil_i32.reshape(1, 6),
+            "f": evil_f32.reshape(1, 6),
+            "u8": np.arange(256, dtype=np.uint8).reshape(1, 256),
+            "b": np.array([[True, False, True]]),
+            "i64": np.array([[7, -9]], np.int64),
+        }
+        direct, packed, layout = _pack_host_values(data)
+        assert not direct and len(packed) == 2  # one 4-byte + one 1-byte blob
+        out = _unpack_values(direct, packed, layout)
+        np.testing.assert_array_equal(np.asarray(out["i"]), data["i"])
+        np.testing.assert_array_equal(
+            np.asarray(out["f"]).view(np.int32), evil_f32.view(np.int32)[None]
+        )
+        np.testing.assert_array_equal(np.asarray(out["u8"]), data["u8"])
+        np.testing.assert_array_equal(np.asarray(out["b"]), data["b"])
+        np.testing.assert_array_equal(
+            np.asarray(out["i64"]), data["i64"].astype(np.int32)
+        )
+
     def test_subset_indices_through_packed_path(self):
         arb = AsyncReplayBuffer(8, n_envs=3, storage="device", sequential=True)
         arb.add({"observations": np.zeros((1, 3, 1), np.float32)})

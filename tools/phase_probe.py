@@ -1,0 +1,137 @@
+"""Phase attribution for the DV3 duty-vs-e2e gap (round-3/4 hypothesis work).
+
+Times cumulative variants of the honest e2e cycle on the current backend:
+
+  V0 duty    : train_every policy steps (fixed device obs) + train on a
+               pre-staged batch  — bench's duty cycle.
+  V1 +put    : fresh host obs -> device_put every policy step.
+  V2 +add    : + the real AsyncReplayBuffer.add per step (device storage,
+               reusing the policy obs put).
+  V3 +sample : + rb.sample + stage per cycle, train on the sampled batch —
+               bench's honest e2e cycle.
+
+Adjacent differences attribute the gap to obs transfer, replay add, and
+replay sample/stage.  Every variant syncs via a host scalar pull per cycle
+(readiness can lie on the tunnel; a value fetch cannot — see BENCHES.md).
+
+Usage: python tools/phase_probe.py [--tiny] [--cycles N] [--repeats N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--cycles", type=int, default=10)
+    p.add_argument("--repeats", type=int, default=2)
+    a = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.data import stage_batch
+
+    print(f"backend: {jax.devices()}", file=sys.stderr)
+    args, state0, opts, actions_dim, is_continuous, obs_space = bench._dv3_setup(
+        a.tiny
+    )
+    T, B, n_envs = (
+        args.per_rank_sequence_length,
+        args.per_rank_batch_size,
+        args.num_envs,
+    )
+    world_opt, actor_opt, critic_opt = opts
+    train_step = make_train_step(
+        args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim,
+        is_continuous,
+    )
+    make_player, player_step = bench._dv3_player_fns(args, actions_dim, is_continuous)
+    sample_batch, fixed_obs, mask = bench._dv3_synth_data(args, actions_dim, obs_space)
+    rb, fake_env_obs, add_step = bench._dv3_replay_harness(args)
+
+    def make_cycle(put: bool, add: bool, sample: bool):
+        def one_cycle(state, player_state, key):
+            player = make_player(state)
+            for _ in range(args.train_every):
+                if put:
+                    obs_u8 = fake_env_obs()
+                    dev_u8 = jnp.asarray(obs_u8)
+                    obs = {"rgb": dev_u8}
+                else:
+                    obs = fixed_obs
+                key, sk = jax.random.split(key)
+                player_state, _ = player_step(player, player_state, obs, sk, None)
+                if add:
+                    add_step(obs_u8 if rb.prefers_host_adds else dev_u8)
+            if sample:
+                local = rb.sample(B, sequence_length=T, n_samples=1)
+                batch = {k: v[0] for k, v in stage_batch(local).items()}
+            else:
+                batch = dict(sample_batch)
+            key, tk = jax.random.split(key)
+            state, metrics = train_step(state, batch, tk, jnp.float32(0.02))
+            float(jax.device_get(metrics["Loss/reconstruction_loss"]))
+            return state, player_state, key
+
+        return one_cycle
+
+    variants = {
+        "V0_duty": make_cycle(False, False, False),
+        "V1_put": make_cycle(True, False, False),
+        "V2_add": make_cycle(True, True, False),
+        "V3_sample": make_cycle(True, True, True),
+    }
+    # Interleaved schedule (V0 V1 V2 V3 | V0 V1 V2 V3 | ...) so tunnel-
+    # latency drift over the run hits every variant equally (the sequential
+    # layout confounded drift with the later variants). Per-variant state
+    # evolves independently; train_step donates, so each gets a fresh copy.
+    slots = {}
+    for name, cyc in variants.items():
+        state = jax.tree_util.tree_map(jnp.copy, state0)
+        player_state = make_player(state).init_states(n_envs)
+        key = jax.random.PRNGKey(1)
+        slots[name] = [cyc, *cyc(state, player_state, key)]  # compile cycle
+    times: dict = {name: [] for name in variants}
+    total = a.cycles * a.repeats
+    for i in range(total):
+        for name in variants:
+            cyc, state, player_state, key = slots[name]
+            t0 = time.perf_counter()
+            state, player_state, key = cyc(state, player_state, key)
+            times[name].append(time.perf_counter() - t0)
+            slots[name] = [cyc, state, player_state, key]
+        if (i + 1) % a.cycles == 0:
+            snap = {n: round(1e3 * sorted(ts)[len(ts) // 2], 1)
+                    for n, ts in times.items()}
+            print(f"after {i + 1} cycles, median ms/cycle: {snap}",
+                  file=sys.stderr)
+    out: dict = {"cycles": total, "interleaved": True}
+    # medians are robust to tunnel-latency spikes
+    best = {
+        n: round(1e3 * sorted(ts)[len(ts) // 2], 1) for n, ts in times.items()
+    }
+    out["median_ms_per_cycle"] = best
+    out["sps"] = {
+        n: round(args.train_every * n_envs / (best[n] / 1e3), 1) for n in best
+    }
+    out["attribution_ms"] = {
+        "obs_put": round(best["V1_put"] - best["V0_duty"], 1),
+        "replay_add": round(best["V2_add"] - best["V1_put"], 1),
+        "replay_sample": round(best["V3_sample"] - best["V2_add"], 1),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -290,8 +290,12 @@ def _dv3_e2e_sps(
     import jax.numpy as jnp
     import numpy as np
 
-    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
-    from sheeprl_tpu.data import AsyncReplayBuffer, stage_batch
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+        make_blob_step,
+        make_train_step,
+    )
+    from sheeprl_tpu.algos.dreamer_v3.utils import make_device_preprocess
+    from sheeprl_tpu.data import AsyncReplayBuffer, StepBlobCodec, stage_batch
     from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
 
     T, B = args.per_rank_sequence_length, args.per_rank_batch_size
@@ -308,6 +312,28 @@ def _dv3_e2e_sps(
     player_state = make_player(state).init_states(n_envs)
 
     rb, fake_env_obs, add_step = _dv3_replay_harness(args)
+    # blob transport mirror of the main's device-buffer hot loop: ONE
+    # transfer per step carries obs + replay floats + ring write indices,
+    # and the policy's own actions land in the row on device (same
+    # SHEEPRL_TPU_STEP_BLOB=0 escape hatch as the main, for A/B probing)
+    import os as _os
+
+    use_blob = (
+        not rb.prefers_host_adds
+        and _os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
+    )
+    if use_blob:
+        codec = StepBlobCodec(
+            {"rgb": (64, 64, 3)},
+            {"rewards": (1,), "dones": (1,), "is_first": (1,)},
+            idx_len=2 * n_envs, n_envs=n_envs,
+        )
+        blob_step = make_blob_step(
+            codec, ("rgb",), make_device_preprocess(("rgb",)),
+            actions_dim, is_continuous,
+        )
+        zeros1 = np.zeros((n_envs, 1), np.float32)
+        expl = jnp.float32(0.0)
 
     key = jax.random.PRNGKey(1)
 
@@ -315,11 +341,27 @@ def _dv3_e2e_sps(
         player = make_player(state)
         for _ in range(args.train_every):
             obs_u8 = fake_env_obs()
-            dev_u8 = jnp.asarray(obs_u8)  # the ONE obs put per step
             key, sk = jax.random.split(key)
-            player_state, _ = player_step(player, player_state, {"rgb": dev_u8}, sk, None)
-            # staged/host buffers want host rows; device buffers reuse the put
-            add_step(obs_u8 if rb.prefers_host_adds else dev_u8)
+            if use_blob:
+                idx = rb.reserve(1)
+                blob = codec.pack(
+                    {"rgb": obs_u8},
+                    {"rewards": zeros1, "dones": zeros1, "is_first": zeros1},
+                    idx,
+                )
+                player_state, _, row, idx_dev = blob_step(
+                    player, player_state, jnp.asarray(blob), sk, expl
+                )
+                rb.add_direct(row, idx_dev)
+            else:
+                dev_u8 = jnp.asarray(obs_u8)  # the ONE obs put per step
+                player_state, _ = player_step(
+                    player, player_state, {"rgb": dev_u8}, sk, None
+                )
+                # staged/host buffers want host rows; device buffers reuse
+                # the put (the blob A/B's OFF arm must stay the previous
+                # best path: obs put + ONE packed add transfer)
+                add_step(obs_u8 if rb.prefers_host_adds else dev_u8)
         local_data = rb.sample(B, sequence_length=T, n_samples=1)
         staged = stage_batch(local_data)
         sample = {k: v[0] for k, v in staged.items()}
@@ -653,7 +695,13 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     sweep_baseline = bf16_sps if bf16_win else candidates[best_fams]
     unroll_sps: dict[int, float] = {}
     if not tiny and sweep_baseline and sweep_baseline > 0.0:
-        for u in (4, 8):
+        # escalating ladder: always measure 4 and 8; climb to 16/32 only
+        # while the top rung keeps winning (each rung is a full recompile,
+        # so the ladder is bounded and climbs only on evidence)
+        ladder = [4, 8, 16, 32]
+        for i, u in enumerate(ladder):
+            if i >= 2 and unroll_sps[ladder[i - 1]] <= unroll_sps[ladder[i - 2]]:
+                break
             _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(u)
             unroll_sps[u] = _plausible(
                 _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
@@ -745,7 +793,7 @@ def _ppo_run(decoupled: bool, num_devices: int = -1, pixel: bool = False) -> flo
     import jax.numpy as jnp
     import numpy as np
 
-    from sheeprl_tpu.algos.ppo.agent import PPOAgent, one_hot_to_env_actions
+    from sheeprl_tpu.algos.ppo.agent import PPOAgent, indices_to_env_actions
     from sheeprl_tpu.algos.ppo.args import PPOArgs
     from sheeprl_tpu.algos.ppo.ppo import (
         TrainState,
@@ -815,8 +863,10 @@ def _ppo_run(decoupled: bool, num_devices: int = -1, pixel: bool = False) -> flo
             dobs = {k: jnp.asarray(obs[k]) for k in obs_keys}
             if decoupled:
                 dobs = {k: jax.device_put(v, meshes.player_device) for k, v in dobs.items()}
-            actions, logprob, value = policy_step(player_agent, dobs, sk)
-            env_actions = one_hot_to_env_actions(actions, actions_dim, is_continuous)
+            actions, logprob, value, env_idx = policy_step(player_agent, dobs, sk)
+            env_actions = indices_to_env_actions(
+                np.asarray(env_idx), actions_dim, is_continuous
+            )
             nobs, rewards, terms, truncs, _ = envs.step(list(env_actions))
             for k in obs_keys:
                 rows[k].append(np.asarray(obs[k]))

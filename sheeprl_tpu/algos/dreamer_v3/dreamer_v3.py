@@ -33,7 +33,7 @@ import numpy as np
 import optax
 
 from ... import nn, ops
-from ...data import AsyncReplayBuffer, stage_batch
+from ...data import AsyncReplayBuffer, StepBlobCodec, stage_batch
 from ...envs import make_vector_env
 from ...envs.wrappers import RestartOnException
 from ...ops.distributions import (
@@ -447,6 +447,35 @@ def _random_actions(action_space, actions_dim, is_continuous: bool):
     return one_hot, sample
 
 
+def make_blob_step(codec, obs_keys, dev_preprocess, actions_dim, is_continuous):
+    """Blob transport (data/blob.py): the whole interaction step — policy
+    obs, the replay row's floats, the ring write indices — rides ONE
+    host->device transfer; this jit unpacks it, runs the policy, and
+    returns the device-resident replay row for `rb.add_direct` (zero
+    further transfers). Disable with `SHEEPRL_TPU_STEP_BLOB=0` (the
+    separate-puts path remains the host/memmap route)."""
+
+    def _blob_step(p, s, blob, k, expl):
+        u8, f32, idx = codec.unpack(blob)
+        o = {**u8, **{kk: f32[kk] for kk in obs_keys if kk in f32}}
+        mask = {kk: v for kk, v in o.items() if kk.startswith("mask")} or None
+        new_s, acts = p.step(
+            s, dev_preprocess(o), k, expl, is_training=True, mask=mask
+        )
+        row = {kk: v[None] for kk, v in o.items()}
+        row["actions"] = acts[None].astype(jnp.float32)
+        for kk in ("rewards", "dones", "is_first"):
+            row[kk] = f32[kk][None]
+        return (
+            new_s,
+            env_action_indices(acts, actions_dim, is_continuous),
+            row,
+            idx,
+        )
+
+    return jax.jit(_blob_step)
+
+
 @register_algorithm()
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV3Args)
@@ -660,11 +689,39 @@ def main(argv: Sequence[str] | None = None) -> None:
     step_data["is_first"] = np.ones((args.num_envs, 1), np.float32)
     player_state = player.init_states(args.num_envs)
     device_step_obs = None  # the policy step's obs puts, reused by rb.add
+    expl_dev = jnp.float32(expl_amount)  # re-put only when the decay ticks
+
+    # blob transport (device buffers): obs + replay-row floats + write
+    # indices ride ONE transfer per step; shapes/dtypes from the first obs
+    use_blob = (
+        not rb.prefers_host_adds
+        and os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
+    )
+    if use_blob:
+        u8_keys = tuple(
+            k for k in obs_keys if np.asarray(obs[k]).dtype == np.uint8
+        )
+        f32_obs_keys = tuple(k for k in obs_keys if k not in u8_keys)
+        codec = StepBlobCodec(
+            {k: np.asarray(obs[k]).shape[1:] for k in u8_keys},
+            {
+                **{k: np.asarray(obs[k]).shape[1:] for k in f32_obs_keys},
+                "rewards": (1,),
+                "dones": (1,),
+                "is_first": (1,),
+            },
+            idx_len=2 * args.num_envs,
+            n_envs=args.num_envs,
+        )
+        blob_step = make_blob_step(
+            codec, tuple(obs_keys), _dev_preprocess, actions_dim, is_continuous
+        )
 
     gradient_steps = 0
     start_time = time.perf_counter()
     for global_step in range(start_step, num_updates + 1):
         # ---- action selection ----------------------------------------------
+        blob_added = False
         if (
             global_step <= learning_starts
             and args.checkpoint_path is None
@@ -676,6 +733,31 @@ def main(argv: Sequence[str] | None = None) -> None:
             ]
             actions = np.stack([p[0] for p in pairs])
             env_actions = [p[1] for p in pairs]
+        elif use_blob:
+            # ONE transfer for the whole step: obs + prev rewards/dones/
+            # is_first + ring write indices; the jit returns the device
+            # replay row and add_direct scatters it transfer-free
+            idx = rb.reserve(1)
+            blob = codec.pack(
+                {k: np.asarray(obs[k]) for k in u8_keys},
+                {
+                    **{k: np.asarray(obs[k]) for k in f32_obs_keys},
+                    "rewards": step_data["rewards"],
+                    "dones": step_data["dones"],
+                    "is_first": step_data["is_first"],
+                },
+                idx,
+            )
+            key, step_key = jax.random.split(key)
+            player_state, env_idx_dev, row, idx_dev = blob_step(
+                player, player_state, jnp.asarray(blob), step_key, expl_dev
+            )
+            rb.add_direct(row, idx_dev)
+            blob_added = True
+            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
+            env_actions = list(
+                indices_to_env_actions(env_idx, actions_dim, is_continuous)
+            )
         else:
             # raw puts (uint8 for pixels): normalization happens inside the
             # jitted player step, and these same device arrays feed rb.add
@@ -684,7 +766,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             key, step_key = jax.random.split(key)
             player_state, actions_dev, env_idx_dev = player_step(
                 player, player_state, device_obs, step_key,
-                jnp.float32(expl_amount), mask,
+                expl_dev, mask,
             )
             env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
             env_actions = list(
@@ -696,17 +778,18 @@ def main(argv: Sequence[str] | None = None) -> None:
                 host=rb.prefers_host_adds,
             )
 
-        step_data["actions"] = (
-            actions if isinstance(actions, jax.Array)
-            else np.asarray(actions, np.float32)
-        )
-        add_data = {k: v[None] for k, v in step_data.items()}
-        if device_step_obs is not None and not rb.prefers_host_adds:
-            # reuse the policy step's obs puts instead of re-transferring
-            # (host/memmap storage and staged buffers want host numpy)
-            for k in obs_keys:
-                add_data[k] = device_step_obs[k][None]
-        rb.add(add_data)
+        if not blob_added:
+            step_data["actions"] = (
+                actions if isinstance(actions, jax.Array)
+                else np.asarray(actions, np.float32)
+            )
+            add_data = {k: v[None] for k, v in step_data.items()}
+            if device_step_obs is not None and not rb.prefers_host_adds:
+                # reuse the policy step's obs puts instead of re-transferring
+                # (host/memmap storage and staged buffers want host numpy)
+                for k in obs_keys:
+                    add_data[k] = device_step_obs[k][None]
+            rb.add(add_data)
         device_step_obs = None
 
         next_obs, rewards, terms, truncs, infos = envs.step(env_actions)
@@ -799,6 +882,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     final=args.expl_min,
                     max_decay_steps=max_step_expl_decay,
                 )
+                expl_dev = jnp.float32(expl_amount)
             aggregator.update("Params/exploration_amount", expl_amount)
 
         sps = (global_step - start_step + 1) * args.num_envs / (

@@ -1,3 +1,4 @@
+from .blob import StepBlobCodec
 from .buffers import (
     AsyncReplayBuffer,
     EpisodeBuffer,
@@ -11,5 +12,6 @@ __all__ = [
     "SequentialReplayBuffer",
     "EpisodeBuffer",
     "AsyncReplayBuffer",
+    "StepBlobCodec",
     "stage_batch",
 ]

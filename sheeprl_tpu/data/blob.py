@@ -1,0 +1,108 @@
+"""One-transfer step transport for the interaction hot loop.
+
+On a tunneled/remote TPU backend every host->device transfer carries a
+flat per-transfer cost regardless of payload bytes (BENCHES.md, round-3
+phase attribution), so the loop's per-step cost is priced by transfer
+COUNT. After the packed-add rework a device-buffer step still pays two
+transfers: the policy obs put and the replay add's packed floats+indices
+put. `StepBlobCodec` merges them: the raw obs (uint8 pixels, float
+vectors/masks), the replay row's host floats (rewards/dones/is_first),
+and the ring write-head indices ride ONE int32 blob; the policy-step jit
+unpacks it on device (bit-exact bitcasts, no value conversion) and the
+replay scatter consumes the unpacked device arrays directly
+(`AsyncReplayBuffer.reserve` + `add_direct`) — zero further transfers.
+
+Layout (static per obs shapes + n_envs):
+
+    [ 4-byte section: float32 values bit-viewed as int32, then the int32
+      write-head indices ][ 1-byte section: uint8 values, zero-padded to
+      a multiple of 4, bit-viewed as int32 ]
+
+Byte order: numpy views on a little-endian host and XLA's
+`bitcast_convert_type` (which defines the minor dimension as the
+little-endian pieces of the wider element) agree, so the roundtrip is
+bit-exact — asserted by `tests/test_data/test_blob.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["StepBlobCodec"]
+
+
+class StepBlobCodec:
+    """Pack/unpack one interaction step into a single int32 blob.
+
+    `u8_shapes` / `f32_shapes`: per-key value shapes WITHOUT the leading
+    n_envs axis (e.g. `{"rgb": (64, 64, 3)}`); every value is transported
+    at `[n_envs, *shape]`. `idx_len` is the length of the int32 index
+    vector riding along (`2 * n_envs` for `concat(starts, cols)`)."""
+
+    def __init__(
+        self,
+        u8_shapes: Mapping[str, Sequence[int]],
+        f32_shapes: Mapping[str, Sequence[int]],
+        idx_len: int,
+        n_envs: int,
+    ) -> None:
+        self.n_envs = int(n_envs)
+        self.idx_len = int(idx_len)
+        self._f32 = []  # (key, shape, offset_in_elems, size_in_elems)
+        off = 0
+        for k, shape in f32_shapes.items():
+            size = int(np.prod((n_envs, *shape)))
+            self._f32.append((k, (n_envs, *tuple(int(s) for s in shape)), off, size))
+            off += size
+        self._idx_off = off
+        self._n4 = off + self.idx_len  # elements in the 4-byte section
+        self._u8 = []
+        off = 0
+        for k, shape in u8_shapes.items():
+            size = int(np.prod((n_envs, *shape)))
+            self._u8.append((k, (n_envs, *tuple(int(s) for s in shape)), off, size))
+            off += size
+        self._u8_bytes = off
+        self._u8_padded = -(-off // 4) * 4
+        self.blob_len = self._n4 + self._u8_padded // 4
+
+    def pack(
+        self,
+        u8_values: Mapping[str, np.ndarray],
+        f32_values: Mapping[str, np.ndarray],
+        idx: np.ndarray,
+    ) -> np.ndarray:
+        """Host side: one int32 array ready for a single `jnp.asarray`."""
+        blob = np.empty(self.blob_len, np.int32)
+        w4 = blob[: self._n4]
+        for k, shape, off, size in self._f32:
+            v = np.ascontiguousarray(f32_values[k], np.float32).reshape(-1)
+            w4[off : off + size] = v.view(np.int32)
+        w4[self._idx_off :] = np.asarray(idx, np.int32).reshape(-1)
+        tail = np.zeros(self._u8_padded, np.uint8)
+        for k, shape, off, size in self._u8:
+            tail[off : off + size] = np.ascontiguousarray(
+                u8_values[k], np.uint8
+            ).reshape(-1)
+        blob[self._n4 :] = tail.view(np.int32)
+        return blob
+
+    def unpack(self, blob: jax.Array):
+        """Device side (inside jit): `(u8_dict, f32_dict, idx)` — exact
+        bit-level inverse of `pack`."""
+        w4 = blob[: self._n4]
+        f32 = {}
+        for k, shape, off, size in self._f32:
+            f32[k] = jax.lax.bitcast_convert_type(
+                w4[off : off + size], jnp.float32
+            ).reshape(shape)
+        idx = w4[self._idx_off :]
+        u8_flat = jax.lax.bitcast_convert_type(blob[self._n4 :], jnp.uint8).reshape(-1)
+        u8 = {}
+        for k, shape, off, size in self._u8:
+            u8[k] = u8_flat[off : off + size].reshape(shape)
+        return u8, f32, idx

@@ -1047,6 +1047,35 @@ class AsyncReplayBuffer:
             self._store, direct, packed, layout, data_len
         )
 
+    # -- blob transport (zero-transfer adds) ----------------------------------
+    def reserve(self, data_len: int = 1) -> np.ndarray:
+        """Advance the write head for a full-width `add_direct` and return
+        `concat(starts, cols)` as int32 — the index vector that rides the
+        step blob (`data/blob.py`) to the device, so the subsequent scatter
+        needs NO host->device transfer of its own. Bookkeeping is identical
+        to a full-width `add`; reserve-then-add_direct must not interleave
+        with other adds for the same rows."""
+        if self._storage_kind != "device" or self._stage_cap > 0:
+            raise RuntimeError(
+                "reserve()/add_direct() require device storage without staging"
+            )
+        cols = np.arange(self._n_envs)
+        starts = self._upos.copy()
+        self._ufull |= starts + data_len >= self._buffer_size
+        self._upos = (starts + data_len) % self._buffer_size
+        return np.concatenate([starts, cols]).astype(np.int32)
+
+    def add_direct(self, data: Mapping[str, jax.Array], idx: jax.Array, data_len: int = 1) -> None:
+        """Scatter a full-width row whose values (and `idx`, from
+        `reserve()` via the step blob) are ALREADY device-resident — the
+        zero-transfer half of the blob transport. Shapes `[data_len,
+        n_envs, *item]`, same contract as `add`."""
+        if self._store is None:
+            self._allocate_store(dict(data))
+        self._store = self._store_add_packed(
+            self._store, {**data, "__idx__": idx}, {}, (), data_len
+        )
+
     # -- sampling -------------------------------------------------------------
     def _partition(self, batch_size: int) -> np.ndarray:
         """Per-env sample counts. The default `split="even"` is a TPU-first

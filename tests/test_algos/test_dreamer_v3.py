@@ -99,3 +99,94 @@ def test_dreamer_v3_mlp_only(tmp_path):
         ]
     )
     assert os.path.isdir(os.path.join(tmp_path, "test", "checkpoints"))
+
+
+def test_blob_step_matches_dict_step():
+    """The one-transfer blob path (make_blob_step) must produce the same
+    player state, env-action indices, and replay row as the separate-puts
+    dict path on identical inputs — the blob is transport, not math."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3, build_models
+    from sheeprl_tpu.algos.dreamer_v3.args import DreamerV3Args
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_blob_step
+    from sheeprl_tpu.algos.dreamer_v3.utils import make_device_preprocess
+    from sheeprl_tpu.algos.ppo.agent import env_action_indices
+    from sheeprl_tpu.data import StepBlobCodec
+
+    args = DreamerV3Args(num_envs=2, env_id="dummy")
+    args.dense_units = 8
+    args.hidden_size = 8
+    args.recurrent_state_size = 8
+    args.cnn_channels_multiplier = 2
+    args.stochastic_size = 4
+    args.discrete_size = 4
+    args.mlp_layers = 1
+    actions_dim, n_envs = [3], 2
+    obs_space = {
+        "rgb": type("S", (), {"shape": (64, 64, 3)})(),
+        "vec": type("S", (), {"shape": (5,)})(),
+    }
+    wm, actor, critic, _ = build_models(
+        jax.random.PRNGKey(0), actions_dim, False, args, obs_space,
+        ["rgb"], ["vec"],
+    )
+    player = PlayerDV3(
+        encoder=wm.encoder, rssm=wm.rssm, actor=actor,
+        actions_dim=(3,), stochastic_size=args.stochastic_size,
+        discrete_size=args.discrete_size,
+        recurrent_state_size=args.recurrent_state_size,
+        is_continuous=False, compute_dtype=args.precision,
+    )
+    prep = make_device_preprocess(("rgb",))
+    codec = StepBlobCodec(
+        {"rgb": (64, 64, 3)},
+        {"vec": (5,), "rewards": (1,), "dones": (1,), "is_first": (1,)},
+        idx_len=2 * n_envs, n_envs=n_envs,
+    )
+    blob_step = make_blob_step(codec, ("rgb", "vec"), prep, actions_dim, False)
+
+    rng = np.random.default_rng(0)
+    obs_np = {
+        "rgb": rng.integers(0, 256, (n_envs, 64, 64, 3), dtype=np.uint8),
+        "vec": rng.normal(size=(n_envs, 5)).astype(np.float32),
+    }
+    floats = {
+        "rewards": rng.normal(size=(n_envs, 1)).astype(np.float32),
+        "dones": np.zeros((n_envs, 1), np.float32),
+        "is_first": np.ones((n_envs, 1), np.float32),
+    }
+    idx = np.array([0, 0, 0, 1], np.int32)
+    state0 = player.init_states(n_envs)
+    key = jax.random.PRNGKey(7)
+    expl = jnp.float32(0.0)
+
+    # dict path (the host/memmap route)
+    dev_obs = {k: jnp.asarray(v) for k, v in obs_np.items()}
+    dict_state, dict_acts = jax.jit(
+        lambda p, s, o, k, e: p.step(s, prep(o), k, e, is_training=True, mask=None)
+    )(player, state0, dev_obs, key, expl)
+    dict_idx = env_action_indices(dict_acts, actions_dim, False)
+
+    # blob path
+    blob = codec.pack(
+        {"rgb": obs_np["rgb"]}, {"vec": obs_np["vec"], **floats}, idx
+    )
+    blob_state, blob_env_idx, row, idx_dev = blob_step(
+        player, state0, jnp.asarray(blob), key, expl
+    )
+
+    np.testing.assert_array_equal(np.asarray(blob_env_idx), np.asarray(dict_idx))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(dict_state), jax.tree_util.tree_leaves(blob_state)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(row["actions"][0]), np.asarray(dict_acts), atol=1e-6
+    )
+    for k in obs_np:
+        np.testing.assert_array_equal(np.asarray(row[k][0]), obs_np[k])
+    for k in floats:
+        np.testing.assert_array_equal(np.asarray(row[k][0]), floats[k])
+    np.testing.assert_array_equal(np.asarray(idx_dev), idx)

@@ -1,0 +1,83 @@
+"""StepBlobCodec: the one-transfer step transport must be a bit-exact
+roundtrip (host pack -> device bitcast unpack), and reserve()/add_direct()
+must write the ring identically to the packed add() path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import AsyncReplayBuffer, StepBlobCodec
+
+
+def test_blob_roundtrip_bit_exact():
+    n_envs = 3
+    codec = StepBlobCodec(
+        u8_shapes={"rgb": (4, 4, 3), "gray": (5,)},
+        f32_shapes={"rewards": (1,), "dones": (1,), "vec": (7,)},
+        idx_len=2 * n_envs,
+        n_envs=n_envs,
+    )
+    rng = np.random.default_rng(0)
+    u8 = {
+        "rgb": rng.integers(0, 256, (n_envs, 4, 4, 3), dtype=np.uint8),
+        "gray": rng.integers(0, 256, (n_envs, 5), dtype=np.uint8),
+    }
+    f32 = {
+        "rewards": rng.normal(size=(n_envs, 1)).astype(np.float32),
+        "dones": np.array([[0.0], [1.0], [0.0]], np.float32),
+        # NaN/inf/subnormal bit patterns must survive the bitcasts
+        "vec": np.array(
+            [[np.nan, np.inf, -np.inf, -0.0, 1e-45, 1.5, -2.5]] * n_envs,
+            np.float32,
+        ),
+    }
+    idx = np.array([0, 1, 2, 0, 1, 2], np.int32)
+
+    blob = codec.pack(u8, f32, idx)
+    assert blob.dtype == np.int32 and blob.shape == (codec.blob_len,)
+
+    out_u8, out_f32, out_idx = jax.jit(codec.unpack)(jnp.asarray(blob))
+    for k in u8:
+        np.testing.assert_array_equal(np.asarray(out_u8[k]), u8[k])
+    for k in f32:
+        np.testing.assert_array_equal(
+            np.asarray(out_f32[k]).view(np.int32), f32[k].view(np.int32)
+        )
+    np.testing.assert_array_equal(np.asarray(out_idx), idx)
+
+
+def test_reserve_add_direct_matches_packed_add():
+    n_envs, cap = 2, 8
+    rng = np.random.default_rng(1)
+    rows = [
+        {
+            "rgb": rng.integers(0, 256, (1, n_envs, 3, 3, 1), dtype=np.uint8),
+            "rewards": rng.normal(size=(1, n_envs, 1)).astype(np.float32),
+            "actions": rng.normal(size=(1, n_envs, 4)).astype(np.float32),
+        }
+        for _ in range(cap + 3)  # wraps around
+    ]
+    via_add = AsyncReplayBuffer(cap, n_envs, storage="device", obs_keys=("rgb",))
+    via_blob = AsyncReplayBuffer(cap, n_envs, storage="device", obs_keys=("rgb",))
+    for row in rows:
+        via_add.add(row)
+        idx = via_blob.reserve(1)
+        via_blob.add_direct(
+            {k: jnp.asarray(v) for k, v in row.items()}, jnp.asarray(idx)
+        )
+    for k in rows[0]:
+        np.testing.assert_array_equal(
+            np.asarray(via_add._store[k]), np.asarray(via_blob._store[k])
+        )
+    np.testing.assert_array_equal(via_add._upos, via_blob._upos)
+    np.testing.assert_array_equal(via_add._ufull, via_blob._ufull)
+
+
+def test_reserve_requires_device_unstaged():
+    host = AsyncReplayBuffer(4, 1, storage="host")
+    with pytest.raises(RuntimeError):
+        host.reserve()
+    staged = AsyncReplayBuffer(4, 1, storage="device", stage_rows=8)
+    with pytest.raises(RuntimeError):
+        staged.reserve()

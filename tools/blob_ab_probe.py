@@ -1,0 +1,64 @@
+"""Chip A/B for the one-transfer blob step transport (data/blob.py).
+
+Interleaves the honest DV3 e2e cycle (bench._dv3_e2e_sps) with the blob
+path ON and OFF — ABAB so tunnel-latency drift hits both variants equally.
+OFF is the previous best path (separate obs put + single packed add put);
+ON merges everything into one int32 blob per step.
+
+Usage: python tools/blob_ab_probe.py [--tiny] [--repeats N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--repeats", type=int, default=4)
+    a = p.parse_args()
+
+    import jax
+
+    import bench
+
+    print(f"backend: {jax.devices()}", file=sys.stderr)
+    args, state, opts, actions_dim, is_continuous, obs_space = bench._dv3_setup(
+        a.tiny
+    )
+    runs: dict[str, list[float]] = {"blob": [], "dict": []}
+    for rep in range(a.repeats):
+        for variant in ("blob", "dict"):
+            os.environ["SHEEPRL_TPU_STEP_BLOB"] = "1" if variant == "blob" else "0"
+            t0 = time.perf_counter()
+            sps = bench._measure_guarded(
+                bench._dv3_e2e_sps, args, state, opts,
+                actions_dim, is_continuous, a.tiny,
+            )
+            runs[variant].append(round(sps, 1))
+            print(
+                f"rep {rep} {variant}: e2e_sps={sps:.1f}"
+                f" ({time.perf_counter() - t0:.1f}s wall)",
+                file=sys.stderr,
+            )
+    os.environ.pop("SHEEPRL_TPU_STEP_BLOB", None)
+    med = {
+        k: sorted(v)[len(v) // 2] if v else 0.0 for k, v in runs.items()
+    }
+    out = {
+        "runs": runs,
+        "median": med,
+        "blob_over_dict": round(med["blob"] / med["dict"], 3) if med["dict"] else None,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

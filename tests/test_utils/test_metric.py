@@ -1,0 +1,54 @@
+"""MetricAggregator / MovingAverageMetric (reference metric.py:12-137):
+running means, windowed stats, and the lazy device-scalar pull — updating
+with jax scalars in the hot loop must not force a sync, and compute() must
+batch-prefetch then convert correctly."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.metric import MetricAggregator, MovingAverageMetric
+
+
+def test_mean_metric_update_compute_reset():
+    agg = MetricAggregator()
+    agg.update("loss", 1.0)
+    agg.update("loss", 3.0)
+    out = agg.compute()
+    assert out == {"loss": 2.0}
+    agg.reset()
+    assert agg.compute() == {}  # empty metrics are skipped
+
+
+def test_device_scalars_pull_at_compute_time():
+    agg = MetricAggregator()
+    # jax scalars (what train_step metrics are) — update must accept them
+    # raw; compute prefetches then converts
+    agg.update("a", jnp.float32(1.5))
+    agg.update("a", jnp.float32(2.5))
+    agg.update("b", jnp.float32(-1.0))
+    out = agg.compute()
+    assert out["a"] == pytest.approx(2.0)
+    assert out["b"] == pytest.approx(-1.0)
+
+
+def test_moving_average_window_and_dict_flattening():
+    agg = MetricAggregator({"rew": MovingAverageMetric(window=3)})
+    for v in (1.0, 2.0, jnp.float32(3.0), 4.0):  # first value evicted
+        agg.update("rew", v)
+    out = agg.compute()
+    assert out["rew/mean"] == pytest.approx(3.0)
+    assert out["rew/min"] == pytest.approx(2.0)
+    assert out["rew/max"] == pytest.approx(4.0)
+    assert out["rew/std"] == pytest.approx(np.std([2.0, 3.0, 4.0]))
+    agg.reset()
+    assert agg.compute() == {}
+
+
+def test_add_duplicate_raises_and_pop():
+    agg = MetricAggregator()
+    agg.add("x")
+    with pytest.raises(ValueError):
+        agg.add("x")
+    agg.pop("x")
+    agg.add("x")  # fine after pop

@@ -65,7 +65,7 @@ from ..dreamer_v3.dreamer_v3 import _random_actions
 from .agent import PlayerDV2, build_models
 from .args import DreamerV2Args
 from .loss import reconstruction_loss
-from .utils import make_device_preprocess, substitute_step_obs, test
+from .utils import make_device_preprocess, make_row_codec, substitute_step_obs, test
 
 
 class DV2TrainState(nn.Module):
@@ -618,6 +618,13 @@ def main(argv: Sequence[str] | None = None) -> None:
             episode_steps[i].append({k: v[i] for k, v in step_data.items()})
     player_state = player.init_states(args.num_envs)
     device_next_obs = None  # this step's obs put, shared policy<->rb.add
+    use_blob = (
+        buffer_type == "sequential"
+        and not rb.prefers_host_adds
+        and os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
+    )
+    if use_blob:
+        blob_add = make_row_codec(obs, obs_keys, args.num_envs, ("rewards", "dones", "is_first"))
 
     gradient_steps = 0
     start_time = time.perf_counter()
@@ -686,11 +693,16 @@ def main(argv: Sequence[str] | None = None) -> None:
             np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
         ).astype(np.float32)
         if buffer_type == "sequential":
-            add_data = {k: v[None] for k, v in step_data.items()}
-            # one put for this step's obs: the add consumes it now and the
-            # next policy step reuses it (unless an env resets below)
-            device_next_obs = substitute_step_obs(add_data, rb, real_next_obs, obs_keys)
-            rb.add(add_data)
+            if use_blob and isinstance(actions, jax.Array):
+                # ONE transfer for obs + row floats + ring write indices;
+                # returns the obs the next policy step reuses (data/blob.py)
+                device_next_obs = blob_add(rb, real_next_obs, step_data, actions)
+            else:
+                add_data = {k: v[None] for k, v in step_data.items()}
+                # one put for this step's obs: the add consumes it now and the
+                # next policy step reuses it (unless an env resets below)
+                device_next_obs = substitute_step_obs(add_data, rb, real_next_obs, obs_keys)
+                rb.add(add_data)
         else:
             # the episode accumulator keeps host rows; re-put next step
             device_next_obs = None

@@ -11,7 +11,14 @@ import numpy as np
 from ...utils.env import make_dict_env
 from ..ppo.agent import one_hot_to_env_actions
 
-__all__ = ["preprocess_obs", "make_device_preprocess", "substitute_step_obs", "test"]
+__all__ = [
+    "preprocess_obs",
+    "make_device_preprocess",
+    "substitute_step_obs",
+    "make_row_codec",
+    "make_blob_row",
+    "test",
+]
 
 
 def preprocess_obs(obs: dict, cnn_keys, mlp_keys) -> dict:
@@ -47,6 +54,61 @@ def substitute_step_obs(add_data, rb, real_next_obs, obs_keys):
     for k in obs_keys:
         add_data[k] = dev[k][None]
     return dev
+
+
+def make_row_codec(obs, obs_keys, n_envs, float_keys):
+    """Build the blob transport for a V1/V2-row-layout main from the first
+    observation's shapes/dtypes (uint8 keys vs float keys split here, once).
+    Returns `blob_add(rb, real_next_obs, step_data, actions_dev)` — the
+    whole one-transfer add: reserve the ring rows, pack obs + row floats +
+    indices into one int32 blob, scatter via the jitted row assembler, and
+    return the obs dict the next policy step reuses."""
+    from ...data import StepBlobCodec
+
+    obs_keys = tuple(obs_keys)
+    float_keys = tuple(float_keys)
+    codec, u8_keys, f32_obs_keys = StepBlobCodec.for_step(
+        obs, obs_keys, n_envs, float_keys
+    )
+    blob_row = make_blob_row(codec, obs_keys, float_keys)
+
+    def blob_add(rb, real_next_obs, step_data, actions_dev):
+        bidx = rb.reserve(1)
+        blob = codec.pack(
+            {k: real_next_obs[k] for k in u8_keys},
+            {
+                **{k: real_next_obs[k] for k in f32_obs_keys},
+                **{k: step_data[k] for k in float_keys},
+            },
+            bidx,
+        )
+        row, idx_dev, obs_dev = blob_row(jax.numpy.asarray(blob), actions_dev)
+        rb.add_direct(row, idx_dev)
+        return obs_dev
+
+    return blob_add
+
+
+def make_blob_row(codec, obs_keys, float_keys):
+    """One-transfer add for the V1/V2 row layout (data/blob.py): the
+    post-env-step stored obs, the row's floats, and the ring write-head
+    indices (`AsyncReplayBuffer.reserve`) ride ONE int32 blob; this jit
+    unpacks it bit-exactly, attaches the policy step's device-resident
+    actions, and returns `(row, idx, obs)` — the row for `add_direct`
+    (zero further transfers) and the obs dict the next policy step reuses
+    in place of `substitute_step_obs`'s separate put. Disable with
+    `SHEEPRL_TPU_STEP_BLOB=0`."""
+
+    def _blob_row(blob, actions_dev):
+        u8, f32, idx = codec.unpack(blob)
+        o = {**u8, **{k: f32[k] for k in obs_keys if k in f32}}
+        row = {k: v[None] for k, v in o.items()}
+        row["actions"] = actions_dev[None].astype(jax.numpy.float32)
+        for k in float_keys:
+            row[k] = f32[k][None]
+        return row, idx, o
+
+    return jax.jit(_blob_row)
 
 
 def test(

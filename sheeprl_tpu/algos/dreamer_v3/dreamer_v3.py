@@ -698,20 +698,8 @@ def main(argv: Sequence[str] | None = None) -> None:
         and os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
     )
     if use_blob:
-        u8_keys = tuple(
-            k for k in obs_keys if np.asarray(obs[k]).dtype == np.uint8
-        )
-        f32_obs_keys = tuple(k for k in obs_keys if k not in u8_keys)
-        codec = StepBlobCodec(
-            {k: np.asarray(obs[k]).shape[1:] for k in u8_keys},
-            {
-                **{k: np.asarray(obs[k]).shape[1:] for k in f32_obs_keys},
-                "rewards": (1,),
-                "dones": (1,),
-                "is_first": (1,),
-            },
-            idx_len=2 * args.num_envs,
-            n_envs=args.num_envs,
+        codec, u8_keys, f32_obs_keys = StepBlobCodec.for_step(
+            obs, obs_keys, args.num_envs, ("rewards", "dones", "is_first")
         )
         blob_step = make_blob_step(
             codec, tuple(obs_keys), _dev_preprocess, actions_dim, is_continuous

@@ -61,7 +61,7 @@ from ..dreamer_v1.agent import PlayerDV1
 from ..dreamer_v1.loss import actor_loss as dv1_actor_loss
 from ..dreamer_v1.loss import critic_loss as dv1_critic_loss
 from ..dreamer_v1.loss import reconstruction_loss
-from ..dreamer_v2.utils import make_device_preprocess, substitute_step_obs, test
+from ..dreamer_v2.utils import make_device_preprocess, make_row_codec, substitute_step_obs, test
 from ..dreamer_v3.agent import WorldModel
 from ..dreamer_v3.dreamer_v3 import _random_actions
 from .agent import build_models, ensemble_apply
@@ -615,6 +615,12 @@ def main(argv: Sequence[str] | None = None) -> None:
     player = make_player(state, exploring=True)
     player_state = player.init_states(args.num_envs)
     device_next_obs = None  # this step's obs put, shared policy<->rb.add
+    use_blob = (
+        not rb.prefers_host_adds
+        and os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
+    )
+    if use_blob:
+        blob_add = make_row_codec(obs, obs_keys, args.num_envs, ("rewards", "dones"))
 
     gradient_steps = 0
     start_time = time.perf_counter()
@@ -683,11 +689,16 @@ def main(argv: Sequence[str] | None = None) -> None:
         step_data["rewards"] = (
             np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
         ).astype(np.float32)
-        add_data = {k: v[None] for k, v in step_data.items()}
-        # one put for this step's obs: the add consumes it now and the
-        # next policy step reuses it (unless an env resets below)
-        device_next_obs = substitute_step_obs(add_data, rb, real_next_obs, obs_keys)
-        rb.add(add_data)
+        if use_blob and isinstance(actions, jax.Array):
+            # ONE transfer for obs + row floats + ring write indices;
+            # returns the obs the next policy step reuses (data/blob.py)
+            device_next_obs = blob_add(rb, real_next_obs, step_data, actions)
+        else:
+            add_data = {k: v[None] for k, v in step_data.items()}
+            # one put for this step's obs: the add consumes it now and the
+            # next policy step reuses it (unless an env resets below)
+            device_next_obs = substitute_step_obs(add_data, rb, real_next_obs, obs_keys)
+            rb.add(add_data)
 
         dones_idxes = np.nonzero(dones)[0].tolist()
         if dones_idxes:

@@ -43,6 +43,31 @@ class StepBlobCodec:
     at `[n_envs, *shape]`. `idx_len` is the length of the int32 index
     vector riding along (`2 * n_envs` for `concat(starts, cols)`)."""
 
+    @classmethod
+    def for_step(cls, obs, obs_keys, n_envs: int, float_keys):
+        """Build the codec for an interaction-step row from the first
+        observation's shapes/dtypes: uint8 obs keys go to the 1-byte
+        section, everything else plus the `[n_envs, 1]` `float_keys`
+        extras (rewards/dones/...) to the 4-byte section, and the ring
+        write indices (`concat(starts, cols)`, len `2 * n_envs`) ride
+        along. Returns `(codec, u8_keys, f32_obs_keys)` — the single
+        construction shared by every main's blob path."""
+        obs_keys = tuple(obs_keys)
+        u8_keys = tuple(
+            k for k in obs_keys if np.asarray(obs[k]).dtype == np.uint8
+        )
+        f32_obs_keys = tuple(k for k in obs_keys if k not in u8_keys)
+        codec = cls(
+            {k: np.asarray(obs[k]).shape[1:] for k in u8_keys},
+            {
+                **{k: np.asarray(obs[k]).shape[1:] for k in f32_obs_keys},
+                **{k: (1,) for k in float_keys},
+            },
+            idx_len=2 * n_envs,
+            n_envs=n_envs,
+        )
+        return codec, u8_keys, f32_obs_keys
+
     def __init__(
         self,
         u8_shapes: Mapping[str, Sequence[int]],

@@ -81,3 +81,55 @@ def test_reserve_requires_device_unstaged():
     staged = AsyncReplayBuffer(4, 1, storage="device", stage_rows=8)
     with pytest.raises(RuntimeError):
         staged.reserve()
+
+
+def test_v2_row_blob_matches_dict_add():
+    """make_blob_row (the V1/V2-layout one-transfer add) must write the
+    ring identically to the dict add path given the same step."""
+    from sheeprl_tpu.algos.dreamer_v2.utils import make_blob_row
+
+    n_envs, cap = 2, 8
+    rng = np.random.default_rng(2)
+    obs_keys = ("rgb", "vec")
+    codec = StepBlobCodec(
+        {"rgb": (4, 4, 3)},
+        {"vec": (5,), "rewards": (1,), "dones": (1,), "is_first": (1,)},
+        idx_len=2 * n_envs,
+        n_envs=n_envs,
+    )
+    blob_row = make_blob_row(codec, obs_keys, ("rewards", "dones", "is_first"))
+
+    step = {
+        "rgb": rng.integers(0, 256, (n_envs, 4, 4, 3), dtype=np.uint8),
+        "vec": rng.normal(size=(n_envs, 5)).astype(np.float32),
+        "rewards": rng.normal(size=(n_envs, 1)).astype(np.float32),
+        "dones": np.zeros((n_envs, 1), np.float32),
+        "is_first": np.ones((n_envs, 1), np.float32),
+    }
+    actions = rng.normal(size=(n_envs, 4)).astype(np.float32)
+
+    via_dict = AsyncReplayBuffer(
+        cap, n_envs, storage="device", sequential=True, obs_keys=obs_keys
+    )
+    via_dict.add({**{k: v[None] for k, v in step.items()},
+                  "actions": actions[None]})
+
+    via_blob = AsyncReplayBuffer(
+        cap, n_envs, storage="device", sequential=True, obs_keys=obs_keys
+    )
+    bidx = via_blob.reserve(1)
+    blob = codec.pack(
+        {"rgb": step["rgb"]},
+        {k: step[k] for k in ("vec", "rewards", "dones", "is_first")},
+        bidx,
+    )
+    row, idx_dev, obs_dev = blob_row(jnp.asarray(blob), jnp.asarray(actions))
+    via_blob.add_direct(row, idx_dev)
+
+    for k in (*step, "actions"):
+        np.testing.assert_array_equal(
+            np.asarray(via_dict._store[k]), np.asarray(via_blob._store[k])
+        )
+    # the returned obs dict is the next policy step's input
+    for k in obs_keys:
+        np.testing.assert_array_equal(np.asarray(obs_dev[k]), step[k])

@@ -56,6 +56,16 @@ class StandardArgs:
         default=-1,
         help="max episode length in env steps (divided by action_repeat); -1 disables",
     )
+    eval_only: bool = Arg(
+        default=False,
+        help="skip training: load --checkpoint_path and run "
+        "--test_episodes greedy evaluation episodes (coupled tasks only; "
+        "decoupled checkpoints share their coupled twin's key contract — "
+        "evaluate them with the coupled task)",
+    )
+    test_episodes: int = Arg(
+        default=1, help="evaluation episodes for --eval_only"
+    )
     # --- TPU-native execution knobs (no reference equivalent) ---
     platform: Optional[str] = Arg(
         default=None, help="jax platform to run on (tpu|cpu|None=jax default)"
@@ -82,7 +92,12 @@ class StandardArgs:
         super().__setattr__(name, value)
         if name == "log_dir" and value:
             os.makedirs(value, exist_ok=True)
-            with open(os.path.join(value, "args.json"), "w") as fh:
+            # an eval run logging into an existing training run directory
+            # must not overwrite the run's config record
+            fname = (
+                "eval_args.json" if getattr(self, "eval_only", False) else "args.json"
+            )
+            with open(os.path.join(value, fname), "w") as fh:
                 json.dump(self.as_dict(), fh)
 
     def as_dict(self) -> dict[str, Any]:

@@ -36,6 +36,11 @@ from ...parallel import (
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.evaluation import (
+    apply_eval_overrides,
+    run_test_episodes,
+    validate_eval_args,
+)
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
@@ -306,11 +311,13 @@ def make_train_step(
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV1Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    validate_eval_args(args)
     require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
             saved.update(checkpoint_path=args.checkpoint_path)
+            apply_eval_overrides(saved, args)
             (args,) = parser.parse_dict(saved)
     args.screen_size = 64
     args.frame_stack = -1
@@ -453,7 +460,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         if args.checkpoint_path
         else None
     )
-    if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt):
+    if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt) and not args.eval_only:
         rb.load(buffer_ckpt)
 
     aggregator = MetricAggregator()
@@ -492,6 +499,8 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     gradient_steps = 0
     start_time = time.perf_counter()
+    if args.eval_only:
+        num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
         if (
             global_step <= learning_starts
@@ -645,7 +654,10 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     profiler.close()
     envs.close()
-    test(player, logger, args, cnn_keys, mlp_keys, log_dir)
+    run_test_episodes(
+        lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir),
+        args, logger,
+    )
     logger.close()
 
 

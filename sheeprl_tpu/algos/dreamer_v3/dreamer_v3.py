@@ -58,6 +58,11 @@ from ...parallel import (
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.evaluation import (
+    apply_eval_overrides,
+    run_test_episodes,
+    validate_eval_args,
+)
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
@@ -480,10 +485,12 @@ def make_blob_step(codec, obs_keys, dev_preprocess, actions_dim, is_continuous):
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV3Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    validate_eval_args(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
             saved.update(checkpoint_path=args.checkpoint_path)
+            apply_eval_overrides(saved, args)
             (args,) = parser.parse_dict(saved)
     # fixed by the 4-stage 64x64 conv trunk (reference dreamer_v3.py:321-323)
     args.screen_size = 64
@@ -662,7 +669,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         if args.checkpoint_path
         else None
     )
-    if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt):
+    if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt) and not args.eval_only:
         rb.load(buffer_ckpt)
 
     aggregator = MetricAggregator()
@@ -707,6 +714,8 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     gradient_steps = 0
     start_time = time.perf_counter()
+    if args.eval_only:
+        num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
         # ---- action selection ----------------------------------------------
         blob_added = False
@@ -910,7 +919,10 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     profiler.close()
     envs.close()
-    test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True)
+    run_test_episodes(
+        lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True),
+        args, logger,
+    )
     logger.close()
 
 

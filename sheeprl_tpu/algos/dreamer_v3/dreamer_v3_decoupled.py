@@ -63,6 +63,11 @@ from .utils import make_device_preprocess, test
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV3Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.eval_only:
+        raise ValueError(
+            "--eval_only is not supported for decoupled tasks; evaluate the "
+            "checkpoint with the coupled twin (same key contract)"
+        )
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

@@ -33,6 +33,11 @@ from ...parallel import (
     shard_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.evaluation import (
+    apply_eval_overrides,
+    run_test_episodes,
+    validate_eval_args,
+)
 from ...utils.env import make_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
@@ -139,11 +144,13 @@ def make_train_step(args: DROQArgs, qf_optim, actor_optim, alpha_optim):
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DROQArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    validate_eval_args(args)
     require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
             saved.update(checkpoint_path=args.checkpoint_path)
+            apply_eval_overrides(saved, args)
             (args,) = parser.parse_dict(saved)
 
     if args.platform:
@@ -224,7 +231,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
         start_step = int(ckpt["global_step"]) + 1
         rb_state_path = args.checkpoint_path + ".buffer.npz"
-        if args.checkpoint_buffer and os.path.exists(rb_state_path):
+        if args.checkpoint_buffer and os.path.exists(rb_state_path) and not args.eval_only:
             rb.load(rb_state_path)
     state = replicate(state, mesh)
 
@@ -240,6 +247,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     obs = np.asarray(obs, dtype=np.float32)
     start_time = time.perf_counter()
 
+    if args.eval_only:
+        num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
         if global_step < learning_starts:
             actions = np.stack(
@@ -329,8 +338,11 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     profiler.close()
     envs.close()
-    test_env = make_env(
-        args.env_id, args.seed, 0, args.capture_video, run_name=log_dir, prefix="test"
-    )()
-    test(state.agent.actor, test_env, logger, args)
+    # fresh env per episode: test() closes the env it is handed
+    run_test_episodes(
+        lambda: test(state.agent.actor, make_env(
+            args.env_id, args.seed, 0, args.capture_video, run_name=log_dir, prefix="test"
+        )(), logger, args),
+        args, logger,
+    )
     logger.close()

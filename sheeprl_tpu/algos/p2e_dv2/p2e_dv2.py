@@ -42,6 +42,11 @@ from ...parallel import (
     shard_time_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.evaluation import (
+    apply_eval_overrides,
+    run_test_episodes,
+    validate_eval_args,
+)
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
@@ -521,10 +526,12 @@ def make_train_step(
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(P2EDV2Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    validate_eval_args(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
             saved.update(checkpoint_path=args.checkpoint_path)
+            apply_eval_overrides(saved, args)
             (args,) = parser.parse_dict(saved)
     args.screen_size = 64
     args.frame_stack = -1
@@ -705,7 +712,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         if args.checkpoint_path
         else None
     )
-    if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt):
+    if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt) and not args.eval_only:
         rb.load(buffer_ckpt)
 
     aggregator = MetricAggregator()
@@ -757,6 +764,8 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     gradient_steps = 0
     start_time = time.perf_counter()
+    if args.eval_only:
+        num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
         if is_exploring and global_step == exploration_updates:
             is_exploring = False
@@ -962,7 +971,10 @@ def main(argv: Sequence[str] | None = None) -> None:
     profiler.close()
     envs.close()
     player = make_player(state, exploring=False)
-    test(player, logger, args, cnn_keys, mlp_keys, log_dir, "few-shot")
+    run_test_episodes(
+        lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, "few-shot"),
+        args, logger,
+    )
     logger.close()
 
 

@@ -42,6 +42,11 @@ from ...parallel import (
     shard_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.evaluation import (
+    apply_eval_overrides,
+    run_test_episodes,
+    validate_eval_args,
+)
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
@@ -167,11 +172,13 @@ def test(agent: RecurrentPPOAgent, env: gym.Env, logger, args, obs_key: str) -> 
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(RecurrentPPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    validate_eval_args(args)
     require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
             saved.update(checkpoint_path=args.checkpoint_path)
+            apply_eval_overrides(saved, args)
             (args,) = parser.parse_dict(saved)
 
     if args.platform:
@@ -268,6 +275,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     global_step = 0
     start_time = time.perf_counter()
 
+    if args.eval_only:
+        num_updates = start_update - 1  # empty training loop: fall through to test
     for update in range(start_update, num_updates + 1):
         lr = ops.polynomial_decay(
             update, initial=args.lr, final=0.0, max_decay_steps=num_updates
@@ -379,10 +388,13 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     profiler.close()
     envs.close()
-    test_env = make_dict_env(
-        args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
-    )()
-    test(state.agent, test_env, logger, args, obs_key)
+    # fresh env per episode: test() closes the env it is handed
+    run_test_episodes(
+        lambda: test(state.agent, make_dict_env(
+            args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
+        )(), logger, args, obs_key),
+        args, logger,
+    )
     logger.close()
 
 

@@ -41,6 +41,11 @@ from .utils import test
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    if args.eval_only:
+        raise ValueError(
+            "--eval_only is not supported for decoupled tasks; evaluate the "
+            "checkpoint with the coupled twin (same key contract)"
+        )
     require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)

@@ -38,6 +38,11 @@ from ...parallel import (
     shard_batch,
 )
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
+from ...utils.evaluation import (
+    apply_eval_overrides,
+    run_test_episodes,
+    validate_eval_args,
+)
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
@@ -243,11 +248,13 @@ def _policy_step_fn(cnn_keys):
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACAEArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
+    validate_eval_args(args)
     require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
             saved.update(checkpoint_path=args.checkpoint_path)
+            apply_eval_overrides(saved, args)
             (args,) = parser.parse_dict(saved)
     if "minedojo" in args.env_id:
         raise ValueError(
@@ -378,7 +385,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         )
         start_step = int(ckpt["global_step"]) + 1
         rb_state_path = args.checkpoint_path + ".buffer.npz"
-        if args.checkpoint_buffer and os.path.exists(rb_state_path):
+        if args.checkpoint_buffer and os.path.exists(rb_state_path) and not args.eval_only:
             rb.load(rb_state_path)
     state = replicate(state, mesh)
 
@@ -395,6 +402,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     device_obs = None  # this step's obs put, reused by rb.add's row
     start_time = time.perf_counter()
 
+    if args.eval_only:
+        num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
         if global_step < learning_starts:
             actions = np.stack(
@@ -517,8 +526,11 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     profiler.close()
     envs.close()
-    test_env = make_dict_env(
-        args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
-    )()
-    test_sac_ae(state.agent, test_env, logger, args, cnn_keys, mlp_keys)
+    # fresh env per episode: test_sac_ae() closes the env it is handed
+    run_test_episodes(
+        lambda: test_sac_ae(state.agent, make_dict_env(
+            args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
+        )(), logger, args, cnn_keys, mlp_keys),
+        args, logger,
+    )
     logger.close()

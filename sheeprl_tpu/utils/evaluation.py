@@ -1,0 +1,72 @@
+"""Shared --eval_only machinery (one definition instead of a copy per
+main): the CLI-override merge for checkpoint-restored configs and the
+multi-episode greedy-evaluation loop."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+__all__ = ["apply_eval_overrides", "run_test_episodes"]
+
+# eval-time flags that stay CLI-controlled when the rest of the config is
+# restored from the checkpoint (evaluate a TPU-trained ckpt on CPU with one
+# local device, into a fresh log dir, with a fresh seed, for N episodes,
+# optionally recording video); flags absent from an algo's args are skipped
+_EVAL_CLI_FLAGS = (
+    "test_episodes",
+    "platform",
+    "num_devices",
+    "seed",
+    "capture_video",
+    "root_dir",
+    "run_name",
+)
+
+
+def validate_eval_args(args: Any) -> None:
+    """Fail fast (right after parsing, before any env/model construction —
+    async env workers must not be spawned on the error path)."""
+    if getattr(args, "eval_only", False) and args.checkpoint_path is None:
+        raise ValueError("--eval_only requires --checkpoint_path")
+
+
+def apply_eval_overrides(saved: dict[str, Any], args: Any) -> dict[str, Any]:
+    """Merge the eval-time CLI flags into a checkpoint-restored args dict.
+    No-op unless `--eval_only` was passed."""
+    if getattr(args, "eval_only", False):
+        saved["eval_only"] = True
+        for f in _EVAL_CLI_FLAGS:
+            if hasattr(args, f):
+                saved[f] = getattr(args, f)
+        if saved.get("num_devices") == -1:
+            # -1 means "all local devices" — right for training, wrong for
+            # a single-stream evaluation rollout (and the checkpoint's
+            # batch sizes need not divide this host's device count); eval
+            # runs on ONE device unless a count is requested explicitly
+            saved["num_devices"] = 1
+    return saved
+
+
+def run_test_episodes(episode_fn: Callable[[], float], args: Any, logger) -> list[float]:
+    """Run `max(test_episodes, 1)` greedy evaluation episodes and log the
+    mean return when more than one ran. Episode i runs with
+    `args.seed = base_seed + i` (restored afterwards) so the episodes
+    differ — `episode_fn` must read `args.seed` per call (every algo's
+    `test()` seeds its env and PRNG from it), and should create its own
+    env per call (`test()` closes the env it is handed)."""
+    base_seed = args.seed
+    rets: list[float] = []
+    try:
+        for i in range(max(args.test_episodes, 1)):
+            args.seed = base_seed + i
+            rets.append(episode_fn())
+            # a readable per-episode series (each test() call also logs
+            # Test/cumulative_reward, but always at step 0)
+            logger.log("Test/episode_reward", rets[-1], i)
+    finally:
+        args.seed = base_seed
+    if len(rets) > 1:
+        logger.log("Test/mean_reward", float(np.mean(rets)), 0)
+    return rets

@@ -71,7 +71,14 @@ def _broadcast_run_name(run_name: str) -> str:
 def create_logger(args: Any, algo_name: str, process_index: int = 0):
     """Build (logger, log_dir, run_name); sets `args.log_dir` (which dumps
     args.json as a side effect on process 0, algos/args.py contract)."""
-    if args.checkpoint_path and os.path.exists(args.checkpoint_path):
+    if (
+        args.checkpoint_path
+        and os.path.exists(args.checkpoint_path)
+        # --eval_only with an explicit --root_dir logs into the requested
+        # directory; otherwise (training resume, or eval without a
+        # destination) reuse the checkpoint's run directory
+        and not (getattr(args, "eval_only", False) and args.root_dir)
+    ):
         # resume into the checkpoint's run directory
         log_dir = os.path.dirname(os.path.dirname(os.path.abspath(args.checkpoint_path)))
         root_dir = os.path.dirname(log_dir)

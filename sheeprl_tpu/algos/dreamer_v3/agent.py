@@ -19,7 +19,6 @@ TPU-first deviations:
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from typing import Sequence
 
@@ -28,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.scan import scan_unroll
-from ... import nn, ops
+from ... import nn
 from ...nn.inits import init_xavier
 from ...ops.distributions import (
     Bernoulli,

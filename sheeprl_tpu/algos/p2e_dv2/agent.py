@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from ... import nn
 from ...nn.inits import init_xavier
 from ..dreamer_v2.agent import build_models as dv2_build_models
-from ..dreamer_v3.agent import Actor, MinedojoActor, WorldModel
+from ..dreamer_v3.agent import Actor, MinedojoActor
 from ..p2e_dv1.agent import build_ensembles, ensemble_apply  # noqa: F401 - re-exported
 
 __all__ = ["build_models", "build_ensembles", "ensemble_apply"]

@@ -20,7 +20,6 @@ TPU-first structure:
 
 from __future__ import annotations
 
-import dataclasses
 import os
 import time
 from functools import partial

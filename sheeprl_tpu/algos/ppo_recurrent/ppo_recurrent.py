@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import os
 import time
-from functools import partial
 from typing import Sequence
 
 import gymnasium as gym

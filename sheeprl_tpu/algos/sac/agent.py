@@ -15,7 +15,6 @@ TPU-first deviations:
 
 from __future__ import annotations
 
-from typing import Sequence
 
 import jax
 import jax.numpy as jnp

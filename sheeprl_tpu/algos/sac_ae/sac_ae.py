@@ -30,7 +30,6 @@ from ... import nn
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import (
-    assert_divisible,
     distributed_setup,
     make_mesh,
     process_index,

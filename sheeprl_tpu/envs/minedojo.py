@@ -37,7 +37,7 @@ MineDojo's native action vector (see the MineDojo sim docs):
 from __future__ import annotations
 
 import copy
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import gymnasium as gym

@@ -1,0 +1,91 @@
+"""Unit tests for the shared --eval_only machinery
+(sheeprl_tpu/utils/evaluation.py)."""
+
+import pytest
+
+from sheeprl_tpu.algos.args import StandardArgs
+from sheeprl_tpu.utils.evaluation import (
+    apply_eval_overrides,
+    run_test_episodes,
+    validate_eval_args,
+)
+
+
+class _StubLogger:
+    def __init__(self):
+        self.logged = []
+
+    def log(self, name, value, step):
+        self.logged.append((name, float(value), step))
+
+
+def test_validate_requires_checkpoint():
+    args = StandardArgs(eval_only=True)
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        validate_eval_args(args)
+    validate_eval_args(StandardArgs(eval_only=False))  # no-op
+    validate_eval_args(StandardArgs(eval_only=True, checkpoint_path="x"))
+
+
+def test_overrides_keep_cli_flags_and_default_one_device():
+    args = StandardArgs(
+        eval_only=True, checkpoint_path="x", test_episodes=7, seed=123,
+        platform="cpu", root_dir="/tmp/out", run_name="e",
+    )
+    saved = {
+        "seed": 42, "platform": None, "num_devices": 4,
+        "root_dir": "/train", "run_name": "t", "test_episodes": 1,
+    }
+    out = apply_eval_overrides(dict(saved), args)
+    assert out["eval_only"] is True
+    assert out["test_episodes"] == 7
+    assert out["seed"] == 123
+    assert out["platform"] == "cpu"
+    assert out["root_dir"] == "/tmp/out" and out["run_name"] == "e"
+    # CLI default -1 ("all local devices") maps to ONE device for eval
+    assert out["num_devices"] == 1
+
+    # explicit device counts pass through
+    args.num_devices = 2
+    assert apply_eval_overrides(dict(saved), args)["num_devices"] == 2
+
+    # without --eval_only the saved config wins untouched
+    args2 = StandardArgs(eval_only=False, checkpoint_path="x", seed=9)
+    assert apply_eval_overrides(dict(saved), args2) == saved
+
+
+def test_run_test_episodes_varies_seed_and_logs_mean():
+    args = StandardArgs(test_episodes=3, seed=100)
+    logger = _StubLogger()
+    seen_seeds = []
+
+    def episode():
+        seen_seeds.append(args.seed)
+        return float(args.seed)  # distinct return per distinct seed
+
+    rets = run_test_episodes(episode, args, logger)
+    assert seen_seeds == [100, 101, 102]
+    assert args.seed == 100  # restored
+    assert rets == [100.0, 101.0, 102.0]
+    series = [e for e in logger.logged if e[0] == "Test/episode_reward"]
+    assert [s[2] for s in series] == [0, 1, 2]
+    (mean,) = [e for e in logger.logged if e[0] == "Test/mean_reward"]
+    assert mean[1] == pytest.approx(101.0)
+
+
+def test_run_test_episodes_single_episode_no_mean():
+    args = StandardArgs(test_episodes=1, seed=5)
+    logger = _StubLogger()
+    run_test_episodes(lambda: 1.0, args, logger)
+    assert not any(e[0] == "Test/mean_reward" for e in logger.logged)
+
+
+def test_seed_restored_on_exception():
+    args = StandardArgs(test_episodes=3, seed=50)
+
+    def boom():
+        raise RuntimeError("episode crashed")
+
+    with pytest.raises(RuntimeError):
+        run_test_episodes(boom, args, _StubLogger())
+    assert args.seed == 50

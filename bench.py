@@ -323,11 +323,15 @@ def _dv3_e2e_sps(
         and _os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
     )
     if use_blob:
+        from sheeprl_tpu.data.blob import verify_blob_roundtrip
+
         codec = StepBlobCodec(
             {"rgb": (64, 64, 3)},
             {"rewards": (1,), "dones": (1,), "is_first": (1,)},
             idx_len=2 * n_envs, n_envs=n_envs,
         )
+        use_blob = verify_blob_roundtrip(codec)
+    if use_blob:
         blob_step = make_blob_step(
             codec, ("rgb",), make_device_preprocess(("rgb",)),
             actions_dim, is_continuous,

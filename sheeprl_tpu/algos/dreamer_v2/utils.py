@@ -59,17 +59,22 @@ def substitute_step_obs(add_data, rb, real_next_obs, obs_keys):
 def make_row_codec(obs, obs_keys, n_envs, float_keys):
     """Build the blob transport for a V1/V2-row-layout main from the first
     observation's shapes/dtypes (uint8 keys vs float keys split here, once).
-    Returns `blob_add(rb, real_next_obs, step_data, actions_dev)` — the
+    Returns `blob_add(rb, real_next_obs, step_data, actions_dev)` — or
+    None when a live roundtrip check fails on the current backend
+    (callers then keep the separate-puts path) — the
     whole one-transfer add: reserve the ring rows, pack obs + row floats +
     indices into one int32 blob, scatter via the jitted row assembler, and
     return the obs dict the next policy step reuses."""
     from ...data import StepBlobCodec
+    from ...data.blob import verify_blob_roundtrip
 
     obs_keys = tuple(obs_keys)
     float_keys = tuple(float_keys)
     codec, u8_keys, f32_obs_keys = StepBlobCodec.for_step(
         obs, obs_keys, n_envs, float_keys
     )
+    if not verify_blob_roundtrip(codec):
+        return None  # backend disagrees on the bitcasts: use separate puts
     blob_row = make_blob_row(codec, obs_keys, float_keys)
 
     def blob_add(rb, real_next_obs, step_data, actions_dev):

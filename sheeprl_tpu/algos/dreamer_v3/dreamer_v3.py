@@ -34,6 +34,7 @@ import optax
 
 from ... import nn, ops
 from ...data import AsyncReplayBuffer, StepBlobCodec, stage_batch
+from ...data.blob import verify_blob_roundtrip
 from ...envs import make_vector_env
 from ...envs.wrappers import RestartOnException
 from ...ops.distributions import (
@@ -708,6 +709,10 @@ def main(argv: Sequence[str] | None = None) -> None:
         codec, u8_keys, f32_obs_keys = StepBlobCodec.for_step(
             obs, obs_keys, args.num_envs, ("rewards", "dones", "is_first")
         )
+        # live-backend roundtrip check: fall back to separate puts rather
+        # than ship corrupt rows if a backend disagrees on the bitcasts
+        use_blob = verify_blob_roundtrip(codec)
+    if use_blob:
         blob_step = make_blob_step(
             codec, tuple(obs_keys), _dev_preprocess, actions_dim, is_continuous
         )

@@ -761,6 +761,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
     if use_blob:
         blob_add = make_row_codec(obs, obs_keys, args.num_envs, ("rewards", "dones", "is_first"))
+        use_blob = blob_add is not None  # live-backend roundtrip check
 
     gradient_steps = 0
     start_time = time.perf_counter()

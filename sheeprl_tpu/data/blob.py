@@ -32,7 +32,53 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["StepBlobCodec"]
+__all__ = ["StepBlobCodec", "verify_blob_roundtrip"]
+
+
+def verify_blob_roundtrip(codec: "StepBlobCodec") -> bool:
+    """One tiny live roundtrip asserting the pack -> device bitcast-unpack
+    path is bit-exact ON THE CURRENT BACKEND. The CPU tests pin the
+    little-endian semantics, but the real-TPU lowering of the u8<->i32
+    `bitcast_convert_type` can only be checked live — callers use this to
+    fall back to the separate-puts transport instead of shipping corrupt
+    rows (or crashing the round-end bench) if a backend disagrees."""
+    import warnings
+
+    def _fallback(reason: str) -> bool:
+        # observable, never silent: a failed check costs the fast path for
+        # the whole run, and a pack/unpack regression must not masquerade
+        # as a backend quirk
+        warnings.warn(
+            f"step-blob transport disabled, falling back to separate "
+            f"host->device puts: {reason}",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return False
+
+    try:
+        rng = np.random.default_rng(0)
+        u8 = {k: rng.integers(0, 256, shape, dtype=np.uint8) for k, shape, _, _ in codec._u8}
+        f32 = {
+            k: rng.normal(size=shape).astype(np.float32)
+            for k, shape, _, _ in codec._f32
+        }
+        idx = rng.integers(-(2**31), 2**31 - 1, codec.idx_len, dtype=np.int32)
+        blob = codec.pack(u8, f32, idx)
+        out_u8, out_f32, out_idx = jax.jit(codec.unpack)(jnp.asarray(blob))
+        for k, v in u8.items():
+            if not np.array_equal(np.asarray(out_u8[k]), v):
+                return _fallback(f"uint8 roundtrip mismatch on key {k!r}")
+        for k, v in f32.items():
+            if not np.array_equal(
+                np.asarray(out_f32[k]).view(np.int32), v.view(np.int32)
+            ):
+                return _fallback(f"float32 bit roundtrip mismatch on key {k!r}")
+        if not np.array_equal(np.asarray(out_idx), idx):
+            return _fallback("int32 index roundtrip mismatch")
+        return True
+    except Exception as exc:  # noqa: BLE001 — any failure means no fast path
+        return _fallback(f"{type(exc).__name__}: {exc}")
 
 
 class StepBlobCodec:

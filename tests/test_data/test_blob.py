@@ -133,3 +133,28 @@ def test_v2_row_blob_matches_dict_add():
     # the returned obs dict is the next policy step's input
     for k in obs_keys:
         np.testing.assert_array_equal(np.asarray(obs_dev[k]), step[k])
+
+
+def test_verify_blob_roundtrip_on_backend():
+    from sheeprl_tpu.data.blob import verify_blob_roundtrip
+
+    codec, _, _ = StepBlobCodec.for_step(
+        {"rgb": np.zeros((2, 4, 4, 3), np.uint8),
+         "vec": np.zeros((2, 5), np.float32)},
+        ("rgb", "vec"), 2, ("rewards", "dones"),
+    )
+    assert verify_blob_roundtrip(codec)  # CPU backend must roundtrip
+
+    class _Broken:
+        """codec whose unpack corrupts a value: verification must fail"""
+        _u8 = codec._u8
+        _f32 = codec._f32
+        idx_len = codec.idx_len
+        pack = codec.pack
+
+        @staticmethod
+        def unpack(blob):
+            u8, f32, idx = codec.unpack(blob)
+            return u8, f32, idx + 1
+
+    assert not verify_blob_roundtrip(_Broken())

@@ -277,6 +277,54 @@ def _dv3_replay_harness(args):
     return rb, fake_env_obs, add_step
 
 
+
+def _dv3_blob_harness(args, actions_dim, is_continuous):
+    """The blob-transport scaffolding of the e2e loop — codec + jitted blob
+    step closure — shared with tools/phase_probe.py so the probe measures
+    exactly the transport bench runs (mirror drift is the failure mode the
+    replay harness already guards against). Returns None when the live
+    roundtrip check rejects the backend (callers then use the
+    separate-puts path, like the mains do)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_blob_step
+    from sheeprl_tpu.algos.dreamer_v3.utils import make_device_preprocess
+    from sheeprl_tpu.data import StepBlobCodec
+    from sheeprl_tpu.data.blob import verify_blob_roundtrip
+
+    n_envs = args.num_envs
+    codec = StepBlobCodec(
+        {"rgb": (64, 64, 3)},
+        {"rewards": (1,), "dones": (1,), "is_first": (1,)},
+        idx_len=2 * n_envs, n_envs=n_envs,
+    )
+    if not verify_blob_roundtrip(codec):
+        return None
+    blob_step = make_blob_step(
+        codec, ("rgb",), make_device_preprocess(("rgb",)),
+        actions_dim, is_continuous,
+    )
+    zeros1 = np.zeros((n_envs, 1), np.float32)
+    expl = jnp.float32(0.0)
+
+    def step(rb, player, player_state, obs_u8, sk):
+        """ONE transfer: reserve -> pack -> blob jit -> zero-transfer add."""
+        idx = rb.reserve(1)
+        blob = codec.pack(
+            {"rgb": obs_u8},
+            {"rewards": zeros1, "dones": zeros1, "is_first": zeros1},
+            idx,
+        )
+        player_state, _, row, idx_dev = blob_step(
+            player, player_state, jnp.asarray(blob), sk, expl
+        )
+        rb.add_direct(row, idx_dev)
+        return player_state
+
+    return step
+
+
 def _dv3_e2e_sps(
     args, state, opts, actions_dim, is_continuous, tiny, n_mesh_devices=0
 ):
@@ -290,12 +338,8 @@ def _dv3_e2e_sps(
     import jax.numpy as jnp
     import numpy as np
 
-    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
-        make_blob_step,
-        make_train_step,
-    )
-    from sheeprl_tpu.algos.dreamer_v3.utils import make_device_preprocess
-    from sheeprl_tpu.data import AsyncReplayBuffer, StepBlobCodec, stage_batch
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.data import AsyncReplayBuffer, stage_batch
     from sheeprl_tpu.parallel import make_mesh, replicate, shard_time_batch
 
     T, B = args.per_rank_sequence_length, args.per_rank_batch_size
@@ -315,29 +359,17 @@ def _dv3_e2e_sps(
     # blob transport mirror of the main's device-buffer hot loop: ONE
     # transfer per step carries obs + replay floats + ring write indices,
     # and the policy's own actions land in the row on device (same
-    # SHEEPRL_TPU_STEP_BLOB=0 escape hatch as the main, for A/B probing)
+    # SHEEPRL_TPU_STEP_BLOB=0 escape hatch and live roundtrip gate as the
+    # main; the shared harness keeps tools/phase_probe.py in lockstep)
     import os as _os
 
-    use_blob = (
+    blob_step_fn = None
+    if (
         not rb.prefers_host_adds
         and _os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
-    )
-    if use_blob:
-        from sheeprl_tpu.data.blob import verify_blob_roundtrip
-
-        codec = StepBlobCodec(
-            {"rgb": (64, 64, 3)},
-            {"rewards": (1,), "dones": (1,), "is_first": (1,)},
-            idx_len=2 * n_envs, n_envs=n_envs,
-        )
-        use_blob = verify_blob_roundtrip(codec)
-    if use_blob:
-        blob_step = make_blob_step(
-            codec, ("rgb",), make_device_preprocess(("rgb",)),
-            actions_dim, is_continuous,
-        )
-        zeros1 = np.zeros((n_envs, 1), np.float32)
-        expl = jnp.float32(0.0)
+    ):
+        blob_step_fn = _dv3_blob_harness(args, actions_dim, is_continuous)
+    use_blob = blob_step_fn is not None
 
     key = jax.random.PRNGKey(1)
 
@@ -347,16 +379,7 @@ def _dv3_e2e_sps(
             obs_u8 = fake_env_obs()
             key, sk = jax.random.split(key)
             if use_blob:
-                idx = rb.reserve(1)
-                blob = codec.pack(
-                    {"rgb": obs_u8},
-                    {"rewards": zeros1, "dones": zeros1, "is_first": zeros1},
-                    idx,
-                )
-                player_state, _, row, idx_dev = blob_step(
-                    player, player_state, jnp.asarray(blob), sk, expl
-                )
-                rb.add_direct(row, idx_dev)
+                player_state = blob_step_fn(rb, player, player_state, obs_u8, sk)
             else:
                 dev_u8 = jnp.asarray(obs_u8)  # the ONE obs put per step
                 player_state, _ = player_step(

@@ -8,7 +8,10 @@ Times cumulative variants of the honest e2e cycle on the current backend:
   V2 +add    : + the real AsyncReplayBuffer.add per step (device storage,
                reusing the policy obs put).
   V3 +sample : + rb.sample + stage per cycle, train on the sampled batch —
-               bench's honest e2e cycle.
+               the separate-puts honest e2e cycle.
+  V4 blob    : the same e2e cycle through the one-transfer blob transport
+               (StepBlobCodec + reserve/add_direct) — bench's default
+               device-buffer path; V4 vs V3 is the blob's chip receipt.
 
 Adjacent differences attribute the gap to obs transfer, replay add, and
 replay sample/stage.  Every variant syncs via a host scalar pull per cycle
@@ -37,6 +40,7 @@ def main() -> None:
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     import bench
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
@@ -86,13 +90,39 @@ def main() -> None:
 
         return one_cycle
 
+    # V4: the blob-transport e2e cycle via bench's OWN harness (the probe
+    # must measure exactly the transport bench runs; the harness applies
+    # the live roundtrip gate). A second replay buffer keeps V4's ring
+    # state and write heads independent of V2/V3's.
+    rb_blob, _, _ = bench._dv3_replay_harness(args)
+    blob_step_fn = bench._dv3_blob_harness(args, actions_dim, is_continuous)
+
+    def blob_cycle(state, player_state, key):
+        player = make_player(state)
+        for _ in range(args.train_every):
+            obs_u8 = fake_env_obs()
+            key, sk = jax.random.split(key)
+            player_state = blob_step_fn(rb_blob, player, player_state, obs_u8, sk)
+        local = rb_blob.sample(B, sequence_length=T, n_samples=1)
+        batch = {k: v[0] for k, v in stage_batch(local).items()}
+        key, tk = jax.random.split(key)
+        state, metrics = train_step(state, batch, tk, jnp.float32(0.02))
+        float(jax.device_get(metrics["Loss/reconstruction_loss"]))
+        return state, player_state, key
+
     variants = {
         "V0_duty": make_cycle(False, False, False),
         "V1_put": make_cycle(True, False, False),
         "V2_add": make_cycle(True, True, False),
         "V3_sample": make_cycle(True, True, True),
     }
-    # Interleaved schedule (V0 V1 V2 V3 | V0 V1 V2 V3 | ...) so tunnel-
+    if blob_step_fn is not None:
+        variants["V4_blob"] = blob_cycle
+    else:
+        print("V4_blob skipped: backend failed the blob roundtrip gate",
+              file=sys.stderr)
+    # Interleaved schedule (V0 V1 V2 V3 V4 | V0 V1 V2 V3 V4 | ...; V4
+    # only when the backend passes the blob gate) so tunnel-
     # latency drift over the run hits every variant equally (the sequential
     # layout confounded drift with the later variants). Per-variant state
     # evolves independently; train_step donates, so each gets a fresh copy.
@@ -130,6 +160,10 @@ def main() -> None:
         "replay_add": round(best["V2_add"] - best["V1_put"], 1),
         "replay_sample": round(best["V3_sample"] - best["V2_add"], 1),
     }
+    if "V4_blob" in best:
+        out["attribution_ms"]["blob_vs_separate_puts"] = round(
+            best["V4_blob"] - best["V3_sample"], 1
+        )
     print(json.dumps(out))
 
 

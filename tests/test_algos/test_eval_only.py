@@ -102,3 +102,52 @@ def test_eval_only_rejected_for_decoupled():
 
     with pytest.raises(ValueError, match="decoupled"):
         main(["--eval_only", "--env_id=discrete_dummy"])
+
+
+def test_coupled_eval_of_decoupled_checkpoint(tmp_path):
+    """The docs claim decoupled checkpoints share the coupled twin's key
+    contract and can be evaluated with the coupled task — prove it: train
+    dreamer_v3_decoupled (player + trainer mesh), then --eval_only the
+    checkpoint with coupled dreamer_v3."""
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import main as coupled_main
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3_decoupled import (
+        main as decoupled_main,
+    )
+
+    train_dir = str(tmp_path / "train")
+    decoupled_main([
+        "--dry_run",
+        "--env_id=discrete_dummy",
+        "--num_envs=1",
+        "--sync_env",
+        "--per_rank_batch_size=2",
+        "--per_rank_sequence_length=1",
+        "--buffer_size=4",
+        "--learning_starts=0",
+        "--gradient_steps=1",
+        "--horizon=4",
+        "--dense_units=8",
+        "--cnn_channels_multiplier=2",
+        "--recurrent_state_size=8",
+        "--hidden_size=8",
+        "--stochastic_size=4",
+        "--discrete_size=4",
+        "--mlp_layers=1",
+        "--train_every=1",
+        "--checkpoint_every=1",
+        "--cnn_keys", "rgb",
+        f"--root_dir={train_dir}",
+        "--run_name=t",
+    ])
+    ckpt = _latest_ckpt(train_dir)
+
+    eval_dir = str(tmp_path / "eval")
+    coupled_main([
+        "--eval_only",
+        f"--checkpoint_path={ckpt}",
+        "--test_episodes=2",
+        f"--root_dir={eval_dir}",
+        "--run_name=e",
+    ])
+    events = glob.glob(os.path.join(eval_dir, "**", "events.*"), recursive=True)
+    assert events

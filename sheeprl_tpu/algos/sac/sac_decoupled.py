@@ -42,10 +42,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     if args.eval_only:
-        raise ValueError(
-            "--eval_only is not supported for decoupled tasks; evaluate the "
-            "checkpoint with the coupled twin (same key contract)"
-        )
+        # decoupled checkpoints share the coupled twin's key contract; a
+        # single-stream evaluation needs no player/trainer split (VERDICT r3 #7)
+        from .sac import main as coupled_main
+
+        return coupled_main(argv)
     require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)

@@ -12,17 +12,25 @@ __all__ = ["apply_eval_overrides", "run_test_episodes"]
 
 # eval-time flags that stay CLI-controlled when the rest of the config is
 # restored from the checkpoint (evaluate a TPU-trained ckpt on CPU with one
-# local device, into a fresh log dir, with a fresh seed, for N episodes,
-# optionally recording video); flags absent from an algo's args are skipped
+# local device, into a fresh log dir, with a fresh seed, for N episodes);
+# flags absent from an algo's args are skipped. These are run-targeting
+# flags whose *training-time* values would misdirect an evaluation (write
+# into the training log dir, demand the training pod's device count), so
+# they are overridden unconditionally.
 _EVAL_CLI_FLAGS = (
     "test_episodes",
     "platform",
     "num_devices",
     "seed",
-    "capture_video",
     "root_dir",
     "run_name",
 )
+
+# training-config preferences that persist from the checkpoint unless the
+# user explicitly overrides them on the eval command line (ADVICE r3: a run
+# trained with capture_video=True must not silently evaluate with the CLI
+# default False)
+_EVAL_CLI_IF_PROVIDED = ("capture_video",)
 
 
 def validate_eval_args(args: Any) -> None:
@@ -37,8 +45,12 @@ def apply_eval_overrides(saved: dict[str, Any], args: Any) -> dict[str, Any]:
     No-op unless `--eval_only` was passed."""
     if getattr(args, "eval_only", False):
         saved["eval_only"] = True
+        provided = getattr(args, "_cli_provided", set())
         for f in _EVAL_CLI_FLAGS:
             if hasattr(args, f):
+                saved[f] = getattr(args, f)
+        for f in _EVAL_CLI_IF_PROVIDED:
+            if f in provided:
                 saved[f] = getattr(args, f)
         if saved.get("num_devices") == -1:
             # -1 means "all local devices" — right for training, wrong for

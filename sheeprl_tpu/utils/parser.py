@@ -122,16 +122,39 @@ class DataclassArgumentParser(argparse.ArgumentParser):
         self, args: list[str] | None = None, return_remaining_strings: bool = False
     ) -> tuple:
         namespace, remaining = self.parse_known_args(args)
+        provided = self._provided_flags(args)
         outputs = []
         for dtype in self.dataclass_types:
             keys = {f.name for f in dataclasses.fields(dtype) if f.init}
             inputs = {k: v for k, v in vars(namespace).items() if k in keys}
-            outputs.append(dtype(**inputs))
+            out = dtype(**inputs)
+            # which fields the user explicitly set on the command line (vs
+            # dataclass defaults) — lets eval-time config merging override
+            # only what was actually asked for (utils/evaluation.py)
+            out._cli_provided = provided & keys
+            outputs.append(out)
         if return_remaining_strings:
             return (*outputs, remaining)
         if remaining:
             raise ValueError(f"unknown arguments: {remaining}")
         return tuple(outputs)
+
+    def _provided_flags(self, args: list[str] | None) -> set:
+        """Re-parse with every default suppressed: the resulting namespace
+        holds exactly the dests the user explicitly provided (works through
+        `--flag=value`, `--no_flag` bool pairs, and `@file.args` expansion)."""
+        saved = [(a, a.default) for a in self._actions]
+        saved_defaults = dict(self._defaults)
+        for a in self._actions:
+            a.default = argparse.SUPPRESS
+        self._defaults.clear()
+        try:
+            namespace, _ = self.parse_known_args(args)
+        finally:
+            for a, d in saved:
+                a.default = d
+            self._defaults.update(saved_defaults)
+        return set(vars(namespace))
 
     def parse_dict(self, args: dict[str, Any], allow_extra_keys: bool = True) -> tuple:
         outputs = []

@@ -89,3 +89,31 @@ def test_seed_restored_on_exception():
     with pytest.raises(RuntimeError):
         run_test_episodes(boom, args, _StubLogger())
     assert args.seed == 50
+
+
+def test_capture_video_persists_unless_explicitly_overridden():
+    """ADVICE r3: a run trained with capture_video=True must not silently
+    evaluate with the CLI default False — the flag only overrides the
+    checkpoint value when the user actually passed it."""
+    from sheeprl_tpu.algos.ppo.args import PPOArgs
+    from sheeprl_tpu.utils.parser import DataclassArgumentParser
+
+    saved = {"capture_video": True, "seed": 1}
+
+    def parse(argv):
+        return DataclassArgumentParser(PPOArgs).parse_args_into_dataclasses(
+            argv
+        )[0]
+
+    base = ["--eval_only", "--checkpoint_path", "x"]
+    # not passed -> checkpoint value survives
+    out = apply_eval_overrides(dict(saved), parse(base))
+    assert out["capture_video"] is True
+    # explicitly disabled -> CLI wins
+    out = apply_eval_overrides(dict(saved), parse([*base, "--no_capture_video"]))
+    assert out["capture_video"] is False
+    # explicitly enabled over a False checkpoint -> CLI wins
+    out = apply_eval_overrides(
+        {"capture_video": False, "seed": 1}, parse([*base, "--capture_video"])
+    )
+    assert out["capture_video"] is True

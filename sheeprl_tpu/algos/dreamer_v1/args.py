@@ -63,6 +63,12 @@ class DreamerV1Args(SeqParallelArgs, StandardArgs):
     expl_decay: bool = Arg(default=False, help="whether or not to decrement the exploration amount")
     expl_min: float = Arg(default=0.0, help="the minimum value for the exploration amount")
     max_step_expl_decay: int = Arg(default=0, help="the maximum number of decay steps")
+    envs_batch_size: int = Arg(
+        default=2,
+        help="the number of environments batched per epoch (config parity: "
+        "the reference declares but never reads this flag, "
+        "dreamer_v1/args.py:71)",
+    )
     action_repeat: int = Arg(default=2, help="the number of times an action is repeated")
     max_episode_steps: int = Arg(
         default=1000,
@@ -83,3 +89,7 @@ class DreamerV1Args(SeqParallelArgs, StandardArgs):
     mine_break_speed: int = Arg(default=100, help="break speed multiplier of Minecraft environments")
     mine_sticky_attack: int = Arg(default=30, help="sticky value for the attack action")
     mine_sticky_jump: int = Arg(default=10, help="sticky value for the jump action")
+    diambra_action_space: str = Arg(default="discrete", help="diambra action space: discrete|multi_discrete")
+    diambra_attack_but_combination: bool = Arg(default=True, help="enable diambra attack button combos")
+    diambra_noop_max: int = Arg(default=0, help="max noop actions after diambra reset")
+    diambra_actions_stack: int = Arg(default=1, help="number of actions stacked in diambra observations")

@@ -26,8 +26,19 @@ class CNNEncoder(nn.Module):
     keys: tuple[str, ...] = nn.static()
 
     @classmethod
-    def init(cls, key, in_channels: int, features_dim: int, screen_size: int, keys: Sequence[str]):
-        model = nn.NatureCNN.init(key, in_channels, features_dim, screen_size=screen_size)
+    def init(
+        cls,
+        key,
+        in_channels: int,
+        features_dim: int,
+        screen_size: int,
+        keys: Sequence[str],
+        channels_multiplier: int = 1,
+    ):
+        model = nn.NatureCNN.init(
+            key, in_channels, features_dim, screen_size=screen_size,
+            channels_multiplier=channels_multiplier,
+        )
         return cls(model=model, keys=tuple(keys))
 
     def __call__(self, obs: dict) -> jax.Array:
@@ -91,14 +102,27 @@ class PPOAgent(nn.Module):
         dense_act: str = "tanh",
         layer_norm: bool = False,
         is_continuous: bool = False,
+        actor_hidden_size: int | None = None,
+        critic_hidden_size: int | None = None,
+        cnn_channels_multiplier: int = 1,
     ):
+        if actor_hidden_size is None:
+            actor_hidden_size = dense_units
+        if critic_hidden_size is None:
+            critic_hidden_size = dense_units
+        if actor_hidden_size <= 0 or critic_hidden_size <= 0:
+            raise ValueError(
+                "actor_hidden_size/critic_hidden_size must be greater than "
+                f"zero, given {actor_hidden_size}/{critic_hidden_size}"
+            )
         k_cnn, k_mlp, k_bb, k_cr, k_heads = jax.random.split(key, 5)
         cnn_encoder = None
         features_dim = 0
         if cnn_keys:
             in_channels = sum(obs_space[k].shape[-1] for k in cnn_keys)
             cnn_encoder = CNNEncoder.init(
-                k_cnn, in_channels, cnn_features_dim, screen_size, cnn_keys
+                k_cnn, in_channels, cnn_features_dim, screen_size, cnn_keys,
+                channels_multiplier=cnn_channels_multiplier,
             )
             features_dim += cnn_features_dim
         mlp_encoder = None
@@ -110,19 +134,21 @@ class PPOAgent(nn.Module):
             )
             features_dim += mlp_features_dim
         actor_backbone = nn.MLP.init(
-            k_bb, features_dim, [dense_units] * mlp_layers,
+            k_bb, features_dim, [actor_hidden_size] * mlp_layers,
             act=dense_act, layer_norm=layer_norm,
         )
         if is_continuous:
-            heads = (nn.Linear.init(k_heads, dense_units, sum(actions_dim) * 2),)
+            heads = (
+                nn.Linear.init(k_heads, actor_hidden_size, sum(actions_dim) * 2),
+            )
         else:
             head_keys = jax.random.split(k_heads, len(actions_dim))
             heads = tuple(
-                nn.Linear.init(hk, dense_units, int(dim))
+                nn.Linear.init(hk, actor_hidden_size, int(dim))
                 for hk, dim in zip(head_keys, actions_dim)
             )
         critic = nn.MLP.init(
-            k_cr, features_dim, [dense_units] * mlp_layers, 1, act=dense_act
+            k_cr, features_dim, [critic_hidden_size] * mlp_layers, 1, act=dense_act
         )
         return cls(
             cnn_encoder=cnn_encoder,

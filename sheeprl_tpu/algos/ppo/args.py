@@ -36,6 +36,23 @@ class PPOArgs(StandardArgs):
     vf_coef: float = Arg(default=1.0, help="value loss coefficient")
     max_grad_norm: float = Arg(default=0.0, help="global grad-norm clip; 0 disables")
     dense_units: int = Arg(default=64, help="units per dense layer")
+    actor_hidden_size: Optional[int] = Arg(
+        default=None,
+        help="units per actor-backbone layer; falls back to dense_units "
+        "(reference parity: ppo/args.py:36)",
+    )
+    critic_hidden_size: Optional[int] = Arg(
+        default=None,
+        help="units per critic layer; falls back to dense_units "
+        "(reference parity: ppo/args.py:37)",
+    )
+    cnn_channels_multiplier: int = Arg(
+        default=1,
+        help="NatureCNN width multiplication factor, must be greater than "
+        "zero (reference parity: ppo/args.py:43 — the reference accepts but "
+        "never applies it, ppo/agent.py:70,93; here it genuinely widens the "
+        "conv stack)",
+    )
     mlp_layers: int = Arg(default=2, help="MLP depth for actor/critic/backbone")
     dense_act: str = Arg(default="tanh", help="dense activation name")
     cnn_act: str = Arg(default="tanh", help="conv activation name")

@@ -258,6 +258,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         screen_size=args.screen_size, mlp_layers=args.mlp_layers,
         dense_units=args.dense_units, dense_act=args.dense_act,
         layer_norm=args.layer_norm, is_continuous=is_continuous,
+        actor_hidden_size=args.actor_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        cnn_channels_multiplier=args.cnn_channels_multiplier,
     )
     optimizer = make_optimizer(args)
     state = TrainState(agent=agent, opt_state=optimizer.init(agent))

@@ -53,10 +53,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(PPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     if args.eval_only:
-        raise ValueError(
-            "--eval_only is not supported for decoupled tasks; evaluate the "
-            "checkpoint with the coupled twin (same key contract)"
-        )
+        # decoupled checkpoints share the coupled twin's key contract; a
+        # single-stream evaluation needs no player/trainer split (VERDICT r3 #7)
+        from .ppo import main as coupled_main
+
+        return coupled_main(argv)
     require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
@@ -98,6 +99,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         screen_size=args.screen_size, mlp_layers=args.mlp_layers,
         dense_units=args.dense_units, dense_act=args.dense_act,
         layer_norm=args.layer_norm, is_continuous=is_continuous,
+        actor_hidden_size=args.actor_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        cnn_channels_multiplier=args.cnn_channels_multiplier,
     )
     optimizer = make_optimizer(args)
     state = TrainState(agent=agent, opt_state=optimizer.init(agent))

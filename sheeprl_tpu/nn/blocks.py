@@ -291,12 +291,24 @@ class NatureCNN(Module):
     act: Activation = static(default="relu")
 
     @classmethod
-    def init(cls, key, in_channels: int, features_dim: int, *, screen_size: int = 64):
+    def init(
+        cls,
+        key,
+        in_channels: int,
+        features_dim: int,
+        *,
+        screen_size: int = 64,
+        channels_multiplier: int = 1,
+    ):
+        if channels_multiplier <= 0:
+            raise ValueError(
+                f"channels_multiplier must be greater than zero, given {channels_multiplier}"
+            )
         ckey, fkey = jax.random.split(key)
         cnn = CNN.init(
             ckey,
             in_channels,
-            channels=[32, 64, 64],
+            channels=[32 * channels_multiplier, 64 * channels_multiplier, 64 * channels_multiplier],
             kernel_sizes=[8, 4, 3],
             strides=[4, 2, 1],
             paddings=["VALID"] * 3,

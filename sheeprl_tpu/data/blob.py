@@ -151,7 +151,18 @@ class StepBlobCodec:
         blob = np.empty(self.blob_len, np.int32)
         w4 = blob[: self._n4]
         for k, shape, off, size in self._f32:
-            v = np.ascontiguousarray(f32_values[k], np.float32).reshape(-1)
+            v = np.asarray(f32_values[k])
+            if v.dtype.kind not in "f":
+                # integer values above 2**24 would silently lose precision
+                # in the float32 value-conversion below (ADVICE r3) — unlike
+                # the bit-exact packed-add path; make the caller choose a
+                # representation instead of corrupting quietly
+                raise TypeError(
+                    f"blob f32 section got non-float dtype {v.dtype} for "
+                    f"key {k!r}; convert integer observations explicitly "
+                    "(or keep them uint8 to ride the bit-exact u8 section)"
+                )
+            v = np.ascontiguousarray(v, np.float32).reshape(-1)
             w4[off : off + size] = v.view(np.int32)
         w4[self._idx_off :] = np.asarray(idx, np.int32).reshape(-1)
         tail = np.zeros(self._u8_padded, np.uint8)

@@ -98,14 +98,19 @@ def _as_time_env(data: Mapping[str, np.ndarray]) -> Batch:
 
 
 _WIDTH_GROUP = {1: "w1", 2: "w2", 4: "w4"}
-_GROUP_VIEW = {"w1": np.uint8, "w2": np.uint16, "w4": np.float32}
+# int32 as the 4-byte carrier, NOT float32: integer transfers are bit-exact
+# by construction, while a backend that canonicalizes NaNs on transfer would
+# corrupt int32 ring indices riding as arbitrary float32 bit patterns
+# (ADVICE r3); float32 values bitcast back on device (_unpack_values), same
+# scheme the blob transport uses (data/blob.py:152-174)
+_GROUP_VIEW = {"w1": np.uint8, "w2": np.uint16, "w4": np.int32}
 
 
 def _pack_host_values(data: Mapping[str, "np.ndarray | jax.Array"]):
     """Split an add batch into device-resident values (`direct` — e.g. the
     policy step's obs put, reused by the mains) and host values packed into
     ONE flat array per itemsize class: all 4-byte dtypes bit-viewed as
-    float32, 1-byte as uint8, 2-byte as uint16 (64-bit values are cast to
+    int32, 1-byte as uint8, 2-byte as uint16 (64-bit values are cast to
     their 32-bit counterpart first — matching what the x64-disabled device
     store holds anyway). On a tunneled backend every `device_put` is a host
     round-trip, so the per-step add cost is transfer *count*, not bytes; in
@@ -841,6 +846,8 @@ class AsyncReplayBuffer:
         self._store: dict[str, jax.Array] | None = None
         self._upos = np.zeros(n_envs, dtype=np.int64)
         self._ufull = np.zeros(n_envs, dtype=bool)
+        # uncommitted reserve() head advance (see add_direct)
+        self._pending_reserve: tuple[np.ndarray, int] | None = None
         self._key = jax.random.PRNGKey(seed)
         # device path: optional host-side staging of full-width adds —
         # staged rows flush as ONE batched scatter (one transfer per key
@@ -1049,32 +1056,46 @@ class AsyncReplayBuffer:
 
     # -- blob transport (zero-transfer adds) ----------------------------------
     def reserve(self, data_len: int = 1) -> np.ndarray:
-        """Advance the write head for a full-width `add_direct` and return
+        """Pick the write rows for a full-width `add_direct` and return
         `concat(starts, cols)` as int32 — the index vector that rides the
         step blob (`data/blob.py`) to the device, so the subsequent scatter
-        needs NO host->device transfer of its own. Bookkeeping is identical
-        to a full-width `add`; reserve-then-add_direct must not interleave
-        with other adds for the same rows."""
+        needs NO host->device transfer of its own. The head advance is
+        DEFERRED to `add_direct` (ADVICE r3): if codec.pack or the blob-step
+        jit raises in between, the never-written row stays outside the
+        sampler's valid window, and a retry `reserve()` reuses the same
+        rows. reserve-then-add_direct must not interleave with other adds
+        for the same rows."""
         if self._storage_kind != "device" or self._stage_cap > 0:
             raise RuntimeError(
                 "reserve()/add_direct() require device storage without staging"
             )
         cols = np.arange(self._n_envs)
         starts = self._upos.copy()
-        self._ufull |= starts + data_len >= self._buffer_size
-        self._upos = (starts + data_len) % self._buffer_size
+        self._pending_reserve = (starts, int(data_len))
         return np.concatenate([starts, cols]).astype(np.int32)
 
     def add_direct(self, data: Mapping[str, jax.Array], idx: jax.Array, data_len: int = 1) -> None:
         """Scatter a full-width row whose values (and `idx`, from
         `reserve()` via the step blob) are ALREADY device-resident — the
         zero-transfer half of the blob transport. Shapes `[data_len,
-        n_envs, *item]`, same contract as `add`."""
+        n_envs, *item]`, same contract as `add`. Commits the head advance
+        `reserve()` deferred, so the row becomes sampleable only once its
+        scatter has been dispatched."""
+        pending = self._pending_reserve
+        if pending is not None and pending[1] != data_len:
+            raise ValueError(
+                f"add_direct data_len {data_len} != reserved {pending[1]}"
+            )
         if self._store is None:
             self._allocate_store(dict(data))
         self._store = self._store_add_packed(
             self._store, {**data, "__idx__": idx}, {}, (), data_len
         )
+        if pending is not None:
+            starts, reserved_len = pending
+            self._ufull |= starts + reserved_len >= self._buffer_size
+            self._upos = (starts + reserved_len) % self._buffer_size
+            self._pending_reserve = None
 
     # -- sampling -------------------------------------------------------------
     def _partition(self, batch_size: int) -> np.ndarray:
@@ -1267,6 +1288,9 @@ class AsyncReplayBuffer:
 
     def load_state_dict(self, state: dict) -> None:
         self._flush_staged()
+        # a reservation taken against the pre-restore head must not commit
+        # over the restored one
+        self._pending_reserve = None
         buffers = state["buffers"]
         if len(buffers) != self._n_envs:
             raise ValueError("checkpointed buffer n_envs mismatch")

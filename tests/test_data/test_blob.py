@@ -158,3 +158,56 @@ def test_verify_blob_roundtrip_on_backend():
             return u8, f32, idx + 1
 
     assert not verify_blob_roundtrip(_Broken())
+
+
+def test_reserve_commit_is_deferred_to_add_direct():
+    """ADVICE r3: a failure between reserve() and add_direct() must not
+    leave a never-written all-zeros row inside the sampler's valid window —
+    the head advance commits only when the scatter is dispatched, and a
+    retry reserve() reuses the same rows."""
+    rb = AsyncReplayBuffer(8, 2, storage="device")
+    row = {"observations": np.ones((1, 2, 3), np.float32)}
+
+    idx1 = rb.reserve(1)
+    # nothing committed yet: head and fill state untouched
+    np.testing.assert_array_equal(rb._upos, np.zeros(2, np.int64))
+    # simulate a pack/jit failure -> the retry gets the SAME rows
+    idx2 = rb.reserve(1)
+    np.testing.assert_array_equal(idx1, idx2)
+
+    rb.add_direct({k: jnp.asarray(v) for k, v in row.items()}, jnp.asarray(idx2))
+    np.testing.assert_array_equal(rb._upos, np.ones(2, np.int64))
+
+    # data_len mismatch with the reservation is a loud error
+    rb.reserve(1)
+    with pytest.raises(ValueError, match="data_len"):
+        rb.add_direct(
+            {k: jnp.asarray(np.ones((2, 2, 3), np.float32)) for k in row},
+            jnp.asarray(rb.reserve(2)),
+            data_len=1,
+        )
+
+
+def test_blob_f32_section_rejects_integer_inputs():
+    """ADVICE r3: integer values above 2**24 would silently lose precision
+    in the f32 value-conversion — the codec must refuse instead."""
+    from sheeprl_tpu.data.blob import StepBlobCodec
+
+    obs = {"state": np.zeros((2, 3), np.float32)}
+    codec, u8_keys, f32_keys = StepBlobCodec.for_step(
+        obs, obs_keys=("state",), float_keys=("rewards",), n_envs=2
+    )
+    good = codec.pack(
+        {},
+        {"state": np.zeros((2, 3), np.float32),
+         "rewards": np.zeros((2, 1), np.float64)},
+        np.zeros(4, np.int32),
+    )
+    assert good.dtype == np.int32
+    with pytest.raises(TypeError, match="non-float"):
+        codec.pack(
+            {},
+            {"state": np.full((2, 3), 2**24 + 1, np.int32),
+             "rewards": np.zeros((2, 1), np.float64)},
+            np.zeros(4, np.int32),
+        )

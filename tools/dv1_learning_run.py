@@ -1,0 +1,185 @@
+"""Budget-proofed DreamerV1 learning receipt (VERDICT r3 next-round #3).
+
+The round-3 attempt reused the DV2/DV3 CartPole recipe verbatim and died to
+the session budget (>75 min on the 1-core box, no checkpoint). This runner
+fixes both failure modes:
+
+- **Shrunk recipe**: DV1 needs fewer imagination FLOPs than DV3 (Gaussian
+  latent, no discrete head) — 4096 total steps, 200-unit nets, horizon 10
+  (vs DV3's 6144 / 256 / 15).
+- **Mid-run checkpoints + resume**: `--checkpoint_every 1024` writes a
+  checkpoint every ~1k env steps, and on restart the runner auto-resumes
+  from the latest one (DV1's `--checkpoint_path` restore path,
+  dreamer_v1.py:382-404), so a timeout costs at most 1k steps, not the run.
+- **Eval-from-checkpoint**: after training (or on `--eval-only` against a
+  partial run) the latest checkpoint is restored and greedily evaluated for
+  10 episodes; the result is written to logs/dv1_learn_r4.json.
+
+Reference scope: /root/reference/sheeprl/algos/dreamer_v1/dreamer_v1.py:40-358
+(the training loop this receipt certifies our redesign of).
+
+Usage: python tools/dv1_learning_run.py [--eval-only] [--root DIR]
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # children: skip axon registration
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import gymnasium as gym
+import jax.numpy as jnp
+import numpy as np
+
+import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+from sheeprl_tpu.algos.dreamer_v1.agent import PlayerDV1, build_models
+from sheeprl_tpu.algos.dreamer_v1.args import DreamerV1Args
+from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import make_optimizers
+from sheeprl_tpu.algos.ppo.agent import one_hot_to_env_actions
+from sheeprl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint
+from sheeprl_tpu.utils.registry import tasks
+
+# Attempt 1 (4096 steps, DV1 defaults use_continues=False/expl 0.3) trained
+# fine (world losses converged, 897 updates, 7 min) but learned nothing
+# (greedy 18.9 ~= random): with no continue predictor the imagined rollouts
+# never terminate, and CartPole's ONLY learning signal is termination (+1
+# reward regardless of action) — DV2/DV3 default the continue head ON, which
+# is why the same recipe worked there. Attempt 2 mirrors the proven DV2
+# recipe: continues on, no epsilon noise (the discrete actor already samples
+# during collection), 6144 steps.
+RECIPE = dict(
+    env_id="CartPole-v1",
+    seed=5,
+    total_steps=6144,
+    learning_starts=512,
+    train_every=4,
+    gradient_steps=1,  # DV1 default is 100 (train_every=1000 regime)
+    per_rank_batch_size=16,
+    per_rank_sequence_length=32,
+    buffer_size=100000,
+    dense_units=200,
+    hidden_size=200,
+    recurrent_state_size=200,
+    stochastic_size=30,
+    mlp_layers=2,
+    horizon=10,
+    action_repeat=1,
+    checkpoint_every=1024,
+    use_continues=True,
+    expl_amount=0.0,
+)
+
+
+def _train(root: Path) -> None:
+    argv = [
+        "--num_devices", "1",
+        "--num_envs", "1",
+        "--sync_env",
+        "--root_dir", str(root),
+        "--run_name", "learn",
+        "--mlp_keys", "state",
+    ]
+    for k, v in RECIPE.items():
+        if isinstance(v, bool):
+            argv += [f"--{k}" if v else f"--no_{k}"]
+        else:
+            argv += [f"--{k}", str(v)]
+    resume = latest_checkpoint(str(root / "learn" / "checkpoints"))
+    if resume is not None:
+        print(f"[dv1] resuming from {resume}", flush=True)
+        argv += ["--checkpoint_path", resume]
+    tasks["dreamer_v1"](argv)
+
+
+def _evaluate(root: Path) -> dict:
+    ckpt = latest_checkpoint(str(root / "learn" / "checkpoints"))
+    assert ckpt is not None, "no checkpoint to evaluate"
+    env = gym.make("CartPole-v1")
+    args = DreamerV1Args(env_id="CartPole-v1", seed=5)
+    args.cnn_keys, args.mlp_keys = [], ["state"]
+    for k in (
+        "dense_units", "hidden_size", "recurrent_state_size",
+        "stochastic_size", "mlp_layers", "horizon", "action_repeat",
+        "use_continues",
+    ):
+        setattr(args, k, RECIPE[k])
+    wm, actor, critic = build_models(
+        jax.random.PRNGKey(0), [2], False, args,
+        {"state": env.observation_space}, [], ["state"],
+    )
+    wopt, aopt, copt = make_optimizers(args)
+    restored = load_checkpoint(ckpt, {
+        "world_model": wm, "actor": actor, "critic": critic,
+        "world_optimizer": wopt.init(wm), "actor_optimizer": aopt.init(actor),
+        "critic_optimizer": copt.init(critic),
+        "expl_decay_steps": 0, "global_step": 0, "batch_size": 0,
+    })
+    player = PlayerDV1(
+        encoder=restored["world_model"].encoder,
+        rssm=restored["world_model"].rssm,
+        actor=restored["actor"],
+        actions_dim=(2,),
+        stochastic_size=RECIPE["stochastic_size"],
+        recurrent_state_size=RECIPE["recurrent_state_size"],
+        is_continuous=False,
+    )
+    step = jax.jit(
+        lambda p, s, o, k: p.step(s, o, k, jnp.float32(0.0), is_training=False)
+    )
+    returns = []
+    for episode in range(10):
+        obs, _ = env.reset(seed=1000 + episode)
+        state = player.init_states(1)
+        key = jax.random.PRNGKey(episode)
+        done, ep_return = False, 0.0
+        while not done:
+            dobs = {"state": jnp.asarray(obs, jnp.float32)[None]}
+            key, sub = jax.random.split(key)
+            state, actions = step(player, state, dobs, sub)
+            act = one_hot_to_env_actions(np.asarray(actions), (2,), False)[0]
+            obs, reward, terminated, truncated, _ = env.step(act.item())
+            ep_return += float(reward)
+            done = terminated or truncated
+        returns.append(ep_return)
+    env.close()
+    return {
+        "checkpoint": ckpt,
+        "returns": returns,
+        "mean_return": float(np.mean(returns)),
+        "global_step_restored": int(restored["global_step"]),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default="logs/dv1_learn_r4b")
+    ap.add_argument("--eval-only", action="store_true")
+    ns = ap.parse_args()
+    root = Path(ns.root)
+    t0 = time.time()
+    if not ns.eval_only:
+        _train(root)
+    result = _evaluate(root)
+    result["recipe"] = RECIPE
+    result["train_plus_eval_seconds"] = round(time.time() - t0, 1)
+    out = Path(str(root) + ".json")
+    out.write_text(json.dumps(result, indent=2))
+    print(json.dumps({k: result[k] for k in ("mean_return", "returns")}))
+    print(f"[dv1] receipt written to {out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

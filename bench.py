@@ -192,16 +192,28 @@ def _dv3_synth_data(args, actions_dim, obs_space):
     return sample_batch, obs, mask
 
 
-def _dv3_duty_cycle_sps(
-    args, state, opts, actions_dim, is_continuous, tiny, obs_space=None
+def _dv3_duty_closure(
+    args, state, opts, actions_dim, is_continuous, obs_space=None
 ):
-    """Device-only duty cycle: train_every jitted policy steps + one update
-    on a fixed pre-staged batch (replay pipeline excluded)."""
+    """Build + compile the device-only duty cycle (train_every jitted policy
+    steps + one update on a fixed pre-staged batch, replay excluded) under
+    the CURRENTLY ACTIVE kernel/precision/unroll configuration, and return a
+    `run_cycles(n) -> elapsed_seconds` closure holding its own state. The
+    keep-decisions interleave several of these in one session (VERDICT r3
+    #1): config is captured at trace time here, timing happens later in
+    round-robin segments so tunnel weather hits every variant equally."""
+    import copy
+
     import jax
     import jax.numpy as jnp
 
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
 
+    # freeze the config: make_player reads args.precision at every call
+    # (compute_dtype is a static retrace key), so without a snapshot a later
+    # args mutation by the caller would silently retrace a "frozen" variant
+    # inside a timed segment and corrupt the precision keep-decisions
+    args = copy.copy(args)
     if obs_space is None:
         obs_space = {"rgb": type("S", (), {"shape": (64, 64, 3)})()}
     world_opt, actor_opt, critic_opt = opts
@@ -229,12 +241,27 @@ def _dv3_duty_cycle_sps(
         float(jax.device_get(metrics["Loss/reconstruction_loss"]))
         return state, player_state, key
 
-    state, player_state, key = one_cycle(state, player_state, key)  # compile
+    holder = [*one_cycle(state, player_state, key)]  # compile/warmup
+
+    def run_cycles(n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            holder[:] = one_cycle(*holder)
+        return time.perf_counter() - t0
+
+    return run_cycles
+
+
+def _dv3_duty_cycle_sps(
+    args, state, opts, actions_dim, is_continuous, tiny, obs_space=None
+):
+    """Single-shot duty-cycle measurement (tools/phase_probe.py and the
+    decoupled bench still time one config at a time)."""
+    run_cycles = _dv3_duty_closure(
+        args, state, opts, actions_dim, is_continuous, obs_space
+    )
     n_cycles = 3 if tiny else 10
-    t0 = time.perf_counter()
-    for _ in range(n_cycles):
-        state, player_state, key = one_cycle(state, player_state, key)
-    dt = time.perf_counter() - t0
+    dt = run_cycles(n_cycles)
     return n_cycles * args.train_every * args.num_envs / dt
 
 
@@ -325,18 +352,20 @@ def _dv3_blob_harness(args, actions_dim, is_continuous):
     return step
 
 
-def _dv3_e2e_sps(
-    args, state, opts, actions_dim, is_continuous, tiny, n_mesh_devices=0
+def _dv3_e2e_closure(
+    args, state, opts, actions_dim, is_continuous, n_mesh_devices=0
 ):
-    """Honest end-to-end loop: the real AsyncReplayBuffer in the cycle —
-    per-step rb.add, rb.sample, dtype cast, host->device transfer, update
-    (only gym env stepping excluded; mirrors dreamer_v3.py:628-660).
-    `n_mesh_devices > 0` runs the update data-parallel over that many
-    devices (batch sharded, params replicated) — the coupled side of the
-    decoupled comparison, so both topologies pay their collectives."""
+    """Build + compile the honest end-to-end cycle (see `_dv3_e2e_sps`) and
+    return `run_cycles(n) -> elapsed_seconds` — the interleavable form, same
+    contract (incl. the config-freezing args snapshot) as
+    `_dv3_duty_closure`."""
+    import copy
+
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    args = copy.copy(args)
 
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
     from sheeprl_tpu.data import AsyncReplayBuffer, stage_batch
@@ -400,13 +429,32 @@ def _dv3_e2e_sps(
         float(jax.device_get(metrics["Loss/reconstruction_loss"]))
         return state, player_state, key
 
-    state, player_state, key = one_cycle(state, player_state, key)  # compile
+    holder = [*one_cycle(state, player_state, key)]  # compile/warmup
+
+    def run_cycles(n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            holder[:] = one_cycle(*holder)
+        return time.perf_counter() - t0
+
+    return run_cycles
+
+
+def _dv3_e2e_sps(
+    args, state, opts, actions_dim, is_continuous, tiny, n_mesh_devices=0
+):
+    """Honest end-to-end loop: the real AsyncReplayBuffer in the cycle —
+    per-step rb.add, rb.sample, dtype cast, host->device transfer, update
+    (only gym env stepping excluded; mirrors dreamer_v3.py:628-660).
+    `n_mesh_devices > 0` runs the update data-parallel over that many
+    devices (batch sharded, params replicated) — the coupled side of the
+    decoupled comparison, so both topologies pay their collectives."""
+    run_cycles = _dv3_e2e_closure(
+        args, state, opts, actions_dim, is_continuous, n_mesh_devices
+    )
     n_cycles = 3 if tiny else 10
-    t0 = time.perf_counter()
-    for _ in range(n_cycles):
-        state, player_state, key = one_cycle(state, player_state, key)
-    dt = time.perf_counter() - t0
-    return n_cycles * args.train_every * n_envs / dt
+    dt = run_cycles(n_cycles)
+    return n_cycles * args.train_every * args.num_envs / dt
 
 
 def _fair_n_train(batch_size: int) -> int:
@@ -620,151 +668,322 @@ def _plausible(sps: float, discards: list, tiny: bool = False) -> float:
     return sps
 
 
+# =============================================================================
+# Interleaved (ABAB) keep-decisions — VERDICT r3 #1. Two round-3 chip-days
+# flipped bf16_kept and the kept pallas family on tunnel weather alone
+# (logs/bench_dv3_r3.json vs r3b: same code, headline 118.9 vs 178.2) because
+# each variant was timed in its own sequential run. Here every phase builds
+# all its variant closures first (config captured at trace time), then times
+# them in round-robin segments within ONE session, and a challenger is kept
+# only if its pooled paired advantage over the baseline exceeds the observed
+# spread — the tools/e2e_ab_probe.py pattern promoted into the bench itself.
+# =============================================================================
+
+
+def _build_closure_guarded(builder, args_, state_, *rest):
+    """Compile one variant closure; an intermittent backend failure yields
+    None (that variant reads 0.0 everywhere) instead of killing the bench."""
+    import traceback
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        state_ = jax.tree_util.tree_map(jnp.copy, state_)
+        return builder(args_, state_, *rest)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return None
+
+
+def _interleave_sps(
+    variants: dict, steps_per_cycle: int, *, segments: int,
+    cycles_per_segment: int, discards: list, tiny: bool = False,
+) -> dict:
+    """Round-robin timed segments over pre-built `run_cycles` closures:
+    segment order A,B,C,A,B,C,... so a tunnel-weather swing lands on every
+    variant, not on whichever ran last. Returns name -> per-segment sps
+    samples (0.0 for failed/implausible segments)."""
+    samples: dict = {name: [] for name in variants}
+    for _ in range(segments):
+        for name, run in variants.items():
+            if run is None:
+                samples[name].append(0.0)
+                continue
+            try:
+                dt = run(cycles_per_segment)
+                sps = cycles_per_segment * steps_per_cycle / dt
+            except Exception:
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+                sps = 0.0
+            samples[name].append(_plausible(sps, discards, tiny))
+    return samples
+
+
+def _pooled(samples: list) -> float:
+    """Pooled per-variant throughput: median of the valid segments (robust
+    to a single weather-hit segment); 0.0 if nothing valid."""
+    import statistics
+
+    valid = [s for s in samples if s > 0.0]
+    return statistics.median(valid) if valid else 0.0
+
+
+def _beats(challenger: list, baseline: list, margin: float = 0.02) -> bool:
+    """Paired-by-segment keep rule: the challenger is kept only if the
+    median of the per-segment ratios challenger/baseline exceeds 1 by more
+    than the observed spread (median absolute deviation of those ratios)
+    AND by at least `margin` — a sub-noise 'win' must not flip a config."""
+    import statistics
+
+    pairs = [(c, b) for c, b in zip(challenger, baseline) if c > 0.0 and b > 0.0]
+    if len(pairs) < 2:
+        return False
+    ratios = [c / b for c, b in pairs]
+    med = statistics.median(ratios)
+    mad = statistics.median([abs(r - med) for r in ratios])
+    return med - 1.0 > max(mad, margin)
+
+
 def bench_dreamer_v3(tiny: bool = False) -> None:
     from sheeprl_tpu.ops import pallas_kernels as pk
 
     args, state, opts, actions_dim, is_continuous, _ = _dv3_setup(tiny)
-    tail = (actions_dim, is_continuous, tiny)
+    build_tail = (actions_dim, is_continuous)
     discards: list = []
+    steps_per_cycle = args.train_every * args.num_envs
+    segments = 2 if tiny else 5
+    cycles = 1 if tiny else 2
 
     import os as _os_mod
 
+    def build_duty(fams, precision=None, unroll=None):
+        """Compile ONE duty-cycle variant under the given config (kernel
+        families / precision / scan unroll are captured at trace time inside
+        the builder's warmup); global knobs are reset by the next build, and
+        the returned closure is config-frozen so later timing segments can
+        interleave variants freely."""
+        if fams is None:
+            _set_kernel_families(None)
+            pk.set_pallas(False)
+        elif fams == "all":
+            _set_kernel_families(None)
+            pk.set_pallas(True, interpret=not pk._backend_is_tpu())
+        else:
+            _set_kernel_families({f: True for f in fams})
+            pk.set_pallas(True, interpret=not pk._backend_is_tpu())
+        if unroll is None:
+            _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
+        else:
+            _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(unroll)
+        old_precision = args.precision
+        if precision is not None:
+            args.precision = precision
+        try:
+            return _build_closure_guarded(
+                _dv3_duty_closure, args, state, opts, *build_tail
+            )
+        finally:
+            args.precision = old_precision
+
+    def interleave(variants):
+        return _interleave_sps(
+            variants, steps_per_cycle, segments=segments,
+            cycles_per_segment=cycles, discards=discards, tiny=tiny,
+        )
+
     # every keep-decision baseline must measure the PLAIN configuration: an
     # inherited unroll override would make the headline unrolled while
-    # scan_unroll_kept reports 1 (the sweep below owns this knob)
+    # scan_unroll_kept reports 1 (the unroll phase below owns this knob)
     _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
-    _set_kernel_families(None)
-    pk.set_pallas(False)
-    off_sps = _plausible(
-        _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
-        discards, tiny,
-    )
-    # the kernels-on cycle runs in --tiny too: it is the only train-step-
-    # level coverage of the pallas-enable wiring (op/block numerics live in
+
+    # ---- phase A: kernel families, interleaved in small waves -------------
+    # waves of (off + <=2 challengers) rather than one 6-way round-robin:
+    # every closure holds a full model+optimizer state copy on device, so
+    # peak memory stays ~3x one state, not 6x (the off baseline is RE-TIMED
+    # inside every wave, so each challenger's keep-decision still pairs with
+    # baseline segments from its own session). The kernels-on variant runs
+    # in --tiny too: it is the only train-step-level coverage of the
+    # pallas-enable wiring (op/block numerics live in
     # tests/test_ops/test_pallas*.py, but a regression in the set_pallas /
     # env-switch integration inside the DV3 step would otherwise only
     # surface on a real chip behind the flaky tunnel)
-    pk.set_pallas(True, interpret=not pk._backend_is_tpu())
-    on_sps = _plausible(
-        _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
-        discards, tiny,
+    off_closure = build_duty(None)
+    all_fams = tuple(_PALLAS_FAMILIES)
+    waves = [("all",)] if tiny else [("all",), ("gru", "two_hot"), ("symlog", "cnn")]
+    # candidate kernel configs: fams-tuple -> (samples, paired off samples,
+    # closure). Each must beat its own wave's interleaved off baseline by
+    # more than the observed spread to be keepable; pooled medians rank the
+    # keepable ones. A failed build/measurement (0.0 samples) can never win.
+    candidates: dict[tuple, tuple] = {}
+    off_sps, off_samples = 0.0, []
+    observed: list[float] = []  # every valid pooled measurement (fallback)
+    for wave in waves:
+        closures = {
+            cfg: build_duty(cfg if cfg != "all" else "all")
+            for cfg in wave
+        }
+        phase = interleave({"off": off_closure, **closures})
+        off_samples = phase["off"]
+        off_sps = max(off_sps, _pooled(off_samples))
+        for cfg in wave:
+            fams = all_fams if cfg == "all" else (cfg,)
+            candidates[fams] = (phase[cfg], phase["off"], closures[cfg])
+            observed.append(_pooled(phase[cfg]))
+        observed.append(_pooled(phase["off"]))
+        # free this wave's losers-to-be after the keep-decision below; for
+        # now only drop refs not needed again (final selection keeps the
+        # winning closure via candidates)
+    on_sps = _pooled(candidates[all_fams][0])
+    fam_sps = {
+        f: _pooled(candidates[(f,)][0])
+        for f in _PALLAS_FAMILIES
+        if (f,) in candidates
+    }
+    solo_winners = tuple(
+        f for f in fam_sps if _beats(candidates[(f,)][0], candidates[(f,)][1])
     )
-
-    # per-kernel attribution (VERDICT r2 #6): one run per family with only
-    # that family enabled, so a losing kernel can't hide behind a winning
-    # one. Skipped in --tiny (3 extra compiles would dominate the CPU smoke).
-    fam_sps: dict[str, float] = {}
-    if not tiny:
-        for fam in _PALLAS_FAMILIES:
-            _set_kernel_families({fam: True})
-            fam_sps[fam] = _plausible(
-                _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
-                discards,
-            )
-        _set_kernel_families(None)
-
-    # keep-decision (VERDICT r1 #4): the headline runs the best measured
-    # config — all-off, all-on, the single best solo family, or the joint
-    # set of all solo winners (losers in the all-on set can mask a winning
-    # combination, and solo runs can't see combination effects). A failed
-    # measurement (0.0 sentinel) can never win.
-    candidates: dict[tuple, float] = {(): off_sps, tuple(_PALLAS_FAMILIES): on_sps}
-    for fam, sps in fam_sps.items():
-        candidates[(fam,)] = sps
-    # a discarded/failed all-off run (0.0) is not a baseline: without it no
-    # solo "win" is meaningful, so skip the joint run and keep kernels off
-    solo_winners = (
-        tuple(f for f in _PALLAS_FAMILIES if fam_sps.get(f, 0.0) > off_sps)
-        if off_sps > 0.0
-        else ()
-    )
+    # ---- phase B (conditional): joint set of the solo winners ---------------
     if len(solo_winners) >= 2 and solo_winners not in candidates:
-        _set_kernel_families({f: True for f in solo_winners})
-        candidates[solo_winners] = _plausible(
-            _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
-            discards,
-        )
-        _set_kernel_families(None)
-    best_fams = max(candidates, key=candidates.get)
-    kernels_win = off_sps > 0.0 and bool(best_fams) and candidates[best_fams] > 0.0
+        joint = build_duty(solo_winners)
+        phase_b = interleave({"off": off_closure, "joint": joint})
+        candidates[solo_winners] = (phase_b["joint"], phase_b["off"], joint)
+        observed.append(_pooled(phase_b["joint"]))
+        observed.append(_pooled(phase_b["off"]))
+
+    keepable = {
+        fams: _pooled(samp)
+        for fams, (samp, base, _c) in candidates.items()
+        if _beats(samp, base)
+    }
+    kernels_win = bool(keepable)
+    best_fams = max(keepable, key=keepable.get) if kernels_win else ()
     if kernels_win and pk._backend_is_tpu():
         _set_kernel_families({f: True for f in best_fams})
         pk.set_pallas(True, interpret=False)
     else:
         _set_kernel_families(None)
         pk.set_pallas(False, interpret=False)
-    # bf16 compute (--precision bfloat16) on top of the winning kernel
-    # config. Skipped in --tiny (reported as null, NOT the 0.0 failure
-    # sentinel): it adds a full train-step compile to the CPU smoke for a
-    # path test_precision.py already covers
-    if tiny:
+    if kernels_win:
+        duty_samples, _, winner_closure = candidates[best_fams]
+    else:
+        duty_samples, winner_closure = off_samples, off_closure
+    # free the losing closures (each holds a full model+opt state on device)
+    for fams, (_s, _b, c) in list(candidates.items()):
+        if c is not winner_closure and c is not off_closure:
+            candidates[fams] = (_s, _b, None)
+    if winner_closure is not off_closure:
+        del off_closure
+
+    # ---- phase C: precision (bf16 vs f32) on the winning kernel config ------
+    # Skipped in --tiny (reported as null, NOT the 0.0 failure sentinel): it
+    # adds a full train-step compile to the CPU smoke for a path
+    # test_precision.py already covers. Also skipped when the baseline build
+    # itself failed (winner_closure None): a challenger can never be kept
+    # against a dead baseline, so the compiles would be pure waste.
+    if tiny or winner_closure is None:
         bf16_sps, bf16_win = None, False
     else:
-        args.precision = "bfloat16"
-        bf16_sps = _plausible(
-            _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
-            discards,
+        bf16_closure = build_duty(
+            best_fams if kernels_win else None, precision="bfloat16"
         )
-        # same valid-baseline guard as kernels_win: a zeroed f32 baseline
-        # (all candidates discarded/failed) must not hand bf16 a free win
-        bf16_win = candidates[best_fams] > 0.0 and bf16_sps > candidates[best_fams]
-        args.precision = "bfloat16" if bf16_win else "float32"
-    duty_sps = max(max(candidates.values()), bf16_sps or 0.0)
-    # scan-unroll sweep on the winning kernel/precision config: the RSSM +
-    # imagination scans have tiny step bodies where XLA's while-loop
-    # per-iteration overhead competes with compute (ops/scan.py). Skipped
-    # in --tiny (two extra full compiles). Keep-decision against the
-    # current best duty cycle; requires a valid baseline like the others.
-    # the sweep measures at args.precision (f32 unless bf16 won), so its
-    # baseline must be the same-precision duty number — comparing f32
-    # unroll candidates against a bf16 duty_sps (possible when every f32
-    # candidate was discarded) would wrongly reject a real f32 win
-    sweep_baseline = bf16_sps if bf16_win else candidates[best_fams]
-    unroll_sps: dict[int, float] = {}
-    if not tiny and sweep_baseline and sweep_baseline > 0.0:
-        # escalating ladder: always measure 4 and 8; climb to 16/32 only
-        # while the top rung keeps winning (each rung is a full recompile,
-        # so the ladder is bounded and climbs only on evidence)
-        ladder = [4, 8, 16, 32]
-        for i, u in enumerate(ladder):
-            if i >= 2 and unroll_sps[ladder[i - 1]] <= unroll_sps[ladder[i - 2]]:
-                break
-            _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(u)
-            unroll_sps[u] = _plausible(
-                _measure_guarded(_dv3_duty_cycle_sps, args, state, opts, *tail),
-                discards,
-            )
-        best_u = max(unroll_sps, key=unroll_sps.get)
-        if unroll_sps[best_u] > sweep_baseline:
-            unroll_kept = best_u
-            duty_sps = max(duty_sps, unroll_sps[best_u])
-            _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(best_u)
+        phase_c = interleave({"f32": winner_closure, "bf16": bf16_closure})
+        bf16_sps = _pooled(phase_c["bf16"])
+        observed.append(bf16_sps)
+        bf16_win = _beats(phase_c["bf16"], phase_c["f32"])
+        if bf16_win:
+            args.precision = "bfloat16"
+            winner_closure = bf16_closure
+            duty_samples = phase_c["bf16"]
         else:
-            unroll_kept = 1
-            _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
-    else:
-        unroll_kept = 1
+            duty_samples = phase_c["f32"]
+            del bf16_closure
+
+    # ---- phase D: scan-unroll ladder on the winning kernel+precision config -
+    # the RSSM + imagination scans have tiny step bodies where XLA's
+    # while-loop per-iteration overhead competes with compute (ops/scan.py).
+    # Evidence-gated escalation is kept from the sequential design: rungs 4/8
+    # interleave against u1 first, and the expensive 16/32 compiles (the scan
+    # body duplicated 16/32x) happen only if 8 beats 4.
+    unroll_sps: dict[int, float] = {}
+    unroll_kept = 1
+    if not tiny and winner_closure is not None:
+        kernel_cfg = best_fams if kernels_win else None
+        rungs = {
+            u: build_duty(kernel_cfg, precision=args.precision, unroll=u)
+            for u in (4, 8)
+        }
         _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
+        phase_d1 = interleave({"u1": winner_closure, 4: rungs[4], 8: rungs[8]})
+        unroll_sps = {u: _pooled(phase_d1[u]) for u in (4, 8)}
+        rung_samples = {u: (phase_d1[u], phase_d1["u1"]) for u in (4, 8)}
+        base_samples = phase_d1["u1"]
+        if unroll_sps[8] > unroll_sps[4] > 0.0:
+            rungs.update({
+                u: build_duty(kernel_cfg, precision=args.precision, unroll=u)
+                for u in (16, 32)
+            })
+            _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
+            phase_d2 = interleave(
+                {"u1": winner_closure, 16: rungs[16], 32: rungs[32]}
+            )
+            for u in (16, 32):
+                unroll_sps[u] = _pooled(phase_d2[u])
+                rung_samples[u] = (phase_d2[u], phase_d2["u1"])
+            base_samples = phase_d2["u1"]
+        observed.extend(unroll_sps.values())
+        rung_winners = {
+            u: unroll_sps[u]
+            for u, (samp, base) in rung_samples.items()
+            if _beats(samp, base)
+        }
+        if rung_winners:
+            unroll_kept = max(rung_winners, key=rung_winners.get)
+            duty_samples = rung_samples[unroll_kept][0]
+            _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(unroll_kept)
+        else:
+            duty_samples = base_samples
+        del rungs
+    del winner_closure
+
+    # the headline is the pooled median of the KEPT configuration from its
+    # own (latest) interleaved phase. If the kept config's samples are all
+    # dead (e.g. the off-baseline build failed), fall back to the best valid
+    # pooled measurement so one backend hiccup zeroes that path, not the
+    # whole artifact (_build_closure_guarded's contract).
+    duty_sps = _pooled(duty_samples) or max([o for o in observed if o > 0.0], default=0.0)
     implied_tflops = duty_sps / 20.0 * DV3_TFLOPS_PER_20_STEPS
-    # individual candidates are already filtered by _plausible; this flag
-    # can only fire if the cap itself is later raised past a lie
+    # individual segments are already filtered by _plausible; this flag can
+    # only fire if the cap itself is later raised past a lie
     suspect_timing = bool(implied_tflops > PLAUSIBLE_TFLOPS_CAP)
-    # e2e gets its own precision keep-decision: the replay/transfer mix can
-    # invert the duty-cycle winner (bf16 wins the duty cycle but pays extra
-    # host->device cast latency in the end-to-end loop on the round-3 chip)
-    e2e_sps = _plausible(
-        _measure_guarded(_dv3_e2e_sps, args, state, opts, *tail), discards, tiny
-    )
+
+    # ---- e2e, with its own interleaved precision keep-decision --------------
+    # the replay/transfer mix can invert the duty-cycle winner (bf16 won the
+    # round-3 duty cycle but lost e2e: the host->device cast mix flips it)
+    def build_e2e(precision):
+        old_precision = args.precision
+        args.precision = precision
+        try:
+            return _build_closure_guarded(
+                _dv3_e2e_closure, args, state, opts, *build_tail
+            )
+        finally:
+            args.precision = old_precision
+
     e2e_precision = args.precision
     if not tiny and bf16_win:
-        args.precision = "float32"
-        e2e_f32 = _plausible(
-            _measure_guarded(_dv3_e2e_sps, args, state, opts, *tail), discards, tiny
+        phase_e = interleave(
+            {"f32": build_e2e("float32"), "bf16": build_e2e("bfloat16")}
         )
-        if e2e_f32 > e2e_sps:
-            e2e_sps, e2e_precision = e2e_f32, "float32"
+        if _beats(phase_e["bf16"], phase_e["f32"]):
+            e2e_sps, e2e_precision = _pooled(phase_e["bf16"]), "bfloat16"
         else:
-            args.precision = "bfloat16"
+            e2e_sps, e2e_precision = _pooled(phase_e["f32"]), "float32"
+            args.precision = "float32"
+    else:
+        e2e_sps = _pooled(interleave({"e2e": build_e2e(args.precision)})["e2e"])
 
     print(
         json.dumps(
@@ -799,6 +1018,12 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
                 "implied_tflops": round(implied_tflops, 1),
                 "suspect_timing": suspect_timing,
                 "implausible_discards": discards,
+                "ab_segments": segments,
+                "ab_cycles_per_segment": cycles,
+                "keep_rule": (
+                    "interleaved round-robin segments; challenger kept iff "
+                    "median paired ratio > 1 + max(MAD, 0.02)"
+                ),
                 "baseline_note": BASELINE_NOTE,
             }
         )
@@ -1044,11 +1269,23 @@ def _probe_backend_once(timeout_s: float) -> tuple[bool, str]:
     hang indefinitely inside PJRT plugin init when the axon tunnel is dead
     (not just raise), so the attempt must be killable from outside. The
     parent process never touches jax here — its own backend cache stays
-    clean for the real run after a successful probe."""
+    clean for the real run after a successful probe.
+
+    When the caller requests the cpu platform (JAX_PLATFORMS=cpu, e.g. a
+    local `bench.py --tiny`), the axon pool-IPs var is blanked for the
+    subprocess: the sitecustomize overrides JAX_PLATFORMS and would still
+    hang on axon plugin registration behind a dead tunnel (VERDICT r3 weak
+    #7) — same recipe as dryrun_multichip."""
+    import os
     import subprocess
 
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        env["PALLAS_AXON_POOL_IPS"] = ""
     code = (
-        "import jax, sys\n"
+        "import jax, sys, os\n"
+        "if os.environ.get('JAX_PLATFORMS', '').split(',')[0] == 'cpu':\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n"
         "pref = (jax.config.jax_platforms or '').split(',')[0]\n"
         "ds = jax.devices()\n"
         "if pref not in ('', 'cpu') and all(d.platform == 'cpu' for d in ds):\n"
@@ -1061,6 +1298,7 @@ def _probe_backend_once(timeout_s: float) -> tuple[bool, str]:
             capture_output=True,
             text=True,
             timeout=timeout_s,
+            env=env,
         )
     except subprocess.TimeoutExpired:
         return False, f"probe timed out after {timeout_s:.0f}s"
@@ -1324,6 +1562,18 @@ def main() -> None:
     parser.add_argument("--tiny", action="store_true")
     opts = parser.parse_args()
     metric, unit = _METRIC_OF_ALGO[opts.algo]
+
+    # honor an explicit JAX_PLATFORMS=cpu in THIS process too (the
+    # sitecustomize overrides the env var at interpreter start, so a local
+    # `JAX_PLATFORMS=cpu python bench.py --tiny` would otherwise still hang
+    # on axon plugin registration behind a dead tunnel — VERDICT r3 weak #7;
+    # config updates win over the sitecustomize write, and blanking the
+    # pool-IPs var keeps measurement subprocesses off the plugin as well)
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     # one JSON line is guaranteed from here on: the watchdog covers arbitrary
     # hangs (including jax backend init in THIS process after a good probe),

@@ -42,3 +42,49 @@ def test_bench_tiny_prints_contract_json():
     # a 0.0 value means every guarded measurement failed (sentinel) — the
     # guarded tracebacks land on stderr, so surface them
     assert payload["value"] > 0, diag
+
+
+def test_interleave_keep_rule_helpers():
+    """The ABAB keep-decision primitives (VERDICT r3 #1): pooled medians
+    ignore dead segments, and a challenger is kept only when its paired
+    advantage exceeds both the observed spread and the 2% floor."""
+    import bench
+
+    assert bench._pooled([0.0, 0.0]) == 0.0
+    assert bench._pooled([100.0, 0.0, 110.0]) == 105.0
+
+    base = [100.0, 100.0, 100.0, 100.0]
+    # clear win: +10% with tight spread
+    assert bench._beats([110.0, 110.5, 109.5, 110.0], base)
+    # sub-noise win: +1% never kept (margin floor)
+    assert not bench._beats([101.0, 101.0, 101.0, 101.0], base)
+    # big median win but spread wider than the advantage: not kept
+    assert not bench._beats([150.0, 80.0, 150.0, 80.0], base)
+    # dead challenger / dead baseline: never kept
+    assert not bench._beats([0.0, 0.0, 0.0, 0.0], base)
+    assert not bench._beats([110.0] * 4, [0.0] * 4)
+    # one dead segment is excluded from pairing, not fatal
+    assert bench._beats([110.0, 0.0, 110.0, 110.0], base)
+
+
+def test_interleave_sps_round_robin_and_guards():
+    import bench
+
+    calls = []
+
+    def make_run(name, dt):
+        def run(n):
+            calls.append(name)
+            return dt * n
+        return run
+
+    samples = bench._interleave_sps(
+        {"a": make_run("a", 0.1), "b": make_run("b", 0.2), "dead": None},
+        steps_per_cycle=10, segments=3, cycles_per_segment=2,
+        discards=[], tiny=True,
+    )
+    # round-robin order: a,b,a,b,a,b (dead variant never called)
+    assert calls == ["a", "b"] * 3
+    assert samples["dead"] == [0.0, 0.0, 0.0]
+    assert all(abs(s - 100.0) < 1e-6 for s in samples["a"])
+    assert all(abs(s - 50.0) < 1e-6 for s in samples["b"])

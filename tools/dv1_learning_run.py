@@ -52,19 +52,24 @@ from sheeprl_tpu.algos.ppo.agent import one_hot_to_env_actions
 from sheeprl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint
 from sheeprl_tpu.utils.registry import tasks
 
-# Attempt 1 (4096 steps, DV1 defaults use_continues=False/expl 0.3) trained
-# fine (world losses converged, 897 updates, 7 min) but learned nothing
-# (greedy 18.9 ~= random): with no continue predictor the imagined rollouts
-# never terminate, and CartPole's ONLY learning signal is termination (+1
-# reward regardless of action) — DV2/DV3 default the continue head ON, which
-# is why the same recipe worked there. Attempt 2 mirrors the proven DV2
-# recipe: continues on, no epsilon noise (the discrete actor already samples
-# during collection), 6144 steps.
+# Attempt 1 (CartPole, 4096 steps, DV1 defaults use_continues=False/expl 0.3)
+# trained fine (world losses converged, 897 updates, 7 min) but learned
+# nothing (greedy 18.9 ~= random): with no continue predictor the imagined
+# rollouts never terminate, and CartPole's ONLY learning signal is
+# termination. Attempt 2 (CartPole, continues on, 6144 steps) collapsed
+# below random (9.8): DV1's actor trains by PURE dynamics backprop of
+# imagined values — no reinforce term, no entropy bonus (reference
+# dreamer_v1/agent.py:485-498 builds a tanh_normal actor unconditionally;
+# discrete CartPole is outside the reference DV1's own design envelope) —
+# and the straight-through discrete policy saturated into always-left.
+# Attempt 3 moves to DV1's native regime: continuous control with dense
+# rewards (Pendulum swing-up, the SAC/DroQ receipt env), tanh_normal actor
+# + additive Gaussian exploration noise, no continue head (no termination).
 RECIPE = dict(
-    env_id="CartPole-v1",
+    env_id="Pendulum-v1",
     seed=5,
-    total_steps=6144,
-    learning_starts=512,
+    total_steps=12288,
+    learning_starts=1024,
     train_every=4,
     gradient_steps=1,  # DV1 default is 100 (train_every=1000 regime)
     per_rank_batch_size=16,
@@ -75,11 +80,11 @@ RECIPE = dict(
     recurrent_state_size=200,
     stochastic_size=30,
     mlp_layers=2,
-    horizon=10,
+    horizon=15,
     action_repeat=1,
-    checkpoint_every=1024,
-    use_continues=True,
-    expl_amount=0.0,
+    checkpoint_every=2048,
+    use_continues=False,
+    expl_amount=0.3,
 )
 
 
@@ -107,8 +112,12 @@ def _train(root: Path) -> None:
 def _evaluate(root: Path) -> dict:
     ckpt = latest_checkpoint(str(root / "learn" / "checkpoints"))
     assert ckpt is not None, "no checkpoint to evaluate"
-    env = gym.make("CartPole-v1")
-    args = DreamerV1Args(env_id="CartPole-v1", seed=5)
+    env = gym.make(RECIPE["env_id"])
+    is_continuous = hasattr(env.action_space, "high")
+    act_dim = (
+        int(np.prod(env.action_space.shape)) if is_continuous else env.action_space.n
+    )
+    args = DreamerV1Args(env_id=RECIPE["env_id"], seed=5)
     args.cnn_keys, args.mlp_keys = [], ["state"]
     for k in (
         "dense_units", "hidden_size", "recurrent_state_size",
@@ -117,7 +126,7 @@ def _evaluate(root: Path) -> dict:
     ):
         setattr(args, k, RECIPE[k])
     wm, actor, critic = build_models(
-        jax.random.PRNGKey(0), [2], False, args,
+        jax.random.PRNGKey(0), [act_dim], is_continuous, args,
         {"state": env.observation_space}, [], ["state"],
     )
     wopt, aopt, copt = make_optimizers(args)
@@ -131,10 +140,10 @@ def _evaluate(root: Path) -> dict:
         encoder=restored["world_model"].encoder,
         rssm=restored["world_model"].rssm,
         actor=restored["actor"],
-        actions_dim=(2,),
+        actions_dim=(act_dim,),
         stochastic_size=RECIPE["stochastic_size"],
         recurrent_state_size=RECIPE["recurrent_state_size"],
-        is_continuous=False,
+        is_continuous=is_continuous,
     )
     step = jax.jit(
         lambda p, s, o, k: p.step(s, o, k, jnp.float32(0.0), is_training=False)
@@ -149,8 +158,15 @@ def _evaluate(root: Path) -> dict:
             dobs = {"state": jnp.asarray(obs, jnp.float32)[None]}
             key, sub = jax.random.split(key)
             state, actions = step(player, state, dobs, sub)
-            act = one_hot_to_env_actions(np.asarray(actions), (2,), False)[0]
-            obs, reward, terminated, truncated, _ = env.step(act.item())
+            if is_continuous:
+                obs, reward, terminated, truncated, _ = env.step(
+                    np.asarray(actions)[0]
+                )
+            else:
+                act = one_hot_to_env_actions(
+                    np.asarray(actions), (act_dim,), False
+                )[0]
+                obs, reward, terminated, truncated, _ = env.step(act.item())
             ep_return += float(reward)
             done = terminated or truncated
         returns.append(ep_return)

@@ -747,6 +747,20 @@ def _beats(challenger: list, baseline: list, margin: float = 0.02) -> bool:
     return med - 1.0 > max(mad, margin)
 
 
+def _paired_ratio(challenger: list, baseline: list) -> float:
+    """Median per-segment ratio challenger/baseline — the weather-immune
+    ranking key: candidates measured in different interleaved sessions are
+    compared by their advantage over their OWN session's baseline, never by
+    absolute sps across sessions (absolute numbers re-import the
+    cross-session tunnel-weather bias the ABAB design exists to kill)."""
+    import statistics
+
+    pairs = [(c, b) for c, b in zip(challenger, baseline) if c > 0.0 and b > 0.0]
+    if len(pairs) < 2:
+        return 0.0
+    return statistics.median([c / b for c, b in pairs])
+
+
 def bench_dreamer_v3(tiny: bool = False) -> None:
     from sheeprl_tpu.ops import pallas_kernels as pk
 
@@ -814,28 +828,44 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     all_fams = tuple(_PALLAS_FAMILIES)
     waves = [("all",)] if tiny else [("all",), ("gru", "two_hot"), ("symlog", "cnn")]
     # candidate kernel configs: fams-tuple -> (samples, paired off samples,
-    # closure). Each must beat its own wave's interleaved off baseline by
-    # more than the observed spread to be keepable; pooled medians rank the
-    # keepable ones. A failed build/measurement (0.0 samples) can never win.
+    # closure-or-None). Each must beat its own wave's interleaved off
+    # baseline by more than the observed spread to be keepable; keepable
+    # candidates are RANKED by paired ratio against their own wave's off
+    # (never by absolute sps across waves — different waves see different
+    # tunnel weather). Losing closures are freed per wave and only the
+    # best-so-far keepable closure is carried, so peak device memory stays
+    # bounded at ~4 full states (off + 2 wave challengers + 1 carried).
     candidates: dict[tuple, tuple] = {}
-    off_sps, off_samples = 0.0, []
+    all_off_samples: list = []
     observed: list[float] = []  # every valid pooled measurement (fallback)
+    best_keep: tuple | None = None  # (fams, ratio) of the carried closure
     for wave in waves:
         closures = {
             cfg: build_duty(cfg if cfg != "all" else "all")
             for cfg in wave
         }
         phase = interleave({"off": off_closure, **closures})
-        off_samples = phase["off"]
-        off_sps = max(off_sps, _pooled(off_samples))
+        all_off_samples.extend(phase["off"])
+        observed.append(_pooled(phase["off"]))
         for cfg in wave:
             fams = all_fams if cfg == "all" else (cfg,)
-            candidates[fams] = (phase[cfg], phase["off"], closures[cfg])
-            observed.append(_pooled(phase[cfg]))
-        observed.append(_pooled(phase["off"]))
-        # free this wave's losers-to-be after the keep-decision below; for
-        # now only drop refs not needed again (final selection keeps the
-        # winning closure via candidates)
+            samp, base, closure = phase[cfg], phase["off"], closures[cfg]
+            observed.append(_pooled(samp))
+            if _beats(samp, base):
+                ratio = _paired_ratio(samp, base)
+                if best_keep is None or ratio > best_keep[1]:
+                    if best_keep is not None:
+                        # drop the previously carried closure
+                        prev = candidates[best_keep[0]]
+                        candidates[best_keep[0]] = (prev[0], prev[1], None)
+                    best_keep = (fams, ratio)
+                else:
+                    closure = None
+            else:
+                closure = None
+            candidates[fams] = (samp, base, closure)
+        del closures
+    off_sps = _pooled(all_off_samples)
     on_sps = _pooled(candidates[all_fams][0])
     fam_sps = {
         f: _pooled(candidates[(f,)][0])
@@ -849,17 +879,26 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     if len(solo_winners) >= 2 and solo_winners not in candidates:
         joint = build_duty(solo_winners)
         phase_b = interleave({"off": off_closure, "joint": joint})
-        candidates[solo_winners] = (phase_b["joint"], phase_b["off"], joint)
+        all_off_samples.extend(phase_b["off"])
+        off_sps = _pooled(all_off_samples)
         observed.append(_pooled(phase_b["joint"]))
         observed.append(_pooled(phase_b["off"]))
+        samp, base = phase_b["joint"], phase_b["off"]
+        if _beats(samp, base):
+            ratio = _paired_ratio(samp, base)
+            if best_keep is None or ratio > best_keep[1]:
+                if best_keep is not None:
+                    prev = candidates[best_keep[0]]
+                    candidates[best_keep[0]] = (prev[0], prev[1], None)
+                best_keep = (solo_winners, ratio)
+                candidates[solo_winners] = (samp, base, joint)
+            else:
+                candidates[solo_winners] = (samp, base, None)
+        else:
+            candidates[solo_winners] = (samp, base, None)
 
-    keepable = {
-        fams: _pooled(samp)
-        for fams, (samp, base, _c) in candidates.items()
-        if _beats(samp, base)
-    }
-    kernels_win = bool(keepable)
-    best_fams = max(keepable, key=keepable.get) if kernels_win else ()
+    kernels_win = best_keep is not None
+    best_fams = best_keep[0] if kernels_win else ()
     if kernels_win and pk._backend_is_tpu():
         _set_kernel_families({f: True for f in best_fams})
         pk.set_pallas(True, interpret=False)
@@ -869,13 +908,11 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     if kernels_win:
         duty_samples, _, winner_closure = candidates[best_fams]
     else:
-        duty_samples, winner_closure = off_samples, off_closure
-    # free the losing closures (each holds a full model+opt state on device)
-    for fams, (_s, _b, c) in list(candidates.items()):
-        if c is not winner_closure and c is not off_closure:
-            candidates[fams] = (_s, _b, None)
+        # the all-off config IS the kept config: report it from the pooled
+        # cross-wave off samples so the headline and pallas_off_sps agree
+        duty_samples, winner_closure = all_off_samples, off_closure
     if winner_closure is not off_closure:
-        del off_closure
+        del off_closure  # free the baseline state once a kernel config won
 
     # ---- phase C: precision (bf16 vs f32) on the winning kernel config ------
     # Skipped in --tiny (reported as null, NOT the 0.0 failure sentinel): it
@@ -934,8 +971,11 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
                 rung_samples[u] = (phase_d2[u], phase_d2["u1"])
             base_samples = phase_d2["u1"]
         observed.extend(unroll_sps.values())
+        # rank winning rungs by paired ratio against their OWN phase's u1
+        # baseline (d1 and d2 are different sessions; absolute pooled sps
+        # across them would re-import cross-session weather bias)
         rung_winners = {
-            u: unroll_sps[u]
+            u: _paired_ratio(samp, base)
             for u, (samp, base) in rung_samples.items()
             if _beats(samp, base)
         }

@@ -1,0 +1,52 @@
+"""XProf trace of the DV3 duty cycle on chip (VERDICT r3 next-round #2).
+
+Runs a handful of flagship-scale duty cycles under `jax.profiler.trace` so
+the trace names the next bottleneck slice (GRU scan vs conv vs host gaps)
+— the evidence the duty-vs-e2e gap analysis needs beyond end-to-end
+timings. Writes to logs/xprof_r4/ (open with xprof/tensorboard).
+
+Usage: python tools/chip_xprof_trace.py [--tiny] [--outdir logs/xprof_r4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--outdir", default="logs/xprof_r4")
+    ap.add_argument("--cycles", type=int, default=4)
+    ns = ap.parse_args()
+
+    import jax
+
+    import bench
+
+    args, state, opts, actions_dim, is_continuous, _ = bench._dv3_setup(ns.tiny)
+    run_cycles = bench._dv3_duty_closure(
+        args, state, opts, actions_dim, is_continuous
+    )
+    # one untraced segment first: compile + cache warm so the trace holds
+    # steady-state cycles, not compilation
+    run_cycles(1)
+    outdir = str(Path(ns.outdir))
+    t0 = time.perf_counter()
+    with jax.profiler.trace(outdir):
+        dt = run_cycles(ns.cycles)
+    sps = ns.cycles * args.train_every * args.num_envs / dt
+    print(
+        f"traced {ns.cycles} duty cycles in {dt:.2f}s "
+        f"({sps:.1f} env-steps/sec) -> {outdir} "
+        f"(wall incl. trace overhead {time.perf_counter() - t0:.2f}s)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -58,10 +58,11 @@ from sheeprl_tpu.utils.registry import tasks
 # rollouts never terminate, and CartPole's ONLY learning signal is
 # termination. Attempt 2 (CartPole, continues on, 6144 steps) collapsed
 # below random (9.8): DV1's actor trains by PURE dynamics backprop of
-# imagined values — no reinforce term, no entropy bonus (reference
-# dreamer_v1/agent.py:485-498 builds a tanh_normal actor unconditionally;
-# discrete CartPole is outside the reference DV1's own design envelope) —
-# and the straight-through discrete policy saturated into always-left.
+# imagined values — no reinforce term, no entropy bonus (the reference
+# DV1 loss has neither; DV2 added both) — and the straight-through discrete
+# policy saturated into always-left. The reference does support discrete
+# DV1 (OneHotCategoricalStraightThrough via the shared Actor); whether its
+# torch implementation also collapses on tiny-CartPole is unverified here.
 # Attempt 3 moves to DV1's native regime: continuous control with dense
 # rewards (Pendulum swing-up, the SAC/DroQ receipt env), tanh_normal actor
 # + additive Gaussian exploration noise, no continue head (no termination).

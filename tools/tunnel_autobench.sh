@@ -1,0 +1,44 @@
+#!/bin/bash
+# Tunnel watchdog + auto-bench: probe every 5 min; on a healthy probe, run
+# the full chip bench (interleaved ABAB keep-decisions) and an xprof
+# duty-cycle trace, so a short tunnel window still lands the round-4
+# receipts. The receipt only counts as landed when the bench exits 0 AND
+# the artifact carries a real number (value > 0) — a tunnel that dies
+# mid-bench leaves no file, so the next healthy window retries.
+# Concurrent CPU learning runs are recorded in the log (they can skew the
+# host-side e2e slice; duty-cycle phases are device-bound).
+cd /root/repo
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  if timeout 45 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
+    echo "$ts TUNNEL_UP" >> logs/tunnel_watch.log
+    if [ ! -f logs/bench_r4_chip.json ]; then
+      echo "$ts autobench: starting (python procs: $(ps -e -o comm= | grep -c python))" >> logs/tunnel_watch.log
+      SHEEPRL_TPU_BENCH_WATCHDOG_S=3000 timeout 3100 python bench.py \
+        > logs/bench_r4_chip.tmp 2> logs/bench_r4_chip.err
+      rc=$?
+      if [ $rc -eq 0 ] && python - <<'PY'
+import json, sys
+try:
+    with open("logs/bench_r4_chip.tmp") as fh:
+        line = [l for l in fh if l.strip().startswith("{")][-1]
+    sys.exit(0 if json.loads(line).get("value", 0) > 0 else 1)
+except Exception:
+    sys.exit(1)
+PY
+      then
+        mv logs/bench_r4_chip.tmp logs/bench_r4_chip.json
+        echo "$ts autobench: LANDED $(tail -c 200 logs/bench_r4_chip.json)" >> logs/tunnel_watch.log
+      else
+        echo "$ts autobench: FAILED rc=$rc (kept .tmp for forensics, will retry)" >> logs/tunnel_watch.log
+      fi
+    fi
+    if [ -f logs/bench_r4_chip.json ] && [ ! -d logs/xprof_r4 ]; then
+      timeout 900 python tools/chip_xprof_trace.py >> logs/tunnel_watch.log 2>&1
+      echo "$ts xprof: rc=$?" >> logs/tunnel_watch.log
+    fi
+  else
+    echo "$ts down" >> logs/tunnel_watch.log
+  fi
+  sleep 300
+done

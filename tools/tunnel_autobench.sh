@@ -37,6 +37,19 @@ PY
       timeout 900 python tools/chip_xprof_trace.py >> logs/tunnel_watch.log 2>&1
       echo "$ts xprof: rc=$?" >> logs/tunnel_watch.log
     fi
+    # round-3 closing state named these two receipts PENDING the first
+    # healthy tunnel (BENCHES.md): phase attribution V0..V4 and the blob
+    # ON/OFF ABAB — run each once after the bench lands
+    if [ -f logs/bench_r4_chip.json ] && [ ! -f logs/phase_probe_r4.json ]; then
+      timeout 2400 python tools/phase_probe.py > logs/phase_probe_r4.tmp 2>> logs/tunnel_watch.log \
+        && mv logs/phase_probe_r4.tmp logs/phase_probe_r4.json
+      echo "$ts phase_probe: rc=$?" >> logs/tunnel_watch.log
+    fi
+    if [ -f logs/bench_r4_chip.json ] && [ ! -f logs/blob_ab_r4.json ]; then
+      timeout 2400 python tools/blob_ab_probe.py > logs/blob_ab_r4.tmp 2>> logs/tunnel_watch.log \
+        && mv logs/blob_ab_r4.tmp logs/blob_ab_r4.json
+      echo "$ts blob_ab: rc=$?" >> logs/tunnel_watch.log
+    fi
   else
     echo "$ts down" >> logs/tunnel_watch.log
   fi

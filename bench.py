@@ -470,7 +470,7 @@ def _fair_n_train(batch_size: int) -> int:
     )
 
 
-def _dv3_e2e_decoupled_closure(args, state, opts, actions_dim, is_continuous):
+def _dv3_e2e_decoupled_closure(args, state, opts, actions_dim, is_continuous, n_train=None):
     """The honest e2e loop in the DECOUPLED topology (player device runs
     PlayerDV3 + the replay ring; the trainer mesh runs the update on the
     shipped [n_samples, T, B] block; refreshed encoder/RSSM/actor weights
@@ -498,7 +498,9 @@ def _dv3_e2e_decoupled_closure(args, state, opts, actions_dim, is_continuous):
     # machinery (block ship, weight return) costs for its extra player
     # device; an indivisible batch would wrap-pad in to_trainers and charge
     # the decoupled side phantom FLOPs
-    meshes = make_decoupled_meshes(_fair_n_train(B) + 1)
+    if n_train is None:
+        n_train = _fair_n_train(B)
+    meshes = make_decoupled_meshes(n_train + 1)
     train_step = make_train_step(
         args, world_opt, actor_opt, critic_opt, ["rgb"], [], actions_dim,
         is_continuous, mesh=meshes.trainer_mesh,
@@ -624,7 +626,7 @@ def bench_dreamer_v3_decoupled(tiny: bool = False) -> None:
                 ),
                 "decoupled": _build_closure_guarded(
                     _dv3_e2e_decoupled_closure, args, state, opts, actions_dim,
-                    is_continuous,
+                    is_continuous, n_train,
                 ),
             },
             args.train_every * args.num_envs,

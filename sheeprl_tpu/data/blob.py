@@ -152,15 +152,29 @@ class StepBlobCodec:
         w4 = blob[: self._n4]
         for k, shape, off, size in self._f32:
             v = np.asarray(f32_values[k])
-            if v.dtype.kind not in "f":
-                # integer values above 2**24 would silently lose precision
-                # in the float32 value-conversion below (ADVICE r3) — unlike
-                # the bit-exact packed-add path; make the caller choose a
-                # representation instead of corrupting quietly
+            if v.dtype.kind not in "fiub":
+                # non-numeric / complex inputs never convert meaningfully
+                # (complex would silently drop its imaginary part)
                 raise TypeError(
-                    f"blob f32 section got non-float dtype {v.dtype} for "
-                    f"key {k!r}; convert integer observations explicitly "
-                    "(or keep them uint8 to ride the bit-exact u8 section)"
+                    f"blob f32 section got dtype {v.dtype} for key {k!r}; "
+                    "only float/int/uint/bool values are packable"
+                )
+            if v.dtype.kind in "iu" and v.size and int(v.ravel().max()) > 2**24:
+                # the first integer that does NOT survive the float32
+                # value-conversion is 2**24 + 1 (ADVICE r3) — unlike the
+                # bit-exact packed-add path; small integer obs (e.g.
+                # MineDojo's int32 equipment ids) convert exactly and pass
+                raise TypeError(
+                    f"blob f32 section got integer dtype {v.dtype} for key "
+                    f"{k!r} with values > 2**24 that do not survive the "
+                    "float32 conversion; convert explicitly (or keep them "
+                    "uint8 to ride the bit-exact u8 section)"
+                )
+            if v.dtype.kind == "i" and v.size and int(v.ravel().min()) < -(2**24):
+                raise TypeError(
+                    f"blob f32 section got integer dtype {v.dtype} for key "
+                    f"{k!r} with values < -(2**24) that do not survive the "
+                    "float32 conversion; convert explicitly"
                 )
             v = np.ascontiguousarray(v, np.float32).reshape(-1)
             w4[off : off + size] = v.view(np.int32)

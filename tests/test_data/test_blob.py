@@ -188,9 +188,12 @@ def test_reserve_commit_is_deferred_to_add_direct():
         )
 
 
-def test_blob_f32_section_rejects_integer_inputs():
-    """ADVICE r3: integer values above 2**24 would silently lose precision
-    in the f32 value-conversion — the codec must refuse instead."""
+def test_blob_f32_section_rejects_unrepresentable_integers():
+    """ADVICE r3: integer values at/above 2**24 would silently lose
+    precision in the f32 value-conversion — the codec must refuse those,
+    while SMALL integer observations (MineDojo's int32 equipment ids) keep
+    converting exactly (the r4 suite caught an over-strict dtype-kind guard
+    breaking the MineDojo e2e path)."""
     from sheeprl_tpu.data.blob import StepBlobCodec
 
     obs = {"state": np.zeros((2, 3), np.float32)}
@@ -204,10 +207,36 @@ def test_blob_f32_section_rejects_integer_inputs():
         np.zeros(4, np.int32),
     )
     assert good.dtype == np.int32
-    with pytest.raises(TypeError, match="non-float"):
+    # small ints convert exactly -> allowed (MineDojo equipment path);
+    # +/-2**24 are the LAST exactly-representable magnitudes -> allowed
+    for ok_val in (361, 2**24, -(2**24)):
+        codec.pack(
+            {},
+            {"state": np.full((2, 3), ok_val, np.int32),
+             "rewards": np.zeros((2, 1), np.float64)},
+            np.zeros(4, np.int32),
+        )
+    with pytest.raises(TypeError, match="> 2\\*\\*24"):
         codec.pack(
             {},
             {"state": np.full((2, 3), 2**24 + 1, np.int32),
+             "rewards": np.zeros((2, 1), np.float64)},
+            np.zeros(4, np.int32),
+        )
+    # all-negative arrays must be caught by the dedicated min check
+    with pytest.raises(TypeError, match="< -\\(2\\*\\*24\\)"):
+        codec.pack(
+            {},
+            {"state": np.full((2, 3), -(2**24) - 1, np.int32),
+             "rewards": np.zeros((2, 1), np.float64)},
+            np.zeros(4, np.int32),
+        )
+    # complex silently dropping its imaginary part is the corruption class
+    # the guard exists for
+    with pytest.raises(TypeError, match="only float/int"):
+        codec.pack(
+            {},
+            {"state": np.zeros((2, 3), np.complex64),
              "rewards": np.zeros((2, 1), np.float64)},
             np.zeros(4, np.int32),
         )

@@ -88,3 +88,19 @@ def test_interleave_sps_round_robin_and_guards():
     assert samples["dead"] == [0.0, 0.0, 0.0]
     assert all(abs(s - 100.0) < 1e-6 for s in samples["a"])
     assert all(abs(s - 50.0) < 1e-6 for s in samples["b"])
+
+
+def test_paired_ratio_ranking_key():
+    """Candidates from different interleaved sessions rank by advantage
+    over their OWN session's baseline — never by absolute sps."""
+    import bench
+
+    # 20% advantage in a slow-weather session
+    assert abs(bench._paired_ratio([120.0, 118.0], [100.0, 100.0]) - 1.19) < 0.02
+    # bigger advantage in an even slower session still ranks higher
+    fast = bench._paired_ratio([120.0, 120.0], [100.0, 100.0])
+    slow = bench._paired_ratio([90.0, 90.0], [70.0, 70.0])
+    assert slow > fast
+    # dead segments excluded; fewer than 2 valid pairs -> 0.0 sentinel
+    assert bench._paired_ratio([0.0, 110.0], [100.0, 100.0]) == 0.0
+    assert bench._paired_ratio([0.0] * 4, [100.0] * 4) == 0.0

@@ -66,10 +66,19 @@ from sheeprl_tpu.utils.registry import tasks
 # Attempt 3 moves to DV1's native regime: continuous control with dense
 # rewards (Pendulum swing-up, the SAC/DroQ receipt env), tanh_normal actor
 # + additive Gaussian exploration noise, no continue head (no termination).
+# Attempt 3 (Pendulum, reference lrs, expl 0.3 constant, 12288 then resumed
+# to 28672 steps) plateaued at greedy -1066..-1213 across every checkpoint
+# vs measured same-protocol random -1287 — within noise, not a receipt.
+# Diagnosis: DV1's reference actor/critic lr (8e-5) is calibrated for its
+# 100-updates-per-1000-steps x 5M-step regime (~500k updates); our receipt
+# budget delivers ~3.5k updates, so the actor barely moves. Attempt 4
+# keeps the reference ALGORITHM and scales the receipt recipe: 4x
+# actor/critic lr and exploration decay (0.3 -> 0.05) so late collection
+# exploits what the world model knows.
 RECIPE = dict(
     env_id="Pendulum-v1",
     seed=5,
-    total_steps=28672,  # extended once: 12288 was still improving (rew_avg -1458->-1022)
+    total_steps=12288,
     learning_starts=1024,
     train_every=4,
     gradient_steps=1,  # DV1 default is 100 (train_every=1000 regime)
@@ -86,6 +95,11 @@ RECIPE = dict(
     checkpoint_every=2048,
     use_continues=False,
     expl_amount=0.3,
+    expl_decay=True,
+    expl_min=0.05,
+    max_step_expl_decay=2000,
+    actor_lr=3e-4,
+    critic_lr=3e-4,
 )
 
 

@@ -23,7 +23,14 @@ class TensorBoardLogger:
         self.log_dir = log_dir
         self._writer = None
         if enabled:
-            from torch.utils.tensorboard import SummaryWriter
+            # tensorboardX, NOT torch.utils.tensorboard: with tensorflow
+            # present, torch's writer makes the `tensorboard` package load
+            # libtensorflow_framework, whose GL deps segfault dm_control's
+            # EGL context creation afterwards (r4 pixel-receipt debugging:
+            # create_logger-then-DMC-render crashed in MjrContext / TF
+            # framework; tensorboardX writes identical event files with no
+            # TF import)
+            from tensorboardX import SummaryWriter
 
             os.makedirs(log_dir, exist_ok=True)
             self._writer = SummaryWriter(log_dir)

@@ -196,7 +196,7 @@ def _evaluate(root: Path) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--root", default="logs/dv1_learn_r4b")
+    ap.add_argument("--root", default="logs/dv1_learn_r4d")
     ap.add_argument("--eval-only", action="store_true")
     ns = ap.parse_args()
     root = Path(ns.root)

@@ -53,7 +53,7 @@ from sheeprl_tpu.utils.registry import tasks
 RECIPE = dict(
     env_id="dmc_cartpole_balance",
     seed=5,
-    total_steps=8192,
+    total_steps=20480,  # extended once at 8192 (world model converged, policy flat at random; extension also halves train_every via the checkpoint sidecar)
     learning_starts=1024,
     train_every=8,
     per_rank_batch_size=8,

@@ -480,3 +480,105 @@ def test_dreamer_v3_decoupled_learns_cartpole(tmp_path):
     env.close()
     mean_return = float(np.mean(returns))
     assert mean_return >= 120.0, f"decoupled DV3 failed to learn: {returns}"
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(7200)
+def test_dreamer_v1_improves_pendulum(tmp_path):
+    """DreamerV1 learning receipt (VERDICT r3 #3), in DV1's native regime:
+    continuous control with dense rewards (its tanh_normal actor trains by
+    pure dynamics backprop — no reinforce term, no entropy bonus — which
+    collapses on discrete tiny-CartPole; see BENCHES.md round-4 DV1
+    investigation). At receipt scale the policy plateaus around -950: a
+    clear, reproducible improvement over the measured same-protocol random
+    baseline (-1287 mean, episodes -865..-1713) without reaching the
+    SAC/DroQ receipts' -300 (the reference's own DV1 regime is 5M steps /
+    ~500k updates; this budget delivers ~2.8k). Validated runs: greedy
+    mean -934.5 at 12288 steps, -982.4 at 24576 (logs/dv1_learn_r4d.json).
+    Threshold -1100: both validated runs clear it by >100, a random-policy
+    10-episode mean needs a >2-sigma fluke to reach it."""
+    from sheeprl_tpu.algos.dreamer_v1.agent import PlayerDV1, build_models
+    from sheeprl_tpu.algos.dreamer_v1.args import DreamerV1Args
+    from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import make_optimizers
+
+    tasks["dreamer_v1"]([
+        "--env_id", "Pendulum-v1",
+        "--seed", "5",
+        "--num_devices", "1",
+        "--num_envs", "1",
+        "--sync_env",
+        "--total_steps", "12288",
+        "--learning_starts", "1024",
+        "--train_every", "4",
+        "--gradient_steps", "1",
+        "--per_rank_batch_size", "16",
+        "--per_rank_sequence_length", "32",
+        "--buffer_size", "100000",
+        "--dense_units", "200",
+        "--hidden_size", "200",
+        "--recurrent_state_size", "200",
+        "--stochastic_size", "30",
+        "--mlp_layers", "2",
+        "--horizon", "15",
+        "--action_repeat", "1",
+        "--checkpoint_every", "4096",
+        "--no_use_continues",
+        "--expl_amount", "0.3",
+        "--expl_decay",
+        "--expl_min", "0.05",
+        "--max_step_expl_decay", "2000",
+        "--actor_lr", "3e-4",
+        "--critic_lr", "3e-4",
+        "--root_dir", str(tmp_path),
+        "--run_name", "learn",
+        "--mlp_keys", "state",
+    ])
+    ckpt = latest_checkpoint(str(tmp_path / "learn" / "checkpoints"))
+    assert ckpt is not None
+
+    env = gym.make("Pendulum-v1")
+    args = DreamerV1Args(env_id="Pendulum-v1", seed=5)
+    args.cnn_keys, args.mlp_keys = [], ["state"]
+    args.dense_units = args.hidden_size = args.recurrent_state_size = 200
+    args.stochastic_size = 30
+    args.mlp_layers, args.horizon, args.action_repeat = 2, 15, 1
+    args.use_continues = False
+    wm, actor, critic = build_models(
+        jax.random.PRNGKey(0), [1], True, args,
+        {"state": env.observation_space}, [], ["state"],
+    )
+    wopt, aopt, copt = make_optimizers(args)
+    restored = load_checkpoint(ckpt, {
+        "world_model": wm, "actor": actor, "critic": critic,
+        "world_optimizer": wopt.init(wm), "actor_optimizer": aopt.init(actor),
+        "critic_optimizer": copt.init(critic),
+        "expl_decay_steps": 0, "global_step": 0, "batch_size": 0,
+    })
+    player = PlayerDV1(
+        encoder=restored["world_model"].encoder,
+        rssm=restored["world_model"].rssm,
+        actor=restored["actor"],
+        actions_dim=(1,),
+        stochastic_size=30, recurrent_state_size=200,
+        is_continuous=True,
+    )
+    step = jax.jit(
+        lambda p, s, o, k: p.step(s, o, k, jnp.float32(0.0), is_training=False)
+    )
+    returns = []
+    for episode in range(10):
+        obs, _ = env.reset(seed=1000 + episode)
+        state = player.init_states(1)
+        key = jax.random.PRNGKey(episode)
+        done, ep_return = False, 0.0
+        while not done:
+            dobs = {"state": jnp.asarray(obs, jnp.float32)[None]}
+            key, sub = jax.random.split(key)
+            state, actions = step(player, state, dobs, sub)
+            obs, reward, terminated, truncated, _ = env.step(np.asarray(actions)[0])
+            ep_return += float(reward)
+            done = terminated or truncated
+        returns.append(ep_return)
+    env.close()
+    mean_return = float(np.mean(returns))
+    assert mean_return >= -1100.0, f"DV1 failed to improve on Pendulum: {returns}"

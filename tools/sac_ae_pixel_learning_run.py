@@ -41,13 +41,18 @@ from sheeprl_tpu.utils.registry import tasks
 RECIPE = dict(
     env_id="dmc_cartpole_swingup",
     seed=5,
-    total_steps=16384,
+    total_steps=8192,  # cut from 16384: collection already hit ~100 by episode 3, and the 1-core box can't fit the full budget in-session
     learning_starts=1000,
-    per_rank_batch_size=64,
+    # batch 32 / 128-unit heads, NOT 64/256: the single-jit SAC-AE pixel
+    # update (5 optimizers + conv enc/dec fwd+bwd) triggers an XLA:CPU
+    # compile blowup at the larger sizes (>25 min observed; fine on TPU,
+    # where this jit compiles in tens of seconds) — the receipt must fit
+    # the 1-core box's session budget
+    per_rank_batch_size=32,
     buffer_size=100000,
-    actor_hidden_size=256,
-    critic_hidden_size=256,
-    dense_units=256,
+    actor_hidden_size=128,
+    critic_hidden_size=128,
+    dense_units=128,
     action_repeat=4,  # the reference's DMC SAC-AE convention
 )
 
@@ -60,7 +65,7 @@ def _train(root: Path) -> None:
         "--root_dir", str(root),
         "--run_name", "learn",
         "--cnn_keys", "rgb",
-        "--checkpoint_every", "4096",
+        "--checkpoint_every", "1024",
     ]
     for k, v in RECIPE.items():
         if isinstance(v, bool):

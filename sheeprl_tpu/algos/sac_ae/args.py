@@ -37,6 +37,12 @@ class SACAEArgs(SACArgs):
     actor_hidden_size: int = Arg(default=1024, help="actor MLP hidden width")
     critic_hidden_size: int = Arg(default=1024, help="critic MLP hidden width")
     cnn_channels_multiplier: int = Arg(default=16, help="conv width multiplier (> 0)")
+    split_update: bool = Arg(
+        default=False,
+        help="compile the update as four per-model jits instead of one fused "
+        "jit (workaround for a pathological XLA:CPU compile at pixel sizes; "
+        "keep the fused default on TPU)",
+    )
     dense_units: int = Arg(default=64, help="units per dense layer (mlp encoder/decoder)")
     mlp_layers: int = Arg(default=2, help="MLP depth for encoder/decoder")
     dense_act: str = Arg(default="relu", help="dense activation name")

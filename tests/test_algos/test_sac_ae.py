@@ -56,3 +56,105 @@ def test_sac_ae_resume(tmp_path):
 def test_sac_ae_rejects_minedojo():
     with pytest.raises(ValueError, match="MineDojo"):
         tasks["sac_ae"](["--env_id", "minedojo_open-ended", "--dry_run"])
+
+
+@pytest.mark.timeout(300)
+def test_sac_ae_split_update_dry_run(tmp_path):
+    tasks["sac_ae"](tiny_argv(tmp_path, "split", extra=("--split_update",)))
+    ckpt = str(tmp_path / "split" / "checkpoints" / "ckpt_1")
+    assert set(load_checkpoint(ckpt).keys()) == CKPT_KEYS
+
+
+@pytest.mark.timeout(600)
+def test_split_update_matches_fused():
+    """--split_update must be a pure compilation-strategy change: with every
+    phase enabled, one split train call produces the same state and losses as
+    the fused jit (same update order, same per-step key derivation)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.sac_ae.agent import (
+        SACAEAgent,
+        SACAECNNDecoder,
+        SACAECNNEncoder,
+        SACAEDecoder,
+        SACAEEncoder,
+    )
+    from sheeprl_tpu.algos.sac_ae.args import SACAEArgs
+    from sheeprl_tpu.algos.sac_ae.sac_ae import (
+        TrainState,
+        make_optimizers,
+        make_split_train_step,
+        make_train_step,
+    )
+
+    args = SACAEArgs(
+        features_dim=8, cnn_channels_multiplier=1,
+        actor_hidden_size=16, critic_hidden_size=16,
+    )
+    act_dim = 2
+    key = jax.random.PRNGKey(3)
+    k_cnn, k_agent, k_dec, k_data, k_train = jax.random.split(key, 5)
+    cnn_encoder = SACAECNNEncoder.init(
+        k_cnn, 3, args.features_dim, ("rgb",),
+        screen_size=64, cnn_channels_multiplier=args.cnn_channels_multiplier,
+    )
+    encoder = SACAEEncoder(cnn_encoder=cnn_encoder, mlp_encoder=None)
+    cnn_decoder = SACAECNNDecoder.init(
+        k_dec, cnn_encoder.conv_output_shape, encoder.output_dim, ("rgb",), [3],
+        cnn_channels_multiplier=args.cnn_channels_multiplier,
+    )
+    decoder = SACAEDecoder(cnn_decoder=cnn_decoder, mlp_decoder=None)
+    agent = SACAEAgent.init(
+        k_agent, encoder, act_dim,
+        num_critics=args.num_critics,
+        actor_hidden_size=args.actor_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        action_low=np.full(act_dim, -1.0), action_high=np.full(act_dim, 1.0),
+        alpha=args.alpha, tau=args.tau, encoder_tau=args.encoder_tau,
+    )
+    optimizers = make_optimizers(args)
+    qf_optim, actor_optim, alpha_optim, encoder_optim, decoder_optim = optimizers
+
+    def fresh_state():
+        return jax.tree_util.tree_map(
+            jnp.array,
+            TrainState(
+                agent=agent, decoder=decoder,
+                qf_opt=qf_optim.init(agent.critic),
+                actor_opt=actor_optim.init(agent.actor),
+                alpha_opt=alpha_optim.init(agent.log_alpha),
+                encoder_opt=encoder_optim.init(agent.critic.encoder),
+                decoder_opt=decoder_optim.init(decoder),
+            ),
+        )
+
+    g, b = 2, 3
+    ks = jax.random.split(k_data, 5)
+    data = {
+        "rgb": jax.random.randint(ks[0], (g, b, 64, 64, 3), 0, 256, jnp.uint8),
+        "next_rgb": jax.random.randint(ks[1], (g, b, 64, 64, 3), 0, 256, jnp.uint8),
+        "actions": jax.random.uniform(ks[2], (g, b, act_dim), jnp.float32, -1, 1),
+        "rewards": jax.random.normal(ks[3], (g, b, 1), jnp.float32),
+        "dones": (jax.random.uniform(ks[4], (g, b, 1)) < 0.2).astype(jnp.float32),
+    }
+    fused = make_train_step(args, optimizers, ("rgb",), ())
+    split = make_split_train_step(args, optimizers, ("rgb",), ())
+    t = jnp.asarray(True)
+    s_fused, m_fused = fused(fresh_state(), data, k_train, t, t, t)
+    s_split, m_split = split(fresh_state(), data, k_train, t, t, t)
+
+    flat_f, _ = jax.tree_util.tree_flatten(s_fused)
+    flat_s, _ = jax.tree_util.tree_flatten(s_split)
+    assert len(flat_f) == len(flat_s)
+    for a, c in zip(flat_f, flat_s):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(c, np.float32),
+            rtol=2e-4, atol=2e-5,
+        )
+    assert set(m_fused) == set(m_split)
+    for name in m_fused:
+        np.testing.assert_allclose(
+            float(m_fused[name]), float(m_split[name]), rtol=2e-4, atol=2e-5
+        )

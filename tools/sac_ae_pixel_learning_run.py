@@ -54,6 +54,9 @@ RECIPE = dict(
     critic_hidden_size=128,
     dense_units=128,
     action_repeat=4,  # the reference's DMC SAC-AE convention
+    # the round-4 fix for the XLA:CPU compile pathology: four per-model jits
+    # instead of the fused update (parity unit-tested vs the fused path)
+    split_update=True,
 )
 
 
@@ -100,7 +103,8 @@ def _evaluate(root: Path, episodes: int = 10) -> dict:
     events = glob.glob(os.path.join(eval_root, "**", "events.*"), recursive=True)
     assert events, f"no TB events under {eval_root}"
     returns: list[float] = []
-    for f in events:
+    # newest first: a resumed run's re-evaluation must not pick a stale file
+    for f in sorted(events, key=os.path.getmtime, reverse=True):
         ea = EventAccumulator(f)
         ea.Reload()
         if "Test/episode_reward" in ea.Tags()["scalars"]:

@@ -215,6 +215,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
 
     start_step = 1
+    restored_buffer = False
     if args.checkpoint_path:
         ckpt = load_checkpoint(
             args.checkpoint_path,
@@ -232,6 +233,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         rb_state_path = args.checkpoint_path + ".buffer.npz"
         if args.checkpoint_buffer and os.path.exists(rb_state_path) and not args.eval_only:
             rb.load(rb_state_path)
+            restored_buffer = True
     state = replicate(state, mesh)
 
     aggregator = MetricAggregator()
@@ -241,6 +243,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     learning_starts = (
         args.learning_starts // args.num_envs if not args.dry_run else 0
     )
+    if args.checkpoint_path and not restored_buffer and not args.dry_run:
+        # bufferless resume: re-collect before updating (same guard as
+        # dreamer_v3) so batch updates don't sample a near-empty ring on
+        # top of the trained weights
+        learning_starts += start_step
 
     obs, _ = envs.reset(seed=args.seed)
     obs = np.asarray(obs, dtype=np.float32)

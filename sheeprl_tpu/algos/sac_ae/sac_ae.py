@@ -117,10 +117,44 @@ def _make_normalize(cnn_keys, mlp_keys):
     return normalize
 
 
+def _make_loss_fns(args: SACAEArgs, cnn_keys, mlp_keys):
+    """Loss closures shared by the fused and split train-step factories —
+    the two compilation strategies must stay mathematically identical
+    (tests/test_algos/test_sac_ae.py::test_split_update_matches_fused), so
+    the loss bodies exist exactly once."""
+    obs_keys = (*cnn_keys, *mlp_keys)
+
+    def actor_loss_fn(actor, agent, obs, key):
+        actions, logprobs = actor(agent.critic.encoder, obs, key, detach=True)
+        q = agent.critic(obs, actions, detach_encoder=True)
+        min_q = jnp.min(q, axis=-1, keepdims=True)
+        return (
+            policy_loss(jax.lax.stop_gradient(agent.alpha), logprobs, min_q),
+            logprobs,
+        )
+
+    def recon_loss_fn(enc_dec, batch, obs, key):
+        enc, dec = enc_dec
+        hidden = enc(obs)
+        recon = dec(hidden)
+        l2 = jnp.mean(0.5 * jnp.sum(jnp.square(hidden), axis=-1))
+        loss = 0.0
+        for k in obs_keys:
+            if k in cnn_keys:
+                target = preprocess_obs(batch[k], key, bits=5)
+            else:
+                target = batch[k].astype(jnp.float32)
+            loss += jnp.mean(jnp.square(target - recon[k]))
+            loss += args.decoder_l2_lambda * l2
+        return loss
+
+    return actor_loss_fn, recon_loss_fn
+
+
 def make_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
     qf_optim, actor_optim, alpha_optim, encoder_optim, decoder_optim = optimizers
-    obs_keys = (*cnn_keys, *mlp_keys)
     normalize = _make_normalize(cnn_keys, mlp_keys)
+    actor_loss_fn, recon_loss_fn = _make_loss_fns(args, cnn_keys, mlp_keys)
 
     def gradient_step(carry, inp):
         state, do_ema, do_actor, do_decoder = carry
@@ -148,18 +182,9 @@ def make_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
 
         # ---- actor + temperature, every actor_network_frequency steps
         # (sac_ae.py:95-112); gradients masked out on skipped steps
-        def actor_loss_fn(actor):
-            actions, logprobs = actor(agent.critic.encoder, obs, k_actor, detach=True)
-            q = agent.critic(obs, actions, detach_encoder=True)
-            min_q = jnp.min(q, axis=-1, keepdims=True)
-            return (
-                policy_loss(jax.lax.stop_gradient(agent.alpha), logprobs, min_q),
-                logprobs,
-            )
-
         (actor_l, logprobs), actor_grads = jax.value_and_grad(
             actor_loss_fn, has_aux=True
-        )(agent.actor)
+        )(agent.actor, agent, obs, k_actor)
         actor_updates, actor_opt = actor_optim.update(
             actor_grads, state.actor_opt, agent.actor
         )
@@ -182,23 +207,8 @@ def make_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
 
         # ---- reconstruction update (sac_ae.py:114-130): 5-bit dithered image
         # targets, raw vector targets, L2 latent penalty; trains encoder+decoder
-        def recon_loss_fn(enc_dec):
-            enc, dec = enc_dec
-            hidden = enc(obs)
-            recon = dec(hidden)
-            l2 = jnp.mean(0.5 * jnp.sum(jnp.square(hidden), axis=-1))
-            loss = 0.0
-            for k in obs_keys:
-                if k in cnn_keys:
-                    target = preprocess_obs(batch[k], k_dither, bits=5)
-                else:
-                    target = batch[k].astype(jnp.float32)
-                loss += jnp.mean(jnp.square(target - recon[k]))
-                loss += args.decoder_l2_lambda * l2
-            return loss
-
         recon_l, (enc_grads, dec_grads) = jax.value_and_grad(recon_loss_fn)(
-            (agent.critic.encoder, decoder)
+            (agent.critic.encoder, decoder), batch, obs, k_dither
         )
         enc_updates, encoder_opt = encoder_optim.update(
             enc_grads, state.encoder_opt, agent.critic.encoder
@@ -538,6 +548,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         "decoder_optimizer": state.decoder_opt, "global_step": 0,
     }
     start_step = 1
+    restored_buffer = False
     if args.checkpoint_path:
         ckpt = load_checkpoint(args.checkpoint_path, ckpt_template_keys)
         state = TrainState(
@@ -550,6 +561,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         rb_state_path = args.checkpoint_path + ".buffer.npz"
         if args.checkpoint_buffer and os.path.exists(rb_state_path) and not args.eval_only:
             rb.load(rb_state_path)
+            restored_buffer = True
     state = replicate(state, mesh)
 
     aggregator = MetricAggregator()
@@ -559,6 +571,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     learning_starts = (
         args.learning_starts // args.num_envs if not args.dry_run else 0
     )
+    if args.checkpoint_path and not restored_buffer and not args.dry_run:
+        # bufferless resume: re-collect before updating (same guard as
+        # dreamer_v3) so batch updates don't sample a near-empty ring on
+        # top of the trained weights
+        learning_starts += start_step
 
     obs, _ = envs.reset(seed=args.seed)
     obs = {k: np.asarray(obs[k]) for k in obs_keys}

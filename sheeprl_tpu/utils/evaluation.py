@@ -41,11 +41,25 @@ def validate_eval_args(args: Any) -> None:
 
 
 def apply_eval_overrides(saved: dict[str, Any], args: Any) -> dict[str, Any]:
-    """Merge the eval-time CLI flags into a checkpoint-restored args dict.
-    No-op unless `--eval_only` was passed."""
+    """Merge CLI flags into a checkpoint-restored args dict.
+
+    With ``--eval_only``: the run-targeting flags in ``_EVAL_CLI_FLAGS``
+    override unconditionally, plus anything in ``_EVAL_CLI_IF_PROVIDED``
+    the user explicitly passed.
+
+    On a TRAINING resume (``--checkpoint_path`` without ``--eval_only``):
+    every flag the user explicitly provided on the command line overrides
+    the sidecar, and the sidecar fills everything unspecified. The
+    reference restores its saved args wholesale on resume
+    (/root/reference/sheeprl/algos/dreamer_v3/dreamer_v3.py:334-338), so a
+    resumed run there cannot change ANY knob; honoring explicit CLI flags
+    is a deliberate improvement — the budget-extension path: resuming with
+    ``--total_steps 2N`` trains to the new budget instead of silently
+    exiting at the old one.
+    """
+    provided = getattr(args, "_cli_provided", set())
     if getattr(args, "eval_only", False):
         saved["eval_only"] = True
-        provided = getattr(args, "_cli_provided", set())
         for f in _EVAL_CLI_FLAGS:
             if hasattr(args, f):
                 saved[f] = getattr(args, f)
@@ -58,6 +72,9 @@ def apply_eval_overrides(saved: dict[str, Any], args: Any) -> dict[str, Any]:
             # batch sizes need not divide this host's device count); eval
             # runs on ONE device unless a count is requested explicitly
             saved["num_devices"] = 1
+    else:
+        for f in provided - {"checkpoint_path", "eval_only"}:
+            saved[f] = getattr(args, f)
     return saved
 
 

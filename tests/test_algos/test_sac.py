@@ -57,6 +57,41 @@ def test_sac_resume(tmp_path):
 
 
 @pytest.mark.timeout(300)
+def test_sac_resume_extends_budget(tmp_path):
+    """Training resume honors explicitly-provided CLI flags over the sidecar
+    (the budget-extension path): resuming a finished 8-step run with
+    --total_steps 16 must train to 16, not silently exit at the restored 8.
+    Flags NOT provided on the resume command line still come from the
+    sidecar (run_name below)."""
+    args = [
+        "--env_id", "Pendulum-v1",
+        "--num_envs", "1",
+        "--sync_env",
+        "--total_steps", "8",
+        "--learning_starts", "2",
+        "--per_rank_batch_size", "2",
+        "--buffer_size", "16",
+        "--checkpoint_every", "4",
+        "--checkpoint_buffer",
+        "--actor_hidden_size", "8",
+        "--critic_hidden_size", "8",
+        "--root_dir", str(tmp_path),
+        "--run_name", "ext",
+    ]
+    tasks["sac"](args)
+    ckpt_dir = tmp_path / "ext" / "checkpoints"
+    assert (ckpt_dir / "ckpt_8").exists()
+    tasks["sac"]([
+        "--checkpoint_path", str(ckpt_dir / "ckpt_8"),
+        "--total_steps", "16",
+    ])
+    assert (ckpt_dir / "ckpt_16").exists(), (
+        "resume with --total_steps 16 trained no further steps "
+        "(sidecar budget silently won)"
+    )
+
+
+@pytest.mark.timeout(300)
 def test_sac_rejects_discrete(tmp_path):
     with pytest.raises(ValueError, match="continuous"):
         tasks["sac"](

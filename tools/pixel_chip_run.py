@@ -55,7 +55,12 @@ RECIPES = {
         total_steps=16384,
         learning_starts=1024,
         train_every=2,
-        buffer_size=100000,
+        # ring bounded to the run budget (nothing evicts within it) so the
+        # per-checkpoint buffer snapshot stays ~200 MB, and checkpointed so
+        # a tunnel-death resume keeps its replay data instead of training
+        # on a near-empty ring
+        buffer_size=16384,
+        checkpoint_buffer=True,
         action_repeat=2,
         checkpoint_every=2048,
         # model/batch sizes: reference defaults (512/512, 32x32, cnn 32,
@@ -66,7 +71,8 @@ RECIPES = {
         seed=5,
         total_steps=12288,
         learning_starts=1000,
-        buffer_size=100000,
+        buffer_size=12288,
+        checkpoint_buffer=True,
         action_repeat=4,  # the reference's DMC SAC-AE convention
         checkpoint_every=2048,
         # batch 128 / hidden 1024 / cnn mult 16: reference defaults
@@ -138,7 +144,9 @@ def main() -> None:
     ap.add_argument("--eval-only", action="store_true")
     ap.add_argument("--episodes", type=int, default=10)
     ap.add_argument("--total-steps", type=int, default=None,
-                    help="override the recipe budget (e.g. to extend a resumed run)")
+                    help="override the recipe budget; works on resume too — "
+                    "explicitly-provided CLI flags override the checkpoint "
+                    "sidecar (apply_eval_overrides' training-resume branch)")
     ap.add_argument("--env-id", default=None,
                     help="override the recipe env (e.g. dmc_walker_walk — BASELINE config 4)")
     ns = ap.parse_args()

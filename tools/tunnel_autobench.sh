@@ -1,22 +1,46 @@
 #!/bin/bash
-# Tunnel watchdog + auto-bench: probe every 5 min; on a healthy probe, run
-# the full chip bench (interleaved ABAB keep-decisions) and an xprof
-# duty-cycle trace, so a short tunnel window still lands the round-4
-# receipts. The receipt only counts as landed when the bench exits 0 AND
+# Tunnel watchdog + auto-bench: probe every 5 min; on a healthy probe, land
+# the round-4 chip receipts in priority order, so even a short tunnel window
+# makes progress. A receipt only counts landed when its process exits 0 AND
 # the artifact carries a real number (value > 0) — a tunnel that dies
-# mid-bench leaves no file, so the next healthy window retries.
-# Concurrent CPU learning runs are recorded in the log (they can skew the
-# host-side e2e slice; duty-cycle phases are device-bound).
+# mid-step leaves no final file, so the next healthy window retries.
+#
+# Priority order (highest value first, resumable work before bounded probes):
+#   1. bench_r4_chip.json — full interleaved-ABAB bench (kernel families,
+#      bf16, scan-unroll ladder, e2e precision; paired-median keep rule)
+#   2. xprof_r4/ — duty-cycle trace naming the next optimization slice
+#   3. dreamer_v3 pixel learning run on chip (VERDICT r3 #4 at real scale).
+#      RESUMABLE: checkpoints every 2048 steps; each attempt is bounded and
+#      auto-resumes, so windows shorter than the full run still accumulate.
+#      Lands logs/dreamer_v3_pixel_chip_r4.json on completion.
+#   4. phase_probe_r4.json / blob_ab_r4.json — round-3 pending attributions
+#   5. sac_ae pixel chip run — only if the CPU split-update receipt
+#      (logs/sac_ae_pixel_r4.json) has not landed by then
+#
+# Concurrent CPU learning runs are recorded in the log. Host-sensitive chip
+# steps (the bench's e2e phases, phase/blob probes) SIGSTOP any CPU learning
+# runner for their duration and SIGCONT it after — on this 1-core box a
+# concurrent trainer would otherwise skew the e2e slice downward.
 cd /root/repo
+
+cpu_jobs() { pgrep -f "pixel_learning_run|dv1_learning_run|decoupled_learning_run" | tr '\n' ' '; }
+pause_cpu() { J=$(cpu_jobs); [ -n "$J" ] && kill -STOP $J 2>/dev/null; }
+resume_cpu() { J=$(cpu_jobs); [ -n "$J" ] && kill -CONT $J 2>/dev/null; }
+# EXIT alone doesn't fire on untrapped signals: a `kill` during a paused
+# phase must not strand the trainers in state T
+trap resume_cpu EXIT INT TERM
+
 while true; do
   ts=$(date -u +%H:%M:%S)
   if timeout 45 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
     echo "$ts TUNNEL_UP" >> logs/tunnel_watch.log
     if [ ! -f logs/bench_r4_chip.json ]; then
       echo "$ts autobench: starting (python procs: $(ps -e -o comm= | grep -c python))" >> logs/tunnel_watch.log
+      pause_cpu
       SHEEPRL_TPU_BENCH_WATCHDOG_S=3000 timeout 3100 python bench.py \
         > logs/bench_r4_chip.tmp 2> logs/bench_r4_chip.err
       rc=$?
+      resume_cpu
       if [ $rc -eq 0 ] && python - <<'PY'
 import json, sys
 try:
@@ -37,18 +61,39 @@ PY
       timeout 900 python tools/chip_xprof_trace.py >> logs/tunnel_watch.log 2>&1
       echo "$ts xprof: rc=$?" >> logs/tunnel_watch.log
     fi
+    # pixel learning at chip scale: resumable across windows (mid-run
+    # checkpoints); a bounded attempt per healthy probe until the receipt
+    # JSON lands
+    if [ -f logs/bench_r4_chip.json ] && [ ! -f logs/dreamer_v3_pixel_chip_r4.json ]; then
+      echo "$ts pixel-chip(dv3): attempt starting" >> logs/tunnel_watch.log
+      MUJOCO_GL=egl timeout 2700 python tools/pixel_chip_run.py --algo dreamer_v3 \
+        >> logs/dv3_pixel_chip_r4.out 2>&1
+      echo "$ts pixel-chip(dv3): rc=$? (json present: $(test -f logs/dreamer_v3_pixel_chip_r4.json && echo yes || echo no))" >> logs/tunnel_watch.log
+    fi
     # round-3 closing state named these two receipts PENDING the first
     # healthy tunnel (BENCHES.md): phase attribution V0..V4 and the blob
-    # ON/OFF ABAB — run each once after the bench lands
-    if [ -f logs/bench_r4_chip.json ] && [ ! -f logs/phase_probe_r4.json ]; then
+    # ON/OFF ABAB — run each once after the pixel receipt lands
+    if [ -f logs/dreamer_v3_pixel_chip_r4.json ] && [ ! -f logs/phase_probe_r4.json ]; then
+      pause_cpu
       timeout 2400 python tools/phase_probe.py > logs/phase_probe_r4.tmp 2>> logs/tunnel_watch.log \
         && mv logs/phase_probe_r4.tmp logs/phase_probe_r4.json
       echo "$ts phase_probe: rc=$?" >> logs/tunnel_watch.log
+      resume_cpu
     fi
-    if [ -f logs/bench_r4_chip.json ] && [ ! -f logs/blob_ab_r4.json ]; then
+    if [ -f logs/dreamer_v3_pixel_chip_r4.json ] && [ ! -f logs/blob_ab_r4.json ]; then
+      pause_cpu
       timeout 2400 python tools/blob_ab_probe.py > logs/blob_ab_r4.tmp 2>> logs/tunnel_watch.log \
         && mv logs/blob_ab_r4.tmp logs/blob_ab_r4.json
       echo "$ts blob_ab: rc=$?" >> logs/tunnel_watch.log
+      resume_cpu
+    fi
+    # SAC-AE pixels on chip only if the CPU split-update receipt never lands
+    if [ -f logs/blob_ab_r4.json ] && [ ! -f logs/sac_ae_pixel_r4.json ] \
+       && [ ! -f logs/sac_ae_pixel_chip_r4.json ]; then
+      echo "$ts pixel-chip(sac_ae): attempt starting" >> logs/tunnel_watch.log
+      MUJOCO_GL=egl timeout 2700 python tools/pixel_chip_run.py --algo sac_ae \
+        >> logs/sac_ae_pixel_chip_r4.out 2>&1
+      echo "$ts pixel-chip(sac_ae): rc=$? (json present: $(test -f logs/sac_ae_pixel_chip_r4.json && echo yes || echo no))" >> logs/tunnel_watch.log
     fi
   else
     echo "$ts down" >> logs/tunnel_watch.log

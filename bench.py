@@ -798,6 +798,7 @@ def _paired_ratio(challenger: list, baseline: list) -> float:
 
 
 def bench_dreamer_v3(tiny: bool = False) -> None:
+    global _LEDGER
     from sheeprl_tpu.ops import pallas_kernels as pk
 
     args, state, opts, actions_dim, is_continuous, _ = _dv3_setup(tiny)
@@ -808,6 +809,124 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     cycles = 1 if tiny else 2
 
     import os as _os_mod
+
+    import jax as _jax
+
+    # incremental/resumable sidecar (VERDICT r4 #1): phases persist the
+    # moment they complete; a restart with the same geometry skips them
+    ledger = None
+    lpath = _ledger_path(tiny)
+    if lpath:
+        ledger = PhaseLedger(
+            lpath,
+            {
+                "algo": "dreamer_v3",
+                "tiny": tiny,
+                "segments": segments,
+                "cycles": cycles,
+                "platform": _jax.default_backend(),
+            },
+        )
+        _LEDGER = ledger
+
+    # best-so-far result state, readable by current_headline() at any phase
+    # boundary (the ledger persists its snapshot so the watchdog / a killed
+    # session can still emit a real number)
+    res: dict = {
+        "on_sps": 0.0,
+        "off_sps": 0.0,
+        "fam_sps": {},
+        "kernels_win": False,
+        "best_fams": (),
+        "bf16_sps": None,
+        "bf16_win": False,
+        "unroll_sps": {},
+        "unroll_kept": 1,
+        "e2e_sps": None,
+        "e2e_precision": args.precision,
+        # per-keep-decision median paired ratios vs the SAME session's
+        # baseline (VERDICT r4 #5: the weather-immunity receipt — each ratio
+        # names the advantage that survived the MAD+2% keep rule)
+        "kept_ratios": {},
+    }
+    duty_samples: list = []
+    observed: list = []  # every valid pooled measurement (fallback)
+
+    def current_headline() -> dict:
+        # the headline is the pooled median of the KEPT configuration from
+        # its own (latest) interleaved phase; if the kept config's samples
+        # are all dead (e.g. the off-baseline build failed), fall back to the
+        # best valid pooled measurement so one backend hiccup zeroes that
+        # path, not the whole artifact (_build_closure_guarded's contract)
+        duty_sps = _pooled(duty_samples) or max(
+            [o for o in observed if o > 0.0], default=0.0
+        )
+        implied_tflops = duty_sps / 20.0 * DV3_TFLOPS_PER_20_STEPS
+        return {
+            "metric": "dreamer_v3_pixel_env_steps_per_sec",
+            "value": round(duty_sps, 1),
+            "unit": "env-steps/sec/chip",
+            "vs_baseline": round(duty_sps / DV3_REFERENCE_SPS, 3),
+            "vs_a100_anchor_fp32": round(duty_sps / A100_ANCHOR_SPS["fp32"], 3),
+            "vs_a100_anchor_tf32": round(duty_sps / A100_ANCHOR_SPS["tf32"], 3),
+            "pallas_on_sps": round(res["on_sps"], 1),
+            "pallas_off_sps": round(res["off_sps"], 1),
+            "pallas_kept": bool(res["kernels_win"]),
+            "pallas_kept_families": (
+                list(res["best_fams"]) if res["kernels_win"] else []
+            ),
+            **{
+                f"pallas_{fam}_sps": round(sps, 1)
+                for fam, sps in res["fam_sps"].items()
+            },
+            "bf16_sps": (
+                None if res["bf16_sps"] is None else round(res["bf16_sps"], 1)
+            ),
+            "bf16_kept": bool(res["bf16_win"]),
+            **{
+                f"scan_unroll_{u}_sps": round(sps, 1)
+                for u, sps in res["unroll_sps"].items()
+            },
+            "scan_unroll_kept": res["unroll_kept"],
+            "e2e_sps": (
+                None if res["e2e_sps"] is None else round(res["e2e_sps"], 1)
+            ),
+            "e2e_precision": res["e2e_precision"],
+            "implied_tflops": round(implied_tflops, 1),
+            # individual segments are already filtered by _plausible; this
+            # flag can only fire if the cap itself is later raised past a lie
+            "suspect_timing": bool(implied_tflops > PLAUSIBLE_TFLOPS_CAP),
+            "implausible_discards": discards,
+            "kept_config_paired_ratios": {
+                k: round(v, 4) for k, v in res["kept_ratios"].items()
+            },
+            "phase_sidecar": lpath,
+            "ab_segments": segments,
+            "ab_cycles_per_segment": cycles,
+            "keep_rule": (
+                "interleaved round-robin segments; challenger kept iff "
+                "median paired ratio > 1 + max(MAD, 0.02)"
+            ),
+            "baseline_note": BASELINE_NOTE,
+        }
+
+    def phase_get(name: str):
+        """Recorded samples for `name`, or None if it must be measured."""
+        if ledger is not None and ledger.done(name):
+            print(f"ledger: phase {name} loaded (skipping measurement)",
+                  file=sys.stderr)
+            return ledger.samples(name)
+        return None
+
+    def phase_finish(name: str, phase: dict, recorded: bool) -> None:
+        """Persist a freshly measured phase + headline snapshot; a loaded
+        phase just refreshes the headline."""
+        if ledger is None:
+            return
+        if recorded:
+            ledger.set_headline(current_headline())
+        else:
+            ledger.complete(name, phase, current_headline())
 
     def build_duty(fams, precision=None, unroll=None):
         """Compile ONE duty-cycle variant under the given config (kernel
@@ -860,29 +979,46 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     # tests/test_ops/test_pallas*.py, but a regression in the set_pallas /
     # env-switch integration inside the DV3 step would otherwise only
     # surface on a real chip behind the flaky tunnel)
-    off_closure = build_duty(None)
+    # the off baseline is built lazily: a fully resumed session (every phase
+    # already in the ledger) pays zero compiles
+    _off_holder: dict = {"closure": None, "built": False}
+
+    def get_off():
+        if not _off_holder["built"]:
+            _off_holder["closure"] = build_duty(None)
+            _off_holder["built"] = True
+        return _off_holder["closure"]
+
     all_fams = tuple(_PALLAS_FAMILIES)
     waves = [("all",)] if tiny else [("all",), ("gru", "two_hot"), ("symlog", "cnn")]
     # candidate kernel configs: fams-tuple -> (samples, paired off samples,
-    # closure-or-None). Each must beat its own wave's interleaved off
-    # baseline by more than the observed spread to be keepable; keepable
-    # candidates are RANKED by paired ratio against their own wave's off
-    # (never by absolute sps across waves — different waves see different
-    # tunnel weather). Losing closures are freed per wave and only the
-    # best-so-far keepable closure is carried, so peak device memory stays
-    # bounded at ~4 full states (off + 2 wave challengers + 1 carried).
+    # closure-or-None, loaded-from-ledger). Each must beat its own wave's
+    # interleaved off baseline by more than the observed spread to be
+    # keepable; keepable candidates are RANKED by paired ratio against their
+    # own wave's off (never by absolute sps across waves — different waves
+    # see different tunnel weather). Losing closures are freed per wave and
+    # only the best-so-far keepable closure is carried, so peak device memory
+    # stays bounded at ~4 full states (off + 2 wave challengers + 1 carried).
+    # Ledger-loaded phases carry no closure at all: the kept config's closure
+    # is rebuilt on demand by ensure_winner() below.
     candidates: dict[tuple, tuple] = {}
     all_off_samples: list = []
-    observed: list[float] = []  # every valid pooled measurement (fallback)
     best_keep: tuple | None = None  # (fams, ratio) of the carried closure
     for wave in waves:
-        closures = {
-            cfg: build_duty(cfg if cfg != "all" else "all")
-            for cfg in wave
-        }
-        phase = interleave({"off": off_closure, **closures})
+        pname = "A_wave_" + "_".join(wave)
+        phase = phase_get(pname)
+        loaded = phase is not None
+        if loaded:
+            closures = {cfg: None for cfg in wave}
+        else:
+            closures = {
+                cfg: build_duty(cfg if cfg != "all" else "all")
+                for cfg in wave
+            }
+            phase = interleave({"off": get_off(), **closures})
         all_off_samples.extend(phase["off"])
         observed.append(_pooled(phase["off"]))
+        res["off_sps"] = _pooled(all_off_samples)
         for cfg in wave:
             fams = all_fams if cfg == "all" else (cfg,)
             samp, base, closure = phase[cfg], phase["off"], closures[cfg]
@@ -893,30 +1029,45 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
                     if best_keep is not None:
                         # drop the previously carried closure
                         prev = candidates[best_keep[0]]
-                        candidates[best_keep[0]] = (prev[0], prev[1], None)
+                        candidates[best_keep[0]] = (prev[0], prev[1], None, prev[3])
                     best_keep = (fams, ratio)
                 else:
                     closure = None
             else:
                 closure = None
-            candidates[fams] = (samp, base, closure)
-        del closures
-    off_sps = _pooled(all_off_samples)
-    on_sps = _pooled(candidates[all_fams][0])
-    fam_sps = {
-        f: _pooled(candidates[(f,)][0])
-        for f in _PALLAS_FAMILIES
-        if (f,) in candidates
-    }
+            candidates[fams] = (samp, base, closure, loaded)
+        if not loaded:
+            del closures
+        # interim headline view after each wave: kept-so-far config (or off)
+        res["kernels_win"] = best_keep is not None
+        res["best_fams"] = best_keep[0] if best_keep else ()
+        duty_samples[:] = (
+            candidates[best_keep[0]][0] if best_keep else all_off_samples
+        )
+        if all_fams in candidates:
+            res["on_sps"] = _pooled(candidates[all_fams][0])
+        res["fam_sps"] = {
+            f: _pooled(candidates[(f,)][0])
+            for f in _PALLAS_FAMILIES
+            if (f,) in candidates
+        }
+        phase_finish(pname, phase, loaded)
     solo_winners = tuple(
-        f for f in fam_sps if _beats(candidates[(f,)][0], candidates[(f,)][1])
+        f
+        for f in res["fam_sps"]
+        if _beats(candidates[(f,)][0], candidates[(f,)][1])
     )
     # ---- phase B (conditional): joint set of the solo winners ---------------
     if len(solo_winners) >= 2 and solo_winners not in candidates:
-        joint = build_duty(solo_winners)
-        phase_b = interleave({"off": off_closure, "joint": joint})
+        pname = "B_joint_" + "_".join(solo_winners)
+        phase_b = phase_get(pname)
+        loaded = phase_b is not None
+        joint = None
+        if not loaded:
+            joint = build_duty(solo_winners)
+            phase_b = interleave({"off": get_off(), "joint": joint})
         all_off_samples.extend(phase_b["off"])
-        off_sps = _pooled(all_off_samples)
+        res["off_sps"] = _pooled(all_off_samples)
         observed.append(_pooled(phase_b["joint"]))
         observed.append(_pooled(phase_b["off"]))
         samp, base = phase_b["joint"], phase_b["off"]
@@ -925,16 +1076,25 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
             if best_keep is None or ratio > best_keep[1]:
                 if best_keep is not None:
                     prev = candidates[best_keep[0]]
-                    candidates[best_keep[0]] = (prev[0], prev[1], None)
+                    candidates[best_keep[0]] = (prev[0], prev[1], None, prev[3])
                 best_keep = (solo_winners, ratio)
-                candidates[solo_winners] = (samp, base, joint)
+                candidates[solo_winners] = (samp, base, joint, loaded)
             else:
-                candidates[solo_winners] = (samp, base, None)
+                candidates[solo_winners] = (samp, base, None, loaded)
         else:
-            candidates[solo_winners] = (samp, base, None)
+            candidates[solo_winners] = (samp, base, None, loaded)
+        res["kernels_win"] = best_keep is not None
+        res["best_fams"] = best_keep[0] if best_keep else ()
+        duty_samples[:] = (
+            candidates[best_keep[0]][0] if best_keep else all_off_samples
+        )
+        phase_finish(pname, phase_b, loaded)
 
     kernels_win = best_keep is not None
     best_fams = best_keep[0] if kernels_win else ()
+    res["kernels_win"], res["best_fams"] = kernels_win, best_fams
+    if kernels_win:
+        res["kept_ratios"]["pallas_" + "_".join(best_fams)] = best_keep[1]
     if kernels_win and pk._backend_is_tpu():
         _set_kernel_families({f: True for f in best_fams})
         pk.set_pallas(True, interpret=False)
@@ -942,37 +1102,66 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         _set_kernel_families(None)
         pk.set_pallas(False, interpret=False)
     if kernels_win:
-        duty_samples, _, winner_closure = candidates[best_fams]
+        samp, _, winner_closure, winner_loaded = candidates[best_fams]
+        duty_samples[:] = samp
     else:
         # the all-off config IS the kept config: report it from the pooled
         # cross-wave off samples so the headline and pallas_off_sps agree
-        duty_samples, winner_closure = all_off_samples, off_closure
-    if winner_closure is not off_closure:
-        del off_closure  # free the baseline state once a kernel config won
+        duty_samples[:] = all_off_samples
+        winner_closure = _off_holder["closure"]
+        # a never-built off baseline means every phase-A wave was loaded
+        # from the ledger: the closure is rebuildable, not failed
+        winner_loaded = not _off_holder["built"]
+    if winner_closure is not _off_holder["closure"]:
+        _off_holder["closure"] = None  # free the baseline state: a kernel config won
+        _off_holder["built"] = False
+
+    def ensure_winner():
+        """The kept config's duty closure: present after a fresh measurement,
+        rebuilt on demand (compile only, no re-timing) when its phase was
+        loaded from the ledger. None only if a build genuinely failed."""
+        nonlocal winner_closure, winner_loaded
+        if winner_closure is None and winner_loaded:
+            winner_closure = build_duty(
+                best_fams if kernels_win else None, precision=args.precision
+            )
+            winner_loaded = False
+        return winner_closure
 
     # ---- phase C: precision (bf16 vs f32) on the winning kernel config ------
     # Skipped in --tiny (reported as null, NOT the 0.0 failure sentinel): it
     # adds a full train-step compile to the CPU smoke for a path
     # test_precision.py already covers. Also skipped when the baseline build
-    # itself failed (winner_closure None): a challenger can never be kept
+    # itself failed (ensure_winner() None): a challenger can never be kept
     # against a dead baseline, so the compiles would be pure waste.
-    if tiny or winner_closure is None:
-        bf16_sps, bf16_win = None, False
-    else:
-        bf16_closure = build_duty(
-            best_fams if kernels_win else None, precision="bfloat16"
-        )
-        phase_c = interleave({"f32": winner_closure, "bf16": bf16_closure})
-        bf16_sps = _pooled(phase_c["bf16"])
-        observed.append(bf16_sps)
-        bf16_win = _beats(phase_c["bf16"], phase_c["f32"])
-        if bf16_win:
-            args.precision = "bfloat16"
-            winner_closure = bf16_closure
-            duty_samples = phase_c["bf16"]
-        else:
-            duty_samples = phase_c["f32"]
-            del bf16_closure
+    if not tiny:
+        pname = "C_precision"
+        phase_c = phase_get(pname)
+        loaded = phase_c is not None
+        bf16_closure = None
+        if not loaded and ensure_winner() is not None:
+            bf16_closure = build_duty(
+                best_fams if kernels_win else None, precision="bfloat16"
+            )
+            phase_c = interleave({"f32": winner_closure, "bf16": bf16_closure})
+        if phase_c is not None:
+            res["bf16_sps"] = _pooled(phase_c["bf16"])
+            observed.append(res["bf16_sps"])
+            res["bf16_win"] = _beats(phase_c["bf16"], phase_c["f32"])
+            if res["bf16_win"]:
+                res["kept_ratios"]["bf16"] = _paired_ratio(
+                    phase_c["bf16"], phase_c["f32"]
+                )
+                args.precision = "bfloat16"
+                # a loaded phase has no closure: the bf16 winner is rebuilt
+                # on demand by ensure_winner() (precision travels via args)
+                winner_closure = bf16_closure
+                winner_loaded = loaded
+                duty_samples[:] = phase_c["bf16"]
+            else:
+                duty_samples[:] = phase_c["f32"]
+                bf16_closure = None
+            phase_finish(pname, phase_c, loaded)
 
     # ---- phase D: scan-unroll ladder on the winning kernel+precision config -
     # the RSSM + imagination scans have tiny step bodies where XLA's
@@ -980,60 +1169,67 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
     # Evidence-gated escalation is kept from the sequential design: rungs 4/8
     # interleave against u1 first, and the expensive 16/32 compiles (the scan
     # body duplicated 16/32x) happen only if 8 beats 4.
-    unroll_sps: dict[int, float] = {}
-    unroll_kept = 1
-    if not tiny and winner_closure is not None:
+    if not tiny:
         kernel_cfg = best_fams if kernels_win else None
-        rungs = {
-            u: build_duty(kernel_cfg, precision=args.precision, unroll=u)
-            for u in (4, 8)
-        }
-        _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
-        phase_d1 = interleave({"u1": winner_closure, 4: rungs[4], 8: rungs[8]})
-        unroll_sps = {u: _pooled(phase_d1[u]) for u in (4, 8)}
-        rung_samples = {u: (phase_d1[u], phase_d1["u1"]) for u in (4, 8)}
-        base_samples = phase_d1["u1"]
-        if unroll_sps[8] > unroll_sps[4] > 0.0:
-            rungs.update({
+        pname1 = "D_unroll_4_8"
+        phase_d1 = phase_get(pname1)
+        loaded1 = phase_d1 is not None
+        rungs: dict = {}
+        if not loaded1 and ensure_winner() is not None:
+            rungs = {
                 u: build_duty(kernel_cfg, precision=args.precision, unroll=u)
-                for u in (16, 32)
-            })
+                for u in (4, 8)
+            }
             _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
-            phase_d2 = interleave(
-                {"u1": winner_closure, 16: rungs[16], 32: rungs[32]}
-            )
-            for u in (16, 32):
-                unroll_sps[u] = _pooled(phase_d2[u])
-                rung_samples[u] = (phase_d2[u], phase_d2["u1"])
-            base_samples = phase_d2["u1"]
-        observed.extend(unroll_sps.values())
-        # rank winning rungs by paired ratio against their OWN phase's u1
-        # baseline (d1 and d2 are different sessions; absolute pooled sps
-        # across them would re-import cross-session weather bias)
-        rung_winners = {
-            u: _paired_ratio(samp, base)
-            for u, (samp, base) in rung_samples.items()
-            if _beats(samp, base)
-        }
-        if rung_winners:
-            unroll_kept = max(rung_winners, key=rung_winners.get)
-            duty_samples = rung_samples[unroll_kept][0]
-            _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(unroll_kept)
-        else:
-            duty_samples = base_samples
-        del rungs
-    del winner_closure
-
-    # the headline is the pooled median of the KEPT configuration from its
-    # own (latest) interleaved phase. If the kept config's samples are all
-    # dead (e.g. the off-baseline build failed), fall back to the best valid
-    # pooled measurement so one backend hiccup zeroes that path, not the
-    # whole artifact (_build_closure_guarded's contract).
-    duty_sps = _pooled(duty_samples) or max([o for o in observed if o > 0.0], default=0.0)
-    implied_tflops = duty_sps / 20.0 * DV3_TFLOPS_PER_20_STEPS
-    # individual segments are already filtered by _plausible; this flag can
-    # only fire if the cap itself is later raised past a lie
-    suspect_timing = bool(implied_tflops > PLAUSIBLE_TFLOPS_CAP)
+            phase_d1 = interleave({"u1": winner_closure, 4: rungs[4], 8: rungs[8]})
+        if phase_d1 is not None:
+            res["unroll_sps"] = {u: _pooled(phase_d1[u]) for u in (4, 8)}
+            rung_samples = {u: (phase_d1[u], phase_d1["u1"]) for u in (4, 8)}
+            base_samples = phase_d1["u1"]
+            # persist d1 before deciding escalation: a tunnel death during
+            # the 16/32 compiles must not lose the 4/8 measurements
+            phase_finish(pname1, phase_d1, loaded1)
+            if res["unroll_sps"][8] > res["unroll_sps"][4] > 0.0:
+                pname2 = "D_unroll_16_32"
+                phase_d2 = phase_get(pname2)
+                loaded2 = phase_d2 is not None
+                if not loaded2 and ensure_winner() is not None:
+                    rungs.update({
+                        u: build_duty(kernel_cfg, precision=args.precision, unroll=u)
+                        for u in (16, 32)
+                    })
+                    _os_mod.environ.pop("SHEEPRL_TPU_SCAN_UNROLL", None)
+                    phase_d2 = interleave(
+                        {"u1": winner_closure, 16: rungs[16], 32: rungs[32]}
+                    )
+                if phase_d2 is not None:
+                    for u in (16, 32):
+                        res["unroll_sps"][u] = _pooled(phase_d2[u])
+                        rung_samples[u] = (phase_d2[u], phase_d2["u1"])
+                    base_samples = phase_d2["u1"]
+                    phase_finish(pname2, phase_d2, loaded2)
+            observed.extend(res["unroll_sps"].values())
+            # rank winning rungs by paired ratio against their OWN phase's u1
+            # baseline (d1 and d2 are different sessions; absolute pooled sps
+            # across them would re-import cross-session weather bias)
+            rung_winners = {
+                u: _paired_ratio(samp, base)
+                for u, (samp, base) in rung_samples.items()
+                if _beats(samp, base)
+            }
+            if rung_winners:
+                res["unroll_kept"] = max(rung_winners, key=rung_winners.get)
+                res["kept_ratios"][f"unroll_{res['unroll_kept']}"] = (
+                    rung_winners[res["unroll_kept"]]
+                )
+                duty_samples[:] = rung_samples[res["unroll_kept"]][0]
+                _os_mod.environ["SHEEPRL_TPU_SCAN_UNROLL"] = str(res["unroll_kept"])
+            else:
+                duty_samples[:] = base_samples
+            if ledger is not None:
+                ledger.set_headline(current_headline())
+            del rungs
+    winner_closure = None  # free the kept config's device state
 
     # ---- e2e, with its own interleaved precision keep-decision --------------
     # the replay/transfer mix can invert the duty-cycle winner (bf16 won the
@@ -1048,62 +1244,42 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
         finally:
             args.precision = old_precision
 
-    e2e_precision = args.precision
-    if not tiny and bf16_win:
-        phase_e = interleave(
-            {"f32": build_e2e("float32"), "bf16": build_e2e("bfloat16")}
-        )
+    res["e2e_precision"] = args.precision
+    if not tiny and res["bf16_win"]:
+        pname = "E_e2e_ab"
+        phase_e = phase_get(pname)
+        loaded = phase_e is not None
+        if not loaded:
+            phase_e = interleave(
+                {"f32": build_e2e("float32"), "bf16": build_e2e("bfloat16")}
+            )
         if _beats(phase_e["bf16"], phase_e["f32"]):
-            e2e_sps, e2e_precision = _pooled(phase_e["bf16"]), "bfloat16"
+            res["kept_ratios"]["e2e_bf16"] = _paired_ratio(
+                phase_e["bf16"], phase_e["f32"]
+            )
+            res["e2e_sps"], res["e2e_precision"] = (
+                _pooled(phase_e["bf16"]), "bfloat16",
+            )
         else:
-            e2e_sps, e2e_precision = _pooled(phase_e["f32"]), "float32"
+            res["e2e_sps"], res["e2e_precision"] = (
+                _pooled(phase_e["f32"]), "float32",
+            )
             args.precision = "float32"
+        phase_finish(pname, phase_e, loaded)
     else:
-        e2e_sps = _pooled(interleave({"e2e": build_e2e(args.precision)})["e2e"])
+        pname = "E_e2e"
+        phase_e = phase_get(pname)
+        loaded = phase_e is not None
+        if not loaded:
+            phase_e = interleave({"e2e": build_e2e(args.precision)})
+        res["e2e_sps"] = _pooled(phase_e["e2e"])
+        phase_finish(pname, phase_e, loaded)
 
-    print(
-        json.dumps(
-            {
-                "metric": "dreamer_v3_pixel_env_steps_per_sec",
-                "value": round(duty_sps, 1),
-                "unit": "env-steps/sec/chip",
-                "vs_baseline": round(duty_sps / DV3_REFERENCE_SPS, 3),
-                "vs_a100_anchor_fp32": round(
-                    duty_sps / A100_ANCHOR_SPS["fp32"], 3
-                ),
-                "vs_a100_anchor_tf32": round(
-                    duty_sps / A100_ANCHOR_SPS["tf32"], 3
-                ),
-                "pallas_on_sps": round(on_sps, 1),
-                "pallas_off_sps": round(off_sps, 1),
-                "pallas_kept": bool(kernels_win),
-                "pallas_kept_families": list(best_fams) if kernels_win else [],
-                **{
-                    f"pallas_{fam}_sps": round(sps, 1)
-                    for fam, sps in fam_sps.items()
-                },
-                "bf16_sps": None if bf16_sps is None else round(bf16_sps, 1),
-                "bf16_kept": bool(bf16_win),
-                **{
-                    f"scan_unroll_{u}_sps": round(sps, 1)
-                    for u, sps in unroll_sps.items()
-                },
-                "scan_unroll_kept": unroll_kept,
-                "e2e_sps": round(e2e_sps, 1),
-                "e2e_precision": e2e_precision,
-                "implied_tflops": round(implied_tflops, 1),
-                "suspect_timing": suspect_timing,
-                "implausible_discards": discards,
-                "ab_segments": segments,
-                "ab_cycles_per_segment": cycles,
-                "keep_rule": (
-                    "interleaved round-robin segments; challenger kept iff "
-                    "median paired ratio > 1 + max(MAD, 0.02)"
-                ),
-                "baseline_note": BASELINE_NOTE,
-            }
-        )
-    )
+    headline = current_headline()
+    if ledger is not None:
+        ledger.set_headline(headline)
+        headline = dict(ledger.headline)  # carries phases_completed
+    print(json.dumps(headline))
 
 
 # =============================================================================
@@ -1298,6 +1474,130 @@ def _failure_line(metric: str, unit: str, error: str) -> str:
     )
 
 
+class PhaseLedger:
+    """Incremental/resumable bench sidecar (VERDICT r4 #1).
+
+    Round 4 proved the all-or-nothing artifact design can fail forever on a
+    flaky tunnel: a >=50-minute healthy window ran most of the interleaved
+    phases and the watchdog still produced an EMPTY artifact because nothing
+    is printed until every phase completes. The ledger fixes the liveness
+    half of that trade:
+
+    - each completed phase's per-variant samples are persisted the moment the
+      phase finishes (atomic write to `path`), together with a best-so-far
+      HEADLINE snapshot assembled from completed phases only;
+    - the watchdog (and the backend-unavailable path) print that snapshot —
+      with `partial: true` and the failure annotated — instead of a bare
+      failure line, so any session that completed >=1 phase lands a number;
+    - a restarted bench with the same meta (ledger version / algo / tiny /
+      segment geometry / backend platform) SKIPS completed phases and only
+      measures the remainder. This composes soundly because every
+      keep-decision is paired WITHIN its own phase's interleaved session
+      (`_beats` / `_paired_ratio`): resuming never compares absolute sps
+      across sessions, it only reuses whole per-phase sample sets.
+
+    Stale-ledger guards: `meta` mismatch discards the file; the env override
+    SHEEPRL_TPU_BENCH_FRESH=1 force-discards. `SHEEPRL_TPU_BENCH_MAX_PHASES`
+    (test hook) emits the partial headline and exits 0 after N phases — the
+    CPU-validated stand-in for "tunnel died mid-run".
+    """
+
+    VERSION = 1
+
+    def __init__(self, path: str, meta: dict):
+        self.path = path
+        self.meta = {"ledger_version": self.VERSION, **meta}
+        self.phases: dict = {}
+        self.headline: dict | None = None
+        import os
+
+        if os.environ.get("SHEEPRL_TPU_BENCH_FRESH") == "1":
+            return
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+            if data.get("meta") == self.meta:
+                self.phases = data.get("phases", {})
+                self.headline = data.get("headline")
+                if self.phases:
+                    print(
+                        f"ledger: resuming {path} with completed phases "
+                        f"{sorted(self.phases)}",
+                        file=sys.stderr,
+                    )
+            else:
+                print(
+                    f"ledger: {path} meta mismatch (have {data.get('meta')}, "
+                    f"want {self.meta}) — starting fresh",
+                    file=sys.stderr,
+                )
+        except FileNotFoundError:
+            pass
+        except Exception as exc:  # corrupt sidecar: never kill the bench
+            print(f"ledger: ignoring unreadable {path}: {exc}", file=sys.stderr)
+
+    def done(self, name: str) -> bool:
+        return name in self.phases
+
+    def samples(self, name: str) -> dict:
+        """Recorded per-variant samples with int-like keys restored (JSON
+        stringifies the scan-unroll rung keys 4/8/16/32)."""
+        raw = self.phases[name]["samples"]
+        return {(int(k) if k.isdigit() else k): v for k, v in raw.items()}
+
+    def complete(self, name: str, samples: dict, headline: dict) -> None:
+        """Persist one finished phase + the current best-so-far headline,
+        then honor the test-hook phase budget."""
+        import os
+        import time as _time
+
+        self.phases[name] = {
+            "samples": {str(k): v for k, v in samples.items()},
+            "recorded_at": _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime()),
+        }
+        self.set_headline(headline)
+        budget = os.environ.get("SHEEPRL_TPU_BENCH_MAX_PHASES")
+        if budget and len(self.phases) >= int(budget):
+            out = dict(self.headline or {})
+            out.update(error=f"phase_budget_exhausted_{budget}", partial=True)
+            print(json.dumps(out))
+            sys.stdout.flush()
+            os._exit(0)
+
+    def set_headline(self, headline: dict) -> None:
+        self.headline = {**headline, "phases_completed": sorted(self.phases)}
+        self._write()
+
+    def _write(self) -> None:
+        import os
+
+        payload = {
+            "meta": self.meta,
+            "phases": self.phases,
+            "headline": self.headline,
+        }
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, self.path)
+
+
+_LEDGER: PhaseLedger | None = None
+
+
+def _ledger_path(tiny: bool) -> str | None:
+    """Sidecar location: on by default for the full bench (the driver/autobench
+    runs), opt-in via SHEEPRL_TPU_BENCH_LEDGER for --tiny (the CPU smoke test
+    must stay hermetic run-to-run), '' disables entirely."""
+    import os
+
+    env = os.environ.get("SHEEPRL_TPU_BENCH_LEDGER")
+    if env is not None:
+        return env or None
+    return None if tiny else "logs/bench_phases.json"
+
+
 _METRIC_OF_ALGO = {
     "dreamer_v3": ("dreamer_v3_pixel_env_steps_per_sec", "env-steps/sec/chip"),
     "ppo": ("ppo_cartpole_env_steps_per_sec", "env-steps/sec/chip"),
@@ -1323,15 +1623,26 @@ _METRIC_OF_ALGO = {
 
 def _arm_watchdog(metric: str, unit: str, budget_s: float) -> None:
     """Last-resort liveness bound: if the whole bench (backend init included)
-    has not finished within `budget_s`, print the explicit-failure JSON line
-    and hard-exit. Round 2 lost its artifact to a ~26-minute hang *inside*
-    `jax.devices()` (BENCH_r02 rc=124, no output) — a watchdog thread is the
-    only guard that covers arbitrary C-level hangs."""
+    has not finished within `budget_s`, emit an artifact and hard-exit. Round
+    2 lost its artifact to a ~26-minute hang *inside* `jax.devices()`
+    (BENCH_r02 rc=124, no output) — a watchdog thread is the only guard that
+    covers arbitrary C-level hangs. Round 4's lesson (VERDICT r4 #1): the
+    artifact must carry every phase completed before the timeout, so the fire
+    path prints the ledger's best-so-far headline (partial, with the timeout
+    annotated) whenever one exists, and exits 0 so the driver records the
+    JSON rather than the rc."""
     import os
     import threading
 
     def fire() -> None:
-        print(_failure_line(metric, unit, f"watchdog_timeout_{int(budget_s)}s"))
+        err = f"watchdog_timeout_{int(budget_s)}s"
+        if _LEDGER is not None and _LEDGER.headline:
+            out = dict(_LEDGER.headline)
+            out.update(error=err, partial=True)
+            print(json.dumps(out))
+            sys.stdout.flush()
+            os._exit(0)
+        print(_failure_line(metric, unit, err))
         sys.stdout.flush()
         os._exit(2)
 
@@ -1655,12 +1966,31 @@ def main() -> None:
     # hangs (including jax backend init in THIS process after a good probe),
     # the probe budget covers a dead tunnel, and exit code is 0 either way so
     # the driver records the artifact instead of an rc
+    # default raised 1500 -> 3600 (VERDICT r4 #1: the one real r4 run needed
+    # >3000s); with the ledger, a timeout now emits completed phases anyway
     _arm_watchdog(
-        metric, unit, float(os.environ.get("SHEEPRL_TPU_BENCH_WATCHDOG_S", 1500))
+        metric, unit, float(os.environ.get("SHEEPRL_TPU_BENCH_WATCHDOG_S", 3600))
     )
     if not _wait_for_backend(
         total_budget_s=float(os.environ.get("SHEEPRL_TPU_BENCH_PROBE_BUDGET_S", 480))
     ):
+        # a dead tunnel NOW must not erase phases an earlier healthy window
+        # landed: re-emit the sidecar's best-so-far headline when one exists
+        lpath = _ledger_path(opts.tiny)
+        if opts.algo == "dreamer_v3" and lpath:
+            try:
+                with open(lpath) as fh:
+                    headline = json.load(fh).get("headline")
+            except Exception:
+                headline = None
+            if headline and headline.get("value", 0) > 0:
+                headline = dict(headline)
+                headline.update(
+                    error="backend_unavailable", partial=True,
+                    resumed_from_sidecar=True,
+                )
+                print(json.dumps(headline))
+                return
         print(_failure_line(metric, unit, "backend_unavailable"))
         return
     if opts.algo == "ppo":

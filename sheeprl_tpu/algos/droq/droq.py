@@ -243,6 +243,9 @@ def main(argv: Sequence[str] | None = None) -> None:
     learning_starts = (
         args.learning_starts // args.num_envs if not args.dry_run else 0
     )
+    # burst size stays the CONFIGURED warmup: after the resume bump below, a
+    # threshold-sized burst would replay ~start_step updates in one env step
+    base_learning_starts = learning_starts
     if args.checkpoint_path and not restored_buffer and not args.dry_run:
         # bufferless resume: re-collect before updating (same guard as
         # dreamer_v3) so batch updates don't sample a near-empty ring on
@@ -289,8 +292,8 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         if global_step >= learning_starts - 1 and rb.can_sample(args.sample_next_obs):
             training_steps = (
-                learning_starts
-                if global_step == learning_starts - 1 and learning_starts > 1
+                base_learning_starts
+                if global_step == learning_starts - 1 and base_learning_starts > 1
                 else 1
             )
             global_batch = args.per_rank_batch_size * n_dev

@@ -266,6 +266,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     learning_starts = (
         args.learning_starts // args.num_envs if not args.dry_run else 0
     )
+    # the catch-up burst size must stay the CONFIGURED warmup, not the
+    # resume-shifted threshold: after the bufferless-resume bump below, a
+    # threshold-sized burst would replay ~start_step update iterations in
+    # one env step against a buffer holding only the fresh re-collection
+    base_learning_starts = learning_starts
     if args.checkpoint_path and not restored_buffer and not args.dry_run:
         # bufferless resume: re-collect before updating (same guard as
         # dreamer_v3) so batch updates don't sample a near-empty ring on
@@ -311,7 +316,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         if global_step >= learning_starts - 1 and rb.can_sample(args.sample_next_obs):
             # catch-up burst at the learning threshold (reference sac.py:234-236)
             training_steps = (
-                learning_starts if global_step == learning_starts - 1 and learning_starts > 1 else 1
+                base_learning_starts
+                if global_step == learning_starts - 1 and base_learning_starts > 1
+                else 1
             )
             global_batch = args.per_rank_batch_size * n_dev
             for _ in range(training_steps):

@@ -41,7 +41,12 @@ class SACAEArgs(SACArgs):
         default=False,
         help="compile the update as four per-model jits instead of one fused "
         "jit (workaround for a pathological XLA:CPU compile at pixel sizes; "
-        "keep the fused default on TPU)",
+        "keep the fused default on TPU). Logging caveat: with "
+        "actor_network_frequency/decoder_update_freq > 1 the split path logs "
+        "Loss/policy_loss, Loss/alpha_loss and Loss/reconstruction_loss only "
+        "on the steps that run those phases, while the fused path logs them "
+        "every step (computed-but-masked) — TB series cadence differs "
+        "between the two modes",
     )
     dense_units: int = Arg(default=64, help="units per dense layer (mlp encoder/decoder)")
     mlp_layers: int = Arg(default=2, help="MLP depth for encoder/decoder")

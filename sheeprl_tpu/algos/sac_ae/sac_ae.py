@@ -265,8 +265,8 @@ def make_split_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
     dispatch + full cross-model fusion is faster.
     """
     qf_optim, actor_optim, alpha_optim, encoder_optim, decoder_optim = optimizers
-    obs_keys = (*cnn_keys, *mlp_keys)
     normalize = _make_normalize(cnn_keys, mlp_keys)
+    actor_loss_fn, recon_loss_fn = _make_loss_fns(args, cnn_keys, mlp_keys)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def critic_step(agent, qf_opt, batch, key):
@@ -291,19 +291,12 @@ def make_split_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
     @partial(jax.jit, donate_argnums=(0, 1, 2))
     def actor_alpha_step(agent, actor_opt, alpha_opt, batch, key):
         obs = normalize(batch)
-
-        def actor_loss_fn(actor):
-            actions, logprobs = actor(agent.critic.encoder, obs, key, detach=True)
-            q = agent.critic(obs, actions, detach_encoder=True)
-            min_q = jnp.min(q, axis=-1, keepdims=True)
-            return (
-                policy_loss(jax.lax.stop_gradient(agent.alpha), logprobs, min_q),
-                logprobs,
-            )
-
+        # the SHARED loss body (value_and_grad differentiates arg 0 only):
+        # the fused/split parity guarantee rests on the closures existing
+        # exactly once in _make_loss_fns
         (actor_l, logprobs), actor_grads = jax.value_and_grad(
             actor_loss_fn, has_aux=True
-        )(agent.actor)
+        )(agent.actor, agent, obs, key)
         actor_updates, actor_opt = actor_optim.update(
             actor_grads, actor_opt, agent.actor
         )
@@ -324,24 +317,8 @@ def make_split_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
     @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
     def recon_step(agent, decoder, encoder_opt, decoder_opt, batch, key):
         obs = normalize(batch)
-
-        def recon_loss_fn(enc_dec):
-            enc, dec = enc_dec
-            hidden = enc(obs)
-            recon = dec(hidden)
-            l2 = jnp.mean(0.5 * jnp.sum(jnp.square(hidden), axis=-1))
-            loss = 0.0
-            for k in obs_keys:
-                if k in cnn_keys:
-                    target = preprocess_obs(batch[k], key, bits=5)
-                else:
-                    target = batch[k].astype(jnp.float32)
-                loss += jnp.mean(jnp.square(target - recon[k]))
-                loss += args.decoder_l2_lambda * l2
-            return loss
-
         recon_l, (enc_grads, dec_grads) = jax.value_and_grad(recon_loss_fn)(
-            (agent.critic.encoder, decoder)
+            (agent.critic.encoder, decoder), batch, obs, key
         )
         enc_updates, encoder_opt = encoder_optim.update(
             enc_grads, encoder_opt, agent.critic.encoder
@@ -571,6 +548,9 @@ def main(argv: Sequence[str] | None = None) -> None:
     learning_starts = (
         args.learning_starts // args.num_envs if not args.dry_run else 0
     )
+    # burst size stays the CONFIGURED warmup: after the resume bump below, a
+    # threshold-sized burst would replay ~start_step updates in one env step
+    base_learning_starts = learning_starts
     if args.checkpoint_path and not restored_buffer and not args.dry_run:
         # bufferless resume: re-collect before updating (same guard as
         # dreamer_v3) so batch updates don't sample a near-empty ring on
@@ -649,8 +629,8 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         if global_step >= learning_starts - 1 and rb.can_sample(args.sample_next_obs):
             training_steps = (
-                learning_starts
-                if global_step == learning_starts - 1 and learning_starts > 1
+                base_learning_starts
+                if global_step == learning_starts - 1 and base_learning_starts > 1
                 else 1
             )
             global_batch = args.per_rank_batch_size * n_dev

@@ -72,7 +72,18 @@ def distributed_setup(
     process_id: Optional[int] = None,
 ) -> None:
     """Initialize multi-host JAX (one call per host process). No-ops when
-    single-host or when the TPU pod runtime auto-configures itself."""
+    single-host or when the TPU pod runtime auto-configures itself.
+
+    Also the framework's hook for the persistent compilation cache: when
+    SHEEPRL_TPU_COMPILE_CACHE names a directory, compiled executables are
+    cached across processes/sessions. This is how the CPU receipt runners
+    amortize the XLA:CPU conv-gradient compile pathology (the SAC-AE
+    reconstruction jit alone costs ~16 min at pixel sizes — once), and it
+    makes resumed TPU bench sessions rebuild closures nearly for free."""
+    cache_dir = os.environ.get("SHEEPRL_TPU_COMPILE_CACHE")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
     if num_processes is not None and num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -92,6 +92,58 @@ def test_sac_resume_extends_budget(tmp_path):
 
 
 @pytest.mark.timeout(300)
+def test_sac_bufferless_resume_burst_is_bounded(tmp_path, monkeypatch):
+    """Bufferless resume (no --checkpoint_buffer) shifts the learning
+    threshold by start_step so the ring re-fills before updates — but the
+    catch-up burst at that threshold must stay the CONFIGURED warmup size
+    (ADVICE r4 #1): a threshold-sized burst would re-execute ~start_step
+    update iterations in one env step against a near-empty buffer, a
+    replay-ratio pathology that effectively hangs large resumes."""
+    import sheeprl_tpu.algos.sac.sac as sac_mod
+
+    args = [
+        "--env_id", "Pendulum-v1",
+        "--num_envs", "1",
+        "--sync_env",
+        "--total_steps", "8",
+        "--learning_starts", "2",
+        "--per_rank_batch_size", "2",
+        "--buffer_size", "16",
+        "--checkpoint_every", "4",
+        "--actor_hidden_size", "8",
+        "--critic_hidden_size", "8",
+        "--root_dir", str(tmp_path),
+        "--run_name", "burst",
+    ]
+    tasks["sac"](args)
+    ckpt = str(tmp_path / "burst" / "checkpoints" / "ckpt_8")
+    assert os.path.exists(ckpt)
+
+    calls = {"n": 0}
+    real_factory = sac_mod.make_train_step
+
+    def counting_factory(*a, **kw):
+        step = real_factory(*a, **kw)
+
+        def counted(*sa, **skw):
+            calls["n"] += 1
+            return step(*sa, **skw)
+
+        return counted
+
+    monkeypatch.setattr(sac_mod, "make_train_step", counting_factory)
+    tasks["sac"](["--checkpoint_path", ckpt, "--total_steps", "12"])
+    assert (tmp_path / "burst" / "checkpoints" / "ckpt_12").exists()
+    # resume runs steps 9..12 with threshold 2+9=11: burst of
+    # base_learning_starts(=2) at step 10, then 1 each at 11 and 12. The
+    # pre-fix pathology would have burst learning_starts(=11) here.
+    assert calls["n"] <= 6, (
+        f"{calls['n']} update iterations on a 4-step bufferless resume — "
+        "the catch-up burst is using the resume-shifted threshold"
+    )
+
+
+@pytest.mark.timeout(300)
 def test_sac_rejects_discrete(tmp_path):
     with pytest.raises(ValueError, match="continuous"):
         tasks["sac"](

@@ -44,6 +44,64 @@ def test_bench_tiny_prints_contract_json():
     assert payload["value"] > 0, diag
 
 
+@pytest.mark.timeout(900)
+def test_bench_ledger_partial_emission_and_resume(tmp_path):
+    """VERDICT r4 #1 (the CPU-validated demonstration): a bench session that
+    dies mid-run must still emit its completed phases, and a restart must
+    skip them. Run 1 is budgeted to ONE phase (the stand-in for a tunnel
+    death after phase A) — it must print a partial headline with value > 0
+    and persist the phase to the sidecar. Run 2 resumes from the sidecar,
+    skips the recorded phase, and completes the remaining phases."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    ledger = str(tmp_path / "ledger.json")
+    env["SHEEPRL_TPU_BENCH_LEDGER"] = ledger
+
+    # run 1: die after the first completed phase
+    env1 = dict(env, SHEEPRL_TPU_BENCH_MAX_PHASES="1")
+    p1 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--tiny"],
+        cwd=REPO, env=env1, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, timeout=420,
+    )
+    diag = f"stdout: {p1.stdout!r}\nstderr tail: {p1.stderr[-2000:]!r}"
+    assert p1.returncode == 0, diag
+    lines = [l for l in p1.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, diag
+    partial = json.loads(lines[0])
+    assert partial["value"] > 0, f"partial emission carries no number; {diag}"
+    assert partial.get("partial") is True, diag
+    assert "phase_budget_exhausted" in partial.get("error", ""), diag
+    assert partial["phases_completed"] == ["A_wave_all"], diag
+    with open(ledger) as fh:
+        side = json.load(fh)
+    assert "A_wave_all" in side["phases"], side.get("phases", {}).keys()
+
+    # run 2: resume — phase A must be loaded, not re-measured
+    p2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--tiny"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, timeout=420,
+    )
+    diag2 = f"stdout: {p2.stdout!r}\nstderr tail: {p2.stderr[-2000:]!r}"
+    assert p2.returncode == 0, diag2
+    lines2 = [l for l in p2.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines2) == 1, diag2
+    final = json.loads(lines2[0])
+    assert final["value"] > 0, diag2
+    assert "A_wave_all" in final["phases_completed"], diag2
+    assert "E_e2e" in final["phases_completed"], diag2
+    assert "phase A_wave_all loaded" in p2.stderr, (
+        "resume did not skip the recorded phase; " + diag2
+    )
+
+
 def test_interleave_keep_rule_helpers():
     """The ABAB keep-decision primitives (VERDICT r3 #1): pooled medians
     ignore dead segments, and a challenger is kept only when its paired

@@ -26,7 +26,8 @@ from __future__ import annotations
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["PALLAS_AXON_POOL_IPS"] = ""  # children: skip axon registration
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ.setdefault("SHEEPRL_TPU_COMPILE_CACHE", "logs/jax_compile_cache")  # children: skip axon registration
 
 import argparse
 import json
@@ -35,6 +36,7 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import jax
 
@@ -195,21 +197,11 @@ def _evaluate(root: Path) -> dict:
 
 
 def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--root", default="logs/dv1_learn_r4d")
-    ap.add_argument("--eval-only", action="store_true")
-    ns = ap.parse_args()
-    root = Path(ns.root)
-    t0 = time.time()
-    if not ns.eval_only:
-        _train(root)
-    result = _evaluate(root)
-    result["recipe"] = RECIPE
-    result["train_plus_eval_seconds"] = round(time.time() - t0, 1)
-    out = Path(str(root) + ".json")
-    out.write_text(json.dumps(result, indent=2))
-    print(json.dumps({k: result[k] for k in ("mean_return", "returns")}))
-    print(f"[dv1] receipt written to {out}", flush=True)
+    from runner_common import bounded_runner_main
+
+    bounded_runner_main(
+        "logs/dv1_learn_r4d", _train, _evaluate, RECIPE, "dv1"
+    )
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ.setdefault("SHEEPRL_TPU_COMPILE_CACHE", "logs/jax_compile_cache")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -34,6 +35,7 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import jax
 
@@ -160,21 +162,12 @@ def _evaluate(root: Path) -> dict:
 
 
 def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--root", default="logs/dv3_decoupled_learn_r4")
-    ap.add_argument("--eval-only", action="store_true")
-    ns = ap.parse_args()
-    root = Path(ns.root)
-    t0 = time.time()
-    if not ns.eval_only:
-        _train(root)
-    result = _evaluate(root)
-    result["recipe"] = RECIPE
-    result["train_plus_eval_seconds"] = round(time.time() - t0, 1)
-    out = Path(str(root) + ".json")
-    out.write_text(json.dumps(result, indent=2))
-    print(json.dumps({k: result[k] for k in ("mean_return", "returns")}))
-    print(f"[dv3-decoupled] receipt written to {out}", flush=True)
+    from runner_common import bounded_runner_main
+
+    bounded_runner_main(
+        "logs/dv3_decoupled_learn_r5", _train, _evaluate, RECIPE,
+        "dv3-decoupled",
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,159 @@
+"""Attribute the SAC-AE XLA:CPU compile stall to a specific split jit.
+
+Round-4 context: the fused SAC-AE update stalls XLA:CPU >25 min at pixel
+sizes; `--split_update` (four per-model jits) was built to sidestep it, but
+the round-4 receipt runner STILL stalled >2.5 h in its first training step
+with split_update=true (batch 32 / 128 units / 64x64x9 frames). This probe
+builds the exact receipt-scale state WITHOUT envs and drives the split
+train_step with the do-flags enabled one at a time, timing each jit's first
+call under a SIGALRM bound — so the stall is attributed to critic / ema /
+actor+alpha / recon rather than "somewhere in XLA".
+
+Usage: python tools/sac_ae_compile_probe.py [--budget-s 900] [--batch 32]
+Prints one JSON line per phase: {"phase": ..., "seconds": ... | "TIMEOUT"}.
+"""
+
+from __future__ import annotations
+
+import os
+
+# the sitecustomize overrides JAX_PLATFORMS at interpreter start, so the env
+# var alone is not enough — the config.update below wins over it
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import argparse
+import json
+import signal
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PhaseTimeout(Exception):
+    pass
+
+
+def _alarm(_sig, _frm):
+    raise PhaseTimeout
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-s", type=int, default=900)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--fused", action="store_true", help="probe the fused path instead")
+    ns = ap.parse_args()
+
+    from sheeprl_tpu.algos.sac_ae.args import SACAEArgs
+    from sheeprl_tpu.algos.sac_ae.agent import (
+        SACAEAgent,
+        SACAECNNDecoder,
+        SACAECNNEncoder,
+        SACAEDecoder,
+        SACAEEncoder,
+    )
+    from sheeprl_tpu.algos.sac_ae.sac_ae import (
+        TrainState,
+        make_optimizers,
+        make_split_train_step,
+        make_train_step,
+    )
+    from sheeprl_tpu.utils.parser import DataclassArgumentParser
+
+    parser = DataclassArgumentParser(SACAEArgs)
+    (args,) = parser.parse_args_into_dataclasses([
+        "--per_rank_batch_size", str(ns.batch),
+        "--actor_hidden_size", str(ns.hidden),
+        "--critic_hidden_size", str(ns.hidden),
+        "--dense_units", str(ns.hidden),
+    ])
+    args.screen_size = 64
+
+    key = jax.random.PRNGKey(0)
+    key, k_cnn, k_agent, k_dec = jax.random.split(key, 4)
+    cnn_keys, mlp_keys = ("rgb",), ()
+    in_channels = 9  # 3 stacked rgb frames, the receipt configuration
+    cnn_encoder = SACAECNNEncoder.init(
+        k_cnn, in_channels, args.features_dim, cnn_keys,
+        screen_size=args.screen_size,
+        cnn_channels_multiplier=args.cnn_channels_multiplier,
+    )
+    encoder = SACAEEncoder(cnn_encoder=cnn_encoder, mlp_encoder=None)
+    cnn_decoder = SACAECNNDecoder.init(
+        k_dec, cnn_encoder.conv_output_shape, encoder.output_dim,
+        cnn_keys, [in_channels],
+        cnn_channels_multiplier=args.cnn_channels_multiplier,
+    )
+    decoder = SACAEDecoder(cnn_decoder=cnn_decoder, mlp_decoder=None)
+    agent = SACAEAgent.init(
+        k_agent, encoder, 1,
+        num_critics=args.num_critics,
+        actor_hidden_size=args.actor_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        action_low=np.array([-1.0]), action_high=np.array([1.0]),
+        alpha=args.alpha, tau=args.tau, encoder_tau=args.encoder_tau,
+    )
+    optimizers = make_optimizers(args)
+    qf_optim, actor_optim, alpha_optim, encoder_optim, decoder_optim = optimizers
+    state = TrainState(
+        agent=agent, decoder=decoder,
+        qf_opt=qf_optim.init(agent.critic),
+        actor_opt=actor_optim.init(agent.actor),
+        alpha_opt=alpha_optim.init(agent.log_alpha),
+        encoder_opt=encoder_optim.init(agent.critic.encoder),
+        decoder_opt=decoder_optim.init(decoder),
+    )
+
+    b = ns.batch
+    rng = np.random.default_rng(0)
+    batch = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (1, b, 64, 64, 9), dtype=np.uint8)),
+        "next_rgb": jnp.asarray(rng.integers(0, 255, (1, b, 64, 64, 9), dtype=np.uint8)),
+        "actions": jnp.asarray(rng.normal(size=(1, b, 1)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(1, b, 1)).astype(np.float32)),
+        "dones": jnp.zeros((1, b, 1), jnp.float32),
+    }
+
+    make = make_train_step if ns.fused else make_split_train_step
+    train_step = make(args, optimizers, cnn_keys, mlp_keys)
+    signal.signal(signal.SIGALRM, _alarm)
+
+    if ns.fused:
+        phases = [("fused_all", (True, True, True))]
+    else:
+        phases = [
+            ("critic_only", (False, False, False)),
+            ("plus_ema", (True, False, False)),
+            ("plus_actor_alpha", (True, True, False)),
+            ("plus_recon", (True, True, True)),
+        ]
+    for name, (do_ema, do_actor, do_decoder) in phases:
+        key, k = jax.random.split(key)
+        t0 = time.perf_counter()
+        signal.alarm(ns.budget_s)
+        try:
+            out_state, metrics = train_step(state, batch, k, do_ema, do_actor, do_decoder)
+            jax.block_until_ready(metrics)
+            signal.alarm(0)
+            dt = round(time.perf_counter() - t0, 1)
+            print(json.dumps({"phase": name, "seconds": dt}), flush=True)
+            state = out_state
+        except PhaseTimeout:
+            print(json.dumps({"phase": name, "seconds": "TIMEOUT",
+                              "budget_s": ns.budget_s}), flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()

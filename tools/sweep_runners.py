@@ -1,0 +1,85 @@
+"""Session-end straggler sweep (VERDICT r4 #4).
+
+SIGTERMs any `tools/*_learning_run.py` / `pixel_chip_run.py` process still
+alive — the bounded harness (tools/runner_common.py) turns SIGTERM into the
+graceful checkpoint-then-eval path, so a swept runner lands a
+partial/resumable receipt instead of dying silently. After a grace window,
+survivors (stuck in native code) get SIGKILL; their mid-run checkpoints
+remain resumable and runner_common's hard timer has usually already written
+a stub.
+
+Usage: python tools/sweep_runners.py [--grace-s 900] [--dry-run]
+Intended callers: the autobench loop's session boundary and any operator
+ending a work session.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import time
+
+PATTERNS = ("learning_run.py", "pixel_chip_run.py")
+
+
+def find_runners() -> dict[int, str]:
+    out = subprocess.run(
+        ["ps", "-e", "-o", "pid=,args="], capture_output=True, text=True
+    ).stdout
+    procs = {}
+    for line in out.splitlines():
+        pid_s, _, cmd = line.strip().partition(" ")
+        if any(p in cmd for p in PATTERNS) and "sweep_runners" not in cmd:
+            procs[int(pid_s)] = cmd.strip()
+    return procs
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grace-s", type=float, default=900.0,
+                    help="wait this long for graceful receipts before SIGKILL")
+    ap.add_argument("--dry-run", action="store_true")
+    ns = ap.parse_args()
+
+    procs = find_runners()
+    if not procs:
+        print("sweep: no runner processes found")
+        return
+    for pid, cmd in procs.items():
+        print(f"sweep: SIGTERM {pid}: {cmd}")
+        if not ns.dry_run:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+    if ns.dry_run:
+        return
+    deadline = time.time() + ns.grace_s
+    while time.time() < deadline:
+        alive = [pid for pid in procs if _alive(pid)]
+        if not alive:
+            print("sweep: all runners exited gracefully")
+            return
+        time.sleep(10)
+    for pid in procs:
+        if _alive(pid):
+            print(f"sweep: SIGKILL {pid} (stuck past grace; checkpoint "
+                  "remains resumable)")
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+if __name__ == "__main__":
+    main()

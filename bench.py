@@ -1287,12 +1287,18 @@ def bench_dreamer_v3(tiny: bool = False) -> None:
 # =============================================================================
 
 
-def _ppo_run(decoupled: bool, num_devices: int = -1, pixel: bool = False) -> float:
+def _ppo_run(
+    decoupled: bool, num_devices: int = -1, pixel: bool = False,
+    telemetry: bool = False,
+) -> float:
     """One PPO throughput run through the real rollout+update loop; returns
     env-steps/sec. `pixel=True` swaps CartPole's 4-float obs for the 64x64x3
     uint8 dummy env (BASELINE config 3's Atari shape): each rollout then
     moves megabytes through the player->trainer path instead of bytes, which
-    is what makes the decoupled comparison meaningful."""
+    is what makes the decoupled comparison meaningful. `telemetry` toggles
+    the real Telemetry subsystem around the loop (the off arm runs the same
+    disabled-instance calls the mains' SHEEPRL_TPU_TELEMETRY=0 path runs),
+    so `--telemetry ab` measures the instrumentation's honest overhead."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1311,7 +1317,15 @@ def _ppo_run(decoupled: bool, num_devices: int = -1, pixel: bool = False) -> flo
     from sheeprl_tpu.envs import make_vector_env
     from sheeprl_tpu.parallel import make_mesh, replicate, shard_batch
     from sheeprl_tpu.parallel.decoupled import make_decoupled_meshes
+    from sheeprl_tpu.telemetry import Telemetry
     from sheeprl_tpu.utils.env import make_dict_env
+
+    import tempfile
+
+    telem = Telemetry(
+        tempfile.mkdtemp(prefix="bench_telemetry_"), rank=0, algo="ppo_bench",
+        enabled=telemetry,
+    )
 
     args = PPOArgs(
         env_id="discrete_dummy" if pixel else "CartPole-v1",
@@ -1361,6 +1375,7 @@ def _ppo_run(decoupled: bool, num_devices: int = -1, pixel: bool = False) -> flo
             leaves = jax.tree_util.tree_leaves(pending_agent)
             if all(l.is_ready() for l in leaves if hasattr(l, "is_ready")):
                 player_agent, pending_agent = pending_agent, None
+        telem.mark("rollout")
         rows = {k: [] for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
         for _ in range(args.rollout_steps):
             key, sk = jax.random.split(key)
@@ -1381,6 +1396,7 @@ def _ppo_run(decoupled: bool, num_devices: int = -1, pixel: bool = False) -> flo
             rows["dones"].append(next_done[:, None])
             next_done = (terms | truncs).astype(np.float32)
             obs = nobs
+        telem.mark("host_to_device")
         data = {k: jnp.asarray(np.stack(v)) for k, v in rows.items()}
         dnext = {k: jnp.asarray(obs[k]) for k in obs_keys}
         returns, advantages = compute_gae_returns(
@@ -1393,6 +1409,7 @@ def _ppo_run(decoupled: bool, num_devices: int = -1, pixel: bool = False) -> flo
             for k, v in data.items() if k not in ("rewards", "dones")
         }
         key, tk = jax.random.split(key)
+        telem.mark("train/dispatch")
         if decoupled:
             flat = meshes.to_trainers(flat)
             state, metrics = train_step(
@@ -1414,18 +1431,33 @@ def _ppo_run(decoupled: bool, num_devices: int = -1, pixel: bool = False) -> flo
     carry = one_update(*carry)  # compile
     n_updates = 4 if pixel else 8
     t0 = time.perf_counter()
-    for _ in range(n_updates):
+    for u in range(n_updates):
         carry = one_update(*carry)
+        telem.interval({}, step=(u + 1) * args.rollout_steps * args.num_envs)
     import jax as _jax
 
     _jax.block_until_ready(carry[0])
     dt = time.perf_counter() - t0
     envs.close()
+    telem.close()
     return n_updates * args.rollout_steps * args.num_envs / dt
 
 
-def bench_ppo() -> None:
-    sps = _ppo_run(decoupled=False)
+def bench_ppo(telemetry: str = "off") -> None:
+    """`telemetry`: "off"/"on" run one arm; "ab" runs both and records the
+    instrumentation overhead honestly (ISSUE 2 satellite) — `value` stays
+    the instrumented number (the always-on path the mains actually run)."""
+    extras: dict = {"telemetry": telemetry}
+    if telemetry == "ab":
+        off_sps = _ppo_run(decoupled=False, telemetry=False)
+        sps = _ppo_run(decoupled=False, telemetry=True)
+        extras.update(
+            telemetry_off_sps=round(off_sps, 1),
+            telemetry_on_sps=round(sps, 1),
+            telemetry_overhead_pct=round(100.0 * (off_sps / max(sps, 1e-9) - 1.0), 2),
+        )
+    else:
+        sps = _ppo_run(decoupled=False, telemetry=telemetry == "on")
     print(
         json.dumps(
             {
@@ -1434,6 +1466,7 @@ def bench_ppo() -> None:
                 "unit": "env-steps/sec/chip",
                 "vs_baseline": round(sps / PPO_CPU_REFERENCE_SPS, 3),
                 "baseline_note": BASELINE_NOTE,
+                **extras,
             }
         )
     )
@@ -1947,6 +1980,11 @@ def main() -> None:
         "--algo", choices=sorted(_METRIC_OF_ALGO), default="dreamer_v3"
     )
     parser.add_argument("--tiny", action="store_true")
+    parser.add_argument(
+        "--telemetry", choices=["on", "off", "ab"], default="off",
+        help="PPO bench only: run the loop with the telemetry subsystem "
+        "on/off, or 'ab' to measure both and record the overhead",
+    )
     opts = parser.parse_args()
     metric, unit = _METRIC_OF_ALGO[opts.algo]
 
@@ -1994,7 +2032,7 @@ def main() -> None:
         print(_failure_line(metric, unit, "backend_unavailable"))
         return
     if opts.algo == "ppo":
-        bench_ppo()
+        bench_ppo(telemetry=opts.telemetry)
     elif opts.algo == "ppo_decoupled":
         bench_ppo_decoupled()
     elif opts.algo == "sac":

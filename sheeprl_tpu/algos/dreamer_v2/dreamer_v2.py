@@ -46,6 +46,8 @@ from ...parallel import (
     scan_batch_spec,
     shard_time_batch,
 )
+from ...telemetry import Telemetry
+from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
     apply_eval_overrides,
@@ -406,7 +408,7 @@ def make_train_step(
         }
         return new_state, metrics
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return donating_jit(train_step, donate_argnums=(0,))
 
 
 @register_algorithm()
@@ -444,6 +446,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "dreamer_v2", process_index=rank)
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
+    telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v2")
 
     envs = make_vector_env(
         [
@@ -639,6 +642,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
+        telem.mark("rollout")
         # ---- action selection ----------------------------------------------
         if (
             global_step <= learning_starts
@@ -759,6 +763,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             else True
         )
         if global_step >= learning_starts and step_before_training <= 0 and can_sample:
+            telem.mark("buffer/sample")
             n_samples = (
                 args.pretrain_steps
                 if global_step == learning_starts
@@ -777,6 +782,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     prioritize_ends=args.prioritize_ends,
                 )
             staged = stage_batch(local_data, to_host=jax.process_count() > 1)
+            telem.mark("train/dispatch")
             for i in range(n_samples):
                 tau = 1.0 if gradient_steps % args.critic_target_network_update_freq == 0 else 0.0
                 sample = {k: v[i] for k, v in staged.items()}
@@ -800,10 +806,11 @@ def main(argv: Sequence[str] | None = None) -> None:
                 )
             aggregator.update("Params/exploration_amount", expl_amount)
 
+        telem.mark("log")
         sps = (global_step - start_step + 1) * single_global_step / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         aggregator.reset()
 
@@ -840,6 +847,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir),
         args, logger,
     )
+    telem.close()
     logger.close()
 
 

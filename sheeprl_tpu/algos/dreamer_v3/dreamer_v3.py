@@ -58,6 +58,8 @@ from ...parallel import (
     scan_batch_spec,
     shard_time_batch,
 )
+from ...telemetry import Telemetry
+from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
     apply_eval_overrides,
@@ -439,7 +441,7 @@ def make_train_step(
         }
         return new_state, metrics
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return donating_jit(train_step, donate_argnums=(0,))
 
 
 def _random_actions(action_space, actions_dim, is_continuous: bool):
@@ -520,6 +522,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "dreamer_v3", process_index=rank)
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
+    telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3")
 
     envs = make_vector_env(
         [
@@ -722,6 +725,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
+        telem.mark("rollout")
         # ---- action selection ----------------------------------------------
         blob_added = False
         if (
@@ -854,12 +858,14 @@ def main(argv: Sequence[str] | None = None) -> None:
                 if global_step == learning_starts
                 else args.gradient_steps
             )
+            telem.mark("buffer/sample")
             local_data = rb.sample(
                 args.per_rank_batch_size,
                 sequence_length=args.per_rank_sequence_length,
                 n_samples=n_samples,
             )
             staged = stage_batch(local_data, to_host=jax.process_count() > 1)
+            telem.mark("train/dispatch")
             for i in range(n_samples):
                 if gradient_steps % args.critic_target_network_update_freq == 0:
                     tau = 1.0 if gradient_steps == 0 else args.critic_tau
@@ -887,10 +893,11 @@ def main(argv: Sequence[str] | None = None) -> None:
                 expl_dev = jnp.float32(expl_amount)
             aggregator.update("Params/exploration_amount", expl_amount)
 
+        telem.mark("log")
         sps = (global_step - start_step + 1) * args.num_envs / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         aggregator.reset()
 
@@ -928,6 +935,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True),
         args, logger,
     )
+    telem.close()
     logger.close()
 
 

@@ -35,6 +35,7 @@ from ...data import AsyncReplayBuffer, stage_batch
 from ...envs import make_vector_env
 from ...envs.wrappers import RestartOnException
 from ...parallel import distributed_setup, make_decoupled_meshes, process_index
+from ...telemetry import Telemetry
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -101,6 +102,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
+    telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3_decoupled")
+    telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
         [
@@ -176,6 +179,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     player_weights = meshes.to_player(
         (state.world_model.encoder, state.world_model.rssm, state.actor)
     )
+    meshes.note_weights_applied()  # the setup copy is, by definition, applied
 
     def make_player(weights) -> PlayerDV3:
         encoder, rssm, p_actor = weights
@@ -277,6 +281,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     prev_metrics = None
     start_time = time.perf_counter()
     for global_step in range(start_step, num_updates + 1):
+        telem.mark("rollout")
         # ---- player: swap in refreshed weights if the transfer landed -------
         if pending_weights is not None:
             leaves = jax.tree_util.tree_leaves(pending_weights)
@@ -286,6 +291,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 player_weights = pending_weights
                 player = make_player(player_weights)
                 pending_weights = None
+                meshes.note_weights_applied()
 
         # ---- player: action selection ---------------------------------------
         if (
@@ -379,15 +385,18 @@ def main(argv: Sequence[str] | None = None) -> None:
                 if global_step == learning_starts
                 else args.gradient_steps
             )
+            telem.mark("buffer/sample")
             local_data = rb.sample(
                 args.per_rank_batch_size,
                 sequence_length=args.per_rank_sequence_length,
                 n_samples=n_samples,
             )
             staged = stage_batch(local_data, to_host=jax.process_count() > 1)
+            telem.mark("host_to_device")
             # ship the whole [n_samples, T, B] block to the trainer mesh,
             # batch axis sharded (the data path — ICI, typed pytree)
             staged = meshes.to_trainers(staged, axis=2)
+            telem.mark("train/dispatch")
             for i in range(n_samples):
                 if gradient_steps % args.critic_target_network_update_freq == 0:
                     tau = 1.0 if gradient_steps == 0 else args.critic_tau
@@ -421,10 +430,11 @@ def main(argv: Sequence[str] | None = None) -> None:
                 )
             aggregator.update("Params/exploration_amount", expl_amount)
 
+        telem.mark("log")
         sps = (global_step - start_step + 1) * args.num_envs / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         aggregator.reset()
 
@@ -471,6 +481,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         logger.log_dict(aggregator.compute(), num_updates)
         aggregator.reset()
     test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True)
+    telem.close()
     logger.close()
 
 

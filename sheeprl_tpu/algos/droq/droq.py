@@ -31,6 +31,8 @@ from ...parallel import (
     replicate,
     shard_batch,
 )
+from ...telemetry import Telemetry
+from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
     apply_eval_overrides,
@@ -136,7 +138,7 @@ def make_train_step(args: DROQArgs, qf_optim, actor_optim, alpha_optim):
             "Loss/alpha_loss": alpha_l,
         }
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return donating_jit(train_step, donate_argnums=(0,))
 
 
 @register_algorithm()
@@ -164,6 +166,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "droq", process_index=rank)
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
+    telem = Telemetry.from_args(args, log_dir, rank, algo="droq")
 
     envs = make_vector_env(
         [
@@ -259,6 +262,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
+        telem.mark("rollout")
         if global_step < learning_starts:
             actions = np.stack(
                 [envs.single_action_space.sample() for _ in range(args.num_envs)]
@@ -298,6 +302,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             )
             global_batch = args.per_rank_batch_size * n_dev
             for _ in range(training_steps):
+                telem.mark("buffer/sample")
                 sample = rb.sample(
                     args.gradient_steps * global_batch,
                     sample_next_obs=args.sample_next_obs,
@@ -317,13 +322,15 @@ def main(argv: Sequence[str] | None = None) -> None:
                     data = shard_batch(data, mesh, axis=1)
                     actor_batch = shard_batch(actor_batch, mesh, axis=0)
                 key, train_key = jax.random.split(key)
+                telem.mark("train/dispatch")
                 state, metrics = train_step(state, data, actor_batch, train_key)
             for name, val in metrics.items():
                 aggregator.update(name, val)
             profiler.tick()
 
+        telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         aggregator.reset()
         if (
@@ -354,4 +361,5 @@ def main(argv: Sequence[str] | None = None) -> None:
         )(), logger, args),
         args, logger,
     )
+    telem.close()
     logger.close()

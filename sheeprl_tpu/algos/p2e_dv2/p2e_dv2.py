@@ -41,6 +41,8 @@ from ...parallel import (
     scan_batch_spec,
     shard_time_batch,
 )
+from ...telemetry import Telemetry
+from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
     apply_eval_overrides,
@@ -519,7 +521,7 @@ def make_train_step(
         )
         return new_state, metrics
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return donating_jit(train_step, donate_argnums=(0,))
 
 
 @register_algorithm()
@@ -557,6 +559,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "p2e_dv2", process_index=rank)
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
+    telem = Telemetry.from_args(args, log_dir, rank, algo="p2e_dv2")
 
     envs = make_vector_env(
         [
@@ -768,6 +771,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
+        telem.mark("rollout")
         if is_exploring and global_step == exploration_updates:
             is_exploring = False
             player = make_player(state, exploring=False)
@@ -887,6 +891,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             else True
         )
         if global_step >= learning_starts and step_before_training <= 0 and can_sample:
+            telem.mark("buffer/sample")
             n_samples = (
                 args.pretrain_steps
                 if global_step == learning_starts and not args.dry_run
@@ -906,6 +911,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 )
             train_step = train_step_exploring if is_exploring else train_step_task
             staged = stage_batch(local_data, to_host=jax.process_count() > 1)
+            telem.mark("train/dispatch")
             for i in range(n_samples):
                 tau = 1.0 if gradient_steps % args.critic_target_network_update_freq == 0 else 0.0
                 sample = {k: v[i] for k, v in staged.items()}
@@ -929,10 +935,11 @@ def main(argv: Sequence[str] | None = None) -> None:
                 )
             aggregator.update("Params/exploration_amount", expl_amount)
 
+        telem.mark("log")
         sps = (global_step - start_step + 1) * single_global_step / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         aggregator.reset()
 
@@ -976,6 +983,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, "few-shot"),
         args, logger,
     )
+    telem.close()
     logger.close()
 
 

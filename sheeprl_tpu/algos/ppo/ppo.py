@@ -42,6 +42,8 @@ from ...parallel import (
     replicate,
     shard_batch,
 )
+from ...telemetry import Telemetry
+from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
     apply_eval_overrides,
@@ -173,7 +175,7 @@ def make_train_step(args: PPOArgs, optimizer, num_minibatches: int):
             "Loss/entropy_loss": ent,
         }
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return donating_jit(train_step, donate_argnums=(0,))
 
 
 @jax.jit
@@ -235,6 +237,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "ppo", process_index=rank)
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
+    telem = Telemetry.from_args(args, log_dir, rank, algo="ppo")
 
     envs = make_vector_env(
         [
@@ -311,6 +314,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         ) if args.anneal_ent_coef else args.ent_coef
 
         # ---- rollout hot loop ------------------------------------------------
+        telem.mark("rollout")
         for _ in range(args.rollout_steps):
             key, step_key = jax.random.split(key)
             device_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
@@ -355,6 +359,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
 
         # ---- GAE + one-jit update -------------------------------------------
+        telem.mark("host_to_device")
         data = {k: jnp.asarray(rb[k]) for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
         device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
         returns, advantages = compute_gae_returns(
@@ -370,6 +375,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         if n_dev > 1:
             flat = shard_batch(flat, mesh)
         key, train_key = jax.random.split(key)
+        telem.mark("train/dispatch")
         state, metrics = train_step(
             state, flat, train_key,
             jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
@@ -379,8 +385,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         profiler.tick()
 
         # ---- logging + checkpoint -------------------------------------------
+        telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         logger.log("Info/learning_rate", lr, global_step)
         aggregator.reset()
@@ -403,4 +410,5 @@ def main(argv: Sequence[str] | None = None) -> None:
         )(), logger, args),
         args, logger,
     )
+    telem.close()
     logger.close()

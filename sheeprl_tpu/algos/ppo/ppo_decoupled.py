@@ -26,6 +26,7 @@ from ... import ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import distributed_setup, make_decoupled_meshes, process_index
+from ...telemetry import Telemetry
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -76,6 +77,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "ppo_decoupled", process_index=rank)
     profiler = StepProfiler.from_args(args, log_dir, rank)
     logger.log_hyperparams(args.as_dict())
+    telem = Telemetry.from_args(args, log_dir, rank, algo="ppo_decoupled")
+    telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
         [
@@ -116,6 +119,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     # trainers hold the replicated train state; the player holds a policy copy
     state = meshes.replicated_on_trainers(state)
     player_agent = meshes.to_player(state.agent)
+    meshes.note_weights_applied()  # the setup copy is, by definition, applied
 
     rollout_and_train_size = args.rollout_steps * args.num_envs
     num_updates = (
@@ -159,6 +163,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         ) if args.anneal_ent_coef else args.ent_coef
 
         # ---- player: swap in new weights if the transfer landed -------------
+        telem.mark("rollout")
         if pending_agent is not None:
             leaves = jax.tree_util.tree_leaves(pending_agent)
             if update == num_updates or all(
@@ -166,6 +171,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             ):
                 player_agent = pending_agent
                 pending_agent = None
+                meshes.note_weights_applied()
 
         # ---- player: rollout (overlaps the in-flight trainer update) --------
         for _ in range(args.rollout_steps):
@@ -204,6 +210,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
 
         # ---- player: GAE, then ship the rollout to the trainer mesh ---------
+        telem.mark("host_to_device")
         data = {
             k: jnp.asarray(rb[k])
             for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")
@@ -222,6 +229,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         flat = meshes.to_trainers(flat)  # the data path (ICI, typed pytree)
 
         # ---- trainers: async-dispatched single-jit update -------------------
+        telem.mark("train/dispatch")
         key, train_key = jax.random.split(key)
         state, metrics = train_step(
             state, flat, train_key,
@@ -239,8 +247,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         profiler.tick()
         prev_metrics = metrics
 
+        telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         logger.log("Info/learning_rate", lr, global_step)
         aggregator.reset()
@@ -267,6 +276,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
     )()
     test(player_agent, test_env, logger, args)
+    telem.close()
     logger.close()
 
 

@@ -40,6 +40,8 @@ from ...parallel import (
     replicate,
     shard_batch,
 )
+from ...telemetry import Telemetry
+from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
     apply_eval_overrides,
@@ -133,7 +135,7 @@ def make_train_step(args: RecurrentPPOArgs, optimizer, seq_len: int, num_minibat
             "Loss/entropy_loss": ent,
         }
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return donating_jit(train_step, donate_argnums=(0,))
 
 
 def _to_windows(data: dict, seq_len: int) -> dict:
@@ -192,6 +194,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "ppo_recurrent", process_index=rank)
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
+    telem = Telemetry.from_args(args, log_dir, rank, algo="ppo_recurrent")
 
     envs = make_vector_env(
         [
@@ -288,6 +291,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         ) if args.anneal_ent_coef else args.ent_coef
 
         # ---- rollout hot loop ------------------------------------------------
+        telem.mark("rollout")
         for _ in range(args.rollout_steps):
             key, step_key = jax.random.split(key)
             dev_obs = jnp.asarray(next_obs)
@@ -334,6 +338,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                     aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
 
         # ---- GAE + one-jit update -------------------------------------------
+        telem.mark("host_to_device")
         data = {
             k: jnp.asarray(rb[k])
             for k in (
@@ -358,6 +363,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         if n_dev > 1:
             windows = shard_batch(windows, mesh, axis=1)
         key, train_key = jax.random.split(key)
+        telem.mark("train/dispatch")
         state, metrics = train_step(
             state, windows, train_key,
             jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
@@ -366,8 +372,9 @@ def main(argv: Sequence[str] | None = None) -> None:
             aggregator.update(name, val)
         profiler.tick()
 
+        telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         logger.log("Info/learning_rate", lr, global_step)
         aggregator.reset()
@@ -394,6 +401,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         )(), logger, args, obs_key),
         args, logger,
     )
+    telem.close()
     logger.close()
 
 

@@ -23,6 +23,7 @@ import numpy as np
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import distributed_setup, make_decoupled_meshes, process_index
+from ...telemetry import Telemetry
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_env
 from ...utils.logger import create_logger
@@ -65,6 +66,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "sac_decoupled", process_index=rank)
     profiler = StepProfiler.from_args(args, log_dir, rank)
     logger.log_hyperparams(args.as_dict())
+    telem = Telemetry.from_args(args, log_dir, rank, algo="sac_decoupled")
+    telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
         [
@@ -138,6 +141,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     # trainers hold the replicated train state; the player holds an actor copy
     state = meshes.replicated_on_trainers(state)
     player_actor = meshes.to_player(state.agent.actor)
+    meshes.note_weights_applied()  # the setup copy is, by definition, applied
 
     aggregator = MetricAggregator()
     num_updates = (
@@ -157,11 +161,13 @@ def main(argv: Sequence[str] | None = None) -> None:
     prev_metrics = None
     for global_step in range(start_step, num_updates + 1):
         # ---- player: swap in new actor weights if the transfer landed -------
+        telem.mark("rollout")
         if pending_actor is not None:
             leaves = jax.tree_util.tree_leaves(pending_actor)
             if all(leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready")):
                 player_actor = pending_actor
                 pending_actor = None
+                meshes.note_weights_applied()
 
         # ---- player: interaction + buffer -----------------------------------
         if global_step < learning_starts:
@@ -201,6 +207,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             )
             global_batch = args.per_rank_batch_size * meshes.num_trainers
             for _ in range(training_steps):
+                telem.mark("buffer/sample")
                 sample = rb.sample(
                     args.gradient_steps * global_batch,
                     sample_next_obs=args.sample_next_obs,
@@ -214,6 +221,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 data = meshes.to_trainers(data, axis=1)  # the data path (ICI)
                 key, train_key = jax.random.split(key)
                 do_ema = jnp.asarray(global_step % args.target_network_frequency == 0)
+                telem.mark("train/dispatch")
                 state, metrics = train_step(state, data, train_key, do_ema)
             # the weight path: refreshed actor streams back to the player
             # device behind the update; consumed when ready
@@ -226,8 +234,9 @@ def main(argv: Sequence[str] | None = None) -> None:
             profiler.tick()
             prev_metrics = metrics
 
+        telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         aggregator.reset()
         if (
@@ -261,6 +270,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         args.env_id, args.seed, 0, args.capture_video, run_name=log_dir, prefix="test"
     )()
     test(state.agent.actor, test_env, logger, args)
+    telem.close()
     logger.close()
 
 

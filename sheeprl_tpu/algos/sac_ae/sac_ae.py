@@ -37,6 +37,8 @@ from ...parallel import (
     replicate,
     shard_batch,
 )
+from ...telemetry import Telemetry
+from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
     apply_eval_overrides,
@@ -247,7 +249,7 @@ def make_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
             "Loss/reconstruction_loss": jnp.mean(recon_l),
         }
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return donating_jit(train_step, donate_argnums=(0,))
 
 
 def make_split_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
@@ -268,7 +270,7 @@ def make_split_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
     normalize = _make_normalize(cnn_keys, mlp_keys)
     actor_loss_fn, recon_loss_fn = _make_loss_fns(args, cnn_keys, mlp_keys)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    @partial(donating_jit, donate_argnums=(0, 1))
     def critic_step(agent, qf_opt, batch, key):
         obs = normalize(batch)
         next_obs = normalize(batch, "next_")
@@ -284,11 +286,11 @@ def make_split_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
         agent = agent.replace(critic=optax.apply_updates(agent.critic, qf_updates))
         return agent, qf_opt, qf_l
 
-    @partial(jax.jit, donate_argnums=(0,))
+    @partial(donating_jit, donate_argnums=(0,))
     def ema_step(agent):
         return agent.critic_target_ema(True)
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    @partial(donating_jit, donate_argnums=(0, 1, 2))
     def actor_alpha_step(agent, actor_opt, alpha_opt, batch, key):
         obs = normalize(batch)
         # the SHARED loss body (value_and_grad differentiates arg 0 only):
@@ -314,7 +316,7 @@ def make_split_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
         )
         return agent, actor_opt, alpha_opt, actor_l, alpha_l
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    @partial(donating_jit, donate_argnums=(0, 1, 2, 3))
     def recon_step(agent, decoder, encoder_opt, decoder_opt, batch, key):
         obs = normalize(batch)
         recon_l, (enc_grads, dec_grads) = jax.value_and_grad(recon_loss_fn)(
@@ -424,6 +426,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger, log_dir, run_name = create_logger(args, "sac_ae", process_index=rank)
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
+    telem = Telemetry.from_args(args, log_dir, rank, algo="sac_ae")
 
     envs = make_vector_env(
         [
@@ -565,6 +568,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
+        telem.mark("rollout")
         if global_step < learning_starts:
             actions = np.stack(
                 [envs.single_action_space.sample() for _ in range(args.num_envs)]
@@ -635,6 +639,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             )
             global_batch = args.per_rank_batch_size * n_dev
             for _ in range(training_steps):
+                telem.mark("buffer/sample")
                 sample = rb.sample(
                     args.gradient_steps * global_batch,
                     sample_next_obs=args.sample_next_obs,
@@ -648,6 +653,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 if n_dev > 1:
                     data = shard_batch(data, mesh, axis=1)
                 key, train_key = jax.random.split(key)
+                telem.mark("train/dispatch")
                 state, metrics = train_step(
                     state, data, train_key,
                     jnp.asarray(global_step % args.target_network_frequency == 0),
@@ -658,8 +664,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                 aggregator.update(name, val)
             profiler.tick()
 
+        telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(aggregator.compute(), global_step)
+        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
         logger.log("Time/step_per_second", sps, global_step)
         aggregator.reset()
         if (
@@ -693,4 +700,5 @@ def main(argv: Sequence[str] | None = None) -> None:
         )(), logger, args, cnn_keys, mlp_keys),
         args, logger,
     )
+    telem.close()
     logger.close()

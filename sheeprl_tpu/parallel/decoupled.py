@@ -25,6 +25,7 @@ rest; the `device_put`s ride ICI/DCN.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -38,11 +39,27 @@ __all__ = ["DecoupledMeshes", "make_decoupled_meshes"]
 
 
 class DecoupledMeshes:
-    """Player device + trainer mesh with the data/weight transfer helpers."""
+    """Player device + trainer mesh with the data/weight transfer helpers.
+
+    The transfer paths keep telemetry counters (ISSUE 2): data/weight
+    transfer counts and byte volumes, plus the weight pipeline's
+    queue-depth (weight versions shipped to the player but not yet swapped
+    in) and staleness (updates the player's current weights are behind the
+    trainers) — the numbers that tell an overlapped run whether its player
+    is starving or training on ancient policies. Mains surface them by
+    registering `telemetry_gauges` with their Telemetry instance and calling
+    `note_weights_applied()` where they swap a landed transfer in."""
 
     def __init__(self, player_device, trainer_mesh: Mesh):
         self.player_device = player_device
         self.trainer_mesh = trainer_mesh
+        self._to_trainer_transfers = 0
+        self._to_trainer_bytes = 0
+        self._to_player_transfers = 0
+        self._to_player_bytes = 0
+        self._weights_shipped = 0
+        self._weights_applied = 0
+        self._last_applied_ts: float | None = None
 
     @property
     def num_trainers(self) -> int:
@@ -66,8 +83,10 @@ class DecoupledMeshes:
                 idx = [slice(None)] * x.ndim
                 idx[axis] = np.arange(size, size + n - rem) % size
                 x = jnp.concatenate([x, x[tuple(idx)]], axis=axis)
+            self._to_trainer_bytes += getattr(x, "nbytes", 0)
             return jax.device_put(x, sharding)
 
+        self._to_trainer_transfers += 1
         return jax.tree_util.tree_map(put, batch)
 
     def replicated_on_trainers(self, tree: Any) -> Any:
@@ -79,9 +98,42 @@ class DecoupledMeshes:
     def to_player(self, tree: Any) -> Any:
         """Ship (updated) params to the player device — the weight path
         (replacing the flattened-vector broadcast, ppo_decoupled.py:304-307)."""
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, self.player_device), tree
-        )
+        self._to_player_transfers += 1
+        self._weights_shipped += 1
+
+        def put(x):
+            self._to_player_bytes += getattr(x, "nbytes", 0)
+            return jax.device_put(x, self.player_device)
+
+        return jax.tree_util.tree_map(put, tree)
+
+    def note_weights_applied(self) -> None:
+        """Record that the player swapped in the most recent landed weight
+        transfer: staleness is measured against versions shipped SINCE."""
+        self._weights_applied = self._weights_shipped
+        self._last_applied_ts = time.monotonic()
+
+    def telemetry_gauges(self) -> dict[str, float]:
+        """Queue-depth/staleness + transfer-volume gauges for Telemetry
+        (`telem.add_gauges(meshes.telemetry_gauges)`)."""
+        return {
+            "Decoupled/data_transfers": float(self._to_trainer_transfers),
+            "Decoupled/data_mb_total": self._to_trainer_bytes / 2**20,
+            "Decoupled/weight_transfers": float(self._to_player_transfers),
+            "Decoupled/weight_mb_total": self._to_player_bytes / 2**20,
+            # weight versions in flight: shipped to the player but not yet
+            # swapped in (a growing queue means the player never catches up)
+            "Decoupled/weight_queue_depth": float(
+                self._weights_shipped - self._weights_applied
+            ),
+            # wall-clock age of the player's current weights (seconds since
+            # the last swap; 0.0 until the first swap happens)
+            "Decoupled/weight_staleness_s": (
+                0.0
+                if self._last_applied_ts is None
+                else time.monotonic() - self._last_applied_ts
+            ),
+        }
 
 
 def make_decoupled_meshes(

@@ -1,0 +1,21 @@
+"""Runtime telemetry subsystem (ISSUE 2): always-on phase timers, XLA
+recompile/memory tracking, a NaN/inf watchdog, and a rank-0 structured JSONL
+event log with console heartbeat — shared by every algorithm main. See
+howto/observability.md for the schema and `tools/telemetry_report.py` for
+offline analysis of a finished or crashed run."""
+
+from .compile_tracker import CompileTracker, monitoring_supported
+from .core import Telemetry, active_telemetry, device_memory_gauges, emit
+from .events import JsonlEventLog
+from .phase import PhaseTimers
+
+__all__ = [
+    "CompileTracker",
+    "JsonlEventLog",
+    "PhaseTimers",
+    "Telemetry",
+    "active_telemetry",
+    "device_memory_gauges",
+    "emit",
+    "monitoring_supported",
+]

@@ -1,0 +1,312 @@
+"""The run-wide telemetry orchestrator every algorithm main constructs.
+
+Always-on, low-overhead observability (ISSUE 2 tentpole): hierarchical phase
+timers, an XLA recompile tracker, device-memory gauges, a NaN/inf watchdog
+over the logged metrics, and a rank-0 JSONL event log with a periodic
+one-line console heartbeat. A main wires it in ~3 calls:
+
+    telem = Telemetry.from_args(args, log_dir, rank, algo="ppo")
+    ...
+    telem.mark("rollout")            # or: with telem.phase("rollout"): ...
+    ...
+    logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), step)
+    ...
+    telem.close()
+
+`interval()` merges everything the subsystem measured since the last call
+into the metric dict (so the phase/compile/memory series ride the existing
+TensorBoard pipeline with no extra logger calls), appends the merged dict to
+`<log_dir>/telemetry.jsonl`, runs the non-finite watchdog, and prints the
+heartbeat when due. Everything is host-side bookkeeping — no device syncs,
+no jit retraces — so the instrumented hot loop stays within noise of the
+uninstrumented one (bench.py --telemetry A/B + the overhead smoke test are
+the receipts).
+
+Kill switch: SHEEPRL_TPU_TELEMETRY=0 disables the subsystem (interval()
+passes metrics through untouched); non-rank-0 processes keep the timers (the
+merged dict goes to their no-op logger anyway) but never write JSONL or
+heartbeat lines.
+"""
+
+from __future__ import annotations
+
+import atexit
+import math
+import os
+import sys
+import time
+import traceback
+from typing import Any, Callable, Iterator
+
+from .compile_tracker import CompileTracker
+from .events import JsonlEventLog
+from .phase import PhaseTimers
+
+__all__ = ["Telemetry", "emit", "active_telemetry", "device_memory_gauges"]
+
+# ---------------------------------------------------------------------------
+# Global emit: shared helpers that should not depend on a Telemetry handle
+# (save_checkpoint, StepProfiler) publish lifecycle events through here; they
+# reach every live instance (normally exactly one per process).
+# ---------------------------------------------------------------------------
+
+_active: list["Telemetry"] = []
+
+
+def active_telemetry() -> list["Telemetry"]:
+    return list(_active)
+
+
+def emit(event: str, **data: Any) -> None:
+    """Publish a lifecycle event to every active Telemetry instance; no-op
+    when none is live (tools, tests, bare library use)."""
+    for t in list(_active):
+        t.event(event, **data)
+
+
+# last uncaught exception, captured so the atexit crash event can name it
+_last_exc: list[str] = []
+_excepthook_installed = False
+
+
+def _install_excepthook() -> None:
+    global _excepthook_installed
+    if _excepthook_installed:
+        return
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        _last_exc[:] = ["".join(traceback.format_exception_only(exc_type, exc)).strip()]
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = hook
+    _excepthook_installed = True
+
+
+def device_memory_gauges() -> dict[str, float]:
+    """Per-local-device HBM gauges from `device.memory_stats()`:
+    bytes_in_use + peak_bytes_in_use (CPU devices report none — empty dict)."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return {}
+    out: dict[str, float] = {}
+    for i, d in enumerate(devices):
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for src, dst in (
+            ("bytes_in_use", f"Memory/d{i}_bytes_in_use"),
+            ("peak_bytes_in_use", f"Memory/d{i}_peak_bytes_in_use"),
+        ):
+            if src in stats:
+                out[dst] = float(stats[src])
+    return out
+
+
+class Telemetry:
+    FILENAME = "telemetry.jsonl"
+
+    def __init__(
+        self,
+        log_dir: str | None,
+        rank: int = 0,
+        algo: str = "",
+        enabled: bool = True,
+        heartbeat_s: float = 30.0,
+    ):
+        self.enabled = enabled
+        self.rank = rank
+        self.algo = algo
+        self.heartbeat_s = heartbeat_s
+        self.timers = PhaseTimers()
+        self._gauge_sources: list[Callable[[], dict[str, float]]] = []
+        self._last_step: int | None = None
+        self._last_heartbeat = time.monotonic()
+        self._last_jsonl_log = 0.0
+        self._last_nan_warn = 0.0
+        self._closed = not enabled
+        self._compiles = CompileTracker()
+        write_jsonl = enabled and rank == 0 and log_dir is not None
+        self._log = JsonlEventLog(
+            os.path.join(log_dir, self.FILENAME) if write_jsonl else None
+        )
+        if enabled:
+            self._compiles.attach()
+            _install_excepthook()
+            atexit.register(self._atexit)
+            _active.append(self)
+
+    # ---- construction policy ---------------------------------------------
+    @classmethod
+    def from_args(
+        cls, args: Any, log_dir: str, rank: int = 0, algo: str = ""
+    ) -> "Telemetry":
+        """The mains' shared construction helper: always-on unless
+        SHEEPRL_TPU_TELEMETRY=0, JSONL/heartbeat on process 0 only, and a
+        `start` lifecycle event carrying the run identity. Checkpoint and
+        profile-window lifecycle events arrive via the module-level `emit`
+        (save_checkpoint / StepProfiler publish them directly)."""
+        enabled = os.environ.get("SHEEPRL_TPU_TELEMETRY", "1") != "0"
+        telem = cls(log_dir, rank=rank, algo=algo, enabled=enabled)
+        if enabled:
+            try:
+                import jax
+
+                backend = jax.default_backend()
+                n_local = len(jax.local_devices())
+            except Exception:
+                backend, n_local = "unknown", 0
+            telem.event(
+                "start",
+                algo=algo,
+                env_id=getattr(args, "env_id", None),
+                seed=getattr(args, "seed", None),
+                num_envs=getattr(args, "num_envs", None),
+                precision=getattr(args, "precision", None),
+                backend=backend,
+                local_devices=n_local,
+                rank=rank,
+                log_dir=log_dir,
+                compile_tracking=telem._compiles.supported,
+            )
+        return telem
+
+    # ---- phase timing -----------------------------------------------------
+    def phase(self, name: str) -> Iterator[None]:
+        return self.timers.phase(name)
+
+    def mark(self, name: str | None) -> None:
+        if self.enabled:
+            self.timers.mark(name)
+
+    # ---- gauges / events --------------------------------------------------
+    def add_gauges(self, source: Callable[[], dict[str, float]]) -> None:
+        """Register a callable polled at every interval (e.g. the decoupled
+        topology's queue-depth/staleness gauges)."""
+        self._gauge_sources.append(source)
+
+    def event(self, name: str, **data: Any) -> None:
+        self._log.emit(name, **data)
+
+    # ---- the per-logging-interval merge ----------------------------------
+    def interval(
+        self, metrics: dict[str, Any], step: int, sps: float | None = None
+    ) -> dict[str, Any]:
+        """Merge this interval's telemetry into `metrics` (returned as a new
+        dict), append the JSONL `log` event, run the NaN watchdog, and print
+        the heartbeat when due. Call once per logging interval, BEFORE
+        `logger.log_dict`."""
+        if not self.enabled:
+            return metrics
+        out = dict(metrics)
+        dstep = None if self._last_step is None else step - self._last_step
+        for name, secs in self.timers.flush().items():
+            out[f"Time/{name}_seconds"] = secs
+            if dstep and secs > 0.0:
+                out[f"Time/{name}_sps"] = dstep / secs
+        if self._compiles.supported:
+            comp = self._compiles.flush()
+            out["XLA/recompiles"] = comp["compiles"]
+            out["XLA/compile_seconds"] = comp["compile_seconds"]
+            out["XLA/total_compiles"] = comp["total_compiles"]
+            out["XLA/total_compile_seconds"] = comp["total_compile_seconds"]
+        out.update(device_memory_gauges())
+        for source in self._gauge_sources:
+            try:
+                out.update(source())
+            except Exception:
+                pass  # a gauge source must never kill the loop
+        self._nan_watchdog(out, step)
+        self._last_step = step
+        now = time.monotonic()
+        # JSONL: every interval that carries real metrics, throttled to the
+        # heartbeat cadence for metric-less intervals (the dreamer family
+        # calls interval() every env step; most carry only phase time)
+        if metrics or (now - self._last_jsonl_log) >= self.heartbeat_s:
+            payload = dict(out)
+            if sps is not None:
+                payload["Time/step_per_second"] = sps
+            self.event("log", step=step, metrics=payload)
+            self._last_jsonl_log = now
+        if self.rank == 0 and (now - self._last_heartbeat) >= self.heartbeat_s:
+            self._heartbeat(out, step, sps)
+            self._last_heartbeat = now
+        return out
+
+    # ---- internals --------------------------------------------------------
+    def _nan_watchdog(self, merged: dict[str, Any], step: int) -> None:
+        bad = {}
+        for k, v in merged.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                bad[k] = repr(v)
+        if not bad:
+            return
+        merged["Health/nonfinite_metrics"] = float(len(bad))
+        self.event("health.nan", step=step, keys=sorted(bad), values=bad)
+        now = time.monotonic()
+        if self.rank == 0 and now - self._last_nan_warn >= self.heartbeat_s:
+            print(
+                f"[telemetry {self.algo}] WARNING: non-finite metrics at "
+                f"step {step}: {sorted(bad)}",
+                file=sys.stderr,
+            )
+            self._last_nan_warn = now
+
+    def _heartbeat(self, merged: dict[str, Any], step: int, sps: float | None) -> None:
+        phases = {
+            k[len("Time/"):-len("_seconds")]: v
+            for k, v in merged.items()
+            if k.startswith("Time/") and k.endswith("_seconds")
+        }
+        total = sum(phases.values())
+        if total > 0:
+            top = sorted(phases.items(), key=lambda kv: -kv[1])[:4]
+            breakdown = " ".join(f"{n} {100 * s / total:.0f}%" for n, s in top)
+        else:
+            breakdown = "-"
+        bits = [f"[telemetry {self.algo}] step={step}"]
+        if sps is not None:
+            bits.append(f"sps={sps:.1f}")
+        bits.append(f"| {breakdown}")
+        if "XLA/total_compiles" in merged:
+            bits.append(
+                f"| compiles={merged['XLA/total_compiles']:.0f} "
+                f"({merged['XLA/total_compile_seconds']:.1f}s)"
+            )
+        mem = [v for k, v in merged.items() if k.endswith("_bytes_in_use")]
+        if mem:
+            bits.append(f"| mem={sum(mem) / 2**30:.2f}GiB")
+        print(" ".join(bits), file=sys.stderr)
+
+    # ---- lifecycle --------------------------------------------------------
+    def _atexit(self) -> None:
+        if not self._closed:
+            self.event(
+                "crash",
+                error=_last_exc[0] if _last_exc else "process exited without close()",
+            )
+            self._teardown()
+
+    def close(self) -> None:
+        """Normal end-of-run teardown: flush open phases, emit `end`."""
+        if self._closed:
+            return
+        self.event("end", phases=self.timers.flush())
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:
+            pass
+        self._teardown()
+
+    def _teardown(self) -> None:
+        self._closed = True
+        self._compiles.detach()
+        self._log.close()
+        if self in _active:
+            _active.remove(self)

@@ -1,0 +1,78 @@
+"""Rank-0 structured JSONL event log (`<log_dir>/telemetry.jsonl`).
+
+One self-describing line per event — run lifecycle (`start`, `checkpoint`,
+`profile.start/stop`, `end`, `crash`), every logged metric dict (`log`), and
+health findings (`health.nan`) — so a finished OR crashed run can be
+reconstructed offline by `tools/telemetry_report.py` without TensorBoard.
+Schema (stable, consumed by the report tool and tests):
+
+    {"ts": <unix seconds>, "event": "<name>", ...event payload}
+    {"ts": ..., "event": "log", "step": 123, "metrics": {"Loss/x": 0.1, ...}}
+
+Writes are a single `write()` of one line + flush: atomic enough for a
+line-oriented append-only file on POSIX, and a crash mid-run loses at most
+the event being written. Non-rank-0 processes construct the writer disabled
+(path=None) — same rank-0-only policy as TensorBoardLogger.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any
+
+__all__ = ["JsonlEventLog"]
+
+
+def _jsonable(value: Any):
+    """Best-effort scalarization: metric dicts carry floats/ints/strings;
+    device scalars and numpy types get float()'d, non-finite floats become
+    strings (json.dumps would otherwise emit bare NaN/Infinity tokens that
+    strict parsers — including the replay path — reject)."""
+    if isinstance(value, bool) or value is None or isinstance(value, (int, str)):
+        return value
+    if isinstance(value, float):
+        return value if math.isfinite(value) else repr(value)
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    try:
+        return _jsonable(float(value))
+    except Exception:
+        return repr(value)
+
+
+class JsonlEventLog:
+    def __init__(self, path: str | None):
+        self.path = path
+        self._fh = None
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", encoding="utf-8")
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def emit(self, event: str, **data: Any) -> None:
+        if self._fh is None:
+            return
+        record = {"ts": round(time.time(), 3), "event": event}
+        record.update({k: _jsonable(v) for k, v in data.items()})
+        try:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        except (OSError, ValueError):
+            # a full disk or a closed fd must never kill the training loop
+            pass
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None

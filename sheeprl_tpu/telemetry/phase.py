@@ -1,0 +1,77 @@
+"""Hierarchical phase timers — the always-on answer to "where did the step
+go?" that the reference's single `Time/step_per_second` scalar cannot give
+(it has ONE wall-clock ratio, reference ppo.py:372; a slow run is opaque).
+
+Two usage styles over one accumulator:
+
+  - `with timers.phase("train"):` — nestable context manager; nested phases
+    get hierarchical names (`train/dispatch`), time is attributed to BOTH
+    the child and its parent (the parent's span covers the child). Exception
+    safe: the time up to the raise is still recorded.
+  - `timers.mark("rollout")` — linear sectioning for the mains' top-level
+    loops, where wrapping a 60-line hot loop in a `with` block would force a
+    re-indent of the whole body: each mark ends the previous marked section
+    and opens the named one; `mark(None)` just ends.
+
+`flush()` returns the accumulated seconds per phase since the last flush and
+restarts any phase that is still open (an open phase contributes its elapsed
+time to the flushed interval and keeps running), so per-interval sums never
+lose or double-count time across logging intervals.
+
+Overhead: one `perf_counter()` call and a dict add per transition — tens of
+nanoseconds to ~1us, invisible next to an env step or a jit dispatch.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+__all__ = ["PhaseTimers"]
+
+
+class PhaseTimers:
+    def __init__(self) -> None:
+        self._acc: dict[str, float] = {}
+        # context-manager nesting stack: (full_name, start_time)
+        self._stack: list[tuple[str, float]] = []
+        # linear mark() section: (name, start_time) or None
+        self._mark: tuple[str, float] | None = None
+
+    # ---- context-manager style -------------------------------------------
+    @contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        full = f"{self._stack[-1][0]}/{name}" if self._stack else name
+        self._stack.append((full, time.perf_counter()))
+        try:
+            yield
+        finally:
+            fname, t0 = self._stack.pop()
+            self._acc[fname] = self._acc.get(fname, 0.0) + (time.perf_counter() - t0)
+
+    # ---- linear sectioning ------------------------------------------------
+    def mark(self, name: str | None) -> None:
+        """End the current marked section (if any) and open `name`."""
+        now = time.perf_counter()
+        if self._mark is not None:
+            prev, t0 = self._mark
+            self._acc[prev] = self._acc.get(prev, 0.0) + (now - t0)
+        self._mark = (name, now) if name is not None else None
+
+    # ---- interval flush ---------------------------------------------------
+    def flush(self) -> dict[str, float]:
+        """Accumulated seconds per phase since the last flush. Open phases
+        (mark sections or live context managers) contribute their elapsed
+        time and restart at now."""
+        now = time.perf_counter()
+        out = dict(self._acc)
+        self._acc.clear()
+        if self._mark is not None:
+            name, t0 = self._mark
+            out[name] = out.get(name, 0.0) + (now - t0)
+            self._mark = (name, now)
+        for i, (name, t0) in enumerate(self._stack):
+            out[name] = out.get(name, 0.0) + (now - t0)
+            self._stack[i] = (name, now)
+        return out

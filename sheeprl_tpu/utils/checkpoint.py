@@ -74,6 +74,14 @@ def save_checkpoint(
         # framework, so the local replica is the complete value
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
             return np.asarray(x.addressable_data(0))
+        # snapshot EVERY device array to host before handing it to orbax's
+        # async writer: the training loop's next `donate_argnums` update
+        # donates (frees) these same buffers while TensorStore may still be
+        # serializing them — a use-after-free observed as heap corruption
+        # in resumed/checkpointing runs. The copy also freezes checkpoint
+        # consistency at save-call time.
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
         return x
 
     state = jax.tree_util.tree_map(_to_host, state)
@@ -86,18 +94,37 @@ def save_checkpoint(
         cfg = args.as_dict() if hasattr(args, "as_dict") else dict(args)
         with open(path + ".args.json", "w") as fh:
             json.dump(cfg, fh)
+    # run-lifecycle record in <log_dir>/telemetry.jsonl (no-op without an
+    # active Telemetry): a post-mortem can tell which checkpoints a crashed
+    # run actually committed
+    from ..telemetry import emit
+
+    emit("checkpoint", path=path, blocking=block)
 
 
 def load_checkpoint(path: str, template: dict[str, Any] | None = None) -> dict[str, Any]:
     """Restore a checkpoint. With `template` (a pytree of the same structure,
     e.g. freshly-initialized models), leaves are restored into the template's
-    types (Module dataclasses stay Modules); without it, raw nested dicts."""
+    types (Module dataclasses stay Modules); without it, raw nested dicts.
+
+    Restored jax.Array leaves are copied into jax-owned buffers before being
+    returned: orbax/TensorStore hands back arrays over ITS allocations, and
+    the train steps' `donate_argnums` would otherwise have XLA free memory
+    its allocator does not own — observed as heap corruption ("corrupted
+    double-linked list" / segfaults) in every resumed-training run on the
+    CPU backend whenever the donated executable came out of the persistent
+    compilation cache. One extra copy at restore time is noise next to the
+    restore itself."""
+    import jax
+    import jax.numpy as jnp
+
     wait_checkpoint()  # never read past an in-flight save
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
-    if template is None:
-        return ckptr.restore(path)
-    return ckptr.restore(path, template)
+    restored = ckptr.restore(path) if template is None else ckptr.restore(path, template)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.array(x) if isinstance(x, jax.Array) else x, restored
+    )
 
 
 def load_checkpoint_args(path: str) -> dict[str, Any] | None:

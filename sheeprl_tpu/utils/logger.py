@@ -44,10 +44,16 @@ class TensorBoardLogger:
             self.log(k, v, step)
 
     def log_hyperparams(self, params: dict[str, Any]) -> None:
+        # TensorBoard's text plugin renders markdown: a proper two-column
+        # table instead of one run-on text blob (pipes in values would break
+        # the row structure, so they are escaped)
         if self._writer is not None:
+            escaped = [
+                (k, str(v).replace("|", "\\|")) for k, v in sorted(params.items())
+            ]
+            rows = "\n".join(f"| {k} | {v} |" for k, v in escaped)
             self._writer.add_text(
-                "hyperparams",
-                "\n".join(f"    {k}: {v}" for k, v in sorted(params.items())),
+                "hyperparams", "| key | value |\n| --- | --- |\n" + rows
             )
 
     def close(self) -> None:

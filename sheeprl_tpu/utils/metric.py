@@ -53,10 +53,19 @@ class MeanMetric:
 class MovingAverageMetric:
     """Windowed statistics over the last `window` values
     (reference MovingAverageMetric, metric.py:70-137). Values are kept raw
-    (possibly device scalars) and pulled at compute() time."""
+    (possibly device scalars) and pulled at compute() time.
 
-    def __init__(self, window: int = 100) -> None:
+    `reset_on_compute=False` (the default): the window SURVIVES the
+    aggregator's per-logging-interval reset — a windowed moving average that
+    is wiped every interval degenerates into an interval mean, which is
+    exactly the bug the flag exists to prevent. An explicit `.reset()` call
+    still clears."""
+
+    reset_on_compute = False
+
+    def __init__(self, window: int = 100, reset_on_compute: bool = False) -> None:
         self._window = deque(maxlen=window)
+        self.reset_on_compute = reset_on_compute
 
     def pending(self) -> list[Any]:
         return list(self._window)
@@ -115,6 +124,11 @@ class MetricAggregator:
                 out[name] = val
         return out
 
-    def reset(self) -> None:
+    def reset(self, force: bool = False) -> None:
+        """Per-logging-interval reset. Metrics that declare
+        `reset_on_compute = False` (windowed moving averages) keep their
+        state across intervals; `force=True` clears everything (end-of-run
+        teardown)."""
         for metric in self.metrics.values():
-            metric.reset()
+            if force or getattr(metric, "reset_on_compute", True):
+                metric.reset()

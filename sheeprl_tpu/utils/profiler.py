@@ -60,6 +60,9 @@ class StepProfiler:
             jax.profiler.start_trace(self._dir)
             self._running = True
             atexit.register(self.close)
+            from ..telemetry import emit
+
+            emit("profile.start", dir=self._dir, steps=self._steps)
             return
         self._seen += 1
         if self._seen >= self._steps:
@@ -91,3 +94,6 @@ class StepProfiler:
                 jax.profiler.stop_trace()
             finally:
                 self._done = True
+                from ..telemetry import emit
+
+                emit("profile.stop", dir=self._dir)

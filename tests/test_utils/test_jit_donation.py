@@ -1,0 +1,50 @@
+"""donating_jit: donation must be dropped in the known-corrupting
+configuration (CPU backend + persistent compilation cache — the tier-1
+environment, where deserialized donating executables corrupted the heap)
+and honor the SHEEPRL_TPU_DONATE override in both directions."""
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.jit import donating_jit, donation_safe
+
+
+def test_donation_disabled_under_cpu_with_persistent_cache(monkeypatch):
+    monkeypatch.delenv("SHEEPRL_TPU_DONATE", raising=False)
+    # conftest wires the persistent cache; this suite runs on CPU
+    assert jax.default_backend() == "cpu"
+    if jax.config.jax_compilation_cache_dir:
+        assert donation_safe() is False
+    x = jnp.ones((4,))
+    f = donating_jit(lambda a: a * 2, donate_argnums=(0,))
+    y = f(x)
+    # without donation the input buffer stays alive and usable
+    if not donation_safe():
+        assert float(x.sum()) == 4.0
+    assert float(y.sum()) == 8.0
+
+
+def test_donate_override_forces_each_direction(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_DONATE", "1")
+    assert donation_safe() is True
+    f = donating_jit(lambda a: a + 1, donate_argnums=(0,))
+    x = jnp.ones((3,))
+    f(x)
+    assert x.is_deleted()  # donation actually happened
+
+    monkeypatch.setenv("SHEEPRL_TPU_DONATE", "0")
+    assert donation_safe() is False
+    g = donating_jit(lambda a: a + 1, donate_argnums=(0,))
+    z = jnp.ones((3,))
+    g(z)
+    assert not z.is_deleted()
+
+
+def test_decorator_form_matches_jax_jit():
+    from functools import partial
+
+    @partial(donating_jit, donate_argnums=(0,))
+    def step(s, d):
+        return s + d
+
+    assert float(step(jnp.float32(1.0), jnp.float32(2.0))) == 3.0

@@ -41,8 +41,31 @@ def test_moving_average_window_and_dict_flattening():
     assert out["rew/min"] == pytest.approx(2.0)
     assert out["rew/max"] == pytest.approx(4.0)
     assert out["rew/std"] == pytest.approx(np.std([2.0, 3.0, 4.0]))
+    # the per-interval reset must NOT wipe the moving-average window — a
+    # windowed metric wiped every logging interval degenerates into an
+    # interval mean (ISSUE 2 satellite)
     agg.reset()
+    out = agg.compute()
+    assert out["rew/mean"] == pytest.approx(3.0)
+    agg.reset(force=True)
     assert agg.compute() == {}
+
+
+def test_reset_on_compute_opt_in_and_mean_metric_default():
+    agg = MetricAggregator(
+        {
+            "windowed": MovingAverageMetric(window=4),
+            "interval": MovingAverageMetric(window=4, reset_on_compute=True),
+        }
+    )
+    agg.update("windowed", 1.0)
+    agg.update("interval", 1.0)
+    agg.update("plain", 5.0)  # auto-added MeanMetric: resets every interval
+    agg.reset()
+    out = agg.compute()
+    assert "windowed/mean" in out  # survived
+    assert "interval/mean" not in out  # opted into interval resets
+    assert "plain" not in out
 
 
 def test_add_duplicate_raises_and_pop():

@@ -1,0 +1,312 @@
+"""Telemetry subsystem (ISSUE 2): phase-timer nesting/exception safety, the
+XLA compile tracker on a forced retrace, JSONL well-formedness + replay
+through tools/telemetry_report.py, the NaN watchdog, decoupled-topology
+gauges, and the always-on overhead bound (the instrumented path must stay
+within 2% of uninstrumented on a CPU-sized workload)."""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.telemetry import (
+    CompileTracker,
+    PhaseTimers,
+    Telemetry,
+    monitoring_supported,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_report_module():
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(REPO, "tools", "telemetry_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# phase timers
+# ---------------------------------------------------------------------------
+
+
+def test_phase_nesting_builds_hierarchical_names():
+    t = PhaseTimers()
+    with t.phase("train"):
+        with t.phase("dispatch"):
+            time.sleep(0.002)
+    out = t.flush()
+    assert set(out) == {"train", "train/dispatch"}
+    # the parent's span covers the child
+    assert out["train"] >= out["train/dispatch"] > 0.0
+    assert t.flush() == {}  # flush clears
+
+
+def test_phase_exception_safety_records_time_and_reraises():
+    t = PhaseTimers()
+    with pytest.raises(RuntimeError):
+        with t.phase("doomed"):
+            time.sleep(0.002)
+            raise RuntimeError("boom")
+    out = t.flush()
+    assert out["doomed"] > 0.0
+
+
+def test_mark_sections_accumulate_and_flush_restarts_open_phase():
+    t = PhaseTimers()
+    t.mark("a")
+    time.sleep(0.002)
+    t.mark("b")  # ends a, starts b
+    time.sleep(0.002)
+    first = t.flush()  # b is OPEN: contributes elapsed and restarts
+    assert first["a"] > 0.0 and first["b"] > 0.0
+    time.sleep(0.002)
+    t.mark(None)
+    second = t.flush()
+    # b's post-flush time lands in the second interval — no loss, no double
+    # count across the flush boundary
+    assert set(second) == {"b"} and second["b"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# compile tracker
+# ---------------------------------------------------------------------------
+
+
+def test_compile_tracker_counts_forced_retrace():
+    if not monitoring_supported():
+        pytest.skip("jax.monitoring not available in this jax")
+    import jax
+    import jax.numpy as jnp
+
+    tracker = CompileTracker().attach()
+    try:
+        f = jax.jit(lambda x: x * 3.0 + 1.0)
+        f(jnp.ones((7,))).block_until_ready()
+        first = tracker.flush()
+        f(jnp.ones((13,))).block_until_ready()  # new shape -> forced retrace
+        second = tracker.flush()
+    finally:
+        tracker.detach()
+    assert first["compiles"] >= 1
+    assert second["compiles"] >= 1, "retrace did not increment the counter"
+    assert second["total_compiles"] >= first["compiles"] + second["compiles"] - 1
+    assert second["total_compile_seconds"] > 0.0
+    # detached trackers stop counting
+    f2 = jax.jit(lambda x: x - 5.0)
+    f2(jnp.ones((3,))).block_until_ready()
+    assert tracker.flush()["compiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# JSONL events + report replay
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_wellformed_and_replayable_by_report(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit")
+    telem.event("start", algo="unit", env_id="dummy", seed=1)
+    telem.mark("rollout")
+    time.sleep(0.002)
+    telem.mark("train/dispatch")
+    merged = telem.interval({"Loss/x": 0.25}, step=100, sps=50.0)
+    assert merged["Loss/x"] == 0.25
+    assert merged["Time/rollout_seconds"] > 0.0
+    telem.close()
+
+    path = tmp_path / "telemetry.jsonl"
+    lines = path.read_text().strip().splitlines()
+    events = [json.loads(l) for l in lines]  # every line parses strictly
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "start" and kinds[-1] == "end"
+    assert "log" in kinds
+    log_ev = events[kinds.index("log")]
+    assert log_ev["step"] == 100
+    assert log_ev["metrics"]["Time/step_per_second"] == 50.0
+
+    mod = _load_report_module()
+    summary = mod.summarize(mod.load_events(str(tmp_path)))
+    assert summary["end"] is not None and summary["crash"] is None
+    assert summary["last_step"] == 100
+    assert "rollout" in summary["phase_seconds"]
+    assert mod.render(summary)  # renders without raising
+
+
+def test_report_tolerates_truncated_tail_and_reports_crash(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit")
+    telem.event("start", algo="unit")
+    telem.interval({"Loss/x": 1.0}, step=1)
+    telem.event("crash", error="KeyboardInterrupt")
+    telem.close()
+    path = tmp_path / "telemetry.jsonl"
+    with open(path, "a") as fh:
+        fh.write('{"ts": 1, "event": "log", "metr')  # crash mid-write
+    mod = _load_report_module()
+    summary = mod.summarize(mod.load_events(str(path)))
+    assert summary["crash"] is not None
+    assert "CRASHED" in mod.render(summary)
+
+
+def test_selftest_entrypoint_passes():
+    mod = _load_report_module()
+    assert mod.main(["--selftest"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# NaN watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_nan_watchdog_fires_on_injected_inf(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit")
+    merged = telem.interval(
+        {"Loss/ok": 1.0, "Loss/exploded": float("inf"), "Loss/gone": float("nan")},
+        step=7,
+    )
+    telem.close()
+    assert merged["Health/nonfinite_metrics"] == 2.0
+    events = [
+        json.loads(l)
+        for l in (tmp_path / "telemetry.jsonl").read_text().strip().splitlines()
+    ]
+    nan_evs = [e for e in events if e["event"] == "health.nan"]
+    assert len(nan_evs) == 1
+    assert nan_evs[0]["keys"] == ["Loss/exploded", "Loss/gone"]
+    assert nan_evs[0]["step"] == 7
+    # the log event must still be strict JSON despite the non-finite values
+    log_evs = [e for e in events if e["event"] == "log"]
+    assert log_evs and isinstance(log_evs[0]["metrics"]["Loss/exploded"], str)
+
+
+def test_disabled_telemetry_passes_metrics_through(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit", enabled=False)
+    metrics = {"Loss/x": 1.0}
+    assert telem.interval(metrics, step=1) is metrics
+    telem.mark("rollout")  # all no-ops, no file
+    telem.close()
+    assert not (tmp_path / "telemetry.jsonl").exists()
+
+
+def test_nonzero_rank_writes_no_jsonl(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=1, algo="unit")
+    out = telem.interval({"Loss/x": 1.0}, step=1)
+    telem.close()
+    assert "Loss/x" in out  # timers/merge still work (no-op logger eats it)
+    assert not (tmp_path / "telemetry.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# decoupled-topology gauges
+# ---------------------------------------------------------------------------
+
+
+def test_decoupled_gauges_track_transfers_and_staleness():
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.parallel.decoupled import make_decoupled_meshes
+
+    meshes = make_decoupled_meshes(2)
+    g0 = meshes.telemetry_gauges()
+    assert g0["Decoupled/data_transfers"] == 0.0
+    assert g0["Decoupled/weight_queue_depth"] == 0.0
+
+    meshes.to_trainers({"x": jnp.ones((4, 3))})
+    meshes.to_player({"w": jnp.ones((5,))})
+    g1 = meshes.telemetry_gauges()
+    assert g1["Decoupled/data_transfers"] == 1.0
+    assert g1["Decoupled/data_mb_total"] > 0.0
+    assert g1["Decoupled/weight_transfers"] == 1.0
+    assert g1["Decoupled/weight_queue_depth"] == 1.0  # shipped, not applied
+
+    meshes.note_weights_applied()
+    g2 = meshes.telemetry_gauges()
+    assert g2["Decoupled/weight_queue_depth"] == 0.0
+    assert g2["Decoupled/weight_staleness_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: tiny PPO run writes telemetry; the report reads it back
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(300)
+def test_tiny_ppo_run_emits_telemetry_and_report_renders(tmp_path):
+    import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+    from sheeprl_tpu.utils.registry import tasks
+
+    tasks["ppo"](
+        [
+            "--env_id", "CartPole-v1", "--dry_run", "--num_envs", "1",
+            "--rollout_steps", "8", "--per_rank_batch_size", "4",
+            "--update_epochs", "1", "--dense_units", "8", "--mlp_layers", "1",
+            "--cnn_features_dim", "16", "--mlp_features_dim", "8",
+            "--root_dir", str(tmp_path), "--run_name", "telem",
+        ]
+    )
+    log_dir = tmp_path / "telem"
+    assert (log_dir / "telemetry.jsonl").exists()
+    mod = _load_report_module()
+    summary = mod.summarize(mod.load_events(str(log_dir)))
+    assert summary["start"]["algo"] == "ppo"
+    assert summary["end"] is not None and summary["crash"] is None
+    # the acceptance phases: rollout + train/dispatch measured, checkpoint
+    # lifecycle recorded via save_checkpoint's global emit
+    assert summary["phase_seconds"].get("rollout", 0.0) > 0.0
+    assert "train/dispatch" in summary["phase_seconds"]
+    assert summary["checkpoints"], "checkpoint event missing"
+    rendered = mod.render(summary)
+    assert "phase breakdown" in rendered and "rollout" in rendered
+
+
+# ---------------------------------------------------------------------------
+# overhead bound
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(120)
+def test_telemetry_overhead_within_two_percent(tmp_path):
+    """The always-on instrumentation pattern every main uses (a few marks +
+    one interval() per logging interval) must cost <2% of a realistically
+    sized step. Per-mark cost on this box is ~5-10us and interval() ~200us
+    (dominated by the JSONL flush), so the bound is checked against a
+    ~3-4ms workload — the floor of what one env step + dispatch costs even
+    on the tiny CPU configs; real updates are 10-1000x larger."""
+    a = np.random.default_rng(0).normal(size=(300, 300))
+
+    def workload():
+        return float(np.linalg.norm(a @ a))
+
+    iters, interval_every = 60, 15
+
+    def run_plain():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            workload()
+        return time.perf_counter() - t0
+
+    telem = Telemetry(str(tmp_path), rank=0, algo="overhead")
+
+    def run_instrumented():
+        t0 = time.perf_counter()
+        for i in range(iters):
+            telem.mark("rollout")
+            workload()
+            telem.mark("train/dispatch")
+            telem.mark("log")
+            if (i + 1) % interval_every == 0:
+                telem.interval({"Loss/x": 1.0}, step=i)
+        return time.perf_counter() - t0
+
+    run_plain(), run_instrumented()  # warmup both paths
+    # interleaved pairs + min-of-ratios: a box-wide slowdown hits both arms
+    # of a pair equally, and one clean pair suffices to prove the bound
+    ratios = [run_instrumented() / run_plain() for _ in range(6)]
+    telem.close()
+    overhead = min(ratios) - 1.0
+    assert overhead < 0.02, f"telemetry overhead {overhead:.2%} exceeds 2%"

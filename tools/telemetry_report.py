@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Offline run-health report from a `telemetry.jsonl` event log.
+
+    python tools/telemetry_report.py <log_dir-or-telemetry.jsonl>
+    python tools/telemetry_report.py --selftest
+
+Reads the structured event log the telemetry subsystem writes
+(sheeprl_tpu/telemetry/, schema in howto/observability.md) and prints, for a
+finished OR crashed run:
+
+  - run identity + lifecycle (start/end/crash, checkpoints committed,
+    profile windows captured);
+  - a phase-breakdown table: total seconds and share of accounted time per
+    phase (`rollout`, `buffer/sample`, `train/dispatch`, ...), from the
+    `Time/<phase>_seconds` series in the `log` events;
+  - throughput (mean / last step-per-second) and XLA compile accounting
+    (total compiles, compile seconds, recompiles AFTER the first logging
+    interval — the retrace-storm signal);
+  - health findings: `health.nan` events with the offending metric keys,
+    peak device memory.
+
+Pure stdlib + the repo's telemetry package (no jax import), so it runs
+anywhere the JSONL can be copied to. `--selftest` synthesizes a small run
+via the real Telemetry class, reports on it, and asserts the critical
+fields — the CI smoke that the writer and this reader stay in sync.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_events(path: str) -> list[dict]:
+    """Parse a telemetry.jsonl (or a log_dir containing one). Tolerates a
+    truncated final line (crash mid-write)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found — did the run write telemetry? "
+            "(rank 0 only; SHEEPRL_TPU_TELEMETRY=0 disables)"
+        )
+    events = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                # a crash can truncate the last line; everything before it
+                # is still a valid record of the run
+                break
+    return events
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate an event list into the report's data model."""
+    summary: dict = {
+        "start": None,
+        "end": None,
+        "crash": None,
+        "checkpoints": [],
+        "profile_windows": 0,
+        "nan_events": [],
+        "log_events": 0,
+        "first_ts": None,
+        "last_ts": None,
+        "last_step": None,
+        "phase_seconds": {},
+        "sps_series": [],
+        "total_compiles": 0.0,
+        "total_compile_seconds": 0.0,
+        "late_recompiles": 0.0,
+        "late_compile_seconds": 0.0,
+        "peak_memory_bytes": 0.0,
+        "gauges_last": {},
+    }
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is not None:
+            summary["first_ts"] = ts if summary["first_ts"] is None else summary["first_ts"]
+            summary["last_ts"] = ts
+        kind = ev.get("event")
+        if kind == "start":
+            summary["start"] = ev
+        elif kind == "end":
+            summary["end"] = ev
+        elif kind == "crash":
+            summary["crash"] = ev
+        elif kind == "checkpoint":
+            summary["checkpoints"].append(ev.get("path"))
+        elif kind == "profile.start":
+            summary["profile_windows"] += 1
+        elif kind == "health.nan":
+            summary["nan_events"].append(ev)
+        elif kind == "log":
+            summary["log_events"] += 1
+            if ev.get("step") is not None:
+                summary["last_step"] = ev["step"]
+            metrics = ev.get("metrics", {})
+            for k, v in metrics.items():
+                if not isinstance(v, (int, float)):
+                    continue
+                if k.startswith("Time/") and k.endswith("_seconds"):
+                    phase = k[len("Time/"):-len("_seconds")]
+                    summary["phase_seconds"][phase] = (
+                        summary["phase_seconds"].get(phase, 0.0) + v
+                    )
+                elif k == "Time/step_per_second":
+                    summary["sps_series"].append(v)
+                elif k == "XLA/total_compiles":
+                    summary["total_compiles"] = v
+                elif k == "XLA/total_compile_seconds":
+                    summary["total_compile_seconds"] = v
+                elif k == "XLA/recompiles" and summary["log_events"] > 1:
+                    summary["late_recompiles"] += v
+                elif k == "XLA/compile_seconds" and summary["log_events"] > 1:
+                    summary["late_compile_seconds"] += v
+                elif k.startswith("Memory/") and k.endswith("bytes_in_use"):
+                    summary["peak_memory_bytes"] = max(summary["peak_memory_bytes"], v)
+                elif k.startswith("Decoupled/"):
+                    summary["gauges_last"][k] = v
+    # the "end" event carries phase time accumulated after the last interval
+    if summary["end"]:
+        for phase, secs in (summary["end"].get("phases") or {}).items():
+            if isinstance(secs, (int, float)):
+                summary["phase_seconds"][phase] = (
+                    summary["phase_seconds"].get(phase, 0.0) + secs
+                )
+    return summary
+
+
+def _fmt_row(cols, widths):
+    return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths)).rstrip()
+
+
+def render(summary: dict) -> str:
+    """The human-readable report."""
+    lines: list[str] = []
+    start = summary["start"] or {}
+    lines.append("== run ==")
+    lines.append(
+        f"algo={start.get('algo', '?')} env={start.get('env_id', '?')} "
+        f"seed={start.get('seed', '?')} backend={start.get('backend', '?')} "
+        f"devices={start.get('local_devices', '?')}"
+    )
+    if summary["first_ts"] is not None and summary["last_ts"] is not None:
+        lines.append(
+            f"wall_clock={summary['last_ts'] - summary['first_ts']:.1f}s "
+            f"log_events={summary['log_events']} last_step={summary['last_step']}"
+        )
+    if summary["crash"]:
+        lines.append(f"OUTCOME: CRASHED — {summary['crash'].get('error')}")
+    elif summary["end"]:
+        lines.append("OUTCOME: completed (clean end event)")
+    else:
+        lines.append("OUTCOME: unknown (no end/crash event — log truncated or run live)")
+    lines.append(
+        f"checkpoints={len(summary['checkpoints'])} "
+        f"profile_windows={summary['profile_windows']}"
+    )
+
+    lines.append("")
+    lines.append("== phase breakdown ==")
+    phases = summary["phase_seconds"]
+    if phases:
+        total = sum(phases.values())
+        widths = (max(len("total (accounted)"), *(len(p) for p in phases)) + 2, 12, 8)
+        lines.append(_fmt_row(("phase", "seconds", "share"), widths))
+        for name, secs in sorted(phases.items(), key=lambda kv: -kv[1]):
+            share = f"{100 * secs / total:.1f}%" if total > 0 else "-"
+            lines.append(_fmt_row((name, f"{secs:.3f}", share), widths))
+        lines.append(_fmt_row(("total (accounted)", f"{total:.3f}", "100%"), widths))
+    else:
+        lines.append("no phase timings recorded")
+
+    lines.append("")
+    lines.append("== throughput / compiles ==")
+    if summary["sps_series"]:
+        sps = summary["sps_series"]
+        lines.append(
+            f"step_per_second: mean={sum(sps) / len(sps):.1f} last={sps[-1]:.1f}"
+        )
+    lines.append(
+        f"xla_compiles={summary['total_compiles']:.0f} "
+        f"({summary['total_compile_seconds']:.1f}s total)"
+    )
+    lines.append(
+        f"recompiles after first interval: {summary['late_recompiles']:.0f} "
+        f"({summary['late_compile_seconds']:.1f}s) "
+        + ("<- RETRACE STORM?" if summary["late_recompiles"] > 0 else "(clean)")
+    )
+
+    lines.append("")
+    lines.append("== health ==")
+    if summary["nan_events"]:
+        keys: set = set()
+        for ev in summary["nan_events"]:
+            keys.update(ev.get("keys", []))
+        lines.append(
+            f"NON-FINITE metrics in {len(summary['nan_events'])} interval(s): "
+            f"{sorted(keys)}"
+        )
+    else:
+        lines.append("no non-finite metrics observed")
+    if summary["peak_memory_bytes"]:
+        lines.append(f"peak_device_memory={summary['peak_memory_bytes'] / 2**30:.2f}GiB")
+    for k, v in sorted(summary["gauges_last"].items()):
+        lines.append(f"{k}={v:.2f}")
+    return "\n".join(lines)
+
+
+def report(path: str) -> dict:
+    """Load + summarize + print; returns the summary (tests use it)."""
+    summary = summarize(load_events(path))
+    print(render(summary))
+    return summary
+
+
+def selftest() -> int:
+    """Synthesize a run through the REAL Telemetry writer, then assert this
+    reader recovers the critical facts from it."""
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from sheeprl_tpu.telemetry import Telemetry
+
+    d = tempfile.mkdtemp(prefix="telemetry_selftest_")
+    telem = Telemetry(d, rank=0, algo="selftest")
+    telem.event("start", algo="selftest", env_id="dummy", seed=0)
+    for step in (10, 20, 30):
+        telem.mark("rollout")
+        telem.mark("train/dispatch")
+        telem.mark("log")
+        metrics = {"Loss/x": 0.5}
+        if step == 20:
+            metrics["Loss/bad"] = float("inf")
+        telem.interval(metrics, step, sps=123.0)
+    telem.event("checkpoint", path=os.path.join(d, "ckpt_30"))
+    telem.close()
+
+    summary = report(d)
+    assert summary["start"] and summary["start"]["algo"] == "selftest"
+    assert summary["end"] is not None and summary["crash"] is None
+    assert summary["log_events"] == 3 and summary["last_step"] == 30
+    assert "rollout" in summary["phase_seconds"], summary["phase_seconds"]
+    assert "train/dispatch" in summary["phase_seconds"]
+    assert len(summary["checkpoints"]) == 1
+    assert len(summary["nan_events"]) == 1
+    assert summary["nan_events"][0]["keys"] == ["Loss/bad"]
+    print("\nselftest OK", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "path", nargs="?", help="run log_dir or telemetry.jsonl path"
+    )
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="synthesize a run and verify writer/reader agreement",
+    )
+    opts = parser.parse_args(argv)
+    if opts.selftest:
+        return selftest()
+    if not opts.path:
+        parser.error("path required (or --selftest)")
+    report(opts.path)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closed the pipe; not an error
+        os._exit(0)

@@ -1507,6 +1507,52 @@ def _failure_line(metric: str, unit: str, error: str) -> str:
     )
 
 
+def _code_fingerprint() -> str:
+    """Identity of the bench-relevant source tree, embedded in the ledger
+    meta (ADVICE r5): a sidecar recorded by OLD code must auto-invalidate on
+    resume instead of relying on the operator remembering
+    SHEEPRL_TPU_BENCH_FRESH=1. git HEAD (plus a digest of uncommitted
+    changes when dirty); outside a git checkout, a digest of bench.py +
+    sheeprl_tpu sources."""
+    import hashlib
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def _git(*argv: str) -> str:
+        return subprocess.run(
+            ["git", "-C", repo, *argv],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+
+    try:
+        head = _git("rev-parse", "--short=12", "HEAD")
+        if head:
+            dirty = _git("status", "--porcelain", "-uno")
+            if dirty:
+                diff = _git("diff", "HEAD").encode()
+                return f"{head}+{hashlib.sha1(diff).hexdigest()[:8]}"
+            return head
+    except Exception:
+        pass
+    h = hashlib.sha1()
+    try:
+        with open(os.path.join(repo, "bench.py"), "rb") as fh:
+            h.update(fh.read())
+        for path in sorted(
+            os.path.join(dp, f)
+            for dp, _, fs in os.walk(os.path.join(repo, "sheeprl_tpu"))
+            for f in fs
+            if f.endswith(".py")
+        ):
+            with open(path, "rb") as fh:
+                h.update(fh.read())
+    except OSError:
+        return "unknown"
+    return f"src-{h.hexdigest()[:12]}"
+
+
 class PhaseLedger:
     """Incremental/resumable bench sidecar (VERDICT r4 #1).
 
@@ -1539,9 +1585,19 @@ class PhaseLedger:
 
     def __init__(self, path: str, meta: dict):
         self.path = path
-        self.meta = {"ledger_version": self.VERSION, **meta}
+        # the code fingerprint rides in meta, so a sidecar written by OLD
+        # code mismatches and is discarded automatically (ADVICE r5)
+        self.meta = {
+            "ledger_version": self.VERSION,
+            "code": _code_fingerprint(),
+            **meta,
+        }
         self.phases: dict = {}
         self.headline: dict | None = None
+        # consumers must be able to tell fresh partial data from re-emitted
+        # old data (ADVICE r5): phases measured by THIS process vs loaded
+        self.measured_this_run: list[str] = []
+        self.resumed_from_sidecar = False
         import os
 
         if os.environ.get("SHEEPRL_TPU_BENCH_FRESH") == "1":
@@ -1552,6 +1608,7 @@ class PhaseLedger:
             if data.get("meta") == self.meta:
                 self.phases = data.get("phases", {})
                 self.headline = data.get("headline")
+                self.resumed_from_sidecar = bool(self.phases)
                 if self.phases:
                     print(
                         f"ledger: resuming {path} with completed phases "
@@ -1588,6 +1645,7 @@ class PhaseLedger:
             "samples": {str(k): v for k, v in samples.items()},
             "recorded_at": _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime()),
         }
+        self.measured_this_run.append(name)
         self.set_headline(headline)
         budget = os.environ.get("SHEEPRL_TPU_BENCH_MAX_PHASES")
         if budget and len(self.phases) >= int(budget):
@@ -1598,7 +1656,12 @@ class PhaseLedger:
             os._exit(0)
 
     def set_headline(self, headline: dict) -> None:
-        self.headline = {**headline, "phases_completed": sorted(self.phases)}
+        self.headline = {
+            **headline,
+            "phases_completed": sorted(self.phases),
+            "phases_measured_this_run": sorted(self.measured_this_run),
+            "resumed_from_sidecar": self.resumed_from_sidecar,
+        }
         self._write()
 
     def _write(self) -> None:
@@ -1971,6 +2034,28 @@ def _wait_for_backend(
         time.sleep(delay_s)
 
 
+def _arm_compile_cache(tiny: bool) -> None:
+    """Arm the persistent XLA compile cache at the runners' shared location
+    (ADVICE r5): bench never calls distributed_setup, so the documented
+    SHEEPRL_TPU_COMPILE_CACHE hook was dead here and resumed bench sessions
+    recompiled every closure. Honor the env var directly; default it for
+    the full bench (--tiny stays hermetic unless the operator sets it).
+    Exported for measurement subprocesses too."""
+    import os
+
+    cache = os.environ.get("SHEEPRL_TPU_COMPILE_CACHE")
+    if cache is None and not tiny:
+        cache = "logs/jax_compile_cache"
+        os.environ["SHEEPRL_TPU_COMPILE_CACHE"] = cache
+    if not cache:
+        return  # unset on --tiny, or explicitly '' — leave package default
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache
+
+
 def main() -> None:
     import argparse
     import os
@@ -1984,6 +2069,13 @@ def main() -> None:
         "--telemetry", choices=["on", "off", "ab"], default="off",
         help="PPO bench only: run the loop with the telemetry subsystem "
         "on/off, or 'ab' to measure both and record the overhead",
+    )
+    parser.add_argument(
+        "--sanitize", action="store_true",
+        help="runtime transfer sanitizer (sheeplint's dynamic half): run "
+        "with jax.transfer_guard('log') so every implicit host<->device "
+        "transfer during measurement is logged to stderr; the artifact is "
+        "tagged sanitize=true (numbers carry guard overhead)",
     )
     opts = parser.parse_args()
     metric, unit = _METRIC_OF_ALGO[opts.algo]
@@ -2026,11 +2118,23 @@ def main() -> None:
                 headline.update(
                     error="backend_unavailable", partial=True,
                     resumed_from_sidecar=True,
+                    # nothing was measured by THIS process — the stored
+                    # headline's value may say otherwise (ADVICE r5)
+                    phases_measured_this_run=[],
                 )
                 print(json.dumps(headline))
                 return
         print(_failure_line(metric, unit, "backend_unavailable"))
         return
+    _arm_compile_cache(opts.tiny)
+    if opts.sanitize:
+        import jax
+
+        # log-level guard: C++-side stderr lines name every implicit
+        # transfer during measurement without aborting timed segments
+        jax.config.update("jax_transfer_guard", "log")
+        global BASELINE_NOTE
+        BASELINE_NOTE = f"sanitize=true; {BASELINE_NOTE}"
     if opts.algo == "ppo":
         bench_ppo(telemetry=opts.telemetry)
     elif opts.algo == "ppo_decoupled":

@@ -83,6 +83,15 @@ class StandardArgs:
     profile_steps: int = Arg(
         default=5, help="number of training iterations in the profile window"
     )
+    sanitize: bool = Arg(
+        default=False,
+        help="runtime transfer/donation sanitizer (sheeplint's dynamic "
+        "half): run device-only phases under jax.transfer_guard('disallow') "
+        "— implicit host<->device transfers are recorded to telemetry.jsonl "
+        "(sanitizer.transfer events) instead of crashing — and wrap the "
+        "train step with checkify NaN/div checks (sanitizer.checkify "
+        "events). Audit mode: adds overhead, never changes results",
+    )
 
     def __setattr__(self, name: str, value: Any) -> None:
         if name == "precision" and value not in ("float32", "bfloat16"):

@@ -59,6 +59,7 @@ from ...parallel import (
     shard_time_batch,
 )
 from ...telemetry import Telemetry
+from ...analysis import Sanitizer
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -523,6 +524,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3")
+    sanitizer = Sanitizer.from_args(args, telem)
+    telem.add_gauges(sanitizer.gauges)
 
     envs = make_vector_env(
         [
@@ -935,6 +938,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True),
         args, logger,
     )
+    sanitizer.close()
     telem.close()
     logger.close()
 

@@ -36,6 +36,7 @@ from ...envs import make_vector_env
 from ...envs.wrappers import RestartOnException
 from ...parallel import distributed_setup, make_decoupled_meshes, process_index
 from ...telemetry import Telemetry
+from ...analysis import Sanitizer
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -103,6 +104,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3_decoupled")
+    sanitizer = Sanitizer.from_args(args, telem)
+    telem.add_gauges(sanitizer.gauges)
     telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
@@ -481,6 +484,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         logger.log_dict(aggregator.compute(), num_updates)
         aggregator.reset()
     test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True)
+    sanitizer.close()
     telem.close()
     logger.close()
 

@@ -42,6 +42,7 @@ from ...parallel import (
     shard_time_batch,
 )
 from ...telemetry import Telemetry
+from ...analysis import Sanitizer
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -560,6 +561,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="p2e_dv2")
+    sanitizer = Sanitizer.from_args(args, telem)
+    telem.add_gauges(sanitizer.gauges)
 
     envs = make_vector_env(
         [
@@ -983,6 +986,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, "few-shot"),
         args, logger,
     )
+    sanitizer.close()
     telem.close()
     logger.close()
 

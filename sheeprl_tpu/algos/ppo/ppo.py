@@ -43,6 +43,7 @@ from ...parallel import (
     shard_batch,
 )
 from ...telemetry import Telemetry
+from ...analysis import Sanitizer
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -120,7 +121,7 @@ def policy_step(agent: PPOAgent, obs: dict, key, use_key: bool = True):
     return actions, logprob, value, env_idx
 
 
-def make_train_step(args: PPOArgs, optimizer, num_minibatches: int):
+def make_train_step(args: PPOArgs, optimizer, num_minibatches: int, sanitizer=None):
     """Build the single-jit PPO update: GAE outside (already in `data`);
     scan(epochs) x scan(minibatches) inside."""
 
@@ -175,6 +176,10 @@ def make_train_step(args: PPOArgs, optimizer, num_minibatches: int):
             "Loss/entropy_loss": ent,
         }
 
+    if sanitizer is not None and sanitizer.enabled:
+        # sanitize mode: checkify NaN/div instrumentation replaces donation
+        # (audit runs trade HBM reuse for a consumed error channel)
+        return sanitizer.checkified(train_step, phase="train")
     return donating_jit(train_step, donate_argnums=(0,))
 
 
@@ -238,6 +243,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo")
+    sanitizer = Sanitizer.from_args(args, telem)
+    telem.add_gauges(sanitizer.gauges)
 
     envs = make_vector_env(
         [
@@ -285,7 +292,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
     global_batch_size = args.per_rank_batch_size * n_dev
     num_minibatches = max(rollout_and_train_size // global_batch_size, 1)
-    train_step = make_train_step(args, optimizer, num_minibatches)
+    train_step = make_train_step(args, optimizer, num_minibatches, sanitizer)
 
     rb = ReplayBuffer(
         args.rollout_steps, args.num_envs,
@@ -321,7 +328,9 @@ def main(argv: Sequence[str] | None = None) -> None:
             actions, logprob, value, env_idx = policy_step(
                 state.agent, device_obs, step_key
             )
-            env_idx_np = np.asarray(env_idx)  # the only required d2h per step
+            # the only required d2h per step; under --sanitize the pull runs
+            # guarded so the audit trail names exactly this sync site
+            env_idx_np = sanitizer.checked("rollout/d2h_pull", np.asarray, env_idx)
             env_actions = indices_to_env_actions(
                 env_idx_np, actions_dim, is_continuous
             )
@@ -362,9 +371,12 @@ def main(argv: Sequence[str] | None = None) -> None:
         telem.mark("host_to_device")
         data = {k: jnp.asarray(rb[k]) for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
         device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
-        returns, advantages = compute_gae_returns(
+        # gamma/lambda enter as committed device scalars: raw python floats
+        # here are an implicit h2d put per update (found by --sanitize)
+        returns, advantages = sanitizer.checked(
+            "gae", compute_gae_returns,
             state.agent, data, device_next_obs, jnp.asarray(next_done)[:, None],
-            args.gamma, args.gae_lambda,
+            jnp.float32(args.gamma), jnp.float32(args.gae_lambda),
         )
         data["returns"], data["advantages"] = returns, advantages
         flat = {
@@ -376,7 +388,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             flat = shard_batch(flat, mesh)
         key, train_key = jax.random.split(key)
         telem.mark("train/dispatch")
-        state, metrics = train_step(
+        state, metrics = sanitizer.checked(
+            "train", train_step,
             state, flat, train_key,
             jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
         )
@@ -410,5 +423,6 @@ def main(argv: Sequence[str] | None = None) -> None:
         )(), logger, args),
         args, logger,
     )
+    sanitizer.close()
     telem.close()
     logger.close()

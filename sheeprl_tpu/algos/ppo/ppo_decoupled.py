@@ -27,6 +27,7 @@ from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import distributed_setup, make_decoupled_meshes, process_index
 from ...telemetry import Telemetry
+from ...analysis import Sanitizer
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -78,6 +79,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     profiler = StepProfiler.from_args(args, log_dir, rank)
     logger.log_hyperparams(args.as_dict())
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo_decoupled")
+    sanitizer = Sanitizer.from_args(args, telem)
+    telem.add_gauges(sanitizer.gauges)
     telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
@@ -276,6 +279,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
     )()
     test(player_agent, test_env, logger, args)
+    sanitizer.close()
     telem.close()
     logger.close()
 

@@ -41,6 +41,7 @@ from ...parallel import (
     shard_batch,
 )
 from ...telemetry import Telemetry
+from ...analysis import Sanitizer
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -69,6 +70,11 @@ class TrainState(nn.Module):
 @jax.jit
 def policy_step(agent: RecurrentPPOAgent, obs, state, key):
     return agent.step(obs, state, key)
+
+
+@jax.jit
+def bootstrap_values(agent: RecurrentPPOAgent, obs, state):
+    return agent.get_values(obs, state)
 
 
 def make_train_step(args: RecurrentPPOArgs, optimizer, seq_len: int, num_minibatches: int):
@@ -195,6 +201,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo_recurrent")
+    sanitizer = Sanitizer.from_args(args, telem)
+    telem.add_gauges(sanitizer.gauges)
 
     envs = make_vector_env(
         [
@@ -346,8 +354,11 @@ def main(argv: Sequence[str] | None = None) -> None:
                 "actor_hxs", "actor_cxs", "critic_hxs", "critic_cxs",
             )
         }
-        next_value, _ = jax.jit(state.agent.get_values)(
-            jnp.asarray(next_obs)[None], agent_state[1]
+        # module-level jit on (agent, ...) — `jax.jit(state.agent.get_values)`
+        # here would build a fresh bound-method closure (and a fresh trace)
+        # every update (sheeplint SL004)
+        next_value, _ = bootstrap_values(
+            state.agent, jnp.asarray(next_obs)[None], agent_state[1]
         )
         returns, advantages = ops.gae(
             data["rewards"], data["values"], data["dones"],
@@ -401,6 +412,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         )(), logger, args, obs_key),
         args, logger,
     )
+    sanitizer.close()
     telem.close()
     logger.close()
 

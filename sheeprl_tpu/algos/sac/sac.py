@@ -39,6 +39,7 @@ from ...parallel import (
     shard_batch,
 )
 from ...telemetry import Telemetry
+from ...analysis import Sanitizer
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -185,6 +186,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="sac")
+    sanitizer = Sanitizer.from_args(args, telem)
+    telem.add_gauges(sanitizer.gauges)
 
     envs = make_vector_env(
         [
@@ -381,5 +384,6 @@ def main(argv: Sequence[str] | None = None) -> None:
         )(), logger, args),
         args, logger,
     )
+    sanitizer.close()
     telem.close()
     logger.close()

@@ -38,6 +38,7 @@ from ...parallel import (
     shard_batch,
 )
 from ...telemetry import Telemetry
+from ...analysis import Sanitizer
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -427,6 +428,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="sac_ae")
+    sanitizer = Sanitizer.from_args(args, telem)
+    telem.add_gauges(sanitizer.gauges)
 
     envs = make_vector_env(
         [
@@ -700,5 +703,6 @@ def main(argv: Sequence[str] | None = None) -> None:
         )(), logger, args, cnn_keys, mlp_keys),
         args, logger,
     )
+    sanitizer.close()
     telem.close()
     logger.close()

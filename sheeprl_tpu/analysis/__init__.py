@@ -1,0 +1,29 @@
+"""Static analysis (sheeplint) + runtime sanitizer for JAX/TPU hazards.
+
+Two halves of one invariant set (ISSUE 3):
+
+  - `linter` / `rules`: AST-level detection of hazards that are knowable
+    before running anything — bare donating jits (SL001), host syncs inside
+    traced bodies (SL002), Python branches on tracers (SL003), per-step
+    recompile patterns (SL004), unregistered dataclass pytrees (SL005),
+    unconstrained sharded jits (SL006). CLI: `python tools/sheeplint.py`.
+  - `sanitizer`: the runtime half for what the AST cannot see — a
+    transfer-guard wrapper that catches *actual* implicit host<->device
+    transfers in guarded phases, and checkify NaN/div instrumentation on
+    train steps — enabled per-run with `--sanitize`, reporting through the
+    telemetry JSONL event log.
+"""
+
+from .linter import lint_file, lint_paths, lint_source
+from .rules import RULES, Rule, Violation
+from .sanitizer import Sanitizer
+
+__all__ = [
+    "RULES",
+    "Rule",
+    "Violation",
+    "Sanitizer",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,630 @@
+"""AST engine for sheeplint (see rules.py for the catalog).
+
+The engine is deliberately *syntactic*: it never imports the linted module,
+so it is safe on files with heavy import sides (algo mains spin up envs at
+import of their deps) and runs in milliseconds over the whole repo. The
+price is heuristic scoping — "inside a jit body" means one of:
+
+  - a function decorated with `jax.jit` / `donating_jit` /
+    `@partial(jax.jit, ...)` / `jax.vmap` / `jax.pmap`;
+  - a function or lambda *passed* to one of those transforms, or used as a
+    `lax.scan` / `lax.cond` / `lax.while_loop` / `lax.fori_loop` /
+    `lax.switch` / `checkify.checkify` body;
+  - any def nested inside one of the above (closures are traced inline).
+
+Cross-module dataflow (a helper jitted in another file) is out of scope;
+the rules are tuned so that what they do catch is near-certainly real, and
+anything intentional is one `# sheeplint: disable=<rule>` comment away.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .rules import RULES, Violation
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "iter_python_files"]
+
+_SUPPRESS_RE = re.compile(
+    r"sheeplint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+?)\s*(?:[-—(].*)?$"
+)
+
+# transforms whose FIRST positional argument is traced when called
+_WRAP_TRANSFORMS = {"jit", "vmap", "pmap", "donating_jit", "named_call"}
+# transforms tracing callables at given positional indexes
+_BODY_ARG_TRANSFORMS = {
+    "scan": (0,),
+    "associative_scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "checkify": (0,),
+    "custom_jvp": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_SHAPE_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+
+
+def _parse_suppressions(src: str) -> tuple[dict[int, set[str]], set[str]]:
+    """Map line -> suppressed rule ids, plus file-level suppressions.
+
+    A trailing `# sheeplint: disable=SL001,SL002` suppresses its own line; a
+    comment alone on a line also suppresses the next line (so directives can
+    sit above decorators or long calls). `disable-file=` applies everywhere.
+    Free-text justifications after the id list (dash/paren separated) are
+    encouraged and ignored by the parser.
+    """
+    per_line: dict[int, set[str]] = {}
+    file_level: set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, file_level
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        kind, id_blob = m.group(1), m.group(2)
+        ids = {
+            part.strip().upper()
+            for part in id_blob.replace(" ", ",").split(",")
+            if part.strip()
+        }
+        ids = {("all" if i == "ALL" else i) for i in ids}
+        if kind == "disable-file":
+            file_level |= ids
+            continue
+        line = tok.start[0]
+        per_line.setdefault(line, set()).update(ids)
+        standalone = tok.line[: tok.start[1]].strip() == ""
+        if standalone:
+            # apply to the next code line, skipping continuation comment
+            # lines and blanks (justifications are encouraged to run long)
+            src_lines = src.splitlines()
+            nxt = line  # 0-based index of the line after the comment
+            while nxt < len(src_lines) and (
+                not src_lines[nxt].strip()
+                or src_lines[nxt].lstrip().startswith("#")
+            ):
+                nxt += 1
+            per_line.setdefault(nxt + 1, set()).update(ids)
+    return per_line, file_level
+
+
+class _Scope:
+    """Name -> FunctionDef bindings for one lexical scope."""
+
+    def __init__(self) -> None:
+        self.defs: dict[str, ast.AST] = {}
+
+
+class _FileAnalysis:
+    def __init__(self, src: str, path: str) -> None:
+        self.src = src
+        self.path = path
+        self.tree = ast.parse(src)
+        self.violations: list[Violation] = []
+        self._annotate_parents()
+        self._collect_imports()
+        self._collect_scopes()
+        self._collect_jit_contexts()
+
+    # ---- plumbing ---------------------------------------------------------
+    def _annotate_parents(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._sheeplint_parent = node  # type: ignore[attr-defined]
+
+    def _parents(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = getattr(node, "_sheeplint_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_sheeplint_parent", None)
+
+    def _collect_imports(self) -> None:
+        """alias -> canonical dotted module/name, for `_dotted` substitution."""
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        self.np_roots = {
+            alias
+            for alias, full in self.aliases.items()
+            if full == "numpy" or full.startswith("numpy.")
+        } | ({"numpy"} if "numpy" not in self.aliases else set())
+        self.jnp_roots = {
+            alias for alias, full in self.aliases.items() if full == "jax.numpy"
+        }
+
+    def _dotted(self, node: ast.AST) -> Optional[str]:
+        """Literal dotted path with import aliases substituted at the root."""
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self.aliases.get(cur.id, cur.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def _collect_scopes(self) -> None:
+        """Per-scope function-def bindings for Name -> def resolution."""
+        self.scope_of: dict[ast.AST, _Scope] = {}
+
+        def visit(owner: ast.AST) -> None:
+            scope = _Scope()
+            self.scope_of[owner] = scope
+            for node in _scope_children(owner):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scope.defs[node.name] = node
+                    visit(node)
+                elif isinstance(node, ast.Lambda):
+                    visit(node)
+
+        visit(self.tree)
+
+    def _resolve_func(self, name_node: ast.expr, at: ast.AST) -> Optional[ast.AST]:
+        if isinstance(name_node, ast.Lambda):
+            return name_node
+        if not isinstance(name_node, ast.Name):
+            return None
+        for owner in (at, *self._parents(at)):
+            scope = self.scope_of.get(owner)
+            if scope and name_node.id in scope.defs:
+                return scope.defs[name_node.id]
+        return None
+
+    # ---- jit-context discovery -------------------------------------------
+    def _transform_kind(self, dotted: Optional[str]) -> Optional[str]:
+        if not dotted:
+            return None
+        leaf = dotted.rsplit(".", 1)[-1]
+        if dotted in ("jax.jit", "jit") or leaf == "donating_jit":
+            return "jit"
+        root = dotted.split(".", 1)[0]
+        if leaf in _WRAP_TRANSFORMS and root in ("jax", "eqx"):
+            return "jit"
+        if leaf in _BODY_ARG_TRANSFORMS and (
+            root in ("jax", "lax", "checkify")
+            or ".lax." in dotted
+            or dotted.startswith("jax.")
+            or "checkify" in dotted
+        ):
+            return leaf
+        return None
+
+    def _jit_like_call(self, call: ast.Call) -> bool:
+        """True for `jax.jit(...)`, `donating_jit(...)`, and
+        `partial(jax.jit, ...)` forms (the closure builders)."""
+        kind = self._transform_kind(self._dotted(call.func))
+        if kind == "jit":
+            return True
+        d = self._dotted(call.func)
+        if d and d.rsplit(".", 1)[-1] == "partial":
+            return any(
+                self._transform_kind(self._dotted(a)) == "jit" for a in call.args
+            )
+        return False
+
+    def _collect_jit_contexts(self) -> None:
+        self.jit_contexts: set[ast.AST] = set()
+        # decorated defs
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    if self._jit_like_call(dec):
+                        self.jit_contexts.add(node)
+                elif self._transform_kind(self._dotted(dec)) == "jit":
+                    self.jit_contexts.add(node)
+        # callables passed to transforms
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = self._dotted(node.func)
+            kind = self._transform_kind(dotted)
+            if kind == "jit" or (
+                kind is None and self._jit_like_call(node)
+            ):
+                for arg in node.args[:1]:
+                    fn = self._resolve_func(arg, node)
+                    if fn is not None:
+                        self.jit_contexts.add(fn)
+            elif kind in _BODY_ARG_TRANSFORMS:
+                for idx in _BODY_ARG_TRANSFORMS[kind]:
+                    if idx < len(node.args):
+                        fn = self._resolve_func(node.args[idx], node)
+                        if fn is not None:
+                            self.jit_contexts.add(fn)
+            # lax.switch: list of branch callables
+            if dotted and dotted.rsplit(".", 1)[-1] == "switch" and len(node.args) > 1:
+                branches = node.args[1]
+                if isinstance(branches, (ast.List, ast.Tuple)):
+                    for el in branches.elts:
+                        fn = self._resolve_func(el, node)
+                        if fn is not None:
+                            self.jit_contexts.add(fn)
+
+    def _in_jit_context(self, node: ast.AST) -> bool:
+        if node in self.jit_contexts:
+            return True
+        return any(p in self.jit_contexts for p in self._parents(node))
+
+    def _top_level_contexts(self) -> list[ast.AST]:
+        return [c for c in self.jit_contexts if not any(
+            p in self.jit_contexts for p in self._parents(c)
+        )]
+
+    # ---- reporting --------------------------------------------------------
+    def report(self, rule_id: str, node: ast.AST, message: str) -> None:
+        self.violations.append(
+            Violation(
+                rule=RULES[rule_id],
+                path=self.path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0) + 1,
+                message=message,
+            )
+        )
+
+
+def _scope_children(owner: ast.AST) -> Iterable[ast.AST]:
+    """All descendants of `owner` that belong to its scope (stop at nested
+    function/lambda boundaries, which own their own scope)."""
+    body = (
+        owner.body
+        if not isinstance(owner, ast.Lambda)
+        else [owner.body]
+    ) if not isinstance(owner, ast.Module) else owner.body
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _contains(node: ast.AST, pred) -> bool:
+    return any(pred(n) for n in ast.walk(node))
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(_is_literal(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal(node.operand)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Rule passes
+# ---------------------------------------------------------------------------
+
+
+def _check_sl001(a: _FileAnalysis) -> None:
+    for node in ast.walk(a.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        donating = any(
+            kw.arg in ("donate_argnums", "donate_argnames") for kw in node.keywords
+        )
+        if not donating:
+            continue
+        d = a._dotted(node.func)
+        if d is None:
+            continue
+        leaf = d.rsplit(".", 1)[-1]
+        if leaf == "donating_jit":
+            continue
+        if d in ("jax.jit", "jit") or (leaf == "jit" and d.startswith("jax")):
+            a.report(
+                "SL001", node,
+                "bare jax.jit with donate_argnums (heap-corruption class on "
+                "deserialized XLA:CPU executables)",
+            )
+        elif leaf == "partial" and any(
+            a._transform_kind(a._dotted(arg)) == "jit"
+            and a._dotted(arg) != "donating_jit"
+            and not (a._dotted(arg) or "").endswith(".donating_jit")
+            for arg in node.args
+        ):
+            a.report(
+                "SL001", node,
+                "partial(jax.jit, donate_argnums=...) outside donating_jit",
+            )
+
+
+def _check_sl002(a: _FileAnalysis, ctx: ast.AST) -> None:
+    for node in ast.walk(ctx):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _HOST_SYNC_METHODS:
+            a.report(
+                "SL002", node,
+                f".{func.attr}() on a traced value inside a jit/scan/vmap body",
+            )
+            continue
+        d = a._dotted(func)
+        if d is not None:
+            root, _, leaf = d.rpartition(".")
+            if root in a.np_roots and leaf in ("asarray", "array") and node.args:
+                if not _is_literal(node.args[0]):
+                    a.report(
+                        "SL002", node,
+                        f"{root}.{leaf}() materializes a traced value on host "
+                        "inside a jit/scan/vmap body",
+                    )
+                continue
+            if d == "jax.device_get":
+                a.report("SL002", node, "jax.device_get inside a jit/scan/vmap body")
+                continue
+        if (
+            isinstance(func, ast.Name)
+            and func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and not _is_literal(node.args[0])
+        ):
+            arg = node.args[0]
+            shapeish = _contains(
+                arg,
+                lambda n: (
+                    isinstance(n, ast.Attribute) and n.attr in _SHAPE_ATTRS
+                ) or (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "len"
+                ),
+            )
+            if not shapeish:
+                a.report(
+                    "SL002", node,
+                    f"{func.id}() forces a device->host sync on a traced value "
+                    "inside a jit/scan/vmap body",
+                )
+
+
+def _check_sl003(a: _FileAnalysis, ctx: ast.AST) -> None:
+    def tracerish(expr: ast.AST) -> bool:
+        def pred(n: ast.AST) -> bool:
+            if not isinstance(n, ast.Call):
+                return False
+            d = a._dotted(n.func)
+            if d is not None and d.split(".", 1)[0] in a.jnp_roots:
+                return True
+            if d is not None and d.startswith("jax.numpy."):
+                return True
+            return (
+                isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("any", "all")
+                and not n.args
+            )
+        return _contains(expr, pred)
+
+    for node in ast.walk(ctx):
+        if isinstance(node, (ast.If, ast.While)) and tracerish(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            a.report(
+                "SL003", node,
+                f"Python `{kind}` on a traced array expression inside a "
+                "jit/scan/vmap body (use lax.cond/lax.while_loop/lax.select)",
+            )
+        elif isinstance(node, ast.Assert) and tracerish(node.test):
+            a.report(
+                "SL003", node,
+                "Python `assert` on a traced array inside a jit/scan/vmap "
+                "body (use checkify.check)",
+            )
+
+
+def _check_sl004(a: _FileAnalysis) -> None:
+    # (a) jit closure built inside a loop: every iteration pays a fresh trace
+    for node in ast.walk(a.tree):
+        if not (isinstance(node, ast.Call) and a._jit_like_call(node)):
+            continue
+        for p in a._parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                break
+            if isinstance(p, (ast.For, ast.While)):
+                a.report(
+                    "SL004", node,
+                    "jit closure built inside a loop body — hoist it so the "
+                    "executable is compiled once, not per iteration",
+                )
+                break
+    # (b) static_argnums naming a parameter with a mutable (unhashable) default
+    for node in ast.walk(a.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        static_nums: list[int] = []
+        for dec in node.decorator_list:
+            if not (isinstance(dec, ast.Call) and a._jit_like_call(dec)):
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "static_argnums" and isinstance(
+                    kw.value, (ast.Constant, ast.Tuple)
+                ):
+                    vals = (
+                        [kw.value.value]
+                        if isinstance(kw.value, ast.Constant)
+                        else [
+                            e.value
+                            for e in kw.value.elts
+                            if isinstance(e, ast.Constant)
+                        ]
+                    )
+                    static_nums.extend(v for v in vals if isinstance(v, int))
+        if not static_nums:
+            continue
+        params = node.args.args
+        defaults = node.args.defaults
+        offset = len(params) - len(defaults)
+        for num in static_nums:
+            if num < offset or num >= len(params):
+                continue
+            default = defaults[num - offset]
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                a.report(
+                    "SL004", node,
+                    f"static_argnums includes `{params[num].arg}` whose "
+                    "default is unhashable — every call raises or retraces",
+                )
+
+
+def _check_sl005(a: _FileAnalysis) -> None:
+    registered: set[str] = set()
+    for node in ast.walk(a.tree):
+        if isinstance(node, ast.Call):
+            d = a._dotted(node.func)
+            leaf = (d or "").rsplit(".", 1)[-1]
+            if leaf in (
+                "register_pytree_node",
+                "register_pytree_with_keys",
+                "register_dataclass",
+                "register_static",
+            ) and node.args and isinstance(node.args[0], ast.Name):
+                registered.add(node.args[0].id)
+    # names referenced inside any jit context
+    referenced: set[str] = set()
+    for ctx in a._top_level_contexts():
+        for node in ast.walk(ctx):
+            if isinstance(node, ast.Name):
+                referenced.add(node.id)
+        if isinstance(ctx, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in (*ctx.args.args, *ctx.args.kwonlyargs):
+                ann = arg.annotation
+                if isinstance(ann, ast.Name):
+                    referenced.add(ann.id)
+                elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                    referenced.add(ann.value)
+    for node in ast.walk(a.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        is_dataclass = False
+        for dec in node.decorator_list:
+            d = a._dotted(dec.func if isinstance(dec, ast.Call) else dec)
+            if d and d.rsplit(".", 1)[-1] == "dataclass":
+                is_dataclass = True
+            if d and d.rsplit(".", 1)[-1] == "register_pytree_node_class":
+                registered.add(node.name)
+        if not is_dataclass:
+            continue
+        bases = [a._dotted(b) for b in node.bases]
+        if any(b not in (None, "object") for b in bases) or (
+            node.bases and any(b is None for b in bases)
+        ):
+            continue  # a base class (e.g. nn.Module) may auto-register
+        if node.name in registered or node.name not in referenced:
+            continue
+        a.report(
+            "SL005", node,
+            f"@dataclass `{node.name}` is used inside jitted code but never "
+            "registered with jax.tree_util",
+        )
+
+
+def _check_sl006(a: _FileAnalysis) -> None:
+    if "parallel" not in Path(a.path).parts:
+        return
+    shardish = (
+        "NamedSharding", "PartitionSpec", "shard_map", "device_put_sharded",
+    )
+    for ctx in a._top_level_contexts():
+        touches, constrained = False, False
+        for node in ast.walk(ctx):
+            if isinstance(node, ast.Name) and node.id in shardish:
+                touches = True
+            elif isinstance(node, ast.Attribute) and node.attr in shardish:
+                touches = True
+            if isinstance(node, ast.Call):
+                d = a._dotted(node.func) or ""
+                leaf = d.rsplit(".", 1)[-1]
+                if leaf in ("with_sharding_constraint", "constrain"):
+                    constrained = True
+        if touches and not constrained:
+            a.report(
+                "SL006", ctx,
+                "jitted function builds shardings but never applies "
+                "with_sharding_constraint — layout is left to GSPMD",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_source(
+    src: str, path: str = "<string>", select: Optional[set[str]] = None
+) -> list[Violation]:
+    try:
+        analysis = _FileAnalysis(src, path)
+    except SyntaxError as exc:
+        raise ValueError(f"{path}: cannot parse: {exc}") from exc
+    _check_sl001(analysis)
+    _check_sl004(analysis)
+    _check_sl005(analysis)
+    _check_sl006(analysis)
+    for ctx in analysis._top_level_contexts():
+        _check_sl002(analysis, ctx)
+        _check_sl003(analysis, ctx)
+    per_line, file_level = _parse_suppressions(src)
+    out = []
+    for v in analysis.violations:
+        if select is not None and v.rule.id not in select:
+            continue
+        if "all" in file_level or v.rule.id in file_level:
+            continue
+        line_sup = per_line.get(v.line, set())
+        if "all" in line_sup or v.rule.id in line_sup:
+            continue
+        out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule.id))
+    return out
+
+
+def lint_file(path: str, select: Optional[set[str]] = None) -> list[Violation]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path, select=select)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for f in sorted(Path(p).rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                yield str(f)
+        else:
+            yield p
+
+
+def lint_paths(
+    paths: Iterable[str], select: Optional[set[str]] = None
+) -> list[Violation]:
+    out: list[Violation] = []
+    for f in iter_python_files(paths):
+        out.extend(lint_file(f, select=select))
+    return out

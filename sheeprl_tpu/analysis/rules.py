@@ -1,0 +1,156 @@
+"""The sheeplint rule catalog.
+
+Every rule names a *statically detectable* JAX/TPU hazard class this codebase
+has either been bitten by (SL001 is the PR-1 heap-corruption class, SL004 is
+the 951-second compile-probe class) or that podracer-style TPU stacks
+(arXiv:2104.06272) treat as a hot-loop invariant: no host↔device syncs, no
+Python control flow on tracers, no per-step recompiles. Rules carry an id,
+severity, one-line summary, and an autofix hint printed with each finding.
+
+Suppression: append `# sheeplint: disable=SL002` to the offending line (or
+put the comment alone on the line above), `disable=all` for every rule, or a
+file-level `# sheeplint: disable-file=SL003` anywhere in the file. Every
+suppression in this repo must carry a justification in the same comment —
+the self-lint test keeps the repo at zero unsuppressed findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["Rule", "Violation", "RULES", "rule_ids"]
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+    autofix: str
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: Rule
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule.id} "
+            f"[{self.rule.severity}] {self.message} (fix: {self.rule.autofix})"
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule.id,
+            "severity": self.rule.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "autofix": self.rule.autofix,
+        }
+
+
+_RULES = [
+    Rule(
+        id="SL001",
+        name="bare-donating-jit",
+        severity=ERROR,
+        summary=(
+            "bare jax.jit(..., donate_argnums=...) outside utils/jit."
+            "donating_jit — deserialized donating executables corrupt the "
+            "glibc heap on XLA:CPU with the persistent cache (PR 1)"
+        ),
+        autofix=(
+            "use sheeprl_tpu.utils.jit.donating_jit (same signature); keep "
+            "raw donation only for sub-cache-floor compiles, with a "
+            "justified suppression"
+        ),
+    ),
+    Rule(
+        id="SL002",
+        name="host-sync-in-jit",
+        severity=ERROR,
+        summary=(
+            "host-sync call (.item()/.tolist()/float()/int()/bool()/"
+            "np.asarray/device_get/block_until_ready) on a traced value "
+            "inside a jit/scan/vmap body — forces a device round-trip per "
+            "trace and breaks the single-dispatch hot loop"
+        ),
+        autofix=(
+            "keep the value on device (jnp ops), or move the sync outside "
+            "the traced function; for debugging use jax.debug.print"
+        ),
+    ),
+    Rule(
+        id="SL003",
+        name="python-branch-on-tracer",
+        severity=ERROR,
+        summary=(
+            "Python if/while on a traced array inside a jit/scan/vmap body "
+            "— raises TracerBoolConversionError or silently bakes one "
+            "branch at trace time"
+        ),
+        autofix=(
+            "use jax.lax.cond / lax.select / lax.while_loop, or "
+            "checkify for error branches"
+        ),
+    ),
+    Rule(
+        id="SL004",
+        name="recompile-hazard",
+        severity=WARNING,
+        summary=(
+            "recompile hazard: jit closure built inside a per-step loop, or "
+            "static_argnums over an unhashable (mutable-default) parameter "
+            "— every call site pays a fresh XLA trace/compile"
+        ),
+        autofix=(
+            "hoist the jit out of the loop (build once, call per step) and "
+            "make static args hashable (tuples, not lists)"
+        ),
+    ),
+    Rule(
+        id="SL005",
+        name="unregistered-dataclass-pytree",
+        severity=ERROR,
+        summary=(
+            "@dataclass used inside jitted code without jax.tree_util "
+            "registration — leaves are invisible to tracing/grad and the "
+            "instance is retraced as a static constant"
+        ),
+        autofix=(
+            "register with jax.tree_util.register_dataclass / "
+            "register_pytree_node_class, or subclass sheeprl_tpu.nn.Module "
+            "(auto-registers)"
+        ),
+    ),
+    Rule(
+        id="SL006",
+        name="unconstrained-sharded-jit",
+        severity=WARNING,
+        summary=(
+            "jitted function in parallel/ builds shardings but never "
+            "applies with_sharding_constraint — GSPMD is free to gather "
+            "the array onto one device inside the jit"
+        ),
+        autofix=(
+            "pin layouts with jax.lax.with_sharding_constraint (or the "
+            "mesh.make_constrain helper) at the function's phase boundaries"
+        ),
+    ),
+]
+
+RULES: dict[str, Rule] = {r.id: r for r in _RULES}
+
+
+def rule_ids() -> list[str]:
+    return sorted(RULES)

@@ -1,0 +1,141 @@
+"""Runtime transfer/donation sanitizer (`--sanitize`), the dynamic half of
+sheeplint.
+
+The linter proves the *code* cannot host-sync inside a trace; the sanitizer
+proves the *run* does not smuggle implicit host<->device transfers into
+phases that must be device-only, and that the train step's arithmetic stays
+finite. Two mechanisms, both off unless `--sanitize` is passed (zero
+overhead otherwise):
+
+  - transfer guard: `checked(phase, fn, ...)` runs `fn` under
+    `jax.transfer_guard("disallow")`. An implicit transfer raises inside
+    XLA; the wrapper records it (first occurrence per phase emits a
+    `sanitizer.transfer` telemetry event with the guard message), then
+    RERUNS the call unguarded so training continues — sanitize mode audits,
+    it does not crash the run.
+  - checkify: `checkified(fn)` wraps a train step with
+    `checkify.checkify(..., errors=float_checks)` under jit; after each
+    call the error payload is consumed and any NaN/div finding emits a
+    `sanitizer.checkify` event.
+
+Violation counts ride the normal metric pipeline via `gauges()`
+(`Sanitizer/...` keys), so tensorboard and telemetry.jsonl both show them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = ["Sanitizer"]
+
+
+class Sanitizer:
+    def __init__(self, enabled: bool = False, telemetry: Any = None):
+        self.enabled = enabled
+        self.telemetry = telemetry
+        # (phase, kind) -> count; kinds: "transfer", "checkify"
+        self.counts: dict[tuple[str, str], int] = {}
+        if enabled:
+            self._emit(
+                "sanitizer.start",
+                transfer_guard="disallow (guarded phases)",
+                checkify="float_checks (nan + div)",
+            )
+
+    @classmethod
+    def from_args(cls, args: Any, telemetry: Any = None) -> "Sanitizer":
+        """Construction helper mirroring Telemetry.from_args: reads the
+        StandardArgs `sanitize` flag every algo parser now carries."""
+        return cls(bool(getattr(args, "sanitize", False)), telemetry)
+
+    # ---- plumbing ---------------------------------------------------------
+    def _emit(self, event: str, **data: Any) -> None:
+        if self.telemetry is not None:
+            try:
+                self.telemetry.event(event, **data)
+            except Exception:
+                pass
+
+    def _record(self, phase: str, kind: str, message: str) -> None:
+        key = (phase, kind)
+        first = key not in self.counts
+        self.counts[key] = self.counts.get(key, 0) + 1
+        if first:
+            self._emit(
+                f"sanitizer.{kind}", phase=phase, message=message[:500]
+            )
+
+    def gauges(self) -> dict[str, float]:
+        """Interval-merged counters (register with telem.add_gauges)."""
+        if not self.enabled:
+            return {}
+        out = {
+            f"Sanitizer/{kind}_{phase}": float(n)
+            for (phase, kind), n in self.counts.items()
+        }
+        out["Sanitizer/enabled"] = 1.0
+        return out
+
+    # ---- transfer guard ---------------------------------------------------
+    def checked(self, phase: str, fn: Callable, *args: Any, **kwargs: Any):
+        """Run `fn` under transfer_guard("disallow"); on an implicit-transfer
+        trip, record it and rerun unguarded (audit, don't crash)."""
+        if not self.enabled:
+            return fn(*args, **kwargs)
+        import jax
+
+        try:
+            with jax.transfer_guard("disallow"):
+                return fn(*args, **kwargs)
+        except Exception as exc:
+            message = str(exc)
+            if "transfer" not in message.lower():
+                raise
+            self._record(phase, "transfer", message.splitlines()[0])
+            return fn(*args, **kwargs)
+
+    # ---- checkify ---------------------------------------------------------
+    def checkified(
+        self,
+        fn: Callable,
+        *,
+        phase: str = "train",
+        jit: Optional[Callable] = None,
+    ) -> Callable:
+        """Wrap `fn` with checkify float checks under jit; the wrapper keeps
+        `fn`'s signature and return value, consuming the error channel into
+        telemetry. `jit` overrides the jit transform (default jax.jit —
+        donation is intentionally dropped: the checkify error args shift
+        argnums, and sanitize runs are audits, not perf runs)."""
+        if not self.enabled:
+            raise RuntimeError("checkified() requires an enabled Sanitizer")
+        import jax
+        from jax.experimental import checkify
+
+        checked = (jit or jax.jit)(
+            checkify.checkify(fn, errors=checkify.float_checks)
+        )
+        # visible proof in telemetry.jsonl that the run's train step carried
+        # float checks, even when it never trips
+        self._emit("sanitizer.checkify_armed", phase=phase)
+
+        def wrapper(*args: Any, **kwargs: Any):
+            err, out = checked(*args, **kwargs)
+            msg = err.get()
+            if msg:
+                self._record(phase, "checkify", msg)
+            return out
+
+        return wrapper
+
+    def close(self) -> None:
+        """Emit the end-of-run violation summary event."""
+        if not self.enabled:
+            return
+        self._emit(
+            "sanitizer.summary",
+            counts={
+                f"{kind}:{phase}": n for (phase, kind), n in self.counts.items()
+            },
+            clean=not self.counts,
+        )

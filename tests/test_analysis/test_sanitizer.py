@@ -1,0 +1,129 @@
+"""Runtime sanitizer (`--sanitize`) receipts: the transfer guard records
+real implicit transfers without crashing the run, checkify findings reach
+telemetry, and a full algo main runs end-to-end in sanitize mode with the
+events visible in telemetry.jsonl (ISSUE 3 acceptance)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.analysis import Sanitizer
+
+
+class FakeTelemetry:
+    def __init__(self):
+        self.events = []
+
+    def event(self, name, **data):
+        self.events.append({"event": name, **data})
+
+
+def test_disabled_sanitizer_is_transparent():
+    s = Sanitizer(enabled=False)
+    assert s.checked("x", lambda a: a + 1, 1) == 2
+    assert s.gauges() == {}
+    with pytest.raises(RuntimeError):
+        s.checkified(lambda x: x)
+    s.close()  # no-op
+
+
+def test_checked_records_transfer_and_reruns():
+    telem = FakeTelemetry()
+    s = Sanitizer(enabled=True, telemetry=telem)
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones(4))  # warm outside the guard
+
+    # implicit h2d (numpy arg into a jitted fn) must be recorded, and the
+    # call must still produce the right answer via the unguarded rerun
+    out = s.checked("train", f, np.ones(4, np.float32))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    trips = [e for e in telem.events if e["event"] == "sanitizer.transfer"]
+    assert len(trips) == 1 and trips[0]["phase"] == "train"
+    assert "transfer" in trips[0]["message"].lower()
+
+    # second trip in the same phase: counted, not re-emitted
+    s.checked("train", f, np.ones(4, np.float32))
+    assert len([e for e in telem.events if e["event"] == "sanitizer.transfer"]) == 1
+    assert s.gauges()["Sanitizer/transfer_train"] == 2.0
+
+    # clean call (device-resident arg) records nothing new
+    s.checked("clean", f, jnp.ones(4))
+    assert s.gauges().get("Sanitizer/transfer_clean") is None
+
+    s.close()
+    summary = telem.events[-1]
+    assert summary["event"] == "sanitizer.summary" and not summary["clean"]
+
+
+def test_checked_propagates_real_errors():
+    s = Sanitizer(enabled=True)
+
+    def boom():
+        raise ValueError("not a transfer problem")
+
+    with pytest.raises(ValueError):
+        s.checked("x", boom)
+
+
+def test_checkified_reports_nan_div():
+    telem = FakeTelemetry()
+    s = Sanitizer(enabled=True, telemetry=telem)
+
+    wrapped = s.checkified(lambda x: jnp.log(x) / (x - 1.0), phase="train")
+    assert any(e["event"] == "sanitizer.checkify_armed" for e in telem.events)
+
+    np.testing.assert_allclose(float(wrapped(jnp.float32(2.0))), np.log(2.0))
+    assert s.gauges().get("Sanitizer/checkify_train") is None
+
+    wrapped(jnp.float32(1.0))  # log(1)/0 -> division by zero
+    checks = [e for e in telem.events if e["event"] == "sanitizer.checkify"]
+    assert len(checks) == 1 and "divi" in checks[0]["message"]
+    assert s.gauges()["Sanitizer/checkify_train"] == 1.0
+
+
+@pytest.mark.timeout(300)
+def test_ppo_dry_run_sanitize_smoke(tmp_path):
+    """One algo end-to-end (CPU, dry-run scale) with --sanitize: the run
+    completes and telemetry.jsonl carries the sanitizer lifecycle — start,
+    checkify instrumentation on the train step, and the end-of-run
+    summary."""
+    from sheeprl_tpu.algos.ppo.ppo import main
+
+    root = str(tmp_path / "sanitize_smoke")
+    main([
+        "--dry_run", "--sanitize", "--num_envs", "2", "--rollout_steps", "8",
+        "--total_steps", "16", "--checkpoint_every", "-1",
+        "--root_dir", root, "--run_name", "r0",
+    ])
+    telemetry_path = os.path.join(root, "r0", "telemetry.jsonl")
+    assert os.path.exists(telemetry_path)
+    events = [json.loads(l) for l in open(telemetry_path)]
+    names = [e["event"] for e in events]
+    assert "sanitizer.start" in names
+    assert "sanitizer.checkify_armed" in names
+    assert "sanitizer.summary" in names
+    # transfer trips, if any, must have been audited (recorded + rerun),
+    # never fatal — and the interval metrics carry the enabled gauge
+    logged = [e for e in events if e["event"] == "log"]
+    assert any(
+        e["metrics"].get("Sanitizer/enabled") == 1.0 for e in logged
+    ), "sanitizer gauges never reached the metric pipeline"
+
+
+@pytest.mark.timeout(120)
+def test_ppo_dry_run_without_sanitize_has_no_sanitizer_events(tmp_path):
+    from sheeprl_tpu.algos.ppo.ppo import main
+
+    root = str(tmp_path / "plain")
+    main([
+        "--dry_run", "--num_envs", "2", "--rollout_steps", "8",
+        "--total_steps", "16", "--checkpoint_every", "-1",
+        "--root_dir", root, "--run_name", "r0",
+    ])
+    telemetry_path = os.path.join(root, "r0", "telemetry.jsonl")
+    events = [json.loads(l) for l in open(telemetry_path)]
+    assert not [e for e in events if e["event"].startswith("sanitizer.")]

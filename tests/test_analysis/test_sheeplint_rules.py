@@ -1,0 +1,386 @@
+"""Per-rule positive/negative fixtures for sheeplint (ISSUE 3 satellite):
+every rule must fire on its seeded violation, stay silent on the idiomatic
+equivalent, and honor the `# sheeplint: disable=` suppression forms."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from sheeprl_tpu.analysis.linter import lint_source
+from sheeprl_tpu.analysis.rules import RULES, rule_ids
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def ids(src: str, path: str = "fixture.py") -> list:
+    return [v.rule.id for v in lint_source(textwrap.dedent(src), path)]
+
+
+def lines(src: str, path: str = "fixture.py") -> dict:
+    return {
+        v.line: v.rule.id for v in lint_source(textwrap.dedent(src), path)
+    }
+
+
+# ---------------------------------------------------------------------------
+# SL001 — bare donating jit
+# ---------------------------------------------------------------------------
+
+
+def test_sl001_positive_direct_and_partial():
+    src = """
+    import jax
+    from functools import partial
+
+    f = jax.jit(lambda x: x, donate_argnums=(0,))
+
+    @partial(jax.jit, donate_argnums=0)
+    def g(x):
+        return x
+    """
+    assert ids(src) == ["SL001", "SL001"]
+
+
+def test_sl001_negative_donating_jit_and_plain_jit():
+    src = """
+    import jax
+    from functools import partial
+    from sheeprl_tpu.utils.jit import donating_jit
+
+    f = donating_jit(lambda x: x, donate_argnums=(0,))
+
+    @partial(donating_jit, donate_argnums=0)
+    def g(x):
+        return x
+
+    h = jax.jit(lambda x: x)
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# SL002 — host syncs inside traced bodies
+# ---------------------------------------------------------------------------
+
+
+def test_sl002_positive_forms():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    @jax.jit
+    def f(x):
+        a = x.item()
+        b = float(x * 2)
+        c = np.asarray(x)
+        return a + b
+
+    def body(carry, t):
+        q = int(carry)
+        return carry, q
+
+    def outer(xs):
+        return lax.scan(body, 0.0, xs)
+    """
+    assert ids(src) == ["SL002"] * 4
+
+
+def test_sl002_negative_shapes_literals_host_side():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        n = float(x.shape[0])
+        m = int(len(x.shape))
+        c = np.array([1, 2, 3])
+        return x * n * m + c.sum()
+
+    def host(x):
+        return float(x), np.asarray(x), x.item()
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# SL003 — Python control flow on tracers
+# ---------------------------------------------------------------------------
+
+
+def test_sl003_positive_if_while():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        if jnp.any(x > 0):
+            x = x + 1
+        while (x < 0).all():
+            x = x + 1
+        return x
+    """
+    assert ids(src) == ["SL003", "SL003"]
+
+
+def test_sl003_negative_static_branching_and_lax():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def f(x, flag: bool):
+        if flag:
+            x = x + 1
+        return lax.cond(x.sum() > 0, lambda v: v, lambda v: -v, x)
+
+    def host(x):
+        if jnp.any(x > 0):
+            return 1
+        return 0
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# SL004 — recompile hazards
+# ---------------------------------------------------------------------------
+
+
+def test_sl004_positive_jit_in_loop():
+    src = """
+    import jax
+
+    def step_loop(x):
+        for i in range(10):
+            f = jax.jit(lambda y: y + i)
+            x = f(x)
+        return x
+    """
+    assert ids(src) == ["SL004"]
+
+
+def test_sl004_positive_unhashable_static_default():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(1,))
+    def f(x, cfg=[1, 2]):
+        return x
+    """
+    assert ids(src) == ["SL004"]
+
+
+def test_sl004_negative_hoisted():
+    src = """
+    import jax
+    from functools import partial
+
+    f = jax.jit(lambda y: y + 1)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def g(x, cfg=(1, 2)):
+        return x
+
+    def step_loop(x):
+        for _ in range(10):
+            x = f(x)
+        return x
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# SL005 — unregistered dataclass pytrees
+# ---------------------------------------------------------------------------
+
+
+def test_sl005_positive_unregistered():
+    src = """
+    import dataclasses
+    import jax
+
+    @dataclasses.dataclass
+    class State:
+        x: object
+
+    @jax.jit
+    def step(s: State):
+        return State(s.x + 1)
+    """
+    assert ids(src) == ["SL005"]
+
+
+def test_sl005_negatives():
+    src = """
+    import dataclasses
+    import jax
+    from jax import tree_util
+    from sheeprl_tpu import nn
+
+    @dataclasses.dataclass
+    class Registered:
+        x: object
+    tree_util.register_dataclass(Registered, data_fields=("x",), meta_fields=())
+
+    @tree_util.register_pytree_node_class
+    @dataclasses.dataclass
+    class Decorated:
+        x: object
+
+    class ModuleChild(nn.Module):
+        x: object
+
+    @dataclasses.dataclass
+    class HostOnlyConfig:
+        lr: float
+
+    @jax.jit
+    def step(a: Registered, b: Decorated, c: ModuleChild):
+        return a, b, c
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# SL006 — unconstrained sharded jits in parallel/
+# ---------------------------------------------------------------------------
+
+_SL006_SRC = """
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+@jax.jit
+def bad(x, mesh):
+    s = NamedSharding(mesh, P("data"))
+    return x * 2
+
+@jax.jit
+def good(x, mesh):
+    s = NamedSharding(mesh, P("data"))
+    return jax.lax.with_sharding_constraint(x, s)
+"""
+
+
+def test_sl006_scoped_to_parallel_paths():
+    assert ids(_SL006_SRC, "sheeprl_tpu/parallel/topo.py") == ["SL006"]
+    # same code outside parallel/ is not in scope for the rule
+    assert ids(_SL006_SRC, "sheeprl_tpu/ops/topo.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_same_line_and_standalone():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        a = x.item()  # sheeplint: disable=SL002 — audited sync
+        # sheeplint: disable=SL002 — the justification of this one
+        # runs over several comment lines before the code line
+        b = x.item()
+        c = x.item()
+        return a + b + c
+    """
+    assert ids(src) == ["SL002"]  # only the unsuppressed third sync
+
+
+def test_suppression_file_level_and_all():
+    src = """
+    # sheeplint: disable-file=SL002
+    import jax
+
+    @jax.jit
+    def f(x):
+        if __import__("jax.numpy").any(x):
+            pass
+        return x.item()
+    """
+    assert "SL002" not in ids(src)
+    src_all = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()  # sheeplint: disable=all
+    """
+    assert ids(src_all) == []
+
+
+def test_suppressed_rule_ids_must_match():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()  # sheeplint: disable=SL001
+    """
+    assert ids(src) == ["SL002"]  # wrong id does not suppress
+
+
+# ---------------------------------------------------------------------------
+# Catalog + CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_rule_catalog_complete():
+    assert rule_ids() == ["SL001", "SL002", "SL003", "SL004", "SL005", "SL006"]
+    for rule in RULES.values():
+        assert rule.severity in ("error", "warning")
+        assert rule.summary and rule.autofix
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\nf = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+    )
+    clean = tmp_path / "clean.py"
+    clean.write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    cli = os.path.join(REPO, "tools", "sheeplint.py")
+
+    p = subprocess.run(
+        [sys.executable, cli, str(bad), "--format", "json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 1, p.stderr
+    payload = json.loads(p.stdout)
+    assert payload[0]["rule"] == "SL001" and payload[0]["line"] == 2
+
+    p = subprocess.run(
+        [sys.executable, cli, str(clean)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+
+    p = subprocess.run(
+        [sys.executable, cli, "--list-rules"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0
+    for rid in rule_ids():
+        assert rid in p.stdout
+
+
+def test_cli_select_filters_rules(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\nf = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+    )
+    cli = os.path.join(REPO, "tools", "sheeplint.py")
+    p = subprocess.run(
+        [sys.executable, cli, str(bad), "--select", "SL002"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr

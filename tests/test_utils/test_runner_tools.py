@@ -1,0 +1,170 @@
+"""Unit receipts for the ISSUE 3 satellite fixes in tools/ and bench.py:
+process matching in the session-end sweep, the bounded --eval-only path,
+and the ledger's code fingerprint + fresh-vs-re-emitted partial fields."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+# ---------------------------------------------------------------------------
+# sweep_runners: only real python processes running runner scripts
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_matches_only_python_runner_processes():
+    from sweep_runners import _is_runner_cmd
+
+    # real runners, in the shapes the autobench loop spawns them
+    assert _is_runner_cmd("python tools/dv1_learning_run.py --root logs/x")
+    assert _is_runner_cmd("python3 -u /root/repo/tools/pixel_chip_run.py")
+    assert _is_runner_cmd("/usr/bin/python3.10 tools/sac_ae_pixel_learning_run.py")
+
+    # ADVICE r5: these used to be SIGKILLed by the substring match
+    assert not _is_runner_cmd("tail -f logs/dv1_learning_run.py.out")
+    assert not _is_runner_cmd("vim tools/dv1_learning_run.py")
+    assert not _is_runner_cmd("grep -r pixel_chip_run.py tools/")
+    assert not _is_runner_cmd("less pixel_chip_run.py")
+    # the sweep itself, and unrelated python work
+    assert not _is_runner_cmd("python tools/sweep_runners.py --dry-run")
+    assert not _is_runner_cmd("python bench.py --tiny")
+    assert not _is_runner_cmd("python -m pytest tests/")
+    assert not _is_runner_cmd("")
+
+
+# ---------------------------------------------------------------------------
+# runner_common: --eval-only rides the same bounds as run_bounded
+# ---------------------------------------------------------------------------
+
+
+def test_run_eval_bounded_receipt(tmp_path):
+    from runner_common import run_eval_bounded
+
+    out = str(tmp_path / "receipt.json")
+    result = run_eval_bounded(
+        lambda: {"mean_return": 12.5, "returns": [12.5]},
+        out, {"recipe": {"algo": "x"}}, eval_budget_s=60.0,
+    )
+    assert result["status"] == "eval_receipt"
+    assert result["mean_return"] == 12.5
+    with open(out) as fh:
+        on_disk = json.load(fh)
+    assert on_disk["recipe"] == {"algo": "x"}
+    assert on_disk["eval_budget_s"] == 60.0
+    assert "train_plus_eval_seconds" in on_disk  # legacy consumer key
+
+
+def test_run_eval_bounded_soft_timeout(tmp_path):
+    from runner_common import run_eval_bounded
+
+    out = str(tmp_path / "receipt.json")
+
+    def slow_eval():
+        import time
+
+        time.sleep(30)
+        return {"mean_return": 0.0}
+
+    result = run_eval_bounded(
+        slow_eval, out, {}, eval_budget_s=1.0, hard_grace_s=600.0,
+    )
+    assert result["status"] == "stub_eval_timeout"
+    assert os.path.exists(out)
+
+
+def test_run_eval_bounded_crash_lands_stub(tmp_path):
+    from runner_common import run_eval_bounded
+
+    out = str(tmp_path / "receipt.json")
+    result = run_eval_bounded(
+        lambda: (_ for _ in ()).throw(RuntimeError("no checkpoint")),
+        out, {}, eval_budget_s=30.0,
+    )
+    assert result["status"] == "stub_no_eval"
+    assert "no checkpoint" in result["eval_error"]
+
+
+# ---------------------------------------------------------------------------
+# bench ledger: code fingerprint + fresh/re-emitted partial fields
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_meta_carries_code_fingerprint(tmp_path, monkeypatch):
+    sys.path.insert(0, REPO)
+    import bench
+
+    fp = bench._code_fingerprint()
+    assert fp and fp != "unknown"
+
+    path = str(tmp_path / "ledger.json")
+    led = bench.PhaseLedger(path, {"algo": "t"})
+    assert led.meta["code"] == fp
+    led.complete("A", {"on": [1.0]}, {"value": 1.0})
+    assert led.measured_this_run == ["A"]
+    assert led.headline["phases_measured_this_run"] == ["A"]
+    assert led.headline["resumed_from_sidecar"] is False
+
+    # same code: resume loads the phase, flags the sidecar origin
+    led2 = bench.PhaseLedger(path, {"algo": "t"})
+    assert led2.done("A")
+    assert led2.resumed_from_sidecar is True
+    led2.set_headline({"value": 1.0})
+    assert led2.headline["resumed_from_sidecar"] is True
+    assert led2.headline["phases_measured_this_run"] == []
+
+    # stale code: a sidecar written under a different fingerprint is
+    # discarded (ADVICE r5 — no SHEEPRL_TPU_BENCH_FRESH needed)
+    with open(path) as fh:
+        data = json.load(fh)
+    data["meta"]["code"] = "deadbeef0000"
+    with open(path, "w") as fh:
+        json.dump(data, fh)
+    led3 = bench.PhaseLedger(path, {"algo": "t"})
+    assert not led3.done("A")
+    assert led3.resumed_from_sidecar is False
+
+
+def test_bench_compile_cache_arming(monkeypatch):
+    import bench
+
+    # explicit '' disables; unset + tiny stays hermetic (no env mutation)
+    monkeypatch.setenv("SHEEPRL_TPU_COMPILE_CACHE", "")
+    bench._arm_compile_cache(tiny=False)
+    assert os.environ["SHEEPRL_TPU_COMPILE_CACHE"] == ""
+
+    monkeypatch.delenv("SHEEPRL_TPU_COMPILE_CACHE", raising=False)
+    bench._arm_compile_cache(tiny=True)
+    assert "SHEEPRL_TPU_COMPILE_CACHE" not in os.environ
+
+    # full bench: defaults to the runners' shared location and applies it
+    bench._arm_compile_cache(tiny=False)
+    assert os.environ["SHEEPRL_TPU_COMPILE_CACHE"] == "logs/jax_compile_cache"
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == "logs/jax_compile_cache"
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == "logs/jax_compile_cache"
+
+
+@pytest.fixture(autouse=True)
+def _restore_cache_config():
+    """test_bench_compile_cache_arming mutates global jax config + env; put
+    both back so the suite's shared-cache contract (conftest) holds."""
+    import jax
+
+    before_cfg = jax.config.jax_compilation_cache_dir
+    before_env = {
+        k: os.environ.get(k)
+        for k in ("SHEEPRL_TPU_COMPILE_CACHE", "JAX_COMPILATION_CACHE_DIR")
+    }
+    yield
+    jax.config.update("jax_compilation_cache_dir", before_cfg)
+    for k, v in before_env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v

@@ -73,7 +73,9 @@ def main() -> None:
 
     from functools import partial
 
-    @partial(jax.jit, donate_argnums=0)
+    from sheeprl_tpu.utils.jit import donating_jit
+
+    @partial(donating_jit, donate_argnums=0)
     def _legacy_store_add(store, data, rows, cols):
         # the round-2 scatter (removed from buffers.py when packing landed)
         return {

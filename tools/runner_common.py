@@ -72,17 +72,24 @@ def bounded_runner_main(
         "latest mid-run checkpoint is evaluated and the receipt marked "
         "partial/resumable",
     )
+    ap.add_argument(
+        "--eval-budget-s", type=float, default=1800.0,
+        help="wall-clock bound on the evaluation phase (both --eval-only "
+        "and the post-training eval share it; ADVICE r5: a bare eval "
+        "session must not outlive the session unbounded either)",
+    )
     ns = ap.parse_args()
     root = Path(ns.root)
     out = str(root) + ".json"
     if ns.eval_only:
-        t0 = time.time()
-        result = evaluate(root)
-        result["recipe"] = recipe
-        result["train_plus_eval_seconds"] = round(time.time() - t0, 1)
-        with open(out, "w") as fh:
-            json.dump(result, fh, indent=2)
-        print(json.dumps({k: result[k] for k in ("mean_return", "returns")}))
+        result = run_eval_bounded(
+            lambda: evaluate(root), out, {"recipe": recipe},
+            eval_budget_s=ns.eval_budget_s,
+        )
+        if "mean_return" in result:
+            print(json.dumps(
+                {k: result[k] for k in ("mean_return", "returns") if k in result}
+            ))
         print(f"[{tag}] receipt written to {out}", flush=True)
         return
     run_bounded(
@@ -91,7 +98,77 @@ def bounded_runner_main(
         lambda: evaluate(root),
         out,
         {"recipe": recipe},
+        eval_budget_s=ns.eval_budget_s,
     )
+
+
+def _write_receipt(receipt_path: str, payload: dict, suffix: str = "") -> None:
+    path = receipt_path + suffix
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".tmp", "w") as fh:
+        json.dump(payload, fh, indent=2)
+    os.replace(path + ".tmp", path)
+
+
+def run_eval_bounded(
+    eval_fn,
+    receipt_path: str,
+    meta: dict,
+    *,
+    eval_budget_s: float = 1800.0,
+    hard_grace_s: float = 1500.0,
+) -> dict:
+    """`--eval-only` twin of run_bounded's eval half (ADVICE r5): the same
+    SIGALRM soft bound plus a daemon hard timer, so a bare evaluation
+    session stuck in a pathological XLA compile ends by a known deadline
+    with a stub receipt instead of surviving as an orphan."""
+    t0 = time.time()
+
+    def _hard_exit() -> None:
+        _write_receipt(
+            receipt_path,
+            {
+                **meta,
+                "status": "stub_hard_deadline",
+                "note": "eval stuck in native code past the hard deadline",
+                "eval_budget_s": eval_budget_s,
+                "elapsed_s": round(time.time() - t0, 1),
+            },
+            suffix=".stub",
+        )
+        print(f"[runner] HARD deadline; stub written to {receipt_path}.stub",
+              flush=True)
+        os._exit(3)
+
+    hard_timer = threading.Timer(eval_budget_s + hard_grace_s, _hard_exit)
+    hard_timer.daemon = True
+    hard_timer.start()
+
+    def _raise(_sig, _frm):
+        raise BudgetExpired
+
+    signal.signal(signal.SIGALRM, _raise)
+    signal.signal(signal.SIGTERM, _raise)  # session-end sweep -> graceful
+    signal.alarm(max(1, int(eval_budget_s)))
+
+    result = {**meta, "eval_budget_s": eval_budget_s}
+    try:
+        result.update(eval_fn())
+        result["status"] = "eval_receipt"
+    except BudgetExpired:
+        result["status"] = "stub_eval_timeout"
+    except Exception as exc:
+        result["status"] = "stub_no_eval"
+        result["eval_error"] = repr(exc)
+    finally:
+        signal.alarm(0)
+        hard_timer.cancel()
+    result["elapsed_s"] = round(time.time() - t0, 1)
+    result["train_plus_eval_seconds"] = result["elapsed_s"]  # legacy key
+    _write_receipt(receipt_path, result)
+    print(json.dumps({k: result.get(k) for k in ("status", "mean_return")}),
+          flush=True)
+    return result
 
 
 def run_bounded(
@@ -115,11 +192,7 @@ def run_bounded(
     t0 = time.time()
 
     def _write(payload: dict, suffix: str = "") -> None:
-        path = receipt_path + suffix
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path + ".tmp", "w") as fh:
-            json.dump(payload, fh, indent=2)
-        os.replace(path + ".tmp", path)
+        _write_receipt(receipt_path, payload, suffix)
 
     def _hard_exit() -> None:
         _write(

@@ -24,6 +24,30 @@ import time
 PATTERNS = ("learning_run.py", "pixel_chip_run.py")
 
 
+def _is_runner_cmd(cmd: str) -> bool:
+    """True only for a python interpreter executing a runner SCRIPT: the
+    first token must be a python binary and a later token must be a path
+    whose basename matches a runner pattern (ADVICE r5: a plain substring
+    match also SIGKILLed `tail -f dv1_learning_run.py`, editors with the
+    file open, and greps over the tools tree)."""
+    tokens = cmd.split()
+    if len(tokens) < 2:
+        return False
+    interp = os.path.basename(tokens[0])
+    if not interp.startswith("python"):
+        return False
+    if "sweep_runners" in cmd:
+        return False
+    for tok in tokens[1:]:
+        if tok.startswith("-"):
+            continue  # interpreter flags (-u, -X, ...)
+        # first non-flag token is the script path (a `python -m pkg` runner
+        # would not match the .py patterns, correctly)
+        base = os.path.basename(tok)
+        return any(base.endswith(p) for p in PATTERNS)
+    return False
+
+
 def find_runners() -> dict[int, str]:
     out = subprocess.run(
         ["ps", "-e", "-o", "pid=,args="], capture_output=True, text=True
@@ -31,7 +55,7 @@ def find_runners() -> dict[int, str]:
     procs = {}
     for line in out.splitlines():
         pid_s, _, cmd = line.strip().partition(" ")
-        if any(p in cmd for p in PATTERNS) and "sweep_runners" not in cmd:
+        if _is_runner_cmd(cmd.strip()):
             procs[int(pid_s)] = cmd.strip()
     return procs
 

@@ -83,6 +83,16 @@ class StandardArgs:
     profile_steps: int = Arg(
         default=5, help="number of training iterations in the profile window"
     )
+    pipeline: str = Arg(
+        default="off",
+        help="critical-path latency hiding (parallel/pipeline.py): 'on' "
+        "overlaps the per-step action device->host pull with host replay "
+        "bookkeeping (ActionPipeline), double-buffers the replay sample so "
+        "the index put + gather run during the train step "
+        "(SamplePrefetcher, epoch-guarded: bit-exact vs 'off'), and defers "
+        "the metric drain's host pulls by one logging interval "
+        "(MetricDrain). 'off' is the synchronous path",
+    )
     sanitize: bool = Arg(
         default=False,
         help="runtime transfer/donation sanitizer (sheeplint's dynamic "
@@ -98,6 +108,8 @@ class StandardArgs:
             raise ValueError(
                 f"precision must be 'float32' or 'bfloat16', got {value!r}"
             )
+        if name == "pipeline" and value not in ("on", "off"):
+            raise ValueError(f"pipeline must be 'on' or 'off', got {value!r}")
         super().__setattr__(name, value)
         if name == "log_dir" and value:
             os.makedirs(value, exist_ok=True)

@@ -24,6 +24,7 @@ from ...data import AsyncReplayBuffer, stage_batch
 from ...envs import make_vector_env
 from ...ops.distributions import Bernoulli, Independent, Normal
 from ...parallel import (
+    Pipeline,
     assert_divisible,
     distributed_setup,
     make_mesh,
@@ -349,6 +350,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v1")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
 
     envs = make_vector_env(
         [
@@ -533,7 +535,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 player, player_state, device_obs, step_key,
                 jnp.float32(expl_amount), mask,
             )
-            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
+            env_idx = pipe.action.fetch(env_idx_dev)  # the ONLY per-step d2h pull
             env_actions = list(
                 indices_to_env_actions(env_idx, actions_dim, is_continuous)
             )
@@ -600,7 +602,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         if global_step >= learning_starts and step_before_training <= 0:
             telem.mark("buffer/sample")
-            local_data = rb.sample(
+            local_data = pipe.sampler(rb).sample(
                 args.per_rank_batch_size,
                 sequence_length=args.per_rank_sequence_length,
                 n_samples=args.gradient_steps if not args.dry_run else 1,
@@ -634,9 +636,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         sps = (global_step - start_step + 1) * single_global_step / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
-        aggregator.reset()
 
         if (
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
@@ -663,6 +665,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     run_test_episodes(

@@ -35,6 +35,7 @@ from ...ops.distributions import (
     TanhNormal,
 )
 from ...parallel import (
+    Pipeline,
     assert_divisible,
     distributed_setup,
     make_mesh,
@@ -450,6 +451,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v2")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
 
     envs = make_vector_env(
         [
@@ -670,7 +672,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 player, player_state, device_obs, step_key,
                 jnp.float32(expl_amount), mask,
             )
-            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
+            env_idx = pipe.action.fetch(env_idx_dev)  # the ONLY per-step d2h pull
             env_actions = list(
                 indices_to_env_actions(env_idx, actions_dim, is_continuous)
             )
@@ -773,13 +775,13 @@ def main(argv: Sequence[str] | None = None) -> None:
                 else args.gradient_steps
             )
             if buffer_type == "sequential":
-                local_data = rb.sample(
+                local_data = pipe.sampler(rb).sample(
                     args.per_rank_batch_size,
                     sequence_length=args.per_rank_sequence_length,
                     n_samples=n_samples,
                 )
             else:
-                local_data = rb.sample(
+                local_data = pipe.sampler(rb).sample(
                     args.per_rank_batch_size,
                     n_samples=n_samples,
                     prioritize_ends=args.prioritize_ends,
@@ -813,9 +815,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         sps = (global_step - start_step + 1) * single_global_step / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
-        aggregator.reset()
 
         # ---- checkpoint ------------------------------------------------------
         if (
@@ -844,6 +846,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     run_test_episodes(

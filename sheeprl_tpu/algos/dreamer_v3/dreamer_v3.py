@@ -47,6 +47,7 @@ from ...ops.distributions import (
     SymlogDistribution,
 )
 from ...parallel import (
+    Pipeline,
     assert_divisible,
     distributed_setup,
     make_mesh,
@@ -526,6 +527,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
 
     envs = make_vector_env(
         [
@@ -678,6 +680,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
     if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt) and not args.eval_only:
         rb.load(buffer_ckpt)
+    sampler = pipe.sampler(rb)
 
     aggregator = MetricAggregator()
     single_global_step = args.num_envs
@@ -761,9 +764,13 @@ def main(argv: Sequence[str] | None = None) -> None:
             player_state, env_idx_dev, row, idx_dev = blob_step(
                 player, player_state, jnp.asarray(blob), step_key, expl_dev
             )
+            # the d2h copy of the action indices starts NOW and lands while
+            # the replay scatter dispatches (ActionPipeline; with --pipeline
+            # off the handle is a plain deferred np.asarray)
+            idx_handle = pipe.action.dispatch(env_idx_dev)
             rb.add_direct(row, idx_dev)
             blob_added = True
-            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
+            env_idx = idx_handle.get()  # the ONLY per-step d2h pull
             env_actions = list(
                 indices_to_env_actions(env_idx, actions_dim, is_continuous)
             )
@@ -777,7 +784,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 player, player_state, device_obs, step_key,
                 expl_dev, mask,
             )
-            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
+            env_idx = pipe.action.fetch(env_idx_dev)  # the ONLY per-step d2h pull
             env_actions = list(
                 indices_to_env_actions(env_idx, actions_dim, is_continuous)
             )
@@ -862,7 +869,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 else args.gradient_steps
             )
             telem.mark("buffer/sample")
-            local_data = rb.sample(
+            local_data = sampler.sample(
                 args.per_rank_batch_size,
                 sequence_length=args.per_rank_sequence_length,
                 n_samples=n_samples,
@@ -900,9 +907,12 @@ def main(argv: Sequence[str] | None = None) -> None:
         sps = (global_step - start_step + 1) * args.num_envs / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        # deferred drain: with --pipeline on this resolves the PREVIOUS
+        # interval's snapshot (its d2h copies landed during this step) and
+        # costs zero synchronous round trips; off mode computes eagerly
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
-        aggregator.reset()
 
         # ---- checkpoint ------------------------------------------------------
         if (
@@ -932,6 +942,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     run_test_episodes(

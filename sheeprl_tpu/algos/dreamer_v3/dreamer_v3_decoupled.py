@@ -34,7 +34,12 @@ from ... import ops
 from ...data import AsyncReplayBuffer, stage_batch
 from ...envs import make_vector_env
 from ...envs.wrappers import RestartOnException
-from ...parallel import distributed_setup, make_decoupled_meshes, process_index
+from ...parallel import (
+    Pipeline,
+    distributed_setup,
+    make_decoupled_meshes,
+    process_index,
+)
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -106,6 +111,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3_decoupled")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
     telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
@@ -316,7 +322,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 player, player_state, device_obs, step_key,
                 jnp.float32(expl_amount), mask,
             )
-            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
+            env_idx = pipe.action.fetch(env_idx_dev)  # the ONLY per-step d2h pull
             env_actions = list(
                 indices_to_env_actions(env_idx, actions_dim, is_continuous)
             )
@@ -389,7 +395,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 else args.gradient_steps
             )
             telem.mark("buffer/sample")
-            local_data = rb.sample(
+            local_data = pipe.sampler(rb).sample(
                 args.per_rank_batch_size,
                 sequence_length=args.per_rank_sequence_length,
                 n_samples=n_samples,
@@ -437,9 +443,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         sps = (global_step - start_step + 1) * args.num_envs / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
-        aggregator.reset()
 
         # ---- checkpoint ------------------------------------------------------
         if (
@@ -469,6 +475,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     # the final update's refreshed weights may still be in flight: swap them

@@ -30,6 +30,7 @@ from ...data import AsyncReplayBuffer, EpisodeBuffer, stage_batch
 from ...envs import make_vector_env
 from ...ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
 from ...parallel import (
+    Pipeline,
     assert_divisible,
     distributed_setup,
     make_mesh,
@@ -563,6 +564,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="p2e_dv2")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
 
     envs = make_vector_env(
         [
@@ -803,7 +805,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 player, player_state, device_obs, step_key,
                 jnp.float32(expl_amount), mask,
             )
-            env_idx = np.asarray(env_idx_dev)  # the ONLY per-step d2h pull
+            env_idx = pipe.action.fetch(env_idx_dev)  # the ONLY per-step d2h pull
             env_actions = list(
                 indices_to_env_actions(env_idx, actions_dim, is_continuous)
             )
@@ -901,13 +903,13 @@ def main(argv: Sequence[str] | None = None) -> None:
                 else args.gradient_steps
             )
             if buffer_type == "sequential":
-                local_data = rb.sample(
+                local_data = pipe.sampler(rb).sample(
                     args.per_rank_batch_size,
                     sequence_length=args.per_rank_sequence_length,
                     n_samples=n_samples,
                 )
             else:
-                local_data = rb.sample(
+                local_data = pipe.sampler(rb).sample(
                     args.per_rank_batch_size,
                     n_samples=n_samples,
                     prioritize_ends=args.prioritize_ends,
@@ -942,9 +944,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         sps = (global_step - start_step + 1) * single_global_step / (
             time.perf_counter() - start_time
         )
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
-        aggregator.reset()
 
         if (
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
@@ -979,6 +981,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     player = make_player(state, exploring=False)

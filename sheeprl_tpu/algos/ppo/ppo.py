@@ -35,6 +35,7 @@ from ... import nn, ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import (
+    Pipeline,
     assert_divisible,
     distributed_setup,
     make_mesh,
@@ -245,6 +246,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
 
     envs = make_vector_env(
         [
@@ -330,7 +332,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             )
             # the only required d2h per step; under --sanitize the pull runs
             # guarded so the audit trail names exactly this sync site
-            env_idx_np = sanitizer.checked("rollout/d2h_pull", np.asarray, env_idx)
+            env_idx_np = sanitizer.checked("rollout/d2h_pull", pipe.action.fetch, env_idx)
             env_actions = indices_to_env_actions(
                 env_idx_np, actions_dim, is_continuous
             )
@@ -400,10 +402,10 @@ def main(argv: Sequence[str] | None = None) -> None:
         # ---- logging + checkpoint -------------------------------------------
         telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
         logger.log("Info/learning_rate", lr, global_step)
-        aggregator.reset()
         if (
             args.checkpoint_every > 0 and update % args.checkpoint_every == 0
         ) or args.dry_run or update == num_updates:
@@ -414,6 +416,8 @@ def main(argv: Sequence[str] | None = None) -> None:
                 block=args.dry_run or update == num_updates,
             )
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     # fresh env per episode: test() closes the env it is handed

@@ -25,7 +25,12 @@ import numpy as np
 from ... import ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
-from ...parallel import distributed_setup, make_decoupled_meshes, process_index
+from ...parallel import (
+    Pipeline,
+    distributed_setup,
+    make_decoupled_meshes,
+    process_index,
+)
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -81,6 +86,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo_decoupled")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
     telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
@@ -186,7 +192,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             actions, logprob, value, env_idx_dev = policy_step(
                 player_agent, device_obs, step_key
             )
-            env_idx = np.asarray(env_idx_dev)
+            env_idx = pipe.action.fetch(env_idx_dev)
             env_actions = indices_to_env_actions(env_idx, actions_dim, is_continuous)
             next_obs, rewards, terms, truncs, infos = envs.step(list(env_actions))
             dones = (terms | truncs).astype(np.float32)
@@ -252,10 +258,10 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
         logger.log("Info/learning_rate", lr, global_step)
-        aggregator.reset()
         if (
             args.checkpoint_every > 0 and update % args.checkpoint_every == 0
         ) or args.dry_run or update == num_updates:
@@ -266,6 +272,8 @@ def main(argv: Sequence[str] | None = None) -> None:
                 block=args.dry_run or update == num_updates,
             )
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     # drain the pipeline: final update's metrics + final weights to the player

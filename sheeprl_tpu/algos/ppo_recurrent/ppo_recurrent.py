@@ -33,6 +33,7 @@ from ... import nn, ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import (
+    Pipeline,
     assert_divisible,
     distributed_setup,
     make_mesh,
@@ -203,6 +204,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo_recurrent")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
 
     envs = make_vector_env(
         [
@@ -385,10 +387,10 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
         logger.log("Info/learning_rate", lr, global_step)
-        aggregator.reset()
         if (
             args.checkpoint_every > 0 and update % args.checkpoint_every == 0
         ) or args.dry_run or update == num_updates:
@@ -403,6 +405,8 @@ def main(argv: Sequence[str] | None = None) -> None:
                 block=args.dry_run or update == num_updates,
             )
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     # fresh env per episode: test() closes the env it is handed

@@ -32,6 +32,7 @@ from ... import nn
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import (
+    Pipeline,
     distributed_setup,
     make_mesh,
     process_index,
@@ -188,6 +189,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="sac")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
 
     envs = make_vector_env(
         [
@@ -296,7 +298,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             actions = np.stack([envs.single_action_space.sample() for _ in range(args.num_envs)])
         else:
             key, step_key = jax.random.split(key)
-            actions = np.asarray(policy_step(state.agent.actor, jnp.asarray(obs), step_key))
+            actions = pipe.action.fetch(policy_step(state.agent.actor, jnp.asarray(obs), step_key))
         next_obs, rewards, terms, truncs, infos = envs.step(list(actions))
         dones = np.logical_or(terms, truncs).astype(np.float32)
 
@@ -330,7 +332,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             global_batch = args.per_rank_batch_size * n_dev
             for _ in range(training_steps):
                 telem.mark("buffer/sample")
-                sample = rb.sample(
+                sample = pipe.sampler(rb).sample(
                     args.gradient_steps * global_batch,
                     sample_next_obs=args.sample_next_obs,
                 )
@@ -353,9 +355,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         # ---- logging + checkpoint -------------------------------------------
         telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
-        aggregator.reset()
         if (
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
@@ -375,6 +377,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + ".buffer.npz")
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     # fresh env per episode: test() closes the env it is handed

@@ -22,7 +22,12 @@ import numpy as np
 
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
-from ...parallel import distributed_setup, make_decoupled_meshes, process_index
+from ...parallel import (
+    Pipeline,
+    distributed_setup,
+    make_decoupled_meshes,
+    process_index,
+)
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -70,6 +75,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="sac_decoupled")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
     telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
@@ -180,7 +186,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         else:
             key, step_key = jax.random.split(key)
             device_obs = jax.device_put(jnp.asarray(obs), meshes.player_device)
-            actions = np.asarray(policy_step(player_actor, device_obs, step_key))
+            actions = pipe.action.fetch(policy_step(player_actor, device_obs, step_key))
         next_obs, rewards, terms, truncs, infos = envs.step(list(actions))
         dones = np.logical_or(terms, truncs).astype(np.float32)
 
@@ -211,7 +217,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             global_batch = args.per_rank_batch_size * meshes.num_trainers
             for _ in range(training_steps):
                 telem.mark("buffer/sample")
-                sample = rb.sample(
+                sample = pipe.sampler(rb).sample(
                     args.gradient_steps * global_batch,
                     sample_next_obs=args.sample_next_obs,
                 )
@@ -239,9 +245,9 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
-        aggregator.reset()
         if (
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
@@ -261,6 +267,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + ".buffer.npz")
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     # drain the pipeline: final update's metrics

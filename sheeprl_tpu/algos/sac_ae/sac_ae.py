@@ -31,6 +31,7 @@ from ... import nn
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import (
+    Pipeline,
     distributed_setup,
     make_mesh,
     process_index,
@@ -430,6 +431,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem = Telemetry.from_args(args, log_dir, rank, algo="sac_ae")
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
+    pipe = Pipeline.from_args(args, telem)
 
     envs = make_vector_env(
         [
@@ -643,7 +645,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             global_batch = args.per_rank_batch_size * n_dev
             for _ in range(training_steps):
                 telem.mark("buffer/sample")
-                sample = rb.sample(
+                sample = pipe.sampler(rb).sample(
                     args.gradient_steps * global_batch,
                     sample_next_obs=args.sample_next_obs,
                 )
@@ -669,9 +671,9 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         telem.mark("log")
         sps = global_step / (time.perf_counter() - start_time)
-        logger.log_dict(telem.interval(aggregator.compute(), global_step, sps), global_step)
+        for drained, dstep in pipe.drain_metrics(aggregator, global_step):
+            logger.log_dict(telem.interval(drained, dstep, sps), dstep)
         logger.log("Time/step_per_second", sps, global_step)
-        aggregator.reset()
         if (
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
@@ -694,6 +696,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + ".buffer.npz")
 
+    for drained, dstep in pipe.flush_metrics():
+        logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
     envs.close()
     # fresh env per episode: test_sac_ae() closes the env it is handed

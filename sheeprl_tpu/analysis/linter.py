@@ -356,30 +356,33 @@ def _check_sl001(a: _FileAnalysis) -> None:
             )
 
 
-def _check_sl002(a: _FileAnalysis, ctx: ast.AST) -> None:
+def _iter_host_syncs(a: _FileAnalysis, ctx: ast.AST):
+    """Yield `(call_node, kind, label)` for every blocking host-sync call
+    under `ctx` — the shared detector behind SL002 (syncs traced inside a
+    jit body) and SL007 (syncs on a hot-loop body's critical path). Kinds:
+    `method` (.item()/.tolist()/.block_until_ready()), `np`
+    (np.asarray/np.array on a non-literal), `device_get`,
+    `block_until_ready` (the jax.* function form), `cast`
+    (float()/int()/bool() on a non-shape expression)."""
     for node in ast.walk(ctx):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
         if isinstance(func, ast.Attribute) and func.attr in _HOST_SYNC_METHODS:
-            a.report(
-                "SL002", node,
-                f".{func.attr}() on a traced value inside a jit/scan/vmap body",
-            )
+            yield node, "method", f".{func.attr}()"
             continue
         d = a._dotted(func)
         if d is not None:
             root, _, leaf = d.rpartition(".")
             if root in a.np_roots and leaf in ("asarray", "array") and node.args:
                 if not _is_literal(node.args[0]):
-                    a.report(
-                        "SL002", node,
-                        f"{root}.{leaf}() materializes a traced value on host "
-                        "inside a jit/scan/vmap body",
-                    )
+                    yield node, "np", f"{root}.{leaf}()"
                 continue
             if d == "jax.device_get":
-                a.report("SL002", node, "jax.device_get inside a jit/scan/vmap body")
+                yield node, "device_get", "jax.device_get"
+                continue
+            if d == "jax.block_until_ready":
+                yield node, "block_until_ready", "jax.block_until_ready"
                 continue
         if (
             isinstance(func, ast.Name)
@@ -399,11 +402,26 @@ def _check_sl002(a: _FileAnalysis, ctx: ast.AST) -> None:
                 ),
             )
             if not shapeish:
-                a.report(
-                    "SL002", node,
-                    f"{func.id}() forces a device->host sync on a traced value "
-                    "inside a jit/scan/vmap body",
-                )
+                yield node, "cast", f"{func.id}()"
+
+
+def _check_sl002(a: _FileAnalysis, ctx: ast.AST) -> None:
+    for node, kind, label in _iter_host_syncs(a, ctx):
+        if kind == "method":
+            msg = f"{label} on a traced value inside a jit/scan/vmap body"
+        elif kind == "np":
+            msg = (
+                f"{label} materializes a traced value on host "
+                "inside a jit/scan/vmap body"
+            )
+        elif kind in ("device_get", "block_until_ready"):
+            msg = f"{label} inside a jit/scan/vmap body"
+        else:
+            msg = (
+                f"{label} forces a device->host sync on a traced value "
+                "inside a jit/scan/vmap body"
+            )
+        a.report("SL002", node, msg)
 
 
 def _check_sl003(a: _FileAnalysis, ctx: ast.AST) -> None:
@@ -571,6 +589,51 @@ def _check_sl006(a: _FileAnalysis) -> None:
             )
 
 
+_HOTLOOP_NAME_RE = re.compile(r"^_?(one_(cycle|step|update)|\w*hot_?loop\w*)$")
+_HOTLOOP_MARK_RE = re.compile(r"sheeplint:\s*hotloop")
+
+
+def _hotloop_marked_lines(src: str) -> set[int]:
+    """Lines carrying a `# sheeplint: hotloop` marker — the explicit way to
+    declare a function a hot-loop body when its name does not say so."""
+    marked: set[int] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT and _HOTLOOP_MARK_RE.search(tok.string):
+                marked.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return marked
+
+
+def _check_sl007(a: _FileAnalysis) -> None:
+    """Blocking host syncs on a hot-loop body's critical path. A function is
+    a hot-loop body when its NAME says so (one_cycle / one_step / one_update
+    / *hot_loop*) or a `# sheeplint: hotloop` marker sits on/above its def.
+    Syncs inside jit bodies are SL002's jurisdiction and skipped here."""
+    marked = _hotloop_marked_lines(a.src)
+    for node in ast.walk(a.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        anchor_lines = {node.lineno, node.lineno - 1}
+        for dec in node.decorator_list:
+            anchor_lines |= {dec.lineno, dec.lineno - 1}
+        hot = bool(_HOTLOOP_NAME_RE.match(node.name)) or bool(
+            anchor_lines & marked
+        )
+        if not hot or a._in_jit_context(node):
+            continue
+        for call, _, label in _iter_host_syncs(a, node):
+            if any(p in a.jit_contexts for p in a._parents(call)):
+                continue  # traced body: SL002 reports it
+            a.report(
+                "SL007", call,
+                f"{label} blocks hot-loop body `{node.name}` — defer the "
+                "pull (parallel/pipeline.py) or move it off the loop",
+            )
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -587,6 +650,7 @@ def lint_source(
     _check_sl004(analysis)
     _check_sl005(analysis)
     _check_sl006(analysis)
+    _check_sl007(analysis)
     for ctx in analysis._top_level_contexts():
         _check_sl002(analysis, ctx)
         _check_sl003(analysis, ctx)

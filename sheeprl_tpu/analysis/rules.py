@@ -147,6 +147,25 @@ _RULES = [
             "mesh.make_constrain helper) at the function's phase boundaries"
         ),
     ),
+    Rule(
+        id="SL007",
+        name="host-sync-in-hot-loop",
+        severity=WARNING,
+        summary=(
+            "blocking host sync (.item()/.tolist()/float()/int()/bool()/"
+            "np.asarray/jax.device_get/block_until_ready) inside a "
+            "hot-loop body (a function named one_cycle/one_step/"
+            "one_update/*hot_loop*, or marked `# sheeplint: hotloop`) — "
+            "the pull serializes the critical path the pipeline "
+            "primitives exist to overlap"
+        ),
+        autofix=(
+            "route the pull through sheeprl_tpu.parallel.pipeline "
+            "(ActionPipeline dispatch/get, SamplePrefetcher, MetricDrain) "
+            "or move it off the hot loop; intentional sync barriers "
+            "(timing fences) get a justified suppression"
+        ),
+    ),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

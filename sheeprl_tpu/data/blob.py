@@ -22,6 +22,16 @@ Byte order: numpy views on a little-endian host and XLA's
 `bitcast_convert_type` (which defines the minor dimension as the
 little-endian pieces of the wider element) agree, so the roundtrip is
 bit-exact — asserted by `tests/test_data/test_blob.py`.
+
+Pipeline ordering contract (ISSUE 4): with the latency-hiding pipeline on,
+the loop dispatches the action indices' `copy_to_host_async`
+(`ActionPipeline.dispatch`) BETWEEN the blob jit returning and
+`rb.add_direct` — the copy then overlaps the replay scatter's dispatch —
+and blocks on the host value only at `env.step`. `add_direct` commits the
+`reserve()`d head advance and bumps `buffer.epoch`, which is exactly the
+counter the `SamplePrefetcher` epoch-consistency guard reads: a sample
+prefetched before the commit can never be served as if it contained the
+row, because the commit advances the epoch past the prefetch's snapshot.
 """
 
 from __future__ import annotations

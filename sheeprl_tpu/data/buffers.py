@@ -185,6 +185,7 @@ class ReplayBuffer:
         self._buf: dict[str, np.ndarray] | dict[str, jax.Array] | None = None
         self._pos = 0
         self._full = False
+        self._epoch = 0
         self._np_rng = np.random.default_rng(seed)
         self._key = jax.random.PRNGKey(seed)
 
@@ -218,6 +219,25 @@ class ReplayBuffer:
         return self._storage_kind == "device"
 
     @property
+    def epoch(self) -> int:
+        """Monotonic write counter, bumped by every ring mutation (add /
+        set_at / __setitem__ / restore). The pipeline SamplePrefetcher's
+        epoch-consistency guard compares epochs to decide whether a
+        prefetched batch still reflects the current ring contents."""
+        return self._epoch
+
+    def get_sample_state(self):
+        """Snapshot of the sampler's PRNG state (device key + numpy rng).
+        The SamplePrefetcher rewinds to this on a discarded prefetch so the
+        fresh resample draws the same key the synchronous path would have —
+        the bit-exact half of the epoch-consistency guard."""
+        return (self._key, self._np_rng.bit_generator.state)
+
+    def set_sample_state(self, state) -> None:
+        self._key = state[0]
+        self._np_rng.bit_generator.state = state[1]
+
+    @property
     def shape(self):
         if self._buf is None:
             return None
@@ -241,6 +261,7 @@ class ReplayBuffer:
             self._buf[key] = jnp.asarray(value)
         else:
             self._buf[key][:] = np.asarray(value)
+        self._epoch += 1
 
     @property
     def pos(self) -> int:
@@ -256,6 +277,7 @@ class ReplayBuffer:
             self._buf[key] = self._buf[key].at[time_idx].set(value)
         else:
             self._buf[key][time_idx] = value
+        self._epoch += 1
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -330,6 +352,7 @@ class ReplayBuffer:
         if self._pos + data_len >= self._buffer_size:
             self._full = True
         self._pos = (self._pos + data_len) % self._buffer_size
+        self._epoch += 1
 
     # -- sampling ------------------------------------------------------------
     def _valid_ranges(self, exclude: int) -> tuple[int, int]:
@@ -440,6 +463,7 @@ class ReplayBuffer:
                     self._buf[k][:] = v
         self._pos = int(state["pos"])
         self._full = bool(state["full"])
+        self._epoch += 1
 
     def save(self, path: str) -> None:
         """Serialize the ring + head state to one `.npz` (the off-policy
@@ -594,6 +618,21 @@ class EpisodeBuffer:
         if self._memmap_dir is not None:
             self._memmap_dir.mkdir(parents=True, exist_ok=True)
         self._np_rng = np.random.default_rng(seed)
+        self._epoch = 0
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def is_device_backed(self) -> bool:
+        return False  # episodes live on host; prefetching gains no overlap
+
+    def get_sample_state(self):
+        return self._np_rng.bit_generator.state
+
+    def set_sample_state(self, state) -> None:
+        self._np_rng.bit_generator.state = state
 
     @property
     def buffer(self) -> list[Batch]:
@@ -667,6 +706,7 @@ class EpisodeBuffer:
             episode = {k: np.asarray(v) for k, v in episode.items()}
         self._buf.append(episode)
         self._episode_dirs.append(ep_dir)
+        self._epoch += 1
 
     def sample(
         self,
@@ -850,6 +890,7 @@ class AsyncReplayBuffer:
         self._store: dict[str, jax.Array] | None = None
         self._upos = np.zeros(n_envs, dtype=np.int64)
         self._ufull = np.zeros(n_envs, dtype=bool)
+        self._epoch = 0
         # uncommitted reserve() head advance (see add_direct)
         self._pending_reserve: tuple[np.ndarray, int] | None = None
         self._key = jax.random.PRNGKey(seed)
@@ -895,6 +936,35 @@ class AsyncReplayBuffer:
         carries a device array). The mains consult this before reusing the
         policy step's device obs puts in `add`."""
         return self._storage_kind != "device" or self._stage_cap > 0
+
+    @property
+    def is_device_backed(self) -> bool:
+        return self._storage_kind == "device"
+
+    @property
+    def epoch(self) -> int:
+        """Monotonic write counter (see ReplayBuffer.epoch): bumped by every
+        add / add_direct commit / row surgery / restore, the pipeline
+        SamplePrefetcher's epoch-consistency guard."""
+        return self._epoch
+
+    def get_sample_state(self):
+        """Sampler PRNG snapshot (device key + numpy partition rng + the
+        per-env sub-buffer states on the host path) — the rewind target for
+        the SamplePrefetcher's discarded-prefetch path."""
+        sub = (
+            tuple(b.get_sample_state() for b in self._buf)
+            if self._buf is not None
+            else None
+        )
+        return (self._key, self._np_rng.bit_generator.state, sub)
+
+    def set_sample_state(self, state) -> None:
+        self._key = state[0]
+        self._np_rng.bit_generator.state = state[1]
+        if state[2] is not None and self._buf is not None:
+            for b, s in zip(self._buf, state[2]):
+                b.set_sample_state(s)
 
     @property
     def full(self):
@@ -999,6 +1069,7 @@ class AsyncReplayBuffer:
         self._store[key] = self._store[key].at[time_idx, env].set(
             item.astype(self._store[key].dtype)
         )
+        self._epoch += 1
 
     def add(self, data: Mapping[str, np.ndarray], indices: Sequence[int] | None = None) -> None:
         data = _as_time_env(dict(data))
@@ -1016,6 +1087,7 @@ class AsyncReplayBuffer:
             self._ensure_buffers()
             for col, env_idx in enumerate(cols):
                 self._buf[env_idx].add({k: v[:, col : col + 1] for k, v in data.items()})
+            self._epoch += 1
             return
         if data_len > self._buffer_size:
             data = {k: v[-self._buffer_size :] for k, v in data.items()}
@@ -1038,6 +1110,7 @@ class AsyncReplayBuffer:
             starts = self._upos
             self._ufull |= starts + data_len >= self._buffer_size
             self._upos = (starts + data_len) % self._buffer_size
+            self._epoch += 1
             if self._staged_rows >= self._stage_cap:
                 self._flush_staged()
             return
@@ -1048,6 +1121,7 @@ class AsyncReplayBuffer:
         self._store = self._packed_scatter(data, starts, cols, data_len)
         self._ufull[cols] |= starts + data_len >= self._buffer_size
         self._upos[cols] = (starts + data_len) % self._buffer_size
+        self._epoch += 1
 
     def _packed_scatter(self, data, starts, cols, data_len):
         """Pack host values into one transfer per width class and scatter;
@@ -1102,6 +1176,7 @@ class AsyncReplayBuffer:
             self._ufull |= starts + reserved_len >= self._buffer_size
             self._upos = (starts + reserved_len) % self._buffer_size
             self._pending_reserve = None
+        self._epoch += 1
 
     # -- sampling -------------------------------------------------------------
     def _partition(self, batch_size: int) -> np.ndarray:
@@ -1336,10 +1411,12 @@ class AsyncReplayBuffer:
                 }
             self._upos = np.asarray([int(s["pos"]) for s in buffers], dtype=np.int64)
             self._ufull = np.asarray([bool(s["full"]) for s in buffers], dtype=bool)
+            self._epoch += 1
             return
         self._ensure_buffers()
         for b, s in zip(self._buf, buffers):
             b.load_state_dict(s)
+        self._epoch += 1
 
     def save(self, path: str) -> None:
         """Serialize all per-env rings into one `.npz` (the Dreamer

@@ -14,7 +14,7 @@ from typing import Any
 
 import numpy as np
 
-__all__ = ["MetricAggregator", "MovingAverageMetric"]
+__all__ = ["MetricAggregator", "MovingAverageMetric", "PendingMetrics"]
 
 
 def _prefetch(values) -> None:
@@ -31,6 +31,22 @@ def _prefetch(values) -> None:
                 pass  # fall back to the blocking pull in compute
 
 
+class _Snapshot:
+    """A metric's pending values frozen at snapshot time, with the metric's
+    own resolve function bound to them — the deferred half of the pipeline
+    MetricDrain (parallel/pipeline.py). `resolve()` produces exactly what
+    `compute()` would have at snapshot time."""
+
+    __slots__ = ("values", "_resolve")
+
+    def __init__(self, values: list[Any], resolve) -> None:
+        self.values = values
+        self._resolve = resolve
+
+    def resolve(self):
+        return self._resolve(self.values)
+
+
 class MeanMetric:
     def __init__(self) -> None:
         self._values: list[Any] = []
@@ -41,10 +57,17 @@ class MeanMetric:
     def update(self, value: Any) -> None:
         self._values.append(value)
 
-    def compute(self) -> float | None:
-        if not self._values:
+    @staticmethod
+    def _resolve(values: list[Any]) -> float | None:
+        if not values:
             return None
-        return float(np.mean([float(v) for v in self._values]))
+        return float(np.mean([float(v) for v in values]))
+
+    def compute(self) -> float | None:
+        return self._resolve(self._values)
+
+    def snapshot(self) -> _Snapshot:
+        return _Snapshot(list(self._values), self._resolve)
 
     def reset(self) -> None:
         self._values.clear()
@@ -73,16 +96,23 @@ class MovingAverageMetric:
     def update(self, value: Any) -> None:
         self._window.append(value)
 
-    def compute(self) -> dict[str, float] | None:
-        if not self._window:
+    @staticmethod
+    def _resolve(values: list[Any]) -> dict[str, float] | None:
+        if not values:
             return None
-        arr = np.asarray([float(v) for v in self._window])
+        arr = np.asarray([float(v) for v in values])
         return {
             "mean": float(arr.mean()),
             "std": float(arr.std()),
             "min": float(arr.min()),
             "max": float(arr.max()),
         }
+
+    def compute(self) -> dict[str, float] | None:
+        return self._resolve(list(self._window))
+
+    def snapshot(self) -> _Snapshot:
+        return _Snapshot(list(self._window), self._resolve)
 
     def reset(self) -> None:
         self._window.clear()
@@ -105,6 +135,16 @@ class MetricAggregator:
     def pop(self, name: str) -> None:
         self.metrics.pop(name, None)
 
+    @staticmethod
+    def _flatten(name: str, val, out: dict) -> None:
+        if val is None:
+            return
+        if isinstance(val, dict):
+            for k, v in val.items():
+                out[f"{name}/{k}"] = v
+        else:
+            out[name] = val
+
     def compute(self) -> dict[str, float]:
         # overlap all pending device pulls before the blocking conversions
         _prefetch(
@@ -112,17 +152,28 @@ class MetricAggregator:
             for metric in self.metrics.values()
             for v in getattr(metric, "pending", list)()
         )
-        out = {}
+        out: dict = {}
         for name, metric in self.metrics.items():
-            val = metric.compute()
-            if val is None:
-                continue
-            if isinstance(val, dict):
-                for k, v in val.items():
-                    out[f"{name}/{k}"] = v
-            else:
-                out[name] = val
+            self._flatten(name, metric.compute(), out)
         return out
+
+    def snapshot(self) -> "PendingMetrics":
+        """Freeze every metric's pending values and issue their async
+        device->host copies NOW; the returned handle's `resolve()` produces
+        the exact dict `compute()` would have, but the blocking conversions
+        run later — after the copies have landed (the pipeline MetricDrain's
+        deferred-drain contract, parallel/pipeline.py). Metric types without
+        a `snapshot()` resolve eagerly here."""
+        snaps: dict[str, _Snapshot] = {}
+        eager: dict = {}
+        for name, metric in self.metrics.items():
+            snap_fn = getattr(metric, "snapshot", None)
+            if snap_fn is not None:
+                snaps[name] = snap_fn()
+            else:
+                self._flatten(name, metric.compute(), eager)
+        _prefetch(v for s in snaps.values() for v in s.values)
+        return PendingMetrics(snaps, eager)
 
     def reset(self, force: bool = False) -> None:
         """Per-logging-interval reset. Metrics that declare
@@ -132,3 +183,21 @@ class MetricAggregator:
         for metric in self.metrics.values():
             if force or getattr(metric, "reset_on_compute", True):
                 metric.reset()
+
+
+class PendingMetrics:
+    """An interval's metric values captured by `MetricAggregator.snapshot()`
+    with their d2h copies in flight; `resolve()` performs the (by then
+    cheap) blocking conversions and returns the flattened metric dict."""
+
+    __slots__ = ("_snaps", "_eager")
+
+    def __init__(self, snaps: dict[str, _Snapshot], eager: dict) -> None:
+        self._snaps = snaps
+        self._eager = eager
+
+    def resolve(self) -> dict:
+        out = dict(self._eager)
+        for name, snap in self._snaps.items():
+            MetricAggregator._flatten(name, snap.resolve(), out)
+        return out

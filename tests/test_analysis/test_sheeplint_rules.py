@@ -330,12 +330,84 @@ def test_suppressed_rule_ids_must_match():
 
 
 # ---------------------------------------------------------------------------
+# SL007 — blocking host sync inside a hot-loop body
+# ---------------------------------------------------------------------------
+
+
+def test_sl007_positive_named_hot_loop():
+    src = """
+    import jax
+    import numpy as np
+
+    def one_cycle(state, metrics, arr):
+        x = float(jax.device_get(metrics["loss"]))
+        y = np.asarray(arr)
+        z = arr.item()
+        jax.block_until_ready(state)
+        return x, y, z
+    """
+    assert ids(src) == ["SL007"] * 5  # device_get + float + asarray + item + block
+
+
+def test_sl007_positive_marker_comment():
+    src = """
+    import numpy as np
+
+    # sheeplint: hotloop
+    def tight_inner(arr):
+        return np.asarray(arr)
+    """
+    assert ids(src) == ["SL007"]
+
+
+def test_sl007_negative_cold_function_and_shapes():
+    src = """
+    import numpy as np
+
+    def setup(arr):
+        return np.asarray(arr)  # not a hot-loop body: no finding
+
+    def one_step(batch):
+        n = int(batch.shape[0])  # shape access, not a device pull
+        return n
+    """
+    assert ids(src) == []
+
+
+def test_sl007_defers_to_sl002_inside_jit_bodies():
+    src = """
+    import jax
+
+    def one_cycle(x):
+        @jax.jit
+        def inner(v):
+            return float(v)  # traced: SL002's jurisdiction
+
+        return inner(x)
+    """
+    assert ids(src) == ["SL002"]
+
+
+def test_sl007_suppression_with_justification():
+    src = """
+    import jax
+
+    def one_cycle(metrics):
+        # sheeplint: disable=SL007 — deliberate timing fence
+        return float(jax.device_get(metrics))
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
 # Catalog + CLI contract
 # ---------------------------------------------------------------------------
 
 
 def test_rule_catalog_complete():
-    assert rule_ids() == ["SL001", "SL002", "SL003", "SL004", "SL005", "SL006"]
+    assert rule_ids() == [
+        "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
+    ]
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")
         assert rule.summary and rule.autofix

@@ -5,13 +5,18 @@ sequential before/after runs confound the code change with tunnel weather.
 This probe alternates the two variants ABAB... inside ONE process against
 the same model state, so each pair of adjacent trials sees the same tunnel:
 
-  legacy: pre-normalized f32 obs put per policy step + per-key transfers in
-          the replay add (the round-2 path, emulated via `_store_add`);
-  packed: raw uint8 obs put normalized inside the jit, the same device
-          array reused by the add, and one transfer per dtype group in the
-          add (the round-3 path: AsyncReplayBuffer._store_add_packed).
+  legacy   : pre-normalized f32 obs put per policy step + per-key transfers
+             in the replay add (the round-2 path, emulated via `_store_add`);
+  packed   : raw uint8 obs put normalized inside the jit, the same device
+             array reused by the add, and one transfer per dtype group in
+             the add (the round-3 path: AsyncReplayBuffer._store_add_packed);
+  pipelined: packed plus the ISSUE-4 SamplePrefetcher in its off-policy
+             staleness mode (SHEEPRL_TPU_PIPELINE_STALENESS-style): the
+             per-cycle replay sample's index put + gather are dispatched
+             during the PREVIOUS train step, so the sample pull leaves the
+             critical path entirely (howto/pipelining.md).
 
-Usage: python tools/e2e_ab_probe.py [--trials 8] [--cycles 5]
+Usage: python tools/e2e_ab_probe.py [--trials 9] [--cycles 5]
 """
 
 from __future__ import annotations
@@ -29,7 +34,7 @@ import numpy as np
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--trials", type=int, default=8)
+    p.add_argument("--trials", type=int, default=9)
     p.add_argument("--cycles", type=int, default=5)
     p.add_argument("--tiny", action="store_true")
     a = p.parse_args()
@@ -99,13 +104,24 @@ def main() -> None:
         rb._ufull[cols] |= starts + data_len >= rb._buffer_size
         rb._upos[cols] = (starts + data_len) % rb._buffer_size
 
-    def make_variant(packed: bool):
+    from sheeprl_tpu.parallel import SamplePrefetcher
+
+    def make_variant(packed: bool, pipelined: bool = False):
         rb = AsyncReplayBuffer(
             max(4 * T, 64), n_envs, storage="device", sequential=True,
             obs_keys=("rgb",), seed=0,
         )
         for _ in range(2 * T + 8):
             rb.add(host_step_data(fake_env_obs()))
+        # the staleness relaxation is what takes the sample pull off the
+        # critical path in a write-every-cycle loop (strict mode would
+        # discard every prefetch); train_every adds/cycle -> one cycle of
+        # staleness, the documented off-policy trade (howto/pipelining.md)
+        sampler = (
+            SamplePrefetcher(rb, enabled=True, max_staleness=args.train_every + 1)
+            if pipelined
+            else rb
+        )
         st = jax.tree_util.tree_map(jnp.copy, state)
         ps = make_player(st).init_states(n_envs)
         key = jax.random.PRNGKey(1)
@@ -129,31 +145,38 @@ def main() -> None:
                     # (4x the bytes), then per-key transfers in the add
                     dev_obs = {
                         "rgb": jnp.asarray(
+                            # sheeplint: disable=SL007 — host numpy
+                            # normalize IS the legacy arm under measurement
                             np.asarray(obs_u8, dtype=np.float32) / 255.0
                         )
                     }
                     box["ps"], _ = legacy_player_step(player, box["ps"], dev_obs, sk, None)
                     legacy_add(rb, host_step_data(obs_u8))
-            local = rb.sample(B, sequence_length=T, n_samples=1)
+            local = sampler.sample(B, sequence_length=T, n_samples=1)
             staged = stage_batch(local)
             sample = {k: v[0] for k, v in staged.items()}
             box["key"], tk = jax.random.split(box["key"])
             box["state"], metrics = train_step(
                 box["state"], sample, tk, jnp.float32(0.02)
             )
+            # sheeplint: disable=SL007 — deliberate per-cycle timing fence
             float(jax.device_get(metrics["Loss/reconstruction_loss"]))
 
         return one_cycle
 
-    variants = {"legacy": make_variant(False), "packed": make_variant(True)}
-    for name, cyc in variants.items():  # compile both before timing
+    variants = {
+        "legacy": make_variant(False),
+        "packed": make_variant(True),
+        "pipelined": make_variant(True, pipelined=True),
+    }
+    for name, cyc in variants.items():  # compile all before timing
         cyc()
         print(f"compiled {name}", file=sys.stderr)
 
-    results: dict[str, list[float]] = {"legacy": [], "packed": []}
-    order = ["legacy", "packed"]
+    results: dict[str, list[float]] = {name: [] for name in variants}
+    order = list(variants)
     for trial in range(a.trials):
-        name = order[trial % 2]
+        name = order[trial % len(order)]
         t0 = time.perf_counter()
         for _ in range(a.cycles):
             variants[name]()
@@ -162,7 +185,7 @@ def main() -> None:
         results[name].append(round(sps, 1))
         print(f"trial {trial} {name}: {sps:.1f} sps", file=sys.stderr)
 
-    med = {k: float(np.median(v)) for k, v in results.items()}
+    med = {k: float(np.median(v)) if v else 0.0 for k, v in results.items()}
     print(
         json.dumps(
             {
@@ -170,6 +193,11 @@ def main() -> None:
                 "median": med,
                 "packed_over_legacy": round(med["packed"] / med["legacy"], 3)
                 if med["legacy"]
+                else None,
+                "pipelined_over_packed": round(
+                    med["pipelined"] / med["packed"], 3
+                )
+                if med["packed"] and med.get("pipelined")
                 else None,
             }
         )

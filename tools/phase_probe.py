@@ -12,10 +12,18 @@ Times cumulative variants of the honest e2e cycle on the current backend:
   V4 blob    : the same e2e cycle through the one-transfer blob transport
                (StepBlobCodec + reserve/add_direct) — bench's default
                device-buffer path; V4 vs V3 is the blob's chip receipt.
+  V5 pull    : V4 plus the per-step action-index d2h pull the real main
+               pays (dreamer_v3.py pulls env indices every step; V4 never
+               did) — completes the honest cycle; V5 - V4 prices the pull.
+  V6 pipeline: V5 with the ISSUE-4 latency-hiding pipeline on — the pull
+               rides ActionPipeline (dispatch before the replay scatter,
+               read after) and the sample is double-buffered
+               (SamplePrefetcher) — V5 - V6 is the pipeline's recovery.
 
-Adjacent differences attribute the gap to obs transfer, replay add, and
-replay sample/stage.  Every variant syncs via a host scalar pull per cycle
-(readiness can lie on the tunnel; a value fetch cannot — see BENCHES.md).
+Adjacent differences attribute the gap to obs transfer, replay add, replay
+sample/stage, the action pull, and the pipeline's recovery of it.  Every
+variant syncs via a host scalar pull per cycle (readiness can lie on the
+tunnel; a value fetch cannot — see BENCHES.md).
 
 Usage: python tools/phase_probe.py [--tiny] [--cycles N] [--repeats N]
 """
@@ -85,30 +93,43 @@ def main() -> None:
                 batch = dict(sample_batch)
             key, tk = jax.random.split(key)
             state, metrics = train_step(state, batch, tk, jnp.float32(0.02))
+            # sheeplint: disable=SL007 — deliberate per-cycle timing fence
             float(jax.device_get(metrics["Loss/reconstruction_loss"]))
             return state, player_state, key
 
         return one_cycle
 
-    # V4: the blob-transport e2e cycle via bench's OWN harness (the probe
-    # must measure exactly the transport bench runs; the harness applies
-    # the live roundtrip gate). A second replay buffer keeps V4's ring
-    # state and write heads independent of V2/V3's.
-    rb_blob, _, _ = bench._dv3_replay_harness(args)
+    # V4/V5/V6: blob-transport e2e cycles via bench's OWN harness (the
+    # probe must measure exactly the transport bench runs; the harness
+    # applies the live roundtrip gate). Each variant gets its own replay
+    # buffer so ring state and write heads stay independent.
+    from sheeprl_tpu.parallel import Pipeline
+
     blob_step_fn = bench._dv3_blob_harness(args, actions_dim, is_continuous)
 
-    def blob_cycle(state, player_state, key):
-        player = make_player(state)
-        for _ in range(args.train_every):
-            obs_u8 = fake_env_obs()
-            key, sk = jax.random.split(key)
-            player_state = blob_step_fn(rb_blob, player, player_state, obs_u8, sk)
-        local = rb_blob.sample(B, sequence_length=T, n_samples=1)
-        batch = {k: v[0] for k, v in stage_batch(local).items()}
-        key, tk = jax.random.split(key)
-        state, metrics = train_step(state, batch, tk, jnp.float32(0.02))
-        float(jax.device_get(metrics["Loss/reconstruction_loss"]))
-        return state, player_state, key
+    def make_blob_cycle(pull: bool, pipelined: bool):
+        rb_blob, _, _ = bench._dv3_replay_harness(args)
+        pipe = Pipeline(enabled=pipelined)
+
+        def blob_cycle(state, player_state, key):
+            player = make_player(state)
+            for _ in range(args.train_every):
+                obs_u8 = fake_env_obs()
+                key, sk = jax.random.split(key)
+                player_state = blob_step_fn(
+                    rb_blob, player, player_state, obs_u8, sk,
+                    action=pipe.action if pipelined else None,
+                    pull=pull and not pipelined,
+                )
+            local = pipe.sampler(rb_blob).sample(B, sequence_length=T, n_samples=1)
+            batch = {k: v[0] for k, v in stage_batch(local).items()}
+            key, tk = jax.random.split(key)
+            state, metrics = train_step(state, batch, tk, jnp.float32(0.02))
+            # sheeplint: disable=SL007 — deliberate per-cycle timing fence
+            float(jax.device_get(metrics["Loss/reconstruction_loss"]))
+            return state, player_state, key
+
+        return blob_cycle
 
     variants = {
         "V0_duty": make_cycle(False, False, False),
@@ -117,9 +138,11 @@ def main() -> None:
         "V3_sample": make_cycle(True, True, True),
     }
     if blob_step_fn is not None:
-        variants["V4_blob"] = blob_cycle
+        variants["V4_blob"] = make_blob_cycle(pull=False, pipelined=False)
+        variants["V5_pull"] = make_blob_cycle(pull=True, pipelined=False)
+        variants["V6_pipeline"] = make_blob_cycle(pull=True, pipelined=True)
     else:
-        print("V4_blob skipped: backend failed the blob roundtrip gate",
+        print("V4/V5/V6 skipped: backend failed the blob roundtrip gate",
               file=sys.stderr)
     # Interleaved schedule (V0 V1 V2 V3 V4 | V0 V1 V2 V3 V4 | ...; V4
     # only when the backend passes the blob gate) so tunnel-
@@ -163,6 +186,12 @@ def main() -> None:
     if "V4_blob" in best:
         out["attribution_ms"]["blob_vs_separate_puts"] = round(
             best["V4_blob"] - best["V3_sample"], 1
+        )
+        out["attribution_ms"]["action_pull"] = round(
+            best["V5_pull"] - best["V4_blob"], 1
+        )
+        out["attribution_ms"]["pipeline_recovery"] = round(
+            best["V5_pull"] - best["V6_pipeline"], 1
         )
     print(json.dumps(out))
 

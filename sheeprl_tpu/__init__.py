@@ -45,37 +45,19 @@ def _enable_compilation_cache() -> None:
     within one process, e.g. a benchmark's duty-cycle and end-to-end
     variants of the same train step). Controls:
 
-      SHEEPRL_TPU_XLA_CACHE=0        disable
-      JAX_COMPILATION_CACHE_DIR=...  override the cache location
-                                     (default: <tmpdir>/sheeprl_tpu_xla_cache)
+      SHEEPRL_TPU_XLA_CACHE=0         disable
+      SHEEPRL_TPU_COMPILE_CACHE=...   the runner/bench shared location
+      JAX_COMPILATION_CACHE_DIR=...   override the cache location
+                                      (default: <tmpdir>/sheeprl_tpu_xla_cache)
 
-    Best-effort: backends whose executables can't be serialized simply
-    skip the cache (jax falls back per-compile)."""
-    if _os.environ.get("SHEEPRL_TPU_XLA_CACHE", "1") == "0":
-        return
-    import tempfile
+    One arming path for the whole repo: `compile/cache.py` (this call,
+    `parallel/mesh.distributed_setup` and `bench.py` all use it — one
+    directory resolution, one compile-time floor). Best-effort: backends
+    whose executables can't be serialized simply skip the cache (jax falls
+    back per-compile)."""
+    from .compile.cache import arm_compile_cache
 
-    # per-user path: a fixed name in world-writable /tmp invites permission
-    # collisions between users and cache poisoning (cache entries are
-    # deserialized executables)
-    uid = getattr(_os, "getuid", lambda: "u")()
-    path = _os.environ.get("JAX_COMPILATION_CACHE_DIR") or _os.path.join(
-        tempfile.gettempdir(), f"sheeprl_tpu_xla_cache_{uid}"
-    )
-    try:
-        import jax
-
-        jax.config.update("jax_compilation_cache_dir", path)
-        # no size floor; keep the 0.5 s compile-time floor — sub-half-second
-        # compiles (tiny eval/preprocess graphs) recompile faster than a
-        # cache round-trip and would bloat the cache
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        # export so SUBPROCESSES (benches, spawned env workers, CLI runs
-        # under test) share this cache instead of creating their own
-        _os.environ["JAX_COMPILATION_CACHE_DIR"] = path
-    except Exception:
-        pass  # never block import on cache wiring
+    arm_compile_cache()
 
 
 _enable_compilation_cache()

@@ -93,6 +93,19 @@ class StandardArgs:
         "the metric drain's host pulls by one logging interval "
         "(MetricDrain). 'off' is the synchronous path",
     )
+    warm_compile: str = Arg(
+        default="off",
+        help="AOT warm-start compilation (compile/plan.py): 'on' registers "
+        "the task's hot jits (train step, player policy, GAE, recon, ...) "
+        "with their exact input avals and AOT-compiles them "
+        "(`jit.lower(...).compile()`) on a background thread overlapped "
+        "with the learning_starts/rollout collection window; the first "
+        "update blocks on the compile barrier, then dispatches the AOT "
+        "executable — bit-exact vs 'off' (any aval drift falls back to the "
+        "cold jit path). Compile/* telemetry gauges carry per-executable "
+        "compile seconds, cache hits/misses and "
+        "time_to_first_update_seconds",
+    )
     sanitize: bool = Arg(
         default=False,
         help="runtime transfer/donation sanitizer (sheeplint's dynamic "
@@ -110,6 +123,10 @@ class StandardArgs:
             )
         if name == "pipeline" and value not in ("on", "off"):
             raise ValueError(f"pipeline must be 'on' or 'off', got {value!r}")
+        if name == "warm_compile" and value not in ("on", "off"):
+            raise ValueError(
+                f"warm_compile must be 'on' or 'off', got {value!r}"
+            )
         super().__setattr__(name, value)
         if name == "log_dir" and value:
             os.makedirs(value, exist_ok=True)

@@ -38,6 +38,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
+from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -351,6 +352,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
 
     envs = make_vector_env(
         [
@@ -470,6 +473,36 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
     if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt) and not args.eval_only:
         rb.load(buffer_ckpt)
+
+    # ---- warm-start shape capture (ISSUE 5): AOT-compile the train step
+    # and the interaction jit concurrently with the learning_starts window
+    act_sum = int(sum(actions_dim))
+    train_step = plan.register(
+        "train_step", train_step,
+        example=lambda: (
+            state,
+            dreamer_sample_spec(
+                envs.single_observation_space, obs_keys, cnn_keys,
+                args.per_rank_sequence_length, args.per_rank_batch_size,
+                act_sum, extra=("rewards", "dones"),
+                mesh=mesh if n_dev > 1 else None,
+            ),
+            key,
+        ),
+        role="update",
+    )
+    player_step = plan.register(
+        "player_step", player_step,
+        example=lambda: (
+            player, player.init_states(args.num_envs),
+            dict_obs_spec(
+                envs.single_observation_space, obs_keys, cnn_keys,
+                (args.num_envs,),
+            ),
+            key, jnp.float32(0.0), None,
+        ),
+    )
+    plan.start()
 
     aggregator = MetricAggregator()
     single_global_step = args.num_envs * args.action_repeat
@@ -673,6 +706,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir),
         args, logger,
     )
+    plan.close()
     sanitizer.close()
     telem.close()
     logger.close()

@@ -61,6 +61,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
+from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, sds
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -528,6 +529,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
 
     envs = make_vector_env(
         [
@@ -725,6 +728,49 @@ def main(argv: Sequence[str] | None = None) -> None:
         blob_step = make_blob_step(
             codec, tuple(obs_keys), _dev_preprocess, actions_dim, is_continuous
         )
+
+    # ---- warm-start shape capture (ISSUE 5): the full-scale DV3 train step
+    # compiles in ~30-40 s per config — AOT-compile it (and the interaction
+    # jit actually in use: blob or player step) concurrently with the
+    # learning_starts collection window
+    act_sum = int(sum(actions_dim))
+
+    def _train_example():
+        return (
+            state,
+            dreamer_sample_spec(
+                envs.single_observation_space, obs_keys, cnn_keys,
+                args.per_rank_sequence_length, args.per_rank_batch_size,
+                act_sum, extra=("rewards", "dones", "is_first"),
+                mesh=mesh if n_dev > 1 else None,
+            ),
+            key, jnp.float32(1.0),
+        )
+
+    train_step = plan.register(
+        "train_step", train_step, example=_train_example, role="update"
+    )
+    if use_blob:
+        blob_step = plan.register(
+            "blob_step", blob_step,
+            example=lambda: (
+                player, player.init_states(args.num_envs),
+                sds((codec.blob_len,), jnp.int32), key, jnp.float32(0.0),
+            ),
+        )
+    else:
+        player_step = plan.register(
+            "player_step", player_step,
+            example=lambda: (
+                player, player.init_states(args.num_envs),
+                dict_obs_spec(
+                    envs.single_observation_space, obs_keys, cnn_keys,
+                    (args.num_envs,),
+                ),
+                key, jnp.float32(0.0), None,
+            ),
+        )
+    plan.start()
 
     gradient_steps = 0
     start_time = time.perf_counter()
@@ -950,6 +996,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True),
         args, logger,
     )
+    plan.close()
     sanitizer.close()
     telem.close()
     logger.close()

@@ -54,6 +54,7 @@ from ..ppo.agent import (
     env_action_indices,
     indices_to_env_actions,
 )
+from ...compile import CompilePlan, dict_obs_spec
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from .agent import PlayerDV3, build_models
 from .args import DreamerV3Args
@@ -112,6 +113,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
     telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
@@ -284,6 +287,40 @@ def main(argv: Sequence[str] | None = None) -> None:
     step_data["is_first"] = np.ones((args.num_envs, 1), np.float32)
     player = make_player(player_weights)
     player_state = player.init_states(args.num_envs)
+
+    # ---- warm-start shape capture (ISSUE 5): zero example batches run
+    # through the SAME trainer-mesh placement as the live loop, so the AOT
+    # executables compile for the exact shardings the updates use
+    act_sum = int(sum(actions_dim))
+    obs_space = envs.single_observation_space
+
+    def _train_example():
+        T, B = args.per_rank_sequence_length, args.per_rank_batch_size
+        sample = {
+            k: np.zeros(
+                (T, B) + tuple(obs_space[k].shape),
+                np.uint8 if k in cnn_keys else np.float32,
+            )
+            for k in obs_keys
+        }
+        sample["actions"] = np.zeros((T, B, act_sum), np.float32)
+        for k in ("rewards", "dones", "is_first"):
+            sample[k] = np.zeros((T, B, 1), np.float32)
+        sample = meshes.to_trainers(sample, axis=1)
+        return (state, sample, key, jnp.float32(1.0))
+
+    train_step = plan.register(
+        "train_step", train_step, example=_train_example, role="update"
+    )
+    player_step = plan.register(
+        "player_step", player_step,
+        example=lambda: (
+            player, player.init_states(args.num_envs),
+            dict_obs_spec(obs_space, obs_keys, cnn_keys, (args.num_envs,)),
+            key, jnp.float32(0.0), None,
+        ),
+    )
+    plan.start()
 
     gradient_steps = 0
     pending_weights = None
@@ -492,6 +529,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         logger.log_dict(aggregator.compute(), num_updates)
         aggregator.reset()
     test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True)
+    plan.close()
     sanitizer.close()
     telem.close()
     logger.close()

@@ -34,6 +34,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
+from ...compile import CompilePlan, sds
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -172,6 +173,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
 
     envs = make_vector_env(
         [
@@ -244,6 +247,57 @@ def main(argv: Sequence[str] | None = None) -> None:
             restored_buffer = True
     state = replicate(state, mesh)
 
+    # ---- warm-start shape capture (ISSUE 5): overlap the train/policy jit
+    # compiles with the learning_starts random-action window
+    global_batch_spec = args.per_rank_batch_size * n_dev
+
+    def _specs():
+        data_sh = actor_sh = None
+        if n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            data_sh = NamedSharding(mesh, PartitionSpec(None, "data"))
+            actor_sh = NamedSharding(mesh, PartitionSpec("data"))
+
+        def leaf(lead, shape, sharding):
+            return sds(lead + shape, jnp.float32, sharding=sharding)
+
+        data = {
+            "observations": leaf(
+                (args.gradient_steps, global_batch_spec), (obs_dim,), data_sh
+            ),
+            "next_observations": leaf(
+                (args.gradient_steps, global_batch_spec), (obs_dim,), data_sh
+            ),
+            "actions": leaf((args.gradient_steps, global_batch_spec), (act_dim,), data_sh),
+            "rewards": leaf((args.gradient_steps, global_batch_spec), (1,), data_sh),
+            "dones": leaf((args.gradient_steps, global_batch_spec), (1,), data_sh),
+        }
+        actor = {
+            "observations": leaf((global_batch_spec,), (obs_dim,), actor_sh),
+            "actions": leaf((global_batch_spec,), (act_dim,), actor_sh),
+            "rewards": leaf((global_batch_spec,), (1,), actor_sh),
+            "dones": leaf((global_batch_spec,), (1,), actor_sh),
+        }
+        if not args.sample_next_obs:
+            actor["next_observations"] = leaf(
+                (global_batch_spec,), (obs_dim,), actor_sh
+            )
+        return data, actor
+
+    train_step = plan.register(
+        "train_step", train_step,
+        example=lambda: (state, _specs()[0], _specs()[1], key),
+        role="update",
+    )
+    policy_step_w = plan.register(
+        "policy_step", policy_step,
+        example=lambda: (
+            state.agent.actor, sds((args.num_envs, obs_dim), jnp.float32), key,
+        ),
+    )
+    plan.start()
+
     aggregator = MetricAggregator()
     num_updates = (
         int(args.total_steps // args.num_envs) if not args.dry_run else start_step
@@ -275,7 +329,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         else:
             key, step_key = jax.random.split(key)
             actions = np.asarray(
-                policy_step(state.agent.actor, jnp.asarray(obs), step_key)
+                policy_step_w(state.agent.actor, jnp.asarray(obs), step_key)
             )
         next_obs, rewards, terms, truncs, infos = envs.step(list(actions))
         dones = np.logical_or(terms, truncs).astype(np.float32)
@@ -359,6 +413,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
+    plan.close()
     profiler.close()
     envs.close()
     # fresh env per episode: test() closes the env it is handed

@@ -46,6 +46,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
+from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -465,6 +466,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
 
     envs = make_vector_env(
         [
@@ -628,6 +631,45 @@ def main(argv: Sequence[str] | None = None) -> None:
     rb.add({k: v[None] for k, v in step_data.items()})
     is_exploring = True
     player = make_player(state, exploring=True)
+
+    # ---- warm-start shape capture (ISSUE 5): AOT-compile the train step
+    # and the interaction jit concurrently with the learning_starts window
+    act_sum = int(sum(actions_dim))
+
+    def _train_example():
+        return (
+            state,
+            dreamer_sample_spec(
+                envs.single_observation_space, obs_keys, cnn_keys,
+                args.per_rank_sequence_length, args.per_rank_batch_size,
+                act_sum, extra=("rewards", "dones"),
+                mesh=mesh if n_dev > 1 else None,
+            ),
+            key,
+        )
+
+    # zero-shot starts exploring; the task step compiles warm too so the
+    # explore->fine-tune handoff pays no second cold compile
+    train_step_exploring = plan.register(
+        "train_step_exploring", train_step_exploring, example=_train_example,
+        role="update",
+    )
+    train_step_task = plan.register(
+        "train_step_task", train_step_task, example=_train_example,
+    )
+    player_step = plan.register(
+        "player_step", player_step,
+        example=lambda: (
+            player, player.init_states(args.num_envs),
+            dict_obs_spec(
+                envs.single_observation_space, obs_keys, cnn_keys,
+                (args.num_envs,),
+            ),
+            key, jnp.float32(0.0), None,
+        ),
+    )
+    plan.start()
+
     player_state = player.init_states(args.num_envs)
     device_next_obs = None  # this step's obs put, shared policy<->rb.add
     use_blob = (
@@ -821,6 +863,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, "few-shot"),
         args, logger,
     )
+    plan.close()
     sanitizer.close()
     telem.close()
     logger.close()

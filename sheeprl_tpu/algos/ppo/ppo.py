@@ -45,6 +45,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
+from ...compile import CompilePlan, sds
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -247,6 +248,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
 
     envs = make_vector_env(
         [
@@ -302,6 +305,73 @@ def main(argv: Sequence[str] | None = None) -> None:
         obs_keys=tuple(obs_keys), seed=args.seed,
     )
 
+    # ---- warm-start shape capture (ISSUE 5): PPO has no learning_starts
+    # window, so the compiles overlap with the FIRST rollout instead — the
+    # GAE + train jits are ready (or nearly so) when the first update phase
+    # begins. Example thunks close over the replicated `state` late-bound.
+    act_sum = int(sum(actions_dim))
+    obs_space = envs.single_observation_space
+
+    def _obs_leaf(lead, k):
+        dt = jnp.uint8 if k in cnn_keys else jnp.float32
+        return sds(lead + tuple(obs_space[k].shape), dt)
+
+    def _gae_example():
+        T, N = args.rollout_steps, args.num_envs
+        data = {k: _obs_leaf((T, N), k) for k in obs_keys}
+        data.update(
+            actions=sds((T, N, act_sum), jnp.float32),
+            logprobs=sds((T, N, 1), jnp.float32),
+            values=sds((T, N, 1), jnp.float32),
+            rewards=sds((T, N, 1), jnp.float32),
+            dones=sds((T, N, 1), jnp.float32),
+        )
+        next_obs = {k: _obs_leaf((N,), k) for k in obs_keys}
+        return (
+            state.agent, data, next_obs, sds((N, 1), jnp.float32),
+            jnp.float32(args.gamma), jnp.float32(args.gae_lambda),
+        )
+
+    def _train_example():
+        flat_n = args.rollout_steps * args.num_envs
+        sharding = None
+        if n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, PartitionSpec("data"))
+
+        def leaf(shape, dtype=jnp.float32, k=None):
+            if k is not None:
+                dtype = jnp.uint8 if k in cnn_keys else jnp.float32
+                shape = tuple(obs_space[k].shape)
+            return sds((flat_n,) + shape, dtype, sharding=sharding)
+
+        flat = {k: leaf((), k=k) for k in obs_keys}
+        flat.update(
+            actions=leaf((act_sum,)),
+            logprobs=leaf((1,)),
+            values=leaf((1,)),
+            returns=leaf((1,)),
+            advantages=leaf((1,)),
+        )
+        return (
+            state, flat, key,
+            jnp.float32(args.lr), jnp.float32(args.clip_coef),
+            jnp.float32(args.ent_coef),
+        )
+
+    policy_step_w = plan.register(
+        "policy_step", policy_step,
+        example=lambda: (
+            state.agent, {k: _obs_leaf((args.num_envs,), k) for k in obs_keys}, key,
+        ),
+    )
+    compute_gae_w = plan.register("gae", compute_gae_returns, example=_gae_example)
+    train_step = plan.register(
+        "train_step", train_step, example=_train_example, role="update"
+    )
+    plan.start()
+
     aggregator = MetricAggregator()
     obs, _ = envs.reset(seed=args.seed)
     next_done = np.zeros(args.num_envs, dtype=np.float32)
@@ -327,7 +397,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         for _ in range(args.rollout_steps):
             key, step_key = jax.random.split(key)
             device_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
-            actions, logprob, value, env_idx = policy_step(
+            actions, logprob, value, env_idx = policy_step_w(
                 state.agent, device_obs, step_key
             )
             # the only required d2h per step; under --sanitize the pull runs
@@ -376,7 +446,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         # gamma/lambda enter as committed device scalars: raw python floats
         # here are an implicit h2d put per update (found by --sanitize)
         returns, advantages = sanitizer.checked(
-            "gae", compute_gae_returns,
+            "gae", compute_gae_w,
             state.agent, data, device_next_obs, jnp.asarray(next_done)[:, None],
             jnp.float32(args.gamma), jnp.float32(args.gae_lambda),
         )
@@ -418,6 +488,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
+    plan.close()
     profiler.close()
     envs.close()
     # fresh env per episode: test() closes the env it is handed

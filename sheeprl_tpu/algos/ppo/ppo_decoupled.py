@@ -33,6 +33,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
+from ...compile import CompilePlan
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
 from ...utils.logger import create_logger
@@ -87,6 +88,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
     telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
@@ -144,6 +147,73 @@ def main(argv: Sequence[str] | None = None) -> None:
         obs_keys=tuple(obs_keys), seed=args.seed,
     )
 
+    # ---- warm-start shape capture (ISSUE 5): zero example batches run
+    # through the SAME placement fns (player device put / meshes.to_trainers)
+    # so the AOT executables compile for the live shardings; compiles overlap
+    # the first rollout
+    act_sum = int(sum(actions_dim))
+    obs_space = envs.single_observation_space
+
+    def _zero_obs(lead):
+        return {
+            k: np.zeros(
+                lead + tuple(obs_space[k].shape),
+                np.uint8 if k in cnn_keys else np.float32,
+            )
+            for k in obs_keys
+        }
+
+    def _policy_example():
+        dev = {
+            k: jax.device_put(jnp.asarray(v), meshes.player_device)
+            for k, v in _zero_obs((args.num_envs,)).items()
+        }
+        return (player_agent, dev, key)
+
+    def _gae_example():
+        T, N = args.rollout_steps, args.num_envs
+        data = {k: jnp.asarray(v) for k, v in _zero_obs((T, N)).items()}
+        data.update(
+            actions=jnp.zeros((T, N, act_sum), jnp.float32),
+            logprobs=jnp.zeros((T, N, 1), jnp.float32),
+            values=jnp.zeros((T, N, 1), jnp.float32),
+            rewards=jnp.zeros((T, N, 1), jnp.float32),
+            dones=jnp.zeros((T, N, 1), jnp.float32),
+        )
+        next_obs = {k: jnp.asarray(v) for k, v in _zero_obs((N,)).items()}
+        return (
+            player_agent, data, next_obs, jnp.zeros((N, 1), jnp.float32),
+            args.gamma, args.gae_lambda,
+        )
+
+    def _train_example():
+        flat_n = args.rollout_steps * args.num_envs
+        flat = {k: jnp.asarray(v) for k, v in _zero_obs((flat_n,)).items()}
+        flat.update(
+            actions=jnp.zeros((flat_n, act_sum), jnp.float32),
+            logprobs=jnp.zeros((flat_n, 1), jnp.float32),
+            values=jnp.zeros((flat_n, 1), jnp.float32),
+            returns=jnp.zeros((flat_n, 1), jnp.float32),
+            advantages=jnp.zeros((flat_n, 1), jnp.float32),
+        )
+        flat = meshes.to_trainers(flat)
+        return (
+            state, flat, key,
+            jnp.float32(args.lr), jnp.float32(args.clip_coef),
+            jnp.float32(args.ent_coef),
+        )
+
+    policy_step_w = plan.register(
+        "policy_step", policy_step, example=_policy_example
+    )
+    compute_gae_w = plan.register(
+        "gae", compute_gae_returns, example=_gae_example
+    )
+    train_step = plan.register(
+        "train_step", train_step, example=_train_example, role="update"
+    )
+    plan.start()
+
     aggregator = MetricAggregator()
     obs, _ = envs.reset(seed=args.seed)
     next_done = np.zeros(args.num_envs, dtype=np.float32)
@@ -189,7 +259,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 k: jax.device_put(jnp.asarray(obs[k]), meshes.player_device)
                 for k in obs_keys
             }
-            actions, logprob, value, env_idx_dev = policy_step(
+            actions, logprob, value, env_idx_dev = policy_step_w(
                 player_agent, device_obs, step_key
             )
             env_idx = pipe.action.fetch(env_idx_dev)
@@ -225,7 +295,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")
         }
         device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
-        returns, advantages = compute_gae_returns(
+        returns, advantages = compute_gae_w(
             player_agent, data, device_next_obs, jnp.asarray(next_done)[:, None],
             args.gamma, args.gae_lambda,
         )
@@ -287,6 +357,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
     )()
     test(player_agent, test_env, logger, args)
+    plan.close()
     sanitizer.close()
     telem.close()
     logger.close()

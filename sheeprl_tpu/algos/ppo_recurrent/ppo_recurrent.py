@@ -43,6 +43,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
+from ...compile import CompilePlan, sds
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -205,6 +206,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
 
     envs = make_vector_env(
         [
@@ -274,6 +277,60 @@ def main(argv: Sequence[str] | None = None) -> None:
         obs_keys=("observations",), seed=args.seed,
     )
 
+    # ---- warm-start shape capture (ISSUE 5): overlap the recurrent update
+    # jit's compile (scan(epochs) x scan(minibatches) over LSTMs — a slow
+    # trace+compile) with the first rollout
+    obs_dim_t = tuple(envs.single_observation_space[obs_key].shape)
+    lstm_hidden = int(state.agent.initial_states(1)[0][0].shape[-1])
+
+    def _windows_example():
+        sharding = None
+        if n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, PartitionSpec(None, "data"))
+
+        def leaf(shape):
+            return sds((seq_len, n_sequences) + shape, jnp.float32, sharding=sharding)
+
+        windows = {
+            "observations": leaf(obs_dim_t),
+            "dones": leaf((1,)),
+            "actions": leaf((1,)),
+            "logprobs": leaf((1,)),
+            "values": leaf((1,)),
+            "actor_hxs": leaf((lstm_hidden,)),
+            "actor_cxs": leaf((lstm_hidden,)),
+            "critic_hxs": leaf((lstm_hidden,)),
+            "critic_cxs": leaf((lstm_hidden,)),
+            "returns": leaf((1,)),
+            "advantages": leaf((1,)),
+        }
+        return (
+            state, windows, key,
+            jnp.float32(args.lr), jnp.float32(args.clip_coef),
+            jnp.float32(args.ent_coef),
+        )
+
+    train_step = plan.register(
+        "train_step", train_step, example=_windows_example, role="update"
+    )
+    policy_step_w = plan.register(
+        "policy_step", policy_step,
+        example=lambda: (
+            state.agent, sds((args.num_envs,) + obs_dim_t, jnp.float32),
+            state.agent.initial_states(args.num_envs), key,
+        ),
+    )
+    bootstrap_values_w = plan.register(
+        "bootstrap_values", bootstrap_values,
+        example=lambda: (
+            state.agent, sds((1, args.num_envs) + obs_dim_t, jnp.float32),
+            state.agent.initial_states(args.num_envs)[1],
+        ),
+    )
+    plan.start()
+
     aggregator = MetricAggregator()
     obs, _ = envs.reset(seed=args.seed)
     next_obs = np.asarray(obs[obs_key], np.float32)
@@ -319,7 +376,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 "critic_hxs": conv(agent_state[1][0])[None],
                 "critic_cxs": conv(agent_state[1][1])[None],
             }
-            action, logprob, value, new_state = policy_step(
+            action, logprob, value, new_state = policy_step_w(
                 state.agent, dev_obs, agent_state, step_key
             )
             env_actions = [int(a) for a in np.asarray(action)]
@@ -359,7 +416,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         # module-level jit on (agent, ...) — `jax.jit(state.agent.get_values)`
         # here would build a fresh bound-method closure (and a fresh trace)
         # every update (sheeplint SL004)
-        next_value, _ = bootstrap_values(
+        next_value, _ = bootstrap_values_w(
             state.agent, jnp.asarray(next_obs)[None], agent_state[1]
         )
         returns, advantages = ops.gae(
@@ -407,6 +464,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
+    plan.close()
     profiler.close()
     envs.close()
     # fresh env per episode: test() closes the env it is handed

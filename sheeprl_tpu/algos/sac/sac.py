@@ -41,6 +41,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
+from ...compile import CompilePlan, sds
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -190,6 +191,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
 
     envs = make_vector_env(
         [
@@ -267,6 +270,46 @@ def main(argv: Sequence[str] | None = None) -> None:
             restored_buffer = True
     state = replicate(state, mesh)
 
+    # ---- warm-start shape capture (ISSUE 5): AOT-compile the train/policy
+    # jits on a background thread during the learning_starts random-action
+    # window; the first update blocks on the compile barrier. Example thunks
+    # are lazy — they close over the replicated `state`/`key` late-bound.
+    global_batch = args.per_rank_batch_size * n_dev
+
+    def _data_spec():
+        sharding = None
+        if n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, PartitionSpec(None, "data"))
+        lead = (args.gradient_steps, global_batch)
+
+        def leaf(shape):
+            return sds(lead + shape, jnp.float32, sharding=sharding)
+
+        spec = {
+            "observations": leaf((obs_dim,)),
+            "next_observations": leaf((obs_dim,)),
+            "actions": leaf((act_dim,)),
+            "rewards": leaf((1,)),
+            "dones": leaf((1,)),
+        }
+        return spec
+
+    train_step = plan.register(
+        "train_step", train_step,
+        example=lambda: (state, _data_spec(), key, jnp.asarray(True)),
+        role="update",
+    )
+    policy_step_w = plan.register(
+        "policy_step", policy_step,
+        example=lambda: (
+            state.agent.actor,
+            sds((args.num_envs, obs_dim), jnp.float32), key,
+        ),
+    )
+    plan.start()
+
     aggregator = MetricAggregator()
     num_updates = (
         int(args.total_steps // args.num_envs) if not args.dry_run else start_step
@@ -298,7 +341,9 @@ def main(argv: Sequence[str] | None = None) -> None:
             actions = np.stack([envs.single_action_space.sample() for _ in range(args.num_envs)])
         else:
             key, step_key = jax.random.split(key)
-            actions = pipe.action.fetch(policy_step(state.agent.actor, jnp.asarray(obs), step_key))
+            actions = pipe.action.fetch(
+                policy_step_w(state.agent.actor, jnp.asarray(obs), step_key)
+            )
         next_obs, rewards, terms, truncs, infos = envs.step(list(actions))
         dones = np.logical_or(terms, truncs).astype(np.float32)
 
@@ -379,6 +424,7 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
+    plan.close()
     profiler.close()
     envs.close()
     # fresh env per episode: test() closes the env it is handed

@@ -40,6 +40,7 @@ from ...utils.registry import register_algorithm
 from ..args import require_float32
 from .agent import SACAgent
 from .args import SACArgs
+from ...compile import CompilePlan
 from .sac import TrainState, make_optimizers, make_train_step, policy_step
 from .utils import test
 
@@ -76,6 +77,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
+    plan = CompilePlan.from_args(args, telem)
+    telem.add_gauges(plan.gauges)
     telem.add_gauges(meshes.telemetry_gauges)
 
     envs = make_vector_env(
@@ -152,6 +155,43 @@ def main(argv: Sequence[str] | None = None) -> None:
     player_actor = meshes.to_player(state.agent.actor)
     meshes.note_weights_applied()  # the setup copy is, by definition, applied
 
+    # ---- warm-start shape capture (ISSUE 5): zero example batches run
+    # through the SAME placement fns (meshes.to_trainers / the player
+    # device put) so the AOT executables compile for the live shardings
+    global_batch_w = args.per_rank_batch_size * meshes.num_trainers
+
+    def _train_example():
+        def z(shape):
+            return np.zeros(
+                (args.gradient_steps, global_batch_w) + shape, np.float32
+            )
+
+        data = {
+            "observations": z((obs_dim,)),
+            "next_observations": z((obs_dim,)),
+            "actions": z((act_dim,)),
+            "rewards": z((1,)),
+            "dones": z((1,)),
+        }
+        data = meshes.to_trainers(data, axis=1)
+        return (state, data, key, jnp.asarray(True))
+
+    train_step = plan.register(
+        "train_step", train_step, example=_train_example, role="update"
+    )
+    policy_step_w = plan.register(
+        "policy_step", policy_step,
+        example=lambda: (
+            player_actor,
+            jax.device_put(
+                jnp.zeros((args.num_envs, obs_dim), jnp.float32),
+                meshes.player_device,
+            ),
+            key,
+        ),
+    )
+    plan.start()
+
     aggregator = MetricAggregator()
     num_updates = (
         int(args.total_steps // args.num_envs) if not args.dry_run else start_step
@@ -186,7 +226,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         else:
             key, step_key = jax.random.split(key)
             device_obs = jax.device_put(jnp.asarray(obs), meshes.player_device)
-            actions = pipe.action.fetch(policy_step(player_actor, device_obs, step_key))
+            actions = pipe.action.fetch(
+                policy_step_w(player_actor, device_obs, step_key)
+            )
         next_obs, rewards, terms, truncs, infos = envs.step(list(actions))
         dones = np.logical_or(terms, truncs).astype(np.float32)
 
@@ -281,6 +323,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         args.env_id, args.seed, 0, args.capture_video, run_name=log_dir, prefix="test"
     )()
     test(state.agent.actor, test_env, logger, args)
+    plan.close()
     sanitizer.close()
     telem.close()
     logger.close()

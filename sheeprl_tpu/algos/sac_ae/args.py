@@ -37,16 +37,31 @@ class SACAEArgs(SACArgs):
     actor_hidden_size: int = Arg(default=1024, help="actor MLP hidden width")
     critic_hidden_size: int = Arg(default=1024, help="critic MLP hidden width")
     cnn_channels_multiplier: int = Arg(default=16, help="conv width multiplier (> 0)")
-    split_update: bool = Arg(
-        default=False,
-        help="compile the update as four per-model jits instead of one fused "
-        "jit (workaround for a pathological XLA:CPU compile at pixel sizes; "
-        "keep the fused default on TPU). Logging caveat: with "
-        "actor_network_frequency/decoder_update_freq > 1 the split path logs "
-        "Loss/policy_loss, Loss/alpha_loss and Loss/reconstruction_loss only "
-        "on the steps that run those phases, while the fused path logs them "
-        "every step (computed-but-masked) — TB series cadence differs "
-        "between the two modes",
+    split_update: str = Arg(
+        default="auto",
+        help="update-jit compilation strategy: 'on' compiles four per-model "
+        "jits, 'off' one fused jit, 'auto' (default) picks split on XLA:CPU "
+        "and fused elsewhere (the fused jit stalls XLA:CPU for minutes-to-"
+        "hours at pixel sizes — VERDICT r5 attributes 951 s to the recon "
+        "jit alone — while TPU prefers one dispatch + full cross-model "
+        "fusion). Booleans are accepted for checkpoint back-compat. Logging "
+        "caveat: with actor_network_frequency/decoder_update_freq > 1 the "
+        "split path logs Loss/policy_loss, Loss/alpha_loss and "
+        "Loss/reconstruction_loss only on the steps that run those phases, "
+        "while the fused path logs them every step (computed-but-masked) — "
+        "TB series cadence differs between the two modes",
+    )
+    recon_chunk: int = Arg(
+        default=-1,
+        help="batch-chunk the reconstruction jit of the split update path "
+        "(compile/partition.py): lax.map over chunks of this size compiles "
+        "the conv fwd+bwd body ONCE at chunk size instead of at full batch, "
+        "collapsing the XLA:CPU compile pathology that scales with batch "
+        "elements. -1 (default) = decide by the measured lowering heuristic, "
+        "0 = never chunk, n = explicit chunk size (must divide the global "
+        "batch). Dither noise is drawn at full batch and sliced, so targets "
+        "match the unchunked path bit-exactly; only the chunk-mean "
+        "reassociation of the loss differs (float-associativity level)",
     )
     dense_units: int = Arg(default=64, help="units per dense layer (mlp encoder/decoder)")
     mlp_layers: int = Arg(default=2, help="MLP depth for encoder/decoder")
@@ -59,3 +74,13 @@ class SACAEArgs(SACArgs):
     diambra_attack_but_combination: bool = Arg(default=True)
     diambra_noop_max: int = Arg(default=0)
     diambra_actions_stack: int = Arg(default=1)
+
+    def __setattr__(self, name, value):
+        if name == "split_update":
+            if isinstance(value, bool):  # pre-round-6 checkpoints stored a bool
+                value = "on" if value else "off"
+            if value not in ("auto", "on", "off"):
+                raise ValueError(
+                    f"split_update must be 'auto', 'on' or 'off', got {value!r}"
+                )
+        super().__setattr__(name, value)

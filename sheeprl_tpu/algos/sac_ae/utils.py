@@ -7,15 +7,21 @@ import jax
 import jax.numpy as jnp
 
 
-def preprocess_obs(obs: jax.Array, key, bits: int = 8) -> jax.Array:
+def preprocess_obs(obs: jax.Array, key, bits: int = 8, noise: jax.Array | None = None) -> jax.Array:
     """Bit-reduced, dithered, centered image target for the reconstruction
-    loss (https://arxiv.org/abs/1807.03039; reference utils.py:64-72)."""
+    loss (https://arxiv.org/abs/1807.03039; reference utils.py:64-72).
+
+    `noise` overrides the internally drawn uniform dither — the batch-chunked
+    reconstruction partition draws it ONCE at full batch shape and feeds
+    slices in, so chunked targets are bit-identical to the unchunked path."""
     bins = 2.0**bits
     obs = obs.astype(jnp.float32)
     if bits < 8:
         obs = jnp.floor(obs / 2 ** (8 - bits))
     obs = obs / bins
-    obs = obs + jax.random.uniform(key, obs.shape) / bins
+    if noise is None:
+        noise = jax.random.uniform(key, obs.shape)
+    obs = obs + noise / bins
     return obs - 0.5
 
 

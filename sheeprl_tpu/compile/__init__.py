@@ -1,0 +1,37 @@
+"""Compile-latency subsystem (ISSUE 5): warm-start AOT compilation
+overlapped with collection, one cache-arming path, and measured
+partitioning of compile-pathological jits.
+
+Import surface is jax-free at module load (the parent package arms the
+persistent cache through here at import time, before jax config must be
+touched); every jax import inside is lazy.
+"""
+
+from .cache import MIN_COMPILE_SECS, CacheStats, arm_compile_cache, default_cache_dir
+from .partition import (
+    PartitionDecision,
+    chunk_for_budget,
+    decide_batch_chunk,
+    lowered_op_counts,
+    predicted_cpu_compile_seconds,
+)
+from .plan import CompilePlan, WarmJit, avals_of, sds
+from .specs import dict_obs_spec, dreamer_sample_spec
+
+__all__ = [
+    "dict_obs_spec",
+    "dreamer_sample_spec",
+    "MIN_COMPILE_SECS",
+    "CacheStats",
+    "CompilePlan",
+    "PartitionDecision",
+    "WarmJit",
+    "arm_compile_cache",
+    "avals_of",
+    "chunk_for_budget",
+    "decide_batch_chunk",
+    "default_cache_dir",
+    "lowered_op_counts",
+    "predicted_cpu_compile_seconds",
+    "sds",
+]

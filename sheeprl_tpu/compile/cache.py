@@ -1,0 +1,158 @@
+"""The ONE persistent-compilation-cache arming path (ISSUE 5 satellite).
+
+Before this module there were two competing cache-arming sites with two
+different thresholds: `sheeprl_tpu/__init__._enable_compilation_cache`
+(min_compile_time 0.5 s, armed at import) and
+`parallel/mesh.distributed_setup` (re-armed with 10.0 s when
+SHEEPRL_TPU_COMPILE_CACHE was set — so after distributed setup every
+executable compiling in 0.5-10 s silently stopped being cached, exactly the
+mid-cost policy/eval jits the warm-start subsystem wants to find on disk).
+`bench.py` carried a third copy of the 10 s arm. All three now call
+:func:`arm_compile_cache`; the single threshold lives in
+:data:`MIN_COMPILE_SECS`.
+
+Directory resolution order (first hit wins):
+
+  1. the explicit ``path`` argument;
+  2. ``SHEEPRL_TPU_COMPILE_CACHE`` (the runner/bench shared location);
+  3. ``JAX_COMPILATION_CACHE_DIR`` (jax's own env var);
+  4. a per-user tmpdir default (``<tmpdir>/sheeprl_tpu_xla_cache_<uid>`` —
+     a fixed name in world-writable /tmp invites permission collisions and
+     cache poisoning, since entries are deserialized executables).
+
+``SHEEPRL_TPU_XLA_CACHE=0`` disables the cache entirely (arm_compile_cache
+returns None and touches nothing).
+
+Cache hit/miss observability rides jax.monitoring: jax 0.4.x records
+``/jax/compilation_cache/cache_hits`` / ``cache_misses`` events per backend
+compile, and :class:`CacheStats` counts them with the same
+attach/detach-listener pattern as telemetry's CompileTracker (jax's listener
+registry is append-only, so ONE module-level listener forwards to attached
+instances).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["MIN_COMPILE_SECS", "arm_compile_cache", "default_cache_dir", "CacheStats"]
+
+# The single compile-time floor below which executables are not persisted:
+# sub-half-second compiles recompile faster than a cache round-trip and would
+# bloat the cache. Everything at or above it — including the 0.5-10 s
+# mid-cost executables the old distributed_setup arm silently dropped — is
+# cached.
+MIN_COMPILE_SECS = 0.5
+
+
+def default_cache_dir() -> str:
+    import tempfile
+
+    uid = getattr(os, "getuid", lambda: "u")()
+    return os.path.join(tempfile.gettempdir(), f"sheeprl_tpu_xla_cache_{uid}")
+
+
+def arm_compile_cache(
+    path: str | None = None,
+    *,
+    min_compile_secs: float | None = None,
+    export_env: bool = True,
+) -> str | None:
+    """Point jax's persistent compilation cache at one directory with one
+    threshold. Returns the armed path, or None when the cache is disabled
+    (``SHEEPRL_TPU_XLA_CACHE=0``) or jax is unavailable. Safe to call
+    repeatedly (idempotent re-arm with identical config).
+
+    ``export_env=True`` (default) also exports ``JAX_COMPILATION_CACHE_DIR``
+    so subprocesses (benches, spawned env workers, CLI runs under test)
+    share the same cache instead of creating their own.
+
+    ``min_compile_secs`` overrides :data:`MIN_COMPILE_SECS` — tests use 0.0
+    to cache tiny graphs; production callers should not pass it.
+    """
+    if os.environ.get("SHEEPRL_TPU_XLA_CACHE", "1") == "0":
+        return None
+    path = (
+        path
+        or os.environ.get("SHEEPRL_TPU_COMPILE_CACHE")
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or default_cache_dir()
+    )
+    floor = MIN_COMPILE_SECS if min_compile_secs is None else min_compile_secs
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # no size floor; the compile-time floor is the only gate
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", floor)
+        if export_env:
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+    except Exception:
+        return None  # never block import/setup on cache wiring
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Hit/miss counting (module-level listener, instances attach/detach)
+# ---------------------------------------------------------------------------
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_lock = threading.Lock()
+_stats: set["CacheStats"] = set()
+_installed: bool | None = None
+
+
+def _on_event(name: str, **kw) -> None:
+    if name == _HIT_EVENT:
+        with _lock:
+            for s in _stats:
+                s._hits += 1
+    elif name == _MISS_EVENT:
+        with _lock:
+            for s in _stats:
+                s._misses += 1
+
+
+def _install_listener() -> bool:
+    global _installed
+    if _installed is not None:
+        return _installed
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_listener(_on_event)
+        _installed = True
+    except Exception:
+        _installed = False
+    return _installed
+
+
+class CacheStats:
+    """Counts persistent-cache hits and misses seen while attached."""
+
+    def __init__(self) -> None:
+        self.supported = _install_listener()
+        self._hits = 0
+        self._misses = 0
+        self._attached = False
+
+    def attach(self) -> "CacheStats":
+        if self.supported and not self._attached:
+            with _lock:
+                _stats.add(self)
+            self._attached = True
+        return self
+
+    def detach(self) -> None:
+        if self._attached:
+            with _lock:
+                _stats.discard(self)
+            self._attached = False
+
+    def snapshot(self) -> dict[str, int]:
+        with _lock:
+            return {"hits": self._hits, "misses": self._misses}

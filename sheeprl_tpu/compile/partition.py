@@ -1,0 +1,275 @@
+"""Measured partitioning of compile-pathological jits (XLA:CPU conv grads).
+
+The SAC-AE reconstruction update is the canonical pathology: one jit holding
+a conv encoder/decoder forward+backward plus five optimizers compiles in
+seconds on TPU but stalls XLA:CPU for minutes-to-hours at pixel sizes
+(VERDICT r5: 951 s of a 1,037 s startup attributed to the recon jit at
+batch 32 / 128 units; an unexplained >2.5 h outlier at the same nominal
+scale). `--split_update` (per-model jits) removes the cross-model fusion
+blowup but the recon jit alone still scales with BATCH: measured on the
+round-6 dev host, first-call time of the isolated recon jit is 81 s at
+batch 2 and 176 s at batch 4 at constant op count (23 stablehlo
+convolutions, 1756 ops — the lowering is batch-invariant; the cost is in
+XLA:CPU's conv-grad compilation, roughly linear in batch elements per
+convolution).
+
+That measurement is the heuristic: lower the candidate jit (sub-second),
+count its convolutions, and predict
+
+    compile_seconds ~= CPU_SECONDS_PER_CONV_ELEMENT * convolutions * batch
+
+If the prediction exceeds the compile budget, partition the batch axis with
+a PYTHON-level chunk loop over ONE chunk-sized executable (gradient
+accumulation across chunks — see sac_ae's `chunked_recon`). In-jit loop
+constructs do NOT work: `lax.map` with a batch-1 body still compiled in
+173 s vs 176 s unchunked (measured), i.e. XLA:CPU pays the pathological
+cost on the traced-through batch regardless of loop structure. A separate
+chunk-sized executable really does compile at chunk cost (81 s at batch 2
+on the same program). The chunk size is the largest batch divisor whose
+predicted compile fits the budget. Nothing here is algorithm-specific: any
+main can ask :func:`decide_batch_chunk` about any jit.
+
+Attribution (round-6 isolation sweep, all at batch 4 / 64x64x9 pixels):
+first call of the full recon-loss gradient 182 s; DECODER-only gradient
+212 s; encoder-only gradient 3.1 s; forward-only 1.4 s; full grad at
+cnn_channels_multiplier 4 instead of 16: 6.2 s. Separating the phases with
+the AOT path (`lower().compile()` vs a timed call of the Compiled) then
+showed that on THIS toolchain (jaxlib 0.4.36 XLA:CPU) the conv-grad
+*compile* is flat in batch (1.5-2.7 s at batch 2 through 32) and the
+scaling cost is EXECUTION of the transposed-conv gradient kernels
+(~40 s/image at multiplier 16, superlinear in channels ~(C1/C0)^2.4) —
+which resolves the VERDICT r5 951 s-vs->2.5 h "compile" discrepancy: the
+number was execution (batch x per-image cost x host speed, and swappable
+under memory pressure), conflated with compile by first-call timing. The
+partition therefore decides on MEASURED quantities that still matter:
+
+  - peak temp memory of the compiled executable (XLA's own
+    `memory_analysis()`, read off a cheap trial AOT compile): batch-32
+    conv-grad activations at pixel scale run to GiB — the memory-pressure
+    path behind the 2.5 h outlier — and chunking divides them by
+    batch/chunk;
+  - trial compile seconds, for toolchains where conv-grad compile IS
+    superlinear (the conv-count x batch predictor guards the trial so a
+    pathological toolchain is not probed at full batch).
+
+Budgets: SHEEPRL_TPU_COMPILE_BUDGET_S (default 120 s) and
+SHEEPRL_TPU_PARTITION_MEM_MB (default 512 MiB).
+"""
+
+from __future__ import annotations
+
+import os
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .plan import avals_of
+
+__all__ = [
+    "CPU_SECONDS_PER_CONV_ELEMENT",
+    "DEFAULT_COMPILE_BUDGET_S",
+    "PartitionDecision",
+    "chunk_for_budget",
+    "decide_batch_chunk",
+    "lowered_op_counts",
+    "partition_mem_budget_bytes",
+    "predicted_cpu_compile_seconds",
+]
+
+# The compile-time predictor that GUARDS the trial compile. On the measured
+# toolchain (jaxlib 0.4.36) conv-grad compile is flat in batch (~0.1 s per
+# convolution, 2.3 s for the 23-conv recon at any batch), so this linear
+# model is a deliberate over-estimate: it only blocks the trial compile on
+# a toolchain whose conv-grad compile really is superlinear (the r4 dev-host
+# report this subsystem was originally sized for).
+CPU_SECONDS_PER_CONV_ELEMENT = 0.05
+
+# Default per-jit compile budget the chunk chooser targets on XLA:CPU. The
+# bounded receipt runners use ~900 s whole-run budgets, so a single jit
+# predicted over 2 min is already pathological.
+DEFAULT_COMPILE_BUDGET_S = 120.0
+
+
+def compile_budget_s() -> float:
+    try:
+        return float(
+            os.environ.get("SHEEPRL_TPU_COMPILE_BUDGET_S", DEFAULT_COMPILE_BUDGET_S)
+        )
+    except ValueError:
+        return DEFAULT_COMPILE_BUDGET_S
+
+
+def lowered_op_counts(fn: Callable, *example: Any) -> dict[str, int]:
+    """Lower `fn` (jitted) at the example's avals — sub-second, no backend
+    compile — and count the ops that drive XLA:CPU compile cost."""
+    lowered = fn.lower(*avals_of(example))
+    text = lowered.as_text()
+    return {
+        "convolutions": text.count("stablehlo.convolution"),
+        "dots": text.count("stablehlo.dot"),
+        "ops": text.count(" = "),
+    }
+
+
+def predicted_cpu_compile_seconds(convolutions: int, batch: int) -> float:
+    return CPU_SECONDS_PER_CONV_ELEMENT * convolutions * max(batch, 1)
+
+
+def chunk_for_budget(batch: int, convolutions: int, budget_s: float) -> int:
+    """Largest divisor of `batch` whose predicted compile fits the budget
+    (0 = no chunking needed). Divisors only: a ragged tail chunk would be a
+    SECOND compiled body, paying the pathology twice."""
+    if batch <= 1 or predicted_cpu_compile_seconds(convolutions, batch) <= budget_s:
+        return 0
+    best = 1
+    for c in range(batch - 1, 0, -1):
+        if batch % c == 0 and predicted_cpu_compile_seconds(convolutions, c) <= budget_s:
+            best = c
+            break
+    return best if best < batch else 0
+
+
+@dataclass
+class PartitionDecision:
+    """What the measured heuristic decided for one jit, and why — surfaced
+    in telemetry (`compile.partition` event) so a receipt run records the
+    decision inputs, not just the outcome."""
+
+    chunk: int  # 0 = leave unpartitioned
+    backend: str
+    batch: int
+    predicted_seconds: float
+    budget_s: float
+    counts: dict[str, int] = field(default_factory=dict)
+    reason: str = ""
+
+    def as_event(self) -> dict[str, Any]:
+        return {
+            "chunk": self.chunk,
+            "backend": self.backend,
+            "batch": self.batch,
+            "predicted_seconds": round(self.predicted_seconds, 1),
+            "budget_s": self.budget_s,
+            **{f"count_{k}": v for k, v in self.counts.items()},
+            "reason": self.reason,
+        }
+
+
+def partition_mem_budget_bytes() -> int:
+    try:
+        mb = float(os.environ.get("SHEEPRL_TPU_PARTITION_MEM_MB", "512"))
+    except ValueError:
+        mb = 512.0
+    return int(mb * 2**20)
+
+
+def _chunk_for_ratio(batch: int, ratio: float) -> int:
+    """Largest divisor of `batch` at or below `batch * ratio` (>=1)."""
+    target = max(int(batch * min(ratio, 1.0)), 1)
+    for c in range(target, 0, -1):
+        if batch % c == 0:
+            return c
+    return 1
+
+
+def decide_batch_chunk(
+    fn: Callable,
+    example: tuple,
+    batch: int,
+    budget_s: float | None = None,
+    backend: str | None = None,
+    mem_budget_bytes: int | None = None,
+) -> PartitionDecision:
+    """Measure `fn` and decide whether (and how finely) to partition its
+    batch axis on this backend. Non-CPU backends never partition — TPU
+    compiles and runs the fused program fine and prefers the fusion.
+
+    The measurement ladder on CPU:
+      1. lower (sub-second) and count convolutions; if the conv-count x
+         batch predictor says even ONE trial compile could be pathological
+         on this toolchain, chunk by the predictor without probing further;
+      2. otherwise trial-AOT-compile the lowered module (seconds on a
+         healthy toolchain) and read XLA's own `memory_analysis()`: when
+         peak temp bytes exceed the memory budget, chunk proportionally —
+         bounding the conv-grad activation footprint that drives the
+         memory-pressure/swap pathology at pixel batch sizes.
+    """
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    budget = compile_budget_s() if budget_s is None else budget_s
+    mem_budget = (
+        partition_mem_budget_bytes() if mem_budget_bytes is None else mem_budget_bytes
+    )
+    if backend != "cpu":
+        return PartitionDecision(
+            chunk=0, backend=backend, batch=batch, predicted_seconds=0.0,
+            budget_s=budget, reason="non-cpu backend: keep fused",
+        )
+    try:
+        from .plan import avals_of
+
+        lowered = fn.lower(*avals_of(example))
+        text = lowered.as_text()
+        counts = {
+            "convolutions": text.count("stablehlo.convolution"),
+            "dots": text.count("stablehlo.dot"),
+            "ops": text.count(" = "),
+        }
+    except Exception as err:
+        return PartitionDecision(
+            chunk=0, backend=backend, batch=batch, predicted_seconds=0.0,
+            budget_s=budget, reason=f"lowering failed: {type(err).__name__}",
+        )
+    pred = predicted_cpu_compile_seconds(counts["convolutions"], batch)
+    if pred > budget * 10:
+        # a toolchain with superlinear conv-grad compile would hang the
+        # trial compile itself: decide on the predictor alone
+        chunk = chunk_for_budget(batch, counts["convolutions"], budget)
+        return PartitionDecision(
+            chunk=chunk, backend=backend, batch=batch, predicted_seconds=pred,
+            budget_s=budget, counts=counts,
+            reason=(
+                f"predicted {pred:.0f}s compile: chunk {batch} -> {chunk} "
+                "without trial compile"
+            ),
+        )
+    try:
+        t0 = _time.perf_counter()
+        exe = lowered.compile()
+        trial_s = _time.perf_counter() - t0
+        ma = exe.memory_analysis()
+        temp_bytes = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+    except Exception as err:
+        return PartitionDecision(
+            chunk=0, backend=backend, batch=batch, predicted_seconds=pred,
+            budget_s=budget, counts=counts,
+            reason=f"trial compile failed: {type(err).__name__}",
+        )
+    counts["temp_bytes"] = temp_bytes
+    counts["trial_compile_ms"] = int(trial_s * 1000)
+    if trial_s > budget:
+        chunk = _chunk_for_ratio(batch, budget / trial_s)
+        reason = (
+            f"trial compile {trial_s:.0f}s > budget {budget:.0f}s: "
+            f"chunk {batch} -> {chunk}"
+        )
+    elif temp_bytes > mem_budget:
+        chunk = _chunk_for_ratio(batch, mem_budget / temp_bytes)
+        reason = (
+            f"peak temp {temp_bytes / 2**20:.0f}MiB > budget "
+            f"{mem_budget / 2**20:.0f}MiB: chunk {batch} -> {chunk}"
+        )
+    else:
+        chunk = 0
+        reason = (
+            f"compile {trial_s:.1f}s and peak temp "
+            f"{temp_bytes / 2**20:.0f}MiB within budget"
+        )
+    if chunk >= batch:
+        chunk = 0
+    return PartitionDecision(
+        chunk=chunk, backend=backend, batch=batch, predicted_seconds=pred,
+        budget_s=budget, counts=counts, reason=reason,
+    )

@@ -1,0 +1,61 @@
+"""Shared shape-capture spec builders for the algo mains.
+
+The Dreamer family (dreamer_v1/v2/v3, p2e_dv1/dv2) all train on `[T, B]`
+sequential replay samples with the same key layout (dict obs + one-hot/
+continuous actions + scalar channels), so the CompilePlan example spec is
+built once here instead of five times inline. Off-policy/on-policy mains
+with simpler batches build their specs inline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+from .plan import sds
+
+__all__ = ["dreamer_sample_spec", "dict_obs_spec"]
+
+
+def dict_obs_spec(obs_space: Any, keys: Sequence[str], cnn_keys: Sequence[str], lead: tuple):
+    """Spec of a dict observation put (`{k: jnp.asarray(obs[k])}`): uint8
+    pixels, float32 vectors (x64 is disabled on device, so float64 spaces
+    land as f32)."""
+    import jax.numpy as jnp
+
+    return {
+        k: sds(
+            lead + tuple(obs_space[k].shape),
+            jnp.uint8 if k in cnn_keys else jnp.float32,
+        )
+        for k in keys
+    }
+
+
+def dreamer_sample_spec(
+    obs_space: Any,
+    obs_keys: Sequence[str],
+    cnn_keys: Sequence[str],
+    T: int,
+    B: int,
+    act_sum: int,
+    extra: Iterable[str] = ("rewards", "dones"),
+    mesh: Any = None,
+) -> dict:
+    """`[T, B, ...]` spec of one sequential replay sample — the Dreamer
+    train-step batch. With a multi-device mesh the leaves carry the
+    time/batch sharding `shard_time_batch` would apply."""
+    import jax.numpy as jnp
+
+    sharding = None
+    if mesh is not None and mesh.devices.size > 1:
+        from ..parallel.mesh import time_batch_sharding
+
+        sharding = time_batch_sharding(mesh)
+    spec = {}
+    for k in obs_keys:
+        dt = jnp.uint8 if k in cnn_keys else jnp.float32
+        spec[k] = sds((T, B) + tuple(obs_space[k].shape), dt, sharding=sharding)
+    spec["actions"] = sds((T, B, act_sum), jnp.float32, sharding=sharding)
+    for k in extra:
+        spec[k] = sds((T, B, 1), jnp.float32, sharding=sharding)
+    return spec

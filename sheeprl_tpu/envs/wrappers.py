@@ -25,6 +25,41 @@ __all__ = [
 ]
 
 
+class StepLatencyWrapper(gym.Wrapper):
+    """Model a real-time environment: every `step()` pays a fixed wall-clock
+    latency without consuming host CPU (`time.sleep` releases the GIL and
+    the core). Robots, remote/throttled simulators and rate-limited web
+    envs all look like this to the learner — the env-step window is IDLE
+    host time that background work (warm-start compilation, prefetchers)
+    can genuinely hide, even on a single-core host.
+
+    Enabled repo-wide by `SHEEPRL_TPU_ENV_LATENCY_MS` (see utils/env.py);
+    `bench.py --algo warm_compile` uses it to put collection in the
+    latency-bound regime its headline models."""
+
+    def __init__(self, env: gym.Env, latency_ms: float):
+        super().__init__(env)
+        self._latency_s = float(latency_ms) / 1000.0
+
+    def step(self, action):
+        import time
+
+        time.sleep(self._latency_s)
+        return self.env.step(action)
+
+
+def maybe_step_latency(env: gym.Env) -> gym.Env:
+    """Apply StepLatencyWrapper when SHEEPRL_TPU_ENV_LATENCY_MS is set (>0)."""
+    import os
+
+    ms = os.environ.get("SHEEPRL_TPU_ENV_LATENCY_MS")
+    try:
+        ms_f = float(ms) if ms else 0.0
+    except ValueError:
+        ms_f = 0.0
+    return StepLatencyWrapper(env, ms_f) if ms_f > 0 else env
+
+
 class MaskVelocityWrapper(gym.ObservationWrapper):
     """Zero out velocity entries to make classic-control tasks partially
     observable (/root/reference/sheeprl/envs/wrappers.py:11-43)."""

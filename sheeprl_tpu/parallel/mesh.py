@@ -79,11 +79,16 @@ def distributed_setup(
     cached across processes/sessions. This is how the CPU receipt runners
     amortize the XLA:CPU conv-gradient compile pathology (the SAC-AE
     reconstruction jit alone costs ~16 min at pixel sizes — once), and it
-    makes resumed TPU bench sessions rebuild closures nearly for free."""
+    makes resumed TPU bench sessions rebuild closures nearly for free.
+    Arming goes through the repo's ONE helper (`compile/cache.py`) — this
+    call previously re-armed with a private 10 s compile-time floor, so
+    after distributed setup every 0.5-10 s executable silently stopped
+    being cached (ISSUE 5 satellite)."""
     cache_dir = os.environ.get("SHEEPRL_TPU_COMPILE_CACHE")
     if cache_dir:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+        from ..compile.cache import arm_compile_cache
+
+        arm_compile_cache(cache_dir)
     if num_processes is not None and num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

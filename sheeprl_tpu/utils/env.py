@@ -25,6 +25,7 @@ from ..envs.wrappers import (
     DictObservation,
     FrameStack,
     MaskVelocityWrapper,
+    maybe_step_latency,
 )
 
 __all__ = ["make_env", "make_dict_env", "get_dummy_env"]
@@ -46,6 +47,7 @@ def make_env(
 
     def thunk() -> gym.Env:
         env = gym.make(env_id, render_mode="rgb_array")
+        env = maybe_step_latency(env)
         if mask_velocities:
             env = MaskVelocityWrapper(env)
         env = ActionRepeat(env, action_repeat)
@@ -246,6 +248,7 @@ def make_dict_env(
                     terminal_on_life_loss=False,
                     grayscale_newaxis=True,
                 )
+        env = maybe_step_latency(env)
         if mask_velocities:
             env = MaskVelocityWrapper(env)
         if "atari" not in env_spec and not lid.startswith("dmc") and "diambra" not in lid:

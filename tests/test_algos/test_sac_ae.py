@@ -60,8 +60,22 @@ def test_sac_ae_rejects_minedojo():
 
 @pytest.mark.timeout(300)
 def test_sac_ae_split_update_dry_run(tmp_path):
-    tasks["sac_ae"](tiny_argv(tmp_path, "split", extra=("--split_update",)))
+    tasks["sac_ae"](tiny_argv(tmp_path, "split", extra=("--split_update", "on")))
     ckpt = str(tmp_path / "split" / "checkpoints" / "ckpt_1")
+    assert set(load_checkpoint(ckpt).keys()) == CKPT_KEYS
+
+
+@pytest.mark.timeout(300)
+def test_sac_ae_chunked_recon_dry_run(tmp_path):
+    """The compile-pathology partition end-to-end: split update with the
+    reconstruction batch chunked (explicit --recon_chunk 1)."""
+    tasks["sac_ae"](
+        tiny_argv(
+            tmp_path, "chunked",
+            extra=("--split_update", "on", "--recon_chunk", "1"),
+        )
+    )
+    ckpt = str(tmp_path / "chunked" / "checkpoints" / "ckpt_1")
     assert set(load_checkpoint(ckpt).keys()) == CKPT_KEYS
 
 
@@ -141,20 +155,25 @@ def test_split_update_matches_fused():
     }
     fused = make_train_step(args, optimizers, ("rgb",), ())
     split = make_split_train_step(args, optimizers, ("rgb",), ())
+    # the compile-pathology partition: recon batch chunked to 1 — dither
+    # noise is drawn at full batch and sliced, so targets are bit-identical
+    # and only the chunk-mean reassociation differs
+    chunked = make_split_train_step(args, optimizers, ("rgb",), (), recon_chunk=1)
     t = jnp.asarray(True)
     s_fused, m_fused = fused(fresh_state(), data, k_train, t, t, t)
-    s_split, m_split = split(fresh_state(), data, k_train, t, t, t)
 
-    flat_f, _ = jax.tree_util.tree_flatten(s_fused)
-    flat_s, _ = jax.tree_util.tree_flatten(s_split)
-    assert len(flat_f) == len(flat_s)
-    for a, c in zip(flat_f, flat_s):
-        np.testing.assert_allclose(
-            np.asarray(a, np.float32), np.asarray(c, np.float32),
-            rtol=2e-4, atol=2e-5,
-        )
-    assert set(m_fused) == set(m_split)
-    for name in m_fused:
-        np.testing.assert_allclose(
-            float(m_fused[name]), float(m_split[name]), rtol=2e-4, atol=2e-5
-        )
+    for variant in (split, chunked):
+        s_v, m_v = variant(fresh_state(), data, k_train, t, t, t)
+        flat_f, _ = jax.tree_util.tree_flatten(s_fused)
+        flat_s, _ = jax.tree_util.tree_flatten(s_v)
+        assert len(flat_f) == len(flat_s)
+        for a, c in zip(flat_f, flat_s):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(c, np.float32),
+                rtol=2e-4, atol=2e-5,
+            )
+        assert set(m_fused) == set(m_v)
+        for name in m_fused:
+            np.testing.assert_allclose(
+                float(m_fused[name]), float(m_v[name]), rtol=2e-4, atol=2e-5
+            )

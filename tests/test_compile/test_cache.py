@@ -1,0 +1,96 @@
+"""The unified cache-arming path + persistent-cache hit/miss counting."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.compile import CacheStats, MIN_COMPILE_SECS, arm_compile_cache
+
+
+@pytest.fixture
+def restore_cache_config():
+    """Snapshot/restore the three jax config knobs the helper touches, plus
+    the env vars, so tests never leak cache state into the suite."""
+    saved = {
+        "dir": jax.config.jax_compilation_cache_dir,
+        "min_secs": jax.config.jax_persistent_cache_min_compile_time_secs,
+        "min_bytes": jax.config.jax_persistent_cache_min_entry_size_bytes,
+        "env": {
+            k: os.environ.get(k)
+            for k in (
+                "JAX_COMPILATION_CACHE_DIR",
+                "SHEEPRL_TPU_COMPILE_CACHE",
+                "SHEEPRL_TPU_XLA_CACHE",
+            )
+        },
+    }
+    yield
+    jax.config.update("jax_compilation_cache_dir", saved["dir"])
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", saved["min_secs"]
+    )
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes", saved["min_bytes"]
+    )
+    for k, v in saved["env"].items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def test_one_threshold_for_everyone(tmp_path, restore_cache_config):
+    """The satellite fix: every arming path lands the SAME compile-time
+    floor (the old distributed_setup re-arm used a silent 10 s)."""
+    path = arm_compile_cache(str(tmp_path / "c1"))
+    assert path == str(tmp_path / "c1")
+    assert jax.config.jax_compilation_cache_dir == path
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == MIN_COMPILE_SECS
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == path
+
+    # distributed_setup routes through the same helper with the same floor
+    os.environ["SHEEPRL_TPU_COMPILE_CACHE"] = str(tmp_path / "c2")
+    from sheeprl_tpu.parallel.mesh import distributed_setup
+
+    distributed_setup()
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "c2")
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == MIN_COMPILE_SECS
+
+
+def test_resolution_order_and_disable(tmp_path, restore_cache_config):
+    os.environ["SHEEPRL_TPU_COMPILE_CACHE"] = str(tmp_path / "envvar")
+    assert arm_compile_cache() == str(tmp_path / "envvar")
+    # explicit path wins over the env var
+    assert arm_compile_cache(str(tmp_path / "explicit")) == str(
+        tmp_path / "explicit"
+    )
+    os.environ["SHEEPRL_TPU_XLA_CACHE"] = "0"
+    assert arm_compile_cache(str(tmp_path / "off")) is None
+
+
+@pytest.mark.timeout(120)
+def test_cache_hit_miss_counting(tmp_path, restore_cache_config):
+    """Compile the same program twice (fresh jit objects, so no in-memory
+    dispatch-cache reuse): first is a persistent-cache miss, second a hit.
+    min_compile_secs=0 lets the tiny test graph qualify for caching."""
+    arm_compile_cache(str(tmp_path / "cache"), min_compile_secs=0.0)
+    stats = CacheStats().attach()
+    if not stats.supported:
+        pytest.skip("jax.monitoring unavailable")
+
+    def build():
+        # non-trivial enough that XLA actually compiles a module
+        return jax.jit(lambda x: jnp.tanh(x @ x.T).sum())
+
+    x = jnp.ones((16, 16), jnp.float32)
+    before = stats.snapshot()
+    build()(x).block_until_ready()
+    mid = stats.snapshot()
+    build()(x).block_until_ready()
+    after = stats.snapshot()
+    stats.detach()
+    assert mid["misses"] - before["misses"] >= 1
+    assert mid["hits"] == before["hits"]
+    assert after["hits"] - mid["hits"] >= 1

@@ -1,0 +1,75 @@
+"""The measured partition heuristic (compile/partition.py)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.compile import (
+    chunk_for_budget,
+    decide_batch_chunk,
+    lowered_op_counts,
+    predicted_cpu_compile_seconds,
+    sds,
+)
+from sheeprl_tpu.compile.partition import CPU_SECONDS_PER_CONV_ELEMENT
+
+
+def test_chunk_for_budget_picks_largest_fitting_divisor():
+    # 10 convs at CPU_SECONDS_PER_CONV_ELEMENT each: budget for 4 elements
+    budget = predicted_cpu_compile_seconds(10, 4)
+    assert chunk_for_budget(32, 10, budget) == 4
+    assert chunk_for_budget(32, 10, budget * 8) == 0  # whole batch fits
+    # prime batch: only 1 divides
+    assert chunk_for_budget(31, 10, budget) == 1
+    assert chunk_for_budget(1, 10, 0.0) == 0  # nothing to chunk
+
+
+def test_predicted_scaling_is_linear_in_batch():
+    one = predicted_cpu_compile_seconds(23, 1)
+    assert predicted_cpu_compile_seconds(23, 8) == pytest.approx(8 * one)
+    assert one == pytest.approx(23 * CPU_SECONDS_PER_CONV_ELEMENT)
+
+
+@pytest.mark.timeout(120)
+def test_lowered_op_counts_sees_convolutions():
+    def convnet(w, x):
+        y = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+        return jnp.mean(jnp.square(y))
+
+    grad = jax.jit(jax.grad(convnet))
+    counts = lowered_op_counts(
+        grad, sds((3, 3, 4, 4), jnp.float32), sds((2, 8, 8, 4), jnp.float32)
+    )
+    # forward conv + the two gradient convs
+    assert counts["convolutions"] >= 2
+    assert counts["ops"] > 0
+
+
+@pytest.mark.timeout(120)
+def test_decide_batch_chunk_cpu_vs_other_backend():
+    def convnet(w, x):
+        y = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+        return jnp.mean(jnp.square(y))
+
+    grad = jax.jit(jax.grad(convnet))
+    example = (sds((3, 3, 4, 4), jnp.float32), sds((32, 8, 8, 4), jnp.float32))
+    # a non-cpu backend never partitions, whatever the budget
+    d = decide_batch_chunk(grad, example, batch=32, budget_s=0.001, backend="tpu")
+    assert d.chunk == 0 and "non-cpu" in d.reason
+    # cpu with a tiny budget must chunk; the decision records its inputs
+    d = decide_batch_chunk(grad, example, batch=32, budget_s=0.001, backend="cpu")
+    assert d.chunk == 1
+    ev = d.as_event()
+    assert ev["count_convolutions"] >= 2 and ev["chunk"] == 1
+    # cpu with a huge budget keeps the batch whole
+    d = decide_batch_chunk(grad, example, batch=32, budget_s=1e9, backend="cpu")
+    assert d.chunk == 0
+
+
+def test_decide_handles_unlowerable_fn():
+    d = decide_batch_chunk(lambda x: x, (jnp.zeros(2),), batch=8, backend="cpu")
+    assert d.chunk == 0 and "lowering failed" in d.reason

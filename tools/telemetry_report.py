@@ -79,6 +79,11 @@ def summarize(events: list[dict]) -> dict:
         "late_compile_seconds": 0.0,
         "peak_memory_bytes": 0.0,
         "gauges_last": {},
+        # ISSUE 5 compile-latency subsystem (compile/plan.py)
+        "compile_events": [],       # per-executable `compile` events
+        "partition_events": [],     # `compile.partition` heuristic decisions
+        "first_update": None,       # the `first_update` stamp event
+        "compile_gauges": {},       # last Compile/* gauge values
     }
     for ev in events:
         ts = ev.get("ts")
@@ -98,6 +103,12 @@ def summarize(events: list[dict]) -> dict:
             summary["profile_windows"] += 1
         elif kind == "health.nan":
             summary["nan_events"].append(ev)
+        elif kind == "compile":
+            summary["compile_events"].append(ev)
+        elif kind == "compile.partition":
+            summary["partition_events"].append(ev)
+        elif kind == "first_update":
+            summary["first_update"] = ev
         elif kind == "log":
             summary["log_events"] += 1
             if ev.get("step") is not None:
@@ -125,6 +136,8 @@ def summarize(events: list[dict]) -> dict:
                     summary["peak_memory_bytes"] = max(summary["peak_memory_bytes"], v)
                 elif k.startswith("Decoupled/"):
                     summary["gauges_last"][k] = v
+                elif k.startswith("Compile/"):
+                    summary["compile_gauges"][k] = v
     # the "end" event carries phase time accumulated after the last interval
     if summary["end"]:
         for phase, secs in (summary["end"].get("phases") or {}).items():
@@ -197,6 +210,48 @@ def render(summary: dict) -> str:
     )
 
     lines.append("")
+    lines.append("== compile breakdown (warm-start subsystem) ==")
+    g = summary["compile_gauges"]
+    fu = summary["first_update"]
+    if fu is not None:
+        lines.append(
+            f"time_to_first_update={fu.get('seconds', 0):.1f}s "
+            f"(warm_compile={fu.get('warm_compile', '?')})"
+        )
+    if summary["compile_events"] or g:
+        warm = [e for e in summary["compile_events"] if e.get("mode") == "warm"]
+        falls = [
+            e for e in summary["compile_events"] if e.get("mode") == "aot_fallback"
+        ]
+        if warm:
+            widths = (max(len("executable"), *(len(str(e.get("jit"))) for e in warm)) + 2, 12, 8, 8)
+            lines.append(_fmt_row(("executable", "compile_s", "hits", "misses"), widths))
+            for e in warm:
+                lines.append(_fmt_row(
+                    (e.get("jit"), f"{e.get('seconds', 0):.2f}",
+                     e.get("cache_hits", 0), e.get("cache_misses", 0)),
+                    widths,
+                ))
+        if g:
+            lines.append(
+                f"plan: entries={g.get('Compile/plan_entries', 0):.0f} "
+                f"compiled={g.get('Compile/plan_compiled', 0):.0f} "
+                f"aot_calls={g.get('Compile/aot_calls', 0):.0f} "
+                f"fallbacks={g.get('Compile/aot_fallbacks', 0):.0f} "
+                f"cache {g.get('Compile/cache_hits', 0):.0f} hit / "
+                f"{g.get('Compile/cache_misses', 0):.0f} miss"
+            )
+        for e in falls:
+            lines.append(f"AOT FALLBACK {e.get('jit')}: {e.get('error', '')}")
+        for e in summary["partition_events"]:
+            lines.append(
+                f"partition {e.get('jit')}: chunk={e.get('chunk')} "
+                f"({e.get('reason', '')})"
+            )
+    else:
+        lines.append("no warm-start compile telemetry (cold path or pre-round-6 log)")
+
+    lines.append("")
     lines.append("== health ==")
     if summary["nan_events"]:
         keys: set = set()
@@ -242,6 +297,12 @@ def selftest() -> int:
             metrics["Loss/bad"] = float("inf")
         telem.interval(metrics, step, sps=123.0)
     telem.event("checkpoint", path=os.path.join(d, "ckpt_30"))
+    # the warm-start subsystem's events ride the same writer (compile/plan.py)
+    telem.event(
+        "compile", jit="train_step", mode="warm", seconds=3.25,
+        cache_hits=0, cache_misses=1, error=None,
+    )
+    telem.event("first_update", seconds=7.5, warm_compile="on")
     telem.close()
 
     summary = report(d)
@@ -253,6 +314,10 @@ def selftest() -> int:
     assert len(summary["checkpoints"]) == 1
     assert len(summary["nan_events"]) == 1
     assert summary["nan_events"][0]["keys"] == ["Loss/bad"]
+    assert summary["first_update"] and summary["first_update"]["seconds"] == 7.5
+    assert len(summary["compile_events"]) == 1
+    assert summary["compile_events"][0]["jit"] == "train_step"
+    assert summary["compile_events"][0]["cache_misses"] == 1
     print("\nselftest OK", file=sys.stderr)
     return 0
 

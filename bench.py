@@ -1840,7 +1840,224 @@ _METRIC_OF_ALGO = {
         "env-steps/sec",
     ),
     "warm_compile": ("time_to_first_update_seconds", "seconds"),
+    "anakin": ("anakin_env_steps_per_sec", "env-steps/sec"),
 }
+
+
+def bench_anakin() -> None:
+    """ISSUE 6 headline: aggregate env_steps_per_second of the fully-jitted
+    Anakin collector (envs/jax/rollout.py) — `lax.scan(policy ∘ env.step)`
+    over a CartPole env batch sharded across the virtual 8-device mesh,
+    zero host transfers per step — against the host-env PPO collection rate
+    on the SAME box with the SAME default policy network (the A/B the
+    acceptance criterion prices: `vs_baseline` = jitted/host, demanded
+    >= 50x). CPU-receiptable: both arms run on the local CPU backend, no
+    tunnel dependence; the chip figure scales with the mesh.
+
+    The host arm is the PPO main's ACTUAL rollout hot loop — jitted
+    policy_step, per-step index pull, vector-env step, and the per-step
+    device-ring `rb.add` — not a stripped-down policy+step loop, so the
+    ratio prices what the Anakin path really replaces.
+
+    Config knobs (env): SHEEPRL_TPU_ANAKIN_ENVS (default 1024),
+    SHEEPRL_TPU_ANAKIN_STEPS (scan span, default 128),
+    SHEEPRL_TPU_ANAKIN_REPEATS (timed rollouts, default 3),
+    SHEEPRL_TPU_ANAKIN_HOST_STEPS (host-arm timed steps, default 192).
+    Compile time is excluded from BOTH arms (first call / warmup steps);
+    the jitted arm's compile seconds are recorded in the artifact."""
+    import os
+    import subprocess
+    import sys
+
+    import jax
+
+    # the acceptance criterion's headline is the VIRTUAL 8-MESH figure;
+    # XLA_FLAGS must exist before backend init, so when this process came
+    # up single-device re-exec the measurement with 8 virtual CPU devices
+    if (
+        jax.default_backend() == "cpu"
+        and jax.local_device_count() == 1
+        and os.environ.get("SHEEPRL_TPU_ANAKIN_NO_REEXEC") != "1"
+    ):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        env["SHEEPRL_TPU_ANAKIN_NO_REEXEC"] = "1"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("PALLAS_AXON_POOL_IPS", "")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--algo", "anakin"],
+            env=env, capture_output=True, text=True, timeout=1800,
+        )
+        lines = [l for l in proc.stdout.splitlines() if l.strip()]
+        if proc.returncode == 0 and lines:
+            print(lines[-1])
+        else:
+            print(_failure_line(
+                "anakin_env_steps_per_sec", "env-steps/sec",
+                f"subprocess rc={proc.returncode}: {proc.stderr[-300:]}",
+            ))
+        return
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.ppo.agent import PPOAgent, indices_to_env_actions
+    from sheeprl_tpu.envs.jax import (
+        JaxCartPole,
+        JaxPixelToy,
+        PPOCollectorCarry,
+        VecJaxEnv,
+        make_ppo_collector,
+    )
+    from sheeprl_tpu.parallel import make_mesh, replicate, shard_env_batch
+
+    num_envs = int(os.environ.get("SHEEPRL_TPU_ANAKIN_ENVS", "1024"))
+    rollout_steps = int(os.environ.get("SHEEPRL_TPU_ANAKIN_STEPS", "128"))
+    repeats = int(os.environ.get("SHEEPRL_TPU_ANAKIN_REPEATS", "3"))
+    host_steps = int(os.environ.get("SHEEPRL_TPU_ANAKIN_HOST_STEPS", "192"))
+
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    num_envs -= num_envs % n_dev  # env batch shards over the mesh
+
+    def _agent_for(venv):
+        space = venv.single_observation_space
+        cnn_keys = [k for k, s in space.spaces.items() if len(s.shape) == 3]
+        mlp_keys = [k for k, s in space.spaces.items() if len(s.shape) == 1]
+        import gymnasium as gym
+
+        act = venv.single_action_space
+        dims = (
+            [int(act.n)]
+            if isinstance(act, gym.spaces.Discrete)
+            else [int(np.prod(act.shape))]
+        )
+        agent = PPOAgent.init(
+            jax.random.PRNGKey(1), dims, space.spaces, cnn_keys, mlp_keys,
+            screen_size=space[cnn_keys[0]].shape[0] if cnn_keys else 64,
+        )
+        return replicate(agent, mesh), dims
+
+    def jitted_arm(env, envs_n, steps):
+        venv = VecJaxEnv(env=env, num_envs=envs_n)
+        agent, dims = _agent_for(venv)
+        collect = jax.jit(make_ppo_collector(venv, steps, dims, False))
+        state, obs = jax.jit(venv.reset)(jax.random.PRNGKey(0))
+        carry = shard_env_batch(
+            PPOCollectorCarry(
+                vec=state, obs=obs,
+                prev_done=jnp.zeros((envs_n, 1), jnp.float32),
+            ),
+            mesh,
+        )
+        key = jax.random.PRNGKey(2)
+        t0 = time.perf_counter()
+        key, k = jax.random.split(key)
+        carry, traj, ep = collect(agent, carry, k)
+        jax.block_until_ready(traj["dones"])
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            key, k = jax.random.split(key)
+            carry, traj, ep = collect(agent, carry, k)
+        jax.block_until_ready(traj["dones"])
+        dt = time.perf_counter() - t0
+        return repeats * steps * envs_n / dt, compile_s
+
+    def host_arm():
+        """The host PPO main's rollout hot loop verbatim (ppo.py): jitted
+        policy_step, per-step env-index pull, vector-env step, device
+        rollout-ring `rb.add` — collection phase only."""
+        from sheeprl_tpu.algos.ppo.agent import buffer_actions
+        from sheeprl_tpu.algos.ppo.args import PPOArgs
+        from sheeprl_tpu.algos.ppo.ppo import policy_step, validate_obs_keys
+        from sheeprl_tpu.data import ReplayBuffer
+        from sheeprl_tpu.envs import make_vector_env
+        from sheeprl_tpu.utils.env import make_dict_env
+
+        args = PPOArgs(env_id="CartPole-v1", num_envs=8, sync_env=True)
+        envs = make_vector_env(
+            [
+                make_dict_env(args.env_id, i, rank=0, args=args)
+                for i in range(args.num_envs)
+            ],
+            sync=True,
+        )
+        cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
+        obs_keys = [*cnn_keys, *mlp_keys]
+        agent = PPOAgent.init(
+            jax.random.PRNGKey(1), [2], envs.single_observation_space.spaces,
+            cnn_keys, mlp_keys,
+        )
+        rb = ReplayBuffer(
+            host_steps, args.num_envs, storage="device",
+            obs_keys=tuple(obs_keys), seed=0,
+        )
+        obs, _ = envs.reset(seed=0)
+        next_done = np.zeros(args.num_envs, dtype=np.float32)
+        key = jax.random.PRNGKey(0)
+
+        def one_step(obs, next_done, key):
+            key, sk = jax.random.split(key)
+            device_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+            actions, logprob, value, env_idx = policy_step(agent, device_obs, sk)
+            env_idx_np = np.asarray(env_idx)  # the per-step d2h pull
+            env_actions = indices_to_env_actions(env_idx_np, [2], False)
+            nobs, rewards, terms, truncs, _ = envs.step(list(env_actions))
+            dones = (terms | truncs).astype(np.float32)
+            row = {k: device_obs[k][None] for k in obs_keys}
+            row.update(
+                actions=buffer_actions(env_idx_np, actions, [2], False, host=False)[None],
+                logprobs=logprob[None],
+                values=value[None],
+                rewards=rewards[None, :, None],
+                dones=next_done[None, :, None],
+            )
+            rb.add(row)
+            return nobs, dones, key
+
+        for _ in range(16):  # warmup: compile + first dispatches
+            obs, next_done, key = one_step(obs, next_done, key)
+        t0 = time.perf_counter()
+        for _ in range(host_steps):
+            obs, next_done, key = one_step(obs, next_done, key)
+        dt = time.perf_counter() - t0
+        envs.close()
+        return host_steps * args.num_envs / dt
+
+    jit_sps, jit_compile_s = jitted_arm(JaxCartPole(), num_envs, rollout_steps)
+    # secondary: on-device pixel rendering rate (uint8 frames drawn in-scan)
+    px_envs = max(n_dev, (num_envs // 16) - (num_envs // 16) % n_dev)
+    px_sps, px_compile_s = jitted_arm(
+        JaxPixelToy(), px_envs, max(rollout_steps // 8, 1)
+    )
+    host_sps = host_arm()
+    print(
+        json.dumps(
+            {
+                "metric": "anakin_env_steps_per_sec",
+                "value": round(jit_sps, 1),
+                "unit": "env-steps/sec",
+                "vs_baseline": round(jit_sps / max(host_sps, 1e-9), 1),
+                "baseline_note": (
+                    "vs_baseline is jitted-anakin / host-env PPO collection "
+                    "on the same box (acceptance floor: 50x); "
+                    + BASELINE_NOTE
+                ),
+                "host_ppo_collect_sps": round(host_sps, 1),
+                "pixeltoy_env_steps_per_sec": round(px_sps, 1),
+                "num_envs": num_envs,
+                "rollout_steps": rollout_steps,
+                "repeats": repeats,
+                "devices": n_dev,
+                "compile_seconds": round(jit_compile_s, 2),
+                "pixeltoy_compile_seconds": round(px_compile_s, 2),
+                "cpu_count": os.cpu_count(),
+            }
+        )
+    )
 
 
 def bench_warm_compile() -> None:
@@ -2521,6 +2738,8 @@ def main() -> None:
         bench_dreamer_v3_decoupled(tiny=opts.tiny)
     elif opts.algo == "warm_compile":
         bench_warm_compile()
+    elif opts.algo == "anakin":
+        bench_anakin()
     else:
         bench_dreamer_v3(tiny=opts.tiny, pipeline_mode=opts.pipeline)
 

@@ -106,6 +106,17 @@ class StandardArgs:
         "compile seconds, cache hits/misses and "
         "time_to_first_update_seconds",
     )
+    env_backend: str = Arg(
+        default="host",
+        help="where the environments live (ISSUE 6, Anakin): 'host' steps "
+        "ordinary gymnasium envs through the vector runners (the default; "
+        "bit-exact pre-Anakin behavior), 'jax' runs the pure-JAX twin of "
+        "env_id (envs/jax/: CartPole-v1, Pendulum-v1, pixeltoy) ON DEVICE "
+        "and collects whole rollouts as one jitted lax.scan over "
+        "policy+env.step — zero host transfers per step, env batch sharded "
+        "across the mesh, trajectories scattered straight into the device "
+        "replay ring. Supported by ppo and dreamer_v3",
+    )
     sanitize: bool = Arg(
         default=False,
         help="runtime transfer/donation sanitizer (sheeplint's dynamic "
@@ -126,6 +137,10 @@ class StandardArgs:
         if name == "warm_compile" and value not in ("on", "off"):
             raise ValueError(
                 f"warm_compile must be 'on' or 'off', got {value!r}"
+            )
+        if name == "env_backend" and value not in ("host", "jax"):
+            raise ValueError(
+                f"env_backend must be 'host' or 'jax', got {value!r}"
             )
         super().__setattr__(name, value)
         if name == "log_dir" and value:

@@ -36,6 +36,12 @@ from ... import nn, ops
 from ...data import AsyncReplayBuffer, StepBlobCodec, stage_batch
 from ...data.blob import verify_blob_roundtrip
 from ...envs import make_vector_env
+from ...envs.jax import (
+    DreamerCollectorCarry,
+    VecJaxEnv,
+    make_dreamer_collector,
+    make_jax_env,
+)
 from ...envs.wrappers import RestartOnException
 from ...ops.distributions import (
     Bernoulli,
@@ -47,8 +53,10 @@ from ...ops.distributions import (
     SymlogDistribution,
 )
 from ...parallel import (
+    AnakinStats,
     Pipeline,
     assert_divisible,
+    shard_env_batch,
     distributed_setup,
     make_mesh,
     process_index,
@@ -532,24 +540,43 @@ def main(argv: Sequence[str] | None = None) -> None:
     plan = CompilePlan.from_args(args, telem)
     telem.add_gauges(plan.gauges)
 
-    envs = make_vector_env(
-        [
-            partial(
-                RestartOnException,
-                partial(
-                    make_dict_env(
-                        args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
-                        run_name=log_dir, vector_env_idx=i,
-                    )
-                ),
+    use_jax_env = args.env_backend == "jax"
+    if use_jax_env:
+        # Anakin arrangement (ISSUE 6): env + player co-reside on chip; the
+        # collection window is chunked jitted scans writing straight into
+        # the device replay ring via reserve()/add_direct()
+        if args.memmap_buffer:
+            raise ValueError(
+                "--env_backend jax writes rollouts into the device replay "
+                "ring; drop --memmap_buffer"
             )
-            for i in range(args.num_envs)
-        ],
-        sync=args.sync_env or args.num_envs == 1,
-    )
-    cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
+        assert_divisible(args.num_envs, mesh.shape["data"], "num_envs")
+        jax_env = make_jax_env(args.env_id)
+        venv = VecJaxEnv(env=jax_env, num_envs=args.num_envs)
+        envs = None
+        observation_space = venv.single_observation_space
+        action_space = venv.single_action_space
+    else:
+        envs = make_vector_env(
+            [
+                partial(
+                    RestartOnException,
+                    partial(
+                        make_dict_env(
+                            args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
+                            run_name=log_dir, vector_env_idx=i,
+                        )
+                    ),
+                )
+                for i in range(args.num_envs)
+            ],
+            sync=args.sync_env or args.num_envs == 1,
+        )
+        observation_space = envs.single_observation_space
+        action_space = envs.single_action_space
+    cnn_keys, mlp_keys = validate_obs_keys(observation_space, args)
     obs_keys = [*cnn_keys, *mlp_keys]
-    actions_dim, is_continuous = actions_dim_of(envs.single_action_space)
+    actions_dim, is_continuous = actions_dim_of(action_space)
 
     key, model_key = jax.random.split(key)
     world_model, actor, critic, target_critic = build_models(
@@ -557,7 +584,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         actions_dim,
         is_continuous,
         args,
-        envs.single_observation_space.spaces,
+        observation_space.spaces,
         cnn_keys,
         mlp_keys,
     )
@@ -702,21 +729,69 @@ def main(argv: Sequence[str] | None = None) -> None:
             max_decay_steps=max_step_expl_decay,
         )
 
-    obs, _ = envs.reset(seed=args.seed)
-    step_data = {k: np.asarray(obs[k]) for k in obs_keys}
-    step_data["dones"] = np.zeros((args.num_envs, 1), np.float32)
-    step_data["rewards"] = np.zeros((args.num_envs, 1), np.float32)
-    step_data["is_first"] = np.ones((args.num_envs, 1), np.float32)
     player_state = player.init_states(args.num_envs)
     device_step_obs = None  # the policy step's obs puts, reused by rb.add
     expl_dev = jnp.float32(expl_amount)  # re-put only when the decay ticks
+    obs = step_data = None
+    use_blob = False
+    anakin = jcarry = None
+    anakin_chunk = 0
+    if use_jax_env:
+        # ---- Anakin collection setup (ISSUE 6): the collection window is
+        # chunked at the train cadence — one jitted scan per train_every
+        # window of env steps, writing straight into the device ring
+        anakin_chunk = max(
+            min(
+                args.train_every // single_global_step,
+                num_updates - start_step + 1,
+            ),
+            1,
+        )
+        key, jreset_key = jax.random.split(key)
+        vec_state, jax_obs = jax.jit(venv.reset)(jreset_key)
+        jcarry = DreamerCollectorCarry(
+            vec=vec_state,
+            obs=jax_obs,
+            prev_reward=jnp.zeros((args.num_envs, 1), jnp.float32),
+            prev_done=jnp.zeros((args.num_envs, 1), jnp.float32),
+            is_first=jnp.ones((args.num_envs, 1), jnp.float32),
+        )
+        # env batch sharded over the mesh's data axis, player replicated —
+        # zero cross-device traffic inside the rollout scan
+        jcarry = shard_env_batch(jcarry, mesh)
+        player_state = shard_env_batch(player_state, mesh)
+        collect = donating_jit(
+            make_dreamer_collector(
+                venv, anakin_chunk, actions_dim, is_continuous,
+                _dev_preprocess, clip_rewards=args.clip_rewards,
+            ),
+            donate_argnums=(2,),
+        )
+        collect_random = donating_jit(
+            make_dreamer_collector(
+                venv, anakin_chunk, actions_dim, is_continuous,
+                _dev_preprocess, clip_rewards=args.clip_rewards,
+                random_actions=True,
+            ),
+            donate_argnums=(2,),
+        )
+        anakin = AnakinStats(
+            scan_span=anakin_chunk, env_batch=args.num_envs, devices=n_dev
+        )
+        telem.add_gauges(anakin.gauges)
+    else:
+        obs, _ = envs.reset(seed=args.seed)
+        step_data = {k: np.asarray(obs[k]) for k in obs_keys}
+        step_data["dones"] = np.zeros((args.num_envs, 1), np.float32)
+        step_data["rewards"] = np.zeros((args.num_envs, 1), np.float32)
+        step_data["is_first"] = np.ones((args.num_envs, 1), np.float32)
 
-    # blob transport (device buffers): obs + replay-row floats + write
-    # indices ride ONE transfer per step; shapes/dtypes from the first obs
-    use_blob = (
-        not rb.prefers_host_adds
-        and os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
-    )
+        # blob transport (device buffers): obs + replay-row floats + write
+        # indices ride ONE transfer per step; shapes/dtypes from the first obs
+        use_blob = (
+            not rb.prefers_host_adds
+            and os.environ.get("SHEEPRL_TPU_STEP_BLOB", "1") != "0"
+        )
     if use_blob:
         codec, u8_keys, f32_obs_keys = StepBlobCodec.for_step(
             obs, obs_keys, args.num_envs, ("rewards", "dones", "is_first")
@@ -739,7 +814,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         return (
             state,
             dreamer_sample_spec(
-                envs.single_observation_space, obs_keys, cnn_keys,
+                observation_space, obs_keys, cnn_keys,
                 args.per_rank_sequence_length, args.per_rank_batch_size,
                 act_sum, extra=("rewards", "dones", "is_first"),
                 mesh=mesh if n_dev > 1 else None,
@@ -750,7 +825,20 @@ def main(argv: Sequence[str] | None = None) -> None:
     train_step = plan.register(
         "train_step", train_step, example=_train_example, role="update"
     )
-    if use_blob:
+    if use_jax_env:
+        # the rollout jit is the interaction-critical executable on this
+        # path: register it so --warm_compile on AOT-builds it during setup
+        collect_w = plan.register(
+            "anakin_rollout", collect,
+            example=lambda: (player, player_state, jcarry, key, expl_dev),
+        )
+        collect_random_w = collect_random
+        if learning_starts >= start_step and args.checkpoint_path is None:
+            collect_random_w = plan.register(
+                "anakin_rollout_random", collect_random,
+                example=lambda: (player, player_state, jcarry, key, expl_dev),
+            )
+    elif use_blob:
         blob_step = plan.register(
             "blob_step", blob_step,
             example=lambda: (
@@ -764,7 +852,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             example=lambda: (
                 player, player.init_states(args.num_envs),
                 dict_obs_spec(
-                    envs.single_observation_space, obs_keys, cnn_keys,
+                    observation_space, obs_keys, cnn_keys,
                     (args.num_envs,),
                 ),
                 key, jnp.float32(0.0), None,
@@ -776,17 +864,54 @@ def main(argv: Sequence[str] | None = None) -> None:
     start_time = time.perf_counter()
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
-    for global_step in range(start_step, num_updates + 1):
+    if use_jax_env:
+        # each iteration collects anakin_chunk steps per env in one scan;
+        # global_step names the last step of the chunk (a trailing partial
+        # chunk is dropped — sub-chunk remainders are below the cadence)
+        steps_iter = range(
+            start_step + anakin_chunk - 1, num_updates + 1, anakin_chunk
+        )
+    else:
+        steps_iter = range(start_step, num_updates + 1)
+    for global_step in steps_iter:
         telem.mark("rollout")
-        # ---- action selection ----------------------------------------------
         blob_added = False
-        if (
+        if use_jax_env:
+            # ---- Anakin collection: one jitted scan per chunk ---------------
+            key, roll_key = jax.random.split(key)
+            random_phase = (
+                global_step <= learning_starts and args.checkpoint_path is None
+            )
+            fn = collect_random_w if random_phase else collect_w
+            t0 = time.perf_counter()
+            idx = rb.reserve(anakin_chunk)
+            player_state, jcarry, traj, ep = sanitizer.checked(
+                "anakin/rollout", fn,
+                player, player_state, jcarry, roll_key, expl_dev,
+            )
+            # rows are already device-resident: the ring scatter is the
+            # zero-transfer half of the blob transport, fed by the scan
+            rb.add_direct(traj, jnp.asarray(idx), data_len=anakin_chunk)
+            jax.block_until_ready(traj["dones"])
+            anakin.note(anakin_chunk * args.num_envs, time.perf_counter() - t0)
+            ep_np = jax.device_get(ep)  # one pull per chunk, not per step
+            if ep_np["episodes"] > 0:
+                aggregator.update(
+                    "Rewards/rew_avg",
+                    float(ep_np["return_sum"] / ep_np["episodes"]),
+                )
+                aggregator.update(
+                    "Game/ep_len_avg",
+                    float(ep_np["length_sum"] / ep_np["episodes"]),
+                )
+        # ---- action selection (host envs) -----------------------------------
+        elif (
             global_step <= learning_starts
             and args.checkpoint_path is None
             and "minedojo" not in args.env_id
         ):
             pairs = [
-                _random_actions(envs.single_action_space, actions_dim, is_continuous)
+                _random_actions(action_space, actions_dim, is_continuous)
                 for _ in range(args.num_envs)
             ]
             actions = np.stack([p[0] for p in pairs])
@@ -840,79 +965,85 @@ def main(argv: Sequence[str] | None = None) -> None:
                 host=rb.prefers_host_adds,
             )
 
-        if not blob_added:
-            step_data["actions"] = (
-                actions if isinstance(actions, jax.Array)
-                else np.asarray(actions, np.float32)
-            )
-            add_data = {k: v[None] for k, v in step_data.items()}
-            if device_step_obs is not None and not rb.prefers_host_adds:
-                # reuse the policy step's obs puts instead of re-transferring
-                # (host/memmap storage and staged buffers want host numpy)
-                for k in obs_keys:
-                    add_data[k] = device_step_obs[k][None]
-            rb.add(add_data)
-        device_step_obs = None
+        if not use_jax_env:
+            if not blob_added:
+                step_data["actions"] = (
+                    actions if isinstance(actions, jax.Array)
+                    else np.asarray(actions, np.float32)
+                )
+                add_data = {k: v[None] for k, v in step_data.items()}
+                if device_step_obs is not None and not rb.prefers_host_adds:
+                    # reuse the policy step's obs puts instead of re-transferring
+                    # (host/memmap storage and staged buffers want host numpy)
+                    for k in obs_keys:
+                        add_data[k] = device_step_obs[k][None]
+                rb.add(add_data)
+            device_step_obs = None
 
-        next_obs, rewards, terms, truncs, infos = envs.step(env_actions)
-        dones = np.logical_or(terms, truncs).astype(np.float32)
+            next_obs, rewards, terms, truncs, infos = envs.step(env_actions)
+            dones = np.logical_or(terms, truncs).astype(np.float32)
 
-        step_data["is_first"] = np.zeros((args.num_envs, 1), np.float32)
-        for i, info in enumerate(infos):
-            # env crash+restart: close the episode retroactively in the ring
-            # (reference dreamer_v3.py:565-573)
-            if info.get("restart_on_exception") and not dones[i]:
-                env_rb = rb.buffer[i]
-                last_idx = (env_rb.pos - 1) % env_rb.buffer_size
-                env_rb.set_at("dones", last_idx, np.ones((1, 1), np.float32))
-                env_rb.set_at("is_first", last_idx, np.zeros((1, 1), np.float32))
-                step_data["is_first"][i] = 1.0
-            if "episode" in info:
-                aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
-                aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
+            step_data["is_first"] = np.zeros((args.num_envs, 1), np.float32)
+            for i, info in enumerate(infos):
+                # env crash+restart: close the episode retroactively in the ring
+                # (reference dreamer_v3.py:565-573)
+                if info.get("restart_on_exception") and not dones[i]:
+                    env_rb = rb.buffer[i]
+                    last_idx = (env_rb.pos - 1) % env_rb.buffer_size
+                    env_rb.set_at("dones", last_idx, np.ones((1, 1), np.float32))
+                    env_rb.set_at("is_first", last_idx, np.zeros((1, 1), np.float32))
+                    step_data["is_first"][i] = 1.0
+                if "episode" in info:
+                    aggregator.update("Rewards/rew_avg", float(info["episode"]["r"]))
+                    aggregator.update("Game/ep_len_avg", float(info["episode"]["l"]))
 
-        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
-        for i, info in enumerate(infos):
-            if "final_observation" in info:
-                for k in obs_keys:
-                    real_next_obs[k][i] = info["final_observation"][k]
+            real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+            for i, info in enumerate(infos):
+                if "final_observation" in info:
+                    for k in obs_keys:
+                        real_next_obs[k][i] = info["final_observation"][k]
 
-        for k in obs_keys:
-            step_data[k] = np.asarray(next_obs[k])
-        obs = next_obs
-        step_data["dones"] = dones[:, None]
-        step_data["rewards"] = (
-            np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
-        ).astype(np.float32)
+            for k in obs_keys:
+                step_data[k] = np.asarray(next_obs[k])
+            obs = next_obs
+            step_data["dones"] = dones[:, None]
+            step_data["rewards"] = (
+                np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
+            ).astype(np.float32)
 
-        dones_idxes = np.nonzero(dones)[0].tolist()
-        if dones_idxes:
-            # terminal rows carry the true final observation and zero actions
-            # (reference dreamer_v3.py:609-628)
-            n_reset = len(dones_idxes)
-            reset_data = {k: real_next_obs[k][dones_idxes][None] for k in obs_keys}
-            reset_data["dones"] = np.ones((1, n_reset, 1), np.float32)
-            reset_data["actions"] = np.zeros(
-                (1, n_reset, int(sum(actions_dim))), np.float32
-            )
-            reset_data["rewards"] = step_data["rewards"][dones_idxes][None]
-            reset_data["is_first"] = np.zeros((1, n_reset, 1), np.float32)
-            rb.add(reset_data, dones_idxes)
-            step_data["rewards"][dones_idxes] = 0.0
-            step_data["dones"][dones_idxes] = 0.0
-            step_data["is_first"][dones_idxes] = 1.0
-            reset_mask = np.zeros((args.num_envs,), np.float32)
-            reset_mask[dones_idxes] = 1.0
-            player_state = player.reset_states(player_state, jnp.asarray(reset_mask))
+            dones_idxes = np.nonzero(dones)[0].tolist()
+            if dones_idxes:
+                # terminal rows carry the true final observation and zero actions
+                # (reference dreamer_v3.py:609-628)
+                n_reset = len(dones_idxes)
+                reset_data = {k: real_next_obs[k][dones_idxes][None] for k in obs_keys}
+                reset_data["dones"] = np.ones((1, n_reset, 1), np.float32)
+                reset_data["actions"] = np.zeros(
+                    (1, n_reset, int(sum(actions_dim))), np.float32
+                )
+                reset_data["rewards"] = step_data["rewards"][dones_idxes][None]
+                reset_data["is_first"] = np.zeros((1, n_reset, 1), np.float32)
+                rb.add(reset_data, dones_idxes)
+                step_data["rewards"][dones_idxes] = 0.0
+                step_data["dones"][dones_idxes] = 0.0
+                step_data["is_first"][dones_idxes] = 1.0
+                reset_mask = np.zeros((args.num_envs,), np.float32)
+                reset_mask[dones_idxes] = 1.0
+                player_state = player.reset_states(player_state, jnp.asarray(reset_mask))
 
-        step_before_training -= 1
+        step_before_training -= anakin_chunk if use_jax_env else 1
 
         # ---- training --------------------------------------------------------
         if global_step >= learning_starts and step_before_training <= 0:
+            # chunked collection never lands exactly ON learning_starts: the
+            # first chunk at/after it is the pretrain moment
+            first_training = (
+                global_step - anakin_chunk < learning_starts
+                if use_jax_env
+                else global_step == learning_starts
+            )
             n_samples = (
-                args.pretrain_steps
-                if global_step == learning_starts
-                else args.gradient_steps
+                args.pretrain_steps if first_training else args.gradient_steps
             )
             telem.mark("buffer/sample")
             local_data = sampler.sample(
@@ -991,7 +1122,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
-    envs.close()
+    if envs is not None:
+        envs.close()
     run_test_episodes(
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True),
         args, logger,

@@ -34,7 +34,14 @@ import optax
 from ... import nn, ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
+from ...envs.jax import (
+    PPOCollectorCarry,
+    VecJaxEnv,
+    make_jax_env,
+    make_ppo_collector,
+)
 from ...parallel import (
+    AnakinStats,
     Pipeline,
     assert_divisible,
     distributed_setup,
@@ -42,6 +49,7 @@ from ...parallel import (
     process_index,
     replicate,
     shard_batch,
+    shard_env_batch,
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
@@ -251,23 +259,42 @@ def main(argv: Sequence[str] | None = None) -> None:
     plan = CompilePlan.from_args(args, telem)
     telem.add_gauges(plan.gauges)
 
-    envs = make_vector_env(
-        [
-            make_dict_env(
-                args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
-                run_name=log_dir, vector_env_idx=i, mask_velocities=args.mask_vel,
+    use_jax_env = args.env_backend == "jax"
+    if use_jax_env:
+        # Anakin arrangement (ISSUE 6): env and agent co-reside on chip; the
+        # whole rollout is ONE jitted lax.scan with zero host transfers per
+        # step, env batch sharded over the mesh
+        if args.memmap_buffer:
+            raise ValueError(
+                "--env_backend jax keeps the rollout on device; drop "
+                "--memmap_buffer"
             )
-            for i in range(args.num_envs)
-        ],
-        sync=args.sync_env or args.num_envs == 1,
-    )
-    cnn_keys, mlp_keys = validate_obs_keys(envs.single_observation_space, args)
+        assert_divisible(args.num_envs, n_dev, "num_envs")
+        jax_env = make_jax_env(args.env_id)
+        venv = VecJaxEnv(env=jax_env, num_envs=args.num_envs)
+        envs = None
+        observation_space = venv.single_observation_space
+        action_space = venv.single_action_space
+    else:
+        envs = make_vector_env(
+            [
+                make_dict_env(
+                    args.env_id, args.seed + rank * args.num_envs + i, rank=rank, args=args,
+                    run_name=log_dir, vector_env_idx=i, mask_velocities=args.mask_vel,
+                )
+                for i in range(args.num_envs)
+            ],
+            sync=args.sync_env or args.num_envs == 1,
+        )
+        observation_space = envs.single_observation_space
+        action_space = envs.single_action_space
+    cnn_keys, mlp_keys = validate_obs_keys(observation_space, args)
     obs_keys = [*cnn_keys, *mlp_keys]
-    actions_dim, is_continuous = actions_dim_of(envs.single_action_space)
+    actions_dim, is_continuous = actions_dim_of(action_space)
 
     key, agent_key = jax.random.split(key)
     agent = PPOAgent.init(
-        agent_key, actions_dim, envs.single_observation_space.spaces,
+        agent_key, actions_dim, observation_space.spaces,
         cnn_keys, mlp_keys,
         cnn_features_dim=args.cnn_features_dim, mlp_features_dim=args.mlp_features_dim,
         screen_size=args.screen_size, mlp_layers=args.mlp_layers,
@@ -299,18 +326,20 @@ def main(argv: Sequence[str] | None = None) -> None:
     num_minibatches = max(rollout_and_train_size // global_batch_size, 1)
     train_step = make_train_step(args, optimizer, num_minibatches, sanitizer)
 
-    rb = ReplayBuffer(
-        args.rollout_steps, args.num_envs,
-        storage="host" if args.memmap_buffer else "device",
-        obs_keys=tuple(obs_keys), seed=args.seed,
-    )
+    rb = None
+    if not use_jax_env:
+        rb = ReplayBuffer(
+            args.rollout_steps, args.num_envs,
+            storage="host" if args.memmap_buffer else "device",
+            obs_keys=tuple(obs_keys), seed=args.seed,
+        )
 
     # ---- warm-start shape capture (ISSUE 5): PPO has no learning_starts
     # window, so the compiles overlap with the FIRST rollout instead — the
     # GAE + train jits are ready (or nearly so) when the first update phase
     # begins. Example thunks close over the replicated `state` late-bound.
     act_sum = int(sum(actions_dim))
-    obs_space = envs.single_observation_space
+    obs_space = observation_space
 
     def _obs_leaf(lead, k):
         dt = jnp.uint8 if k in cnn_keys else jnp.float32
@@ -360,12 +389,39 @@ def main(argv: Sequence[str] | None = None) -> None:
             jnp.float32(args.ent_coef),
         )
 
-    policy_step_w = plan.register(
-        "policy_step", policy_step,
-        example=lambda: (
-            state.agent, {k: _obs_leaf((args.num_envs,), k) for k in obs_keys}, key,
-        ),
-    )
+    collect_w = anakin = carry = None
+    if use_jax_env:
+        # the Anakin collector: one jitted lax.scan = one whole rollout.
+        # Donating the carry lets XLA reuse the env-state/obs buffers
+        # between rollouts.
+        collect = donating_jit(
+            make_ppo_collector(venv, args.rollout_steps, actions_dim, is_continuous),
+            donate_argnums=(1,),
+        )
+        key, reset_key = jax.random.split(key)
+        vec_state, jax_obs = jax.jit(venv.reset)(reset_key)
+        carry = PPOCollectorCarry(
+            vec=vec_state,
+            obs=jax_obs,
+            prev_done=jnp.zeros((args.num_envs, 1), jnp.float32),
+        )
+        # env batch sharded over the mesh, policy replicated — each device
+        # steps its env slice with zero cross-device traffic in the scan
+        carry = shard_env_batch(carry, mesh)
+        anakin = AnakinStats(
+            scan_span=args.rollout_steps, env_batch=args.num_envs, devices=n_dev
+        )
+        telem.add_gauges(anakin.gauges)
+        collect_w = plan.register(
+            "anakin_rollout", collect, example=lambda: (state.agent, carry, key)
+        )
+    else:
+        policy_step_w = plan.register(
+            "policy_step", policy_step,
+            example=lambda: (
+                state.agent, {k: _obs_leaf((args.num_envs,), k) for k in obs_keys}, key,
+            ),
+        )
     compute_gae_w = plan.register("gae", compute_gae_returns, example=_gae_example)
     train_step = plan.register(
         "train_step", train_step, example=_train_example, role="update"
@@ -373,8 +429,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     plan.start()
 
     aggregator = MetricAggregator()
-    obs, _ = envs.reset(seed=args.seed)
-    next_done = np.zeros(args.num_envs, dtype=np.float32)
+    if use_jax_env:
+        obs, next_done = None, None
+    else:
+        obs, _ = envs.reset(seed=args.seed)
+        next_done = np.zeros(args.num_envs, dtype=np.float32)
     global_step = 0
     start_time = time.perf_counter()
 
@@ -394,7 +453,33 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         # ---- rollout hot loop ------------------------------------------------
         telem.mark("rollout")
-        for _ in range(args.rollout_steps):
+        if use_jax_env:
+            # the whole rollout is one device-resident scan; the only host
+            # work afterwards is the episode-stat pull (one device_get per
+            # rollout, not per step)
+            key, roll_key = jax.random.split(key)
+            t0 = time.perf_counter()
+            carry, traj, ep = sanitizer.checked(
+                "anakin/rollout", collect_w, state.agent, carry, roll_key
+            )
+            jax.block_until_ready(traj["dones"])
+            anakin.note(
+                args.rollout_steps * args.num_envs, time.perf_counter() - t0
+            )
+            global_step += args.rollout_steps * args.num_envs
+            ep_np = jax.device_get(ep)
+            if ep_np["episodes"] > 0:
+                aggregator.update(
+                    "Rewards/rew_avg",
+                    float(ep_np["return_sum"] / ep_np["episodes"]),
+                )
+                aggregator.update(
+                    "Game/ep_len_avg",
+                    float(ep_np["length_sum"] / ep_np["episodes"]),
+                )
+        else:
+            traj = None
+        for _ in range(0 if use_jax_env else args.rollout_steps):
             key, step_key = jax.random.split(key)
             device_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
             actions, logprob, value, env_idx = policy_step_w(
@@ -441,13 +526,21 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         # ---- GAE + one-jit update -------------------------------------------
         telem.mark("host_to_device")
-        data = {k: jnp.asarray(rb[k]) for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
-        device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+        if use_jax_env:
+            # already device-resident: the scan's trajectory IS the rollout
+            # store, and the bootstrap obs/done live in the collector carry
+            data = traj
+            device_next_obs = carry.obs
+            next_done_dev = carry.prev_done
+        else:
+            data = {k: jnp.asarray(rb[k]) for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
+            device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+            next_done_dev = jnp.asarray(next_done)[:, None]
         # gamma/lambda enter as committed device scalars: raw python floats
         # here are an implicit h2d put per update (found by --sanitize)
         returns, advantages = sanitizer.checked(
             "gae", compute_gae_w,
-            state.agent, data, device_next_obs, jnp.asarray(next_done)[:, None],
+            state.agent, data, device_next_obs, next_done_dev,
             jnp.float32(args.gamma), jnp.float32(args.gae_lambda),
         )
         data["returns"], data["advantages"] = returns, advantages
@@ -490,7 +583,8 @@ def main(argv: Sequence[str] | None = None) -> None:
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     plan.close()
     profiler.close()
-    envs.close()
+    if envs is not None:
+        envs.close()
     # fresh env per episode: test() closes the env it is handed
     run_test_episodes(
         lambda: test(state.agent, make_dict_env(

@@ -1,0 +1,68 @@
+"""Pure-JAX on-device environments + the Anakin rollout collectors.
+
+See `core.py` for the env API, `rollout.py` for the jitted collectors, and
+`howto/jax_envs.md` for authoring guidance and the `--env_backend` flag.
+"""
+
+from __future__ import annotations
+
+from .cartpole import CartPoleState, JaxCartPole
+from .core import JaxEnv, VecEnvState, VecJaxEnv, tree_select
+from .gym_compat import JaxEnvGymWrapper
+from .pendulum import JaxPendulum, PendulumState
+from .pixeltoy import JaxPixelToy, PixelToyState
+from .rollout import (
+    DreamerCollectorCarry,
+    PPOCollectorCarry,
+    make_dreamer_collector,
+    make_ppo_collector,
+    random_action_sampler,
+)
+
+__all__ = [
+    "CartPoleState",
+    "DreamerCollectorCarry",
+    "JaxCartPole",
+    "JaxEnv",
+    "JaxEnvGymWrapper",
+    "JaxPendulum",
+    "JaxPixelToy",
+    "PPOCollectorCarry",
+    "PendulumState",
+    "PixelToyState",
+    "VecEnvState",
+    "VecJaxEnv",
+    "has_jax_env",
+    "make_jax_env",
+    "make_ppo_collector",
+    "make_dreamer_collector",
+    "random_action_sampler",
+    "tree_select",
+]
+
+# env-id registry: the ids the host pipeline already understands map to
+# their on-device twins, plus the JAX-only pixel toy
+_REGISTRY = {
+    "cartpole-v1": JaxCartPole,
+    "pendulum-v1": JaxPendulum,
+    "pixeltoy": JaxPixelToy,
+    "pixeltoy-v0": JaxPixelToy,
+}
+
+
+def has_jax_env(env_id: str) -> bool:
+    """True when `env_id` has a pure-JAX implementation (`--env_backend
+    jax` is available for it)."""
+    return env_id.lower() in _REGISTRY
+
+
+def make_jax_env(env_id: str, **overrides) -> JaxEnv:
+    """Build the pure-JAX env registered under `env_id` (case-insensitive).
+    `overrides` become static config fields (e.g. `max_episode_steps`)."""
+    cls = _REGISTRY.get(env_id.lower())
+    if cls is None:
+        raise ValueError(
+            f"no pure-JAX environment registered for {env_id!r}; available: "
+            f"{sorted(_REGISTRY)} (use --env_backend host for everything else)"
+        )
+    return cls(**overrides)

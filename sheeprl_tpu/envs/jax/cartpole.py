@@ -1,0 +1,95 @@
+"""Pure-JAX CartPole — dynamics parity with Gymnasium's `CartPole-v1`.
+
+Same Euler-integrated cart-pole ODE, constants and termination thresholds
+as `gymnasium/envs/classic_control/cartpole.py` (tested to tolerance in
+`tests/test_envs/test_jax_envs.py`); the 500-step `TimeLimit` truncation of
+the registered v1 spec is folded into the state's step counter. Computation
+is float32 (the host env integrates in float64 and rounds the returned
+observation to float32 — the per-step drift is below 1e-6)."""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+from .core import JaxEnv
+
+__all__ = ["CartPoleState", "JaxCartPole"]
+
+_GRAVITY = 9.8
+_MASSCART = 1.0
+_MASSPOLE = 0.1
+_TOTAL_MASS = _MASSPOLE + _MASSCART
+_LENGTH = 0.5  # half the pole's length
+_POLEMASS_LENGTH = _MASSPOLE * _LENGTH
+_FORCE_MAG = 10.0
+_TAU = 0.02
+_THETA_THRESHOLD = 12 * 2 * np.pi / 360
+_X_THRESHOLD = 2.4
+
+
+class CartPoleState(nn.Module):
+    state: jax.Array  # [4] f32: x, x_dot, theta, theta_dot
+    t: jax.Array  # [] i32 steps since reset (TimeLimit counter)
+
+
+class JaxCartPole(JaxEnv):
+    max_episode_steps: int = nn.static(default=500)
+
+    def reset(self, key):
+        state = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+        return CartPoleState(state=state, t=jnp.zeros((), jnp.int32)), {
+            "state": state
+        }
+
+    def step(self, state: CartPoleState, action, key):
+        del key  # deterministic dynamics; key kept for the uniform env API
+        x, x_dot, theta, theta_dot = (
+            state.state[0], state.state[1], state.state[2], state.state[3]
+        )
+        force = jnp.where(action == 1, _FORCE_MAG, -_FORCE_MAG)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        temp = (
+            force + _POLEMASS_LENGTH * jnp.square(theta_dot) * sintheta
+        ) / _TOTAL_MASS
+        thetaacc = (_GRAVITY * sintheta - costheta * temp) / (
+            _LENGTH * (4.0 / 3.0 - _MASSPOLE * jnp.square(costheta) / _TOTAL_MASS)
+        )
+        xacc = temp - _POLEMASS_LENGTH * thetaacc * costheta / _TOTAL_MASS
+        # euler integrator (the gymnasium default)
+        x = x + _TAU * x_dot
+        x_dot = x_dot + _TAU * xacc
+        theta = theta + _TAU * theta_dot
+        theta_dot = theta_dot + _TAU * thetaacc
+        new = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+        t = state.t + 1
+        terminated = (
+            (jnp.abs(x) > _X_THRESHOLD) | (jnp.abs(theta) > _THETA_THRESHOLD)
+        )
+        truncated = t >= self.max_episode_steps
+        reward = jnp.float32(1.0)
+        return (
+            CartPoleState(state=new, t=t),
+            {"state": new},
+            reward,
+            terminated,
+            truncated,
+        )
+
+    @property
+    def observation_space(self):
+        high = np.array(
+            [_X_THRESHOLD * 2, np.inf, _THETA_THRESHOLD * 2, np.inf],
+            dtype=np.float32,
+        )
+        return gym.spaces.Dict(
+            {"state": gym.spaces.Box(-high, high, dtype=np.float32)}
+        )
+
+    @property
+    def action_space(self):
+        return gym.spaces.Discrete(2)

@@ -1,0 +1,155 @@
+"""On-device environment API — the Anakin arrangement's env half.
+
+Podracer (arXiv:2104.06272) co-locates environment and agent on the same
+chip so a `lax.scan` over `policy -> env.step` runs with zero host
+round-trips per step. Everything in this package exists to make that scan
+legal JAX: an environment is a *pure function pair* over an explicit pytree
+state —
+
+    env.reset(key)                 -> (EnvState, obs_dict)
+    env.step(state, action, key)   -> (EnvState, obs_dict, reward, term, trunc)
+
+with all configuration (physics constants, episode limits, image sizes) as
+static metadata on an `nn.Module` subclass, so the env itself has no array
+leaves and traces for free. Observations are dicts keyed exactly like the
+host pipeline (`utils/env.py`): vector obs under ``"state"``, pixels under
+``"rgb"`` as uint8 NHWC — the same agent/encoder code runs on either
+backend.
+
+`VecJaxEnv` lifts a single env to a fixed batch of `num_envs` parallel
+copies via `jax.vmap`, with **same-step auto-reset** matching the host
+vector runners (`envs/vector.py`): when an env finishes, the returned
+observation is already the reset one and the final pre-reset observation is
+surfaced in the step info — the policy never sees a stale terminal obs, and
+the batch shape never changes, so thousands of envs run as one fused XLA
+program. Episode statistics (return/length) are part of the vector state so
+reward logging needs no host-side bookkeeping in the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+
+__all__ = ["JaxEnv", "VecEnvState", "VecJaxEnv", "tree_select"]
+
+
+def tree_select(mask: jax.Array, on_true: Any, on_false: Any) -> Any:
+    """Per-env select between two identically-shaped pytrees: `mask` is
+    `[N]` bool/float, broadcast against each leaf's trailing dims. The
+    auto-reset primitive (done rows take the freshly-reset leaf)."""
+
+    def one(a, b):
+        m = mask.reshape(mask.shape + (1,) * (a.ndim - mask.ndim)).astype(bool)
+        return jnp.where(m, a, b)
+
+    return jax.tree_util.tree_map(one, on_true, on_false)
+
+
+class JaxEnv(nn.Module):
+    """Base class for pure-JAX environments. Subclasses define:
+
+    - a registered pytree ``State`` (subclass `nn.Module`; array leaves
+      only — auto-reset `tree_select`s whole states);
+    - ``reset(key) -> (State, obs_dict)`` and
+      ``step(state, action, key) -> (State, obs_dict, reward, terminated,
+      truncated)``, both pure and single-env (batching is `VecJaxEnv`'s
+      job); rewards/flags are scalars (`f32`, `bool`, `bool`);
+    - host-side space descriptors: `observation_space` / `action_space`
+      (gymnasium spaces, used for agent init and eval-time wrappers — never
+      inside a jit).
+
+    Actions arrive in the env-native layout the host twins use: an `int32`
+    scalar for `Discrete`, `f32 [act_dim]` for `Box`.
+    """
+
+    # subclasses override via nn.static fields; declared here for tooling
+    def reset(self, key):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def step(self, state, action, key):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @property
+    def observation_space(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @property
+    def action_space(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class VecEnvState(nn.Module):
+    """State of a `VecJaxEnv`: the vmapped per-env states plus on-device
+    episode statistics (so reward logging costs one pull per *rollout*, not
+    one per step)."""
+
+    env_state: Any
+    ep_return: jax.Array  # [N] f32 running episode return
+    ep_length: jax.Array  # [N] i32 running episode length
+
+
+class VecJaxEnv(nn.Module):
+    """`num_envs` parallel copies of a pure-JAX env with same-step
+    auto-reset — the batched env the Anakin rollout scans over."""
+
+    env: Any
+    num_envs: int = nn.static(default=1)
+
+    def reset(self, key) -> tuple[VecEnvState, dict]:
+        keys = jax.random.split(key, self.num_envs)
+        states, obs = jax.vmap(self.env.reset)(keys)
+        return (
+            VecEnvState(
+                env_state=states,
+                ep_return=jnp.zeros((self.num_envs,), jnp.float32),
+                ep_length=jnp.zeros((self.num_envs,), jnp.int32),
+            ),
+            obs,
+        )
+
+    def step(
+        self, state: VecEnvState, actions: jax.Array, key
+    ) -> tuple[VecEnvState, dict, jax.Array, jax.Array, dict]:
+        """One batched step with auto-reset. Returns
+        `(state', obs, reward [N] f32, done [N] bool, info)` where `obs` is
+        already the reset observation for finished envs and `info` carries
+        `final_obs` (the true pre-reset observation), `terminated`,
+        `truncated`, and the completed-episode `ep_return`/`ep_length`
+        (valid where `done`)."""
+        step_key, reset_key = jax.random.split(key)
+        step_keys = jax.random.split(step_key, self.num_envs)
+        states, obs, reward, term, trunc = jax.vmap(self.env.step)(
+            state.env_state, actions, step_keys
+        )
+        done = jnp.logical_or(term, trunc)
+        reset_keys = jax.random.split(reset_key, self.num_envs)
+        fresh_states, fresh_obs = jax.vmap(self.env.reset)(reset_keys)
+        ep_return = state.ep_return + reward
+        ep_length = state.ep_length + 1
+        info = {
+            "final_obs": obs,
+            "terminated": term,
+            "truncated": trunc,
+            "ep_return": ep_return,
+            "ep_length": ep_length,
+        }
+        new_state = VecEnvState(
+            env_state=tree_select(done, fresh_states, states),
+            ep_return=jnp.where(done, 0.0, ep_return),
+            ep_length=jnp.where(done, 0, ep_length),
+        )
+        return new_state, tree_select(done, fresh_obs, obs), reward, done, info
+
+    # -- host-side conveniences (never traced) -------------------------------
+    @property
+    def single_observation_space(self):
+        return self.env.observation_space
+
+    @property
+    def single_action_space(self):
+        return self.env.action_space

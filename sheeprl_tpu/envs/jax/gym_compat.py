@@ -1,0 +1,74 @@
+"""Host adapter: run a pure-JAX env as an ordinary gymnasium.Env.
+
+The bridge that keeps both backends honest. It serves three jobs:
+
+- **eval**: the algo mains' final greedy evaluation runs on a single host
+  env; envs that exist only as JAX (``pixeltoy``) get their gym twin here
+  (`utils/env.py` dispatches the env id to this wrapper);
+- **parity tests**: `tests/test_envs/test_jax_envs.py` steps the wrapper
+  against real Gymnasium envs;
+- **host-backend runs**: `--env_backend host` with a JAX-only env id still
+  works — the env steps one-at-a-time through the normal vector runners.
+
+Single-env `step`/`reset` are jitted once per wrapper; dynamics are
+therefore bit-identical to the on-device Anakin path."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+__all__ = ["JaxEnvGymWrapper"]
+
+
+class JaxEnvGymWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(self, env: Any, seed: int = 0):
+        self._env = env
+        self._step = jax.jit(env.step)
+        self._reset = jax.jit(env.reset)
+        self._state = None
+        self._key = jax.random.PRNGKey(seed)
+        self.observation_space = env.observation_space
+        self.action_space = env.action_space
+        self.render_mode = "rgb_array"
+
+    def _split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    @staticmethod
+    def _host_obs(obs: dict) -> dict:
+        return {k: np.asarray(v) for k, v in obs.items()}
+
+    def reset(self, *, seed: int | None = None, options: dict | None = None):
+        super().reset(seed=seed)
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+        self._state, obs = self._reset(self._split())
+        return self._host_obs(obs), {}
+
+    def step(self, action):
+        if isinstance(self.action_space, gym.spaces.Discrete):
+            action = np.int32(action)
+        else:
+            action = np.asarray(action, np.float32)
+        self._state, obs, reward, term, trunc = self._step(
+            self._state, action, self._split()
+        )
+        return (
+            self._host_obs(obs),
+            float(reward),
+            bool(term),
+            bool(trunc),
+            {},
+        )
+
+    def render(self):
+        if self._state is not None and hasattr(self._env, "_render"):
+            return np.asarray(self._env._render(self._state))
+        return None

@@ -1,0 +1,88 @@
+"""Pure-JAX Pendulum — dynamics parity with Gymnasium's `Pendulum-v1`.
+
+Same torque-limited pendulum swing-up ODE, cost function and reset
+distribution as `gymnasium/envs/classic_control/pendulum.py`; the 200-step
+`TimeLimit` truncation of the registered v1 spec is folded into the state's
+step counter. The env never terminates — episodes end by truncation only,
+exactly like the host twin."""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+from .core import JaxEnv
+
+__all__ = ["PendulumState", "JaxPendulum"]
+
+_MAX_SPEED = 8.0
+_MAX_TORQUE = 2.0
+_DT = 0.05
+_G = 10.0
+_M = 1.0
+_L = 1.0
+_RESET_X = np.pi  # DEFAULT_X: theta in [-pi, pi]
+_RESET_Y = 1.0  # DEFAULT_Y: theta_dot in [-1, 1]
+
+
+def _angle_normalize(x):
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class PendulumState(nn.Module):
+    state: jax.Array  # [2] f32: theta, theta_dot
+    t: jax.Array  # [] i32 steps since reset (TimeLimit counter)
+
+
+class JaxPendulum(JaxEnv):
+    max_episode_steps: int = nn.static(default=200)
+
+    def reset(self, key):
+        high = jnp.asarray([_RESET_X, _RESET_Y], jnp.float32)
+        state = jax.random.uniform(key, (2,), jnp.float32, -1.0, 1.0) * high
+        return PendulumState(state=state, t=jnp.zeros((), jnp.int32)), {
+            "state": self._obs(state)
+        }
+
+    @staticmethod
+    def _obs(state):
+        th, thdot = state[0], state[1]
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot]).astype(jnp.float32)
+
+    def step(self, state: PendulumState, action, key):
+        del key  # deterministic dynamics; key kept for the uniform env API
+        th, thdot = state.state[0], state.state[1]
+        u = jnp.clip(action.reshape(()), -_MAX_TORQUE, _MAX_TORQUE)
+        costs = (
+            _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * (u**2)
+        )
+        newthdot = thdot + (
+            3 * _G / (2 * _L) * jnp.sin(th) + 3.0 / (_M * _L**2) * u
+        ) * _DT
+        newthdot = jnp.clip(newthdot, -_MAX_SPEED, _MAX_SPEED)
+        newth = th + newthdot * _DT
+        new = jnp.stack([newth, newthdot]).astype(jnp.float32)
+        t = state.t + 1
+        return (
+            PendulumState(state=new, t=t),
+            {"state": self._obs(new)},
+            -costs.astype(jnp.float32),
+            jnp.zeros((), bool),
+            t >= self.max_episode_steps,
+        )
+
+    @property
+    def observation_space(self):
+        high = np.array([1.0, 1.0, _MAX_SPEED], dtype=np.float32)
+        return gym.spaces.Dict(
+            {"state": gym.spaces.Box(-high, high, dtype=np.float32)}
+        )
+
+    @property
+    def action_space(self):
+        return gym.spaces.Box(
+            -_MAX_TORQUE, _MAX_TORQUE, shape=(1,), dtype=np.float32
+        )

@@ -1,0 +1,97 @@
+"""PixelToy — a pure-JAX pixel-observation toy env for the Anakin path.
+
+A grid-world chase rendered ON DEVICE as uint8 NHWC frames: the agent (red
+block) must reach the goal (green block) on a `grid x grid` board drawn
+into a `size x size x 3` image (`"rgb"`, uint8 — the exact layout the host
+pixel pipeline emits, so the CNN encoders run unchanged). Five discrete
+actions (noop/up/down/left/right), +1 terminal reward at the goal, a small
+per-step penalty, truncation at `max_episode_steps`. Rendering is pure
+broadcasting arithmetic — no host round-trip anywhere — which makes this
+the pixel-rate stress test for the jitted collector: thousands of envs
+render thousands of frames per `lax.scan` step inside one XLA program.
+
+A host twin for eval/debugging exists via `gym_compat.JaxEnvGymWrapper`
+(`make_dict_env` dispatches the `pixeltoy` env id to it)."""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+from .core import JaxEnv
+
+__all__ = ["PixelToyState", "JaxPixelToy"]
+
+# action -> (drow, dcol): noop, up, down, left, right
+_MOVES = np.array([[0, 0], [-1, 0], [1, 0], [0, -1], [0, 1]], dtype=np.int32)
+
+
+class PixelToyState(nn.Module):
+    agent: jax.Array  # [2] i32 (row, col) in grid cells
+    goal: jax.Array  # [2] i32 (row, col) in grid cells
+    t: jax.Array  # [] i32 steps since reset
+
+
+class JaxPixelToy(JaxEnv):
+    size: int = nn.static(default=64)  # rendered image side (pixels)
+    grid: int = nn.static(default=16)  # board side (cells)
+    max_episode_steps: int = nn.static(default=128)
+    step_penalty: float = nn.static(default=0.01)
+
+    def _spawn(self, key):
+        """Agent and goal on distinct cells: the goal re-rolls one
+        deterministic offset when it collides with the agent."""
+        k_agent, k_goal = jax.random.split(key)
+        agent = jax.random.randint(k_agent, (2,), 0, self.grid, jnp.int32)
+        goal = jax.random.randint(k_goal, (2,), 0, self.grid, jnp.int32)
+        collide = jnp.all(goal == agent)
+        goal = jnp.where(collide, (goal + 1) % self.grid, goal)
+        return agent, goal
+
+    def reset(self, key):
+        agent, goal = self._spawn(key)
+        state = PixelToyState(agent=agent, goal=goal, t=jnp.zeros((), jnp.int32))
+        return state, {"rgb": self._render(state)}
+
+    def _render(self, state: PixelToyState) -> jax.Array:
+        cell = self.size // self.grid
+        px = jnp.arange(self.size) // cell  # pixel row/col -> board cell
+        agent = (px[:, None] == state.agent[0]) & (px[None, :] == state.agent[1])
+        goal = (px[:, None] == state.goal[0]) & (px[None, :] == state.goal[1])
+        zeros = jnp.zeros((self.size, self.size), bool)
+        return (
+            jnp.stack([agent, goal, zeros], axis=-1).astype(jnp.uint8) * 255
+        )
+
+    def step(self, state: PixelToyState, action, key):
+        del key  # deterministic dynamics; key kept for the uniform env API
+        move = jnp.asarray(_MOVES)[action]
+        agent = jnp.clip(state.agent + move, 0, self.grid - 1)
+        reached = jnp.all(agent == state.goal)
+        t = state.t + 1
+        new = PixelToyState(agent=agent, goal=state.goal, t=t)
+        reward = jnp.where(reached, 1.0, -self.step_penalty).astype(jnp.float32)
+        return (
+            new,
+            {"rgb": self._render(new)},
+            reward,
+            reached,
+            t >= self.max_episode_steps,
+        )
+
+    @property
+    def observation_space(self):
+        return gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(
+                    0, 255, (self.size, self.size, 3), np.uint8
+                )
+            }
+        )
+
+    @property
+    def action_space(self):
+        return gym.spaces.Discrete(len(_MOVES))

@@ -1,0 +1,261 @@
+"""Anakin rollout collectors — the fully-jitted `scan(policy ∘ env.step)`.
+
+Podracer's Anakin arrangement (arXiv:2104.06272): with the environment
+expressed as pure JAX (`core.VecJaxEnv`), a whole rollout becomes ONE
+jitted call — `lax.scan` over `policy_step ∘ env.step` — that runs
+entirely on device. No action pull, no observation put, no per-step
+dispatch: the host's only involvement is launching the scan and, once per
+rollout, reading the episode-statistics scalars. That makes per-step host
+cost structurally zero (PRs 4–5 merely *hid* it behind async transfers)
+and is what moves collection into the millions-of-env-steps/sec regime.
+
+Two collector factories share the scan skeleton:
+
+- `make_ppo_collector`: rows match the PPO rollout store exactly
+  (`obs_keys..., actions (one-hot/raw), logprobs, values, rewards,
+  dones=done-entering-the-step`) so the trajectory feeds the existing GAE +
+  train jits unchanged;
+- `make_dreamer_collector`: rows match the DreamerV3 replay layout
+  (`obs_keys..., actions, rewards, dones, is_first`, host-shifted
+  alignment: reward/done of step t-1 ride row t) and scatter straight into
+  the device replay ring via `AsyncReplayBuffer.reserve`/`add_direct` —
+  the ONLY difference vs the host layout is that episode boundaries are
+  one row (the auto-reset row carries the terminal reward/done next to
+  `is_first=1`) instead of the host path's separate terminal row.
+
+Both return, besides the trajectory, an `ep` dict of on-device scalars
+(`episodes`, `return_sum`, `length_sum`) — one `device_get` per rollout
+replaces the host path's per-step info parsing.
+
+The scan body is a hot loop in the sheeplint sense — the
+`# sheeplint: hotloop` markers arm SL007 so any future `.item()`/
+`np.asarray` slipped into the body fails CI, and
+`tests/test_envs/test_jax_envs.py` runs a compiled collector under
+`jax.transfer_guard("disallow")` as the runtime half of that guarantee.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from .core import VecJaxEnv
+
+__all__ = [
+    "PPOCollectorCarry",
+    "DreamerCollectorCarry",
+    "make_ppo_collector",
+    "make_dreamer_collector",
+    "random_action_sampler",
+]
+
+
+class PPOCollectorCarry(nn.Module):
+    """Everything the PPO rollout scan threads between steps (and between
+    rollouts — the carry survives across updates, exactly like the host
+    loop's `obs`/`next_done`)."""
+
+    vec: Any  # VecEnvState
+    obs: Any  # dict of [N, ...] observations
+    prev_done: jax.Array  # [N, 1] f32: done flag entering the next step
+
+
+class DreamerCollectorCarry(nn.Module):
+    vec: Any  # VecEnvState
+    obs: Any  # dict of [N, ...] observations (raw; uint8 pixels)
+    prev_reward: jax.Array  # [N, 1] f32 (host-shifted row alignment)
+    prev_done: jax.Array  # [N, 1] f32
+    is_first: jax.Array  # [N, 1] f32
+
+
+def _episode_summary(done_f, ep_return, ep_length):
+    """Reduce per-step done/episode-stat stacks to three scalars — the one
+    device->host pull reward logging costs per rollout."""
+    return {
+        "episodes": jnp.sum(done_f),
+        "return_sum": jnp.sum(ep_return * done_f),
+        "length_sum": jnp.sum(ep_length * done_f),
+    }
+
+
+def _env_native_actions(
+    actions: jax.Array, actions_dim: Sequence[int], is_continuous: bool
+):
+    """Jit-side twin of `ppo.agent.one_hot_to_env_actions`: the env-native
+    action layout (`int32 [N]` argmax for a single discrete head, `[N, H]`
+    for multi-discrete, raw floats for continuous)."""
+    if is_continuous:
+        return actions
+    out, start = [], 0
+    for dim in actions_dim:
+        out.append(jnp.argmax(actions[..., start : start + dim], axis=-1))
+        start += dim
+    idx = jnp.stack(out, axis=-1).astype(jnp.int32)
+    if len(actions_dim) == 1:
+        return idx[..., 0]
+    return idx
+
+
+def random_action_sampler(
+    action_space, actions_dim: Sequence[int], is_continuous: bool
+) -> Callable:
+    """Device-side analogue of the hosts' `action_space.sample()` warmup:
+    `sample(key, n) -> actions [n, sum(actions_dim)]` (one-hot for discrete
+    heads, uniform-in-box for continuous). Bounds are baked as constants
+    from the gym space so the sampler stays pure."""
+    if is_continuous:
+        low = jnp.asarray(action_space.low, jnp.float32)
+        high = jnp.asarray(action_space.high, jnp.float32)
+
+        def sample(key, n):
+            u = jax.random.uniform(key, (n,) + low.shape, jnp.float32)
+            return (low + u * (high - low)).reshape(n, -1)
+
+        return sample
+
+    dims = tuple(int(d) for d in actions_dim)
+
+    def sample(key, n):
+        keys = jax.random.split(key, len(dims))
+        hots = [
+            jax.nn.one_hot(
+                jax.random.randint(k, (n,), 0, d), d, dtype=jnp.float32
+            )
+            for k, d in zip(keys, dims)
+        ]
+        return jnp.concatenate(hots, axis=-1)
+
+    return sample
+
+
+def make_ppo_collector(
+    venv: VecJaxEnv,
+    rollout_steps: int,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+) -> Callable:
+    """Build `collect(agent, carry, key) -> (carry', traj, ep)` where
+    `traj` is the `[T, N, ...]` rollout-store layout PPO's GAE + train jits
+    already consume. Jit (or `CompilePlan.register`) the result — one call
+    is one whole rollout."""
+
+    def collect(agent, carry: PPOCollectorCarry, key):
+        def body(c, _):  # sheeplint: hotloop
+            vec, obs, prev_done, k = c
+            k, k_act, k_step = jax.random.split(k, 3)
+            actions, logprob, _, value = agent(obs, key=k_act)
+            env_actions = _env_native_actions(actions, actions_dim, is_continuous)
+            vec, next_obs, reward, done, info = venv.step(vec, env_actions, k_step)
+            row = dict(obs)
+            row.update(
+                actions=actions,
+                logprobs=logprob,
+                values=value,
+                rewards=reward[:, None],
+                dones=prev_done,
+            )
+            done_f = done.astype(jnp.float32)
+            stats = (done_f, info["ep_return"], info["ep_length"].astype(jnp.float32))
+            return (vec, next_obs, done_f[:, None], k), (row, stats)
+
+        (vec, obs, prev_done, _), (traj, stats) = jax.lax.scan(
+            body,
+            (carry.vec, carry.obs, carry.prev_done, key),
+            None,
+            length=rollout_steps,
+        )
+        ep = _episode_summary(*stats)
+        return PPOCollectorCarry(vec=vec, obs=obs, prev_done=prev_done), traj, ep
+
+    return collect
+
+
+def make_dreamer_collector(
+    venv: VecJaxEnv,
+    steps: int,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    dev_preprocess: Callable,
+    clip_rewards: bool = False,
+    random_actions: bool = False,
+) -> Callable:
+    """Build `collect(player, player_state, carry, key, expl) ->
+    (player_state', carry', traj, ep)` producing `steps` device replay rows
+    `[T, N, ...]` in the DreamerV3 ring layout, ready for
+    `rb.reserve(steps)` + `rb.add_direct`. With `random_actions=True` the
+    player is threaded through untouched and actions come from the device
+    `random_action_sampler` — the learning-starts warmup without leaving
+    the chip."""
+    sampler = random_action_sampler(
+        venv.single_action_space, actions_dim, is_continuous
+    )
+
+    def collect(player, player_state, carry: DreamerCollectorCarry, key, expl):
+        def body(c, _):  # sheeplint: hotloop
+            pstate, vec, obs, prev_reward, prev_done, is_first, k = c
+            k, k_act, k_step = jax.random.split(k, 3)
+            if random_actions:
+                actions = sampler(k_act, venv.num_envs)
+            else:
+                pstate, actions = player.step(
+                    pstate, dev_preprocess(obs), k_act, expl, is_training=True
+                )
+            row = dict(obs)
+            row.update(
+                actions=actions.astype(jnp.float32),
+                rewards=prev_reward,
+                dones=prev_done,
+                is_first=is_first,
+            )
+            env_actions = _env_native_actions(
+                actions.astype(jnp.float32), actions_dim, is_continuous
+            )
+            vec, next_obs, reward, done, info = venv.step(vec, env_actions, k_step)
+            if clip_rewards:
+                reward = jnp.tanh(reward)
+            done_f = done.astype(jnp.float32)[:, None]
+            if not random_actions:
+                pstate = player.reset_states(pstate, done_f[:, 0])
+            stats = (
+                done_f[:, 0],
+                info["ep_return"],
+                info["ep_length"].astype(jnp.float32),
+            )
+            # next row's host-shifted fields: this step's reward/done land on
+            # the auto-reset row together with its is_first flag (the host
+            # path splits them onto a separate terminal row instead)
+            return (pstate, vec, next_obs, reward[:, None], done_f, done_f, k), (
+                row,
+                stats,
+            )
+
+        (pstate, vec, obs, prev_reward, prev_done, is_first, _), (traj, stats) = (
+            jax.lax.scan(
+                body,
+                (
+                    player_state,
+                    carry.vec,
+                    carry.obs,
+                    carry.prev_reward,
+                    carry.prev_done,
+                    carry.is_first,
+                    key,
+                ),
+                None,
+                length=steps,
+            )
+        )
+        ep = _episode_summary(*stats)
+        new_carry = DreamerCollectorCarry(
+            vec=vec,
+            obs=obs,
+            prev_reward=prev_reward,
+            prev_done=prev_done,
+            is_first=is_first,
+        )
+        return pstate, new_carry, traj, ep
+
+    return collect

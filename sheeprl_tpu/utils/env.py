@@ -227,6 +227,12 @@ def make_dict_env(
                 seed=args.seed,
                 rank=rank + vector_env_idx,
             )
+        elif "pixeltoy" in lid:
+            # JAX-only env: the host twin steps the same jitted dynamics
+            # one env at a time (eval + --env_backend host runs)
+            from ..envs.jax import JaxEnvGymWrapper, make_jax_env
+
+            env = JaxEnvGymWrapper(make_jax_env(lid), seed=seed)
         else:
             env_spec = str(gym.spec(env_id).entry_point)
             env = gym.make(env_id, render_mode="rgb_array")

@@ -190,3 +190,38 @@ def test_blob_step_matches_dict_step():
     for k in floats:
         np.testing.assert_array_equal(np.asarray(row[k][0]), floats[k])
     np.testing.assert_array_equal(np.asarray(idx_dev), idx)
+
+
+@pytest.mark.timeout(300)
+def test_dreamer_v3_jax_env_backend_dry_run(tmp_path):
+    """ISSUE 6: --env_backend jax collects via the Anakin scan and writes
+    into the device ring with reserve()/add_direct(); the dry run trains and
+    checkpoints like the host path."""
+    main(
+        TINY
+        + [
+            "--env_id=CartPole-v1",
+            "--env_backend=jax",
+            "--num_envs=1",
+            f"--root_dir={tmp_path}",
+            "--run_name=jax_backend",
+        ]
+    )
+    ckpt_dir = os.path.join(tmp_path, "jax_backend", "checkpoints")
+    entries = sorted(os.listdir(ckpt_dir))
+    assert any(e.startswith("ckpt_") for e in entries)
+
+
+@pytest.mark.timeout(300)
+def test_dreamer_v3_jax_env_backend_rejects_memmap(tmp_path):
+    with pytest.raises(ValueError, match="device replay"):
+        main(
+            TINY
+            + [
+                "--env_id=CartPole-v1",
+                "--env_backend=jax",
+                "--memmap_buffer",
+                f"--root_dir={tmp_path}",
+                "--run_name=jax_memmap",
+            ]
+        )

@@ -1,0 +1,104 @@
+"""CompilePlan receipt for the Anakin rollout jit (ISSUE 6 satellite): the
+registered collector AOT-compiles during the warm-start window and its
+executable produces bitwise-identical rollouts to the cold jit path."""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.compile import CompilePlan
+from sheeprl_tpu.envs.jax import (
+    JaxCartPole,
+    PPOCollectorCarry,
+    VecJaxEnv,
+    make_ppo_collector,
+)
+
+
+class _On:
+    warm_compile = "on"
+
+
+def _setup():
+    from sheeprl_tpu.algos.ppo.agent import PPOAgent
+
+    venv = VecJaxEnv(env=JaxCartPole(), num_envs=4)
+    agent = PPOAgent.init(
+        jax.random.PRNGKey(1), [2], venv.single_observation_space.spaces,
+        [], ["state"], dense_units=8, mlp_layers=1, mlp_features_dim=8,
+    )
+    collect = jax.jit(make_ppo_collector(venv, 8, (2,), False))
+    state, obs = jax.jit(venv.reset)(jax.random.PRNGKey(0))
+    carry = PPOCollectorCarry(
+        vec=state, obs=obs, prev_done=jnp.zeros((4, 1), jnp.float32)
+    )
+    return agent, collect, carry
+
+
+@pytest.mark.timeout(300)
+def test_anakin_rollout_warm_aot_bit_exact():
+    agent, collect, carry = _setup()
+    key = jax.random.PRNGKey(5)
+    carry_cold, traj_cold, ep_cold = collect(agent, carry, key)
+
+    plan = CompilePlan.from_args(_On())
+    wrapped = plan.register(
+        "anakin_rollout", collect, example=lambda: (agent, carry, key)
+    )
+    plan.start()
+    assert plan.wait(timeout=240), "anakin rollout warm compile did not finish"
+    st = plan.stats()["entries"]["anakin_rollout"]
+    assert st["compiled"] and st["error"] is None
+
+    carry_aot, traj_aot, ep_aot = wrapped(agent, carry, key)
+    st = plan.stats()["entries"]["anakin_rollout"]
+    assert st["aot_calls"] == 1 and st["fallbacks"] == 0
+
+    for k in traj_cold:
+        np.testing.assert_array_equal(
+            np.asarray(traj_cold[k]), np.asarray(traj_aot[k]), err_msg=k
+        )
+    np.testing.assert_array_equal(
+        np.asarray(carry_cold.prev_done), np.asarray(carry_aot.prev_done)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ep_cold["return_sum"]), np.asarray(ep_aot["return_sum"])
+    )
+    plan.close()
+
+
+@pytest.mark.timeout(300)
+def test_anakin_rollout_in_ppo_main_plan(tmp_path):
+    """End-to-end receipt: a --env_backend jax --warm_compile on PPO dry run
+    registers `anakin_rollout` in its CompilePlan and the run's compile
+    telemetry records the AOT build (Compile/exe/anakin_rollout_seconds)."""
+    import json
+    import os
+
+    from sheeprl_tpu.utils.registry import tasks
+    import sheeprl_tpu.algos  # noqa: F401
+
+    tasks["ppo"]([
+        "--env_id", "CartPole-v1", "--env_backend", "jax", "--dry_run",
+        "--warm_compile", "on",
+        "--num_envs", "8", "--rollout_steps", "8", "--per_rank_batch_size", "16",
+        "--update_epochs", "1", "--dense_units", "8", "--mlp_layers", "1",
+        "--mlp_features_dim", "8",
+        "--root_dir", str(tmp_path), "--run_name", "anakin_warm",
+    ])
+    events_path = os.path.join(tmp_path, "anakin_warm", "telemetry.jsonl")
+    events = [json.loads(line) for line in open(events_path)]
+    compiled = {
+        e.get("jit")
+        for e in events
+        if e.get("event") == "compile" and e.get("mode") in ("warm", "warmup")
+        and e.get("error") is None
+    }
+    assert "anakin_rollout" in compiled, compiled
+    summaries = [e for e in events if e.get("event") == "compile.summary"]
+    assert summaries, "no compile.summary event"
+    entries = summaries[-1]["entries"]
+    assert entries["anakin_rollout"]["compiled"], entries["anakin_rollout"]
+    assert entries["anakin_rollout"]["aot_calls"] >= 1

@@ -84,6 +84,7 @@ def summarize(events: list[dict]) -> dict:
         "partition_events": [],     # `compile.partition` heuristic decisions
         "first_update": None,       # the `first_update` stamp event
         "compile_gauges": {},       # last Compile/* gauge values
+        "anakin_gauges": {},        # last Anakin/* gauge values (jax envs)
     }
     for ev in events:
         ts = ev.get("ts")
@@ -138,6 +139,8 @@ def summarize(events: list[dict]) -> dict:
                     summary["gauges_last"][k] = v
                 elif k.startswith("Compile/"):
                     summary["compile_gauges"][k] = v
+                elif k.startswith("Anakin/"):
+                    summary["anakin_gauges"][k] = v
     # the "end" event carries phase time accumulated after the last interval
     if summary["end"]:
         for phase, secs in (summary["end"].get("phases") or {}).items():
@@ -250,6 +253,22 @@ def render(summary: dict) -> str:
             )
     else:
         lines.append("no warm-start compile telemetry (cold path or pre-round-6 log)")
+
+    a = summary["anakin_gauges"]
+    if a:
+        lines.append("")
+        lines.append("== anakin collection (on-device jax envs) ==")
+        lines.append(
+            f"env_steps_per_second: last={a.get('Anakin/env_steps_per_second', 0):,.0f} "
+            f"avg={a.get('Anakin/env_steps_per_second_avg', 0):,.0f}"
+        )
+        lines.append(
+            f"scan_span={a.get('Anakin/scan_span', 0):.0f} "
+            f"env_batch={a.get('Anakin/env_batch', 0):.0f} "
+            f"devices={a.get('Anakin/devices', 0):.0f} "
+            f"rollouts={a.get('Anakin/rollouts', 0):.0f} "
+            f"env_steps_total={a.get('Anakin/env_steps_total', 0):,.0f}"
+        )
 
     lines.append("")
     lines.append("== health ==")

@@ -61,3 +61,38 @@ def _enable_compilation_cache() -> None:
 
 
 _enable_compilation_cache()
+
+
+def _enable_partitionable_rng() -> None:
+    """Layout-invariant PRNG, on by default (SHEEPRL_TPU_PARTITIONABLE_RNG=0
+    opts out). With jax 0.4.37's default (`jax_threefry_partitionable`
+    False), random bits generated inside a sharded jit depend on the GSPMD
+    partitioning of the rng op — a DreamerV3 train step under the (data,
+    seq) mesh draws DIFFERENT posterior/prior samples than the identical
+    unsharded step (State/kl diverged 12% in
+    tests/test_algos/test_seq_parallel.py, compounding through the RSSM
+    scan). A sharded-by-construction framework needs sampling that is a
+    function of (key, shape) alone, so the partitionable threefry scheme is
+    armed process-wide. Random STREAMS change vs the old scheme (same key,
+    different numbers) — run-internal comparisons (checkpoint parity, warm
+    A/B, pipeline on/off) are unaffected because both arms draw from the
+    same scheme.
+
+    Set via env so importing sheeprl_tpu stays jax-free (sheeplint runs on
+    bare CPython in CI); if jax is already imported the live config is
+    updated too."""
+    import sys as _sys
+
+    explicit = _os.environ.get("JAX_THREEFRY_PARTITIONABLE")
+    on = _os.environ.get("SHEEPRL_TPU_PARTITIONABLE_RNG", "1") not in ("0", "false")
+    if explicit is None:
+        _os.environ["JAX_THREEFRY_PARTITIONABLE"] = "true" if on else "false"
+    else:  # an explicit jax-level setting wins over our default
+        on = explicit.lower() not in ("0", "false")
+    if "jax" in _sys.modules:
+        import jax
+
+        jax.config.update("jax_threefry_partitionable", on)
+
+
+_enable_partitionable_rng()

@@ -183,7 +183,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         next_obs = {k: jnp.asarray(v) for k, v in _zero_obs((N,)).items()}
         return (
             player_agent, data, next_obs, jnp.zeros((N, 1), jnp.float32),
-            args.gamma, args.gae_lambda,
+            jnp.float32(args.gamma), jnp.float32(args.gae_lambda),
         )
 
     def _train_example():
@@ -295,9 +295,13 @@ def main(argv: Sequence[str] | None = None) -> None:
             for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")
         }
         device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+        # gamma/lambda as committed device scalars, not python floats — raw
+        # floats enter the jit weak-typed (retrace on weak/strong mix + an
+        # implicit h2d put per rollout); sheepcheck SC004 caught this one
+        # (coupled ppo was fixed in PR 2, this call site was missed)
         returns, advantages = compute_gae_w(
             player_agent, data, device_next_obs, jnp.asarray(next_done)[:, None],
-            args.gamma, args.gae_lambda,
+            jnp.float32(args.gamma), jnp.float32(args.gae_lambda),
         )
         data["returns"], data["advantages"] = returns, advantages
         flat = {

@@ -12,14 +12,23 @@ Two halves of one invariant set (ISSUE 3):
     transfers in guarded phases, and checkify NaN/div instrumentation on
     train steps — enabled per-run with `--sanitize`, reporting through the
     telemetry JSONL event log.
+  - `jaxpr_check`: the IR half (ISSUE 7, `tools/sheepcheck.py`) — every
+    hot jit registered in a main's CompilePlan is abstract-evaled to a
+    ClosedJaxpr (shape capture, zero execution) and analyzed for hazards
+    the AST cannot see through the jit boundary (SC001-SC005: dtype
+    promotion, host callbacks, donation aliasing, scan-carry weak types,
+    CPU conv pathology), plus the compile-cost fingerprints behind the
+    CI-gated `analysis/budget.json` ledger.
 """
 
+from . import jaxpr_check
 from .linter import lint_file, lint_paths, lint_source
 from .rules import RULES, Rule, Violation
 from .sanitizer import Sanitizer
 
 __all__ = [
     "RULES",
+    "jaxpr_check",
     "Rule",
     "Violation",
     "Sanitizer",

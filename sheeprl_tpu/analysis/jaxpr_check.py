@@ -1,0 +1,853 @@
+"""sheepcheck: jaxpr-level whole-program analysis over the CompilePlan.
+
+sheeplint (linter.py) proves hazards from SOURCE — it never sees through a
+`jax.jit` boundary, a helper defined in another module, or anything that
+only materializes in the traced program. Since PR 5 every hot jit of all 13
+algo mains is registered in the CompilePlan with an example thunk producing
+its exact input avals, and PR 6 made whole rollouts single jits — so the
+program we actually dispatch is fully described by that registry, the way
+MSRL's dataflow fragments describe a training job as an analyzable graph
+(arXiv:2210.00882). This module closes the loop: instantiate a main's plan
+in capture mode (`SHEEPRL_TPU_PLAN_MODE=capture` — CPU, tiny avals, zero
+execution), abstract-eval each registered jit to a ClosedJaxpr via
+`jit.trace(*avals)`, and run IR-level analyzers over it. Podracer-style
+fully-jitted loops (arXiv:2104.06272) make exactly these hazards invisible
+to AST linting: a dtype upcast, a host callback, or a dead donation inside
+a `lax.scan` body is a property of the traced program, not of any one
+source file.
+
+Rule catalog (SC = sheepcheck; suppressions live in `SUPPRESSIONS` below,
+keyed `(algo, jit, rule)`, each with a mandatory justification):
+
+  SC001  silent dtype promotion — any float64 value, or a widening float
+         `convert_element_type` (f32->f64 always; bf16->f32 only under
+         `audit_bf16=True`, the ROADMAP-5c mixed-precision audit: a
+         bf16 model whose jaxpr silently upcasts to f32 pays full-width
+         FLOPs while claiming bf16).
+  SC002  host callback / infeed / outfeed traced into the jit — pure/io/
+         debug callbacks serialize the program on a host round-trip per
+         dispatch (jax.debug.print left in a scan body is the classic).
+  SC003  donation hazards — a donated argument aliased into >=2 outputs,
+         donated but dead (unused in the jaxpr), or donated with no
+         shape/dtype-compatible output to reuse its buffer (XLA drops the
+         alias: the donation silently buys nothing).
+  SC004  weak-type hazards — weak-typed scan-carry avals (the carry
+         fixpoint retraces the body once per weak leaf, and any
+         strong-typed caller of the same program retraces the whole jit),
+         weak-typed top-level jit inputs (a python scalar at the call
+         site: retrace on weak/strong mix + an implicit h2d put per call),
+         or carry/output aval mismatches.
+  SC005  conv work above the measured XLA:CPU pathology threshold — the
+         conv-count x batch predictor from compile/partition.py says this
+         jit lands in the transposed-conv-grad regime `--split_update
+         auto` / `--recon_chunk` exist for.
+
+Each analyzable jit also yields a *fingerprint* — primitive histogram, op
+count, dtype set, donation map, FLOP/byte estimates from XLA's
+`cost_analysis` — which `tools/sheepcheck.py` writes to the committed
+`analysis/budget.json` ledger. CI re-derives the fingerprints and fails on
+unexplained drift (new dtypes, op-count growth past tolerance, lost
+donations): "did this PR quietly bloat or de-optimize a jit?" becomes a
+gated check instead of a bench regression three rounds later.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Iterable, Iterator
+
+from .rules import Rule
+
+__all__ = [
+    "SC_RULES",
+    "SUPPRESSIONS",
+    "CAPTURE_ARGV",
+    "CAPTURE_VARIANTS",
+    "resolve_capture",
+    "Finding",
+    "JitReport",
+    "analyze_closed_jaxpr",
+    "analyze_entry",
+    "analyze_plan",
+    "build_budget",
+    "capture_plan",
+    "check_budget",
+    "fingerprint_jaxpr",
+    "iter_eqns",
+]
+
+ERROR = "error"
+WARNING = "warning"
+
+_SC_RULES = [
+    Rule(
+        id="SC001",
+        name="silent-dtype-promotion",
+        severity=ERROR,
+        summary=(
+            "float64 value or widening float convert_element_type in the "
+            "traced program (f32->f64 always; bf16->f32 under the "
+            "mixed-precision audit) — double-width FLOPs and memory the "
+            "source never asked for"
+        ),
+        autofix=(
+            "pin dtypes at the boundary (jnp.float32(...)/astype), keep "
+            "x64 disabled, and for bf16 paths cast moments/reductions "
+            "explicitly so the audit sees intended upcasts only"
+        ),
+    ),
+    Rule(
+        id="SC002",
+        name="host-callback-in-jit",
+        severity=ERROR,
+        summary=(
+            "host callback (pure_callback/io_callback/debug_callback) or "
+            "infeed/outfeed traced into a registered jit — every dispatch "
+            "pays a host round-trip, and inside scan it serializes the "
+            "whole rollout"
+        ),
+        autofix=(
+            "remove the debug.print/io_callback from the hot jit (use "
+            "telemetry gauges off-path), or suppress with justification "
+            "for intentional instrumentation builds"
+        ),
+    ),
+    Rule(
+        id="SC003",
+        name="donation-alias-conflict",
+        severity=WARNING,
+        summary=(
+            "donated argument aliased into multiple outputs, dead in the "
+            "jaxpr, or without any shape/dtype-matching output — XLA "
+            "either rejects the alias or silently drops it, so the "
+            "donation buys no buffer reuse"
+        ),
+        autofix=(
+            "donate only arguments whose buffers a same-aval output can "
+            "reuse (the train-state in, train-state out pattern); drop "
+            "donate_argnums for pure readers"
+        ),
+    ),
+    Rule(
+        id="SC004",
+        name="weak-type-instability",
+        severity=WARNING,
+        summary=(
+            "weak-typed avals in positions that force extra traces: a "
+            "lax.scan carry (the carry fixpoint retraces the body) or a "
+            "top-level jit input (a python scalar at the call site — "
+            "mixing weak/strong callers retraces the whole jit, and every "
+            "call pays an implicit h2d put of the constant; the PR-2 "
+            "gamma/lambda class), or a carry/output aval mismatch"
+        ),
+        autofix=(
+            "initialize carries and call-site scalars with concrete-dtype "
+            "arrays (jnp.float32(0.0), jnp.zeros(..., dtype)) instead of "
+            "python scalars"
+        ),
+    ),
+    Rule(
+        id="SC005",
+        name="cpu-conv-pathology",
+        severity=WARNING,
+        summary=(
+            "convolution work above the measured XLA:CPU pathology "
+            "threshold (conv-count x batch predictor, "
+            "compile/partition.py) — transposed-conv-grad execution in "
+            "this regime runs minutes-per-update on CPU"
+        ),
+        autofix=(
+            "run the jit through decide_batch_chunk / --split_update auto "
+            "/ --recon_chunk, or suppress where the jit only ever runs "
+            "on TPU"
+        ),
+    ),
+]
+
+SC_RULES: dict[str, Rule] = {r.id: r for r in _SC_RULES}
+
+# (algo, jit, rule) -> justification. A finding matching a key here is
+# reported as suppressed, not failing; the justification is MANDATORY and
+# printed in verbose output so every suppression stays auditable (same
+# contract as sheeplint's `# sheeplint: disable=... — why`).
+SUPPRESSIONS: dict[tuple[str, str, str], str] = {}
+
+_HOST_PRIMS = {
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "callback",
+    "infeed",
+    "outfeed",
+}
+
+_FLOAT_WIDTH = {"bfloat16": 16, "float16": 16, "float32": 32, "float64": 64}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: Rule
+    algo: str
+    jit: str
+    message: str
+    suppressed: str | None = None  # justification when suppressed
+
+    def format(self) -> str:
+        sup = f" [suppressed: {self.suppressed}]" if self.suppressed else ""
+        return (
+            f"{self.algo}/{self.jit}: {self.rule.id} [{self.rule.severity}] "
+            f"{self.message}{sup}"
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule.id,
+            "severity": self.rule.severity,
+            "algo": self.algo,
+            "jit": self.jit,
+            "message": self.message,
+            "suppressed": self.suppressed,
+        }
+
+
+@dataclasses.dataclass
+class JitReport:
+    algo: str
+    name: str
+    fingerprint: dict | None = None
+    findings: list[Finding] = dataclasses.field(default_factory=list)
+    error: str | None = None  # not analyzable (no example / not lowerable)
+
+    @property
+    def failing(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def _subjaxprs(params: dict) -> Iterator[Any]:
+    """Yield every (Closed)Jaxpr reachable from an eqn's params — covers
+    pjit/scan/remat ('jaxpr'), while ('cond_jaxpr'/'body_jaxpr'), cond
+    ('branches'), custom_* ('call_jaxpr'), and any future param shape that
+    stores jaxprs in lists/tuples."""
+    import jax
+
+    def walk(v):
+        if isinstance(v, jax.core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jax.core.Jaxpr):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for el in v:
+                yield from walk(el)
+
+    for v in params.values():
+        yield from walk(v)
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Every eqn of `jaxpr` (a core.Jaxpr or ClosedJaxpr), recursively
+    through call/control-flow sub-jaxprs."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _aval_str(aval: Any) -> str:
+    dtype = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", None)
+    if dtype is None:
+        return str(aval)
+    s = f"{dtype.name}[{','.join(str(d) for d in (shape or ()))}]"
+    if getattr(aval, "weak_type", False):
+        s += "~"  # weak-typed leaf
+    return s
+
+
+def _all_avals(closed: Any) -> Iterator[Any]:
+    inner = closed.jaxpr
+    for v in (*inner.invars, *inner.outvars):
+        if hasattr(v, "aval"):
+            yield v.aval
+    for eqn in iter_eqns(inner):
+        for v in (*eqn.invars, *eqn.outvars):
+            if hasattr(v, "aval"):
+                yield v.aval
+
+
+# ---------------------------------------------------------------------------
+# analyzers (one per SC rule, all pure functions of the IR)
+# ---------------------------------------------------------------------------
+
+
+def _check_sc001(closed: Any, audit_bf16: bool) -> Iterator[str]:
+    f64 = sorted(
+        {
+            _aval_str(a)
+            for a in _all_avals(closed)
+            if getattr(getattr(a, "dtype", None), "name", "") == "float64"
+        }
+    )
+    if f64:
+        yield (
+            f"float64 values in the traced program ({len(f64)} distinct "
+            f"avals, e.g. {f64[0]}) — x64 leaked into a TPU-targeted jit"
+        )
+    for eqn in iter_eqns(closed):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = getattr(eqn.invars[0].aval.dtype, "name", "")
+        dst = getattr(eqn.outvars[0].aval.dtype, "name", "")
+        if src not in _FLOAT_WIDTH or dst not in _FLOAT_WIDTH:
+            continue
+        if _FLOAT_WIDTH[dst] <= _FLOAT_WIDTH[src]:
+            continue
+        if dst == "float64":
+            yield f"widening convert {src}->{dst} ({_aval_str(eqn.outvars[0].aval)})"
+        elif audit_bf16 and src == "bfloat16":
+            yield (
+                f"bf16 upcast: convert {src}->{dst} "
+                f"({_aval_str(eqn.outvars[0].aval)}) — audit whether this "
+                "upcast is an intended fp32 island (moments/reductions)"
+            )
+
+
+def _check_sc002(closed: Any) -> Iterator[str]:
+    hits: dict[str, int] = {}
+    for eqn in iter_eqns(closed):
+        if eqn.primitive.name in _HOST_PRIMS:
+            hits[eqn.primitive.name] = hits.get(eqn.primitive.name, 0) + 1
+    for name, count in sorted(hits.items()):
+        yield f"{count}x `{name}` traced into the jit"
+
+
+def _donated_flags(lowered: Any, closed: Any) -> list[bool]:
+    """Donation flags aligned with the closed jaxpr's invars (flat arg
+    order). Falls back to all-False when args_info is unavailable or the
+    flattening disagrees with the jaxpr arity."""
+    import jax
+
+    try:
+        leaves = jax.tree_util.tree_leaves(lowered.args_info)
+        flags = [bool(getattr(info, "donated", False)) for info in leaves]
+    except Exception:
+        return [False] * len(closed.jaxpr.invars)
+    if len(flags) != len(closed.jaxpr.invars):
+        return [False] * len(closed.jaxpr.invars)
+    return flags
+
+
+def _check_sc003(closed: Any, donated: list[bool]) -> Iterator[str]:
+    inner = closed.jaxpr
+    if not any(donated):
+        return
+    used: set[int] = set()
+    for eqn in iter_eqns(inner):
+        for v in eqn.invars:
+            if hasattr(v, "aval"):
+                used.add(id(v))
+    out_ids = [id(v) for v in inner.outvars if hasattr(v, "aval")]
+    # greedy aval matching: every output reuses at most one donated buffer
+    free_outputs: list[tuple[Any, Any]] = [
+        (getattr(v.aval, "shape", None), getattr(v.aval, "dtype", None))
+        for v in inner.outvars
+        if hasattr(v, "aval")
+    ]
+    for i, (var, is_donated) in enumerate(zip(inner.invars, donated)):
+        if not is_donated:
+            continue
+        alias_count = out_ids.count(id(var))
+        if alias_count >= 2:
+            yield (
+                f"donated arg {i} ({_aval_str(var.aval)}) is returned as "
+                f"{alias_count} outputs — one buffer cannot alias into both"
+            )
+            continue
+        if id(var) not in used and alias_count == 0:
+            yield (
+                f"donated arg {i} ({_aval_str(var.aval)}) is dead: never "
+                "read and never returned — the caller's buffer is "
+                "invalidated for nothing"
+            )
+            continue
+        key = (getattr(var.aval, "shape", None), getattr(var.aval, "dtype", None))
+        if key in free_outputs:
+            free_outputs.remove(key)  # claimed by this donation
+        else:
+            yield (
+                f"donated arg {i} ({_aval_str(var.aval)}) has no "
+                "shape/dtype-matching output left to reuse its buffer — "
+                "XLA drops the alias silently"
+            )
+
+
+def _check_sc004(closed: Any) -> Iterator[str]:
+    # top-level weak inputs: the registered example (and therefore the live
+    # call site it mirrors) feeds a python scalar straight into the jit —
+    # this is how sheepcheck caught ppo_decoupled's gae still taking raw
+    # `args.gamma`/`args.gae_lambda` after PR 2 fixed coupled ppo
+    for i, var in enumerate(closed.jaxpr.invars):
+        aval = getattr(var, "aval", None)
+        if aval is not None and getattr(aval, "weak_type", False):
+            yield (
+                f"jit input {i} is weak-typed ({_aval_str(aval)}) — the "
+                "call site passes a python scalar; wrap it once as "
+                "jnp.float32(...)"
+            )
+    for eqn in iter_eqns(closed):
+        if eqn.primitive.name != "scan":
+            continue
+        body = eqn.params.get("jaxpr")
+        if body is None:
+            continue
+        inner = getattr(body, "jaxpr", body)
+        nc = int(eqn.params.get("num_consts", 0))
+        nk = int(eqn.params.get("num_carry", 0))
+        carry_in = inner.invars[nc : nc + nk]
+        carry_out = inner.outvars[:nk]
+        for i, vin in enumerate(carry_in):
+            a_in = getattr(vin, "aval", None)
+            a_out = getattr(carry_out[i], "aval", None) if i < len(carry_out) else None
+            if a_in is not None and getattr(a_in, "weak_type", False):
+                yield (
+                    f"scan carry {i} is weak-typed ({_aval_str(a_in)}) — "
+                    "initialize it with a concrete dtype"
+                )
+            elif (
+                a_in is not None
+                and a_out is not None
+                and (
+                    getattr(a_in, "dtype", None) != getattr(a_out, "dtype", None)
+                    or getattr(a_in, "shape", None) != getattr(a_out, "shape", None)
+                )
+            ):
+                yield (
+                    f"scan carry {i} is unstable: in {_aval_str(a_in)} vs "
+                    f"out {_aval_str(a_out)}"
+                )
+
+
+def _check_sc005(closed: Any) -> Iterator[str]:
+    from ..compile.partition import compile_budget_s, predicted_cpu_compile_seconds
+
+    convs = [e for e in iter_eqns(closed) if e.primitive.name == "conv_general_dilated"]
+    if not convs:
+        return
+    batch = 1
+    grad_convs = 0
+    for eqn in convs:
+        lhs_dil = eqn.params.get("lhs_dilation") or ()
+        if any(d > 1 for d in lhs_dil):
+            grad_convs += 1
+        dn = eqn.params.get("dimension_numbers")
+        lhs_shape = getattr(eqn.invars[0].aval, "shape", ())
+        bdim = dn.lhs_spec[0] if dn is not None else 0
+        if lhs_shape:
+            batch = max(batch, int(lhs_shape[bdim]))
+    predicted = predicted_cpu_compile_seconds(len(convs), batch)
+    budget = compile_budget_s()
+    if (grad_convs and predicted > budget) or predicted > 10 * budget:
+        yield (
+            f"{len(convs)} convolutions ({grad_convs} gradient-class, "
+            f"lhs-dilated) at batch {batch}: predictor says "
+            f"{predicted:.0f}s on XLA:CPU (budget {budget:.0f}s) — the "
+            "regime --split_update auto / --recon_chunk partition"
+        )
+
+
+def analyze_closed_jaxpr(
+    closed: Any,
+    *,
+    algo: str = "<fixture>",
+    name: str = "<jit>",
+    donated: list[bool] | None = None,
+    rules: set[str] | None = None,
+    audit_bf16: bool = False,
+) -> list[Finding]:
+    """Run the SC analyzers over one ClosedJaxpr. `donated` is the per-flat-
+    invar donation mask (from `Lowered.args_info`); fixture tests can pass
+    it directly."""
+    if donated is None:
+        donated = [False] * len(closed.jaxpr.invars)
+    checks: list[tuple[str, Iterable[str]]] = [
+        ("SC001", _check_sc001(closed, audit_bf16)),
+        ("SC002", _check_sc002(closed)),
+        ("SC003", _check_sc003(closed, donated)),
+        ("SC004", _check_sc004(closed)),
+        ("SC005", _check_sc005(closed)),
+    ]
+    out: list[Finding] = []
+    for rule_id, messages in checks:
+        if rules is not None and rule_id not in rules:
+            continue
+        for message in messages:
+            finding = Finding(SC_RULES[rule_id], algo, name, message)
+            finding.suppressed = SUPPRESSIONS.get((algo, name, rule_id))
+            out.append(finding)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + budget ledger
+# ---------------------------------------------------------------------------
+
+
+def fingerprint_jaxpr(closed: Any, lowered: Any = None) -> dict:
+    """The compile-cost fingerprint of one jit: what the budget ledger
+    commits and the CI drift gate compares."""
+    prims: dict[str, int] = {}
+    op_count = 0
+    for eqn in iter_eqns(closed):
+        op_count += 1
+        prims[eqn.primitive.name] = prims.get(eqn.primitive.name, 0) + 1
+    dtypes = sorted(
+        {
+            getattr(getattr(a, "dtype", None), "name", "")
+            for a in _all_avals(closed)
+        }
+        - {""}
+    )
+    fp: dict[str, Any] = {
+        "in_avals": [_aval_str(v.aval) for v in closed.jaxpr.invars],
+        "out_avals": [_aval_str(v.aval) for v in closed.jaxpr.outvars],
+        "op_count": op_count,
+        "primitives": dict(sorted(prims.items())),
+        "dtypes": dtypes,
+        "donated": 0,
+        "flops": None,
+        "bytes_accessed": None,
+    }
+    if lowered is not None:
+        donated = _donated_flags(lowered, closed)
+        fp["donated"] = int(sum(donated))
+        try:
+            cost = lowered.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            if cost:
+                flops = cost.get("flops")
+                touched = cost.get("bytes accessed")
+                fp["flops"] = None if flops is None else round(float(flops), 1)
+                fp["bytes_accessed"] = (
+                    None if touched is None else round(float(touched), 1)
+                )
+        except Exception:
+            pass  # cost model unavailable on this backend: fingerprint without it
+    return fp
+
+
+def analyze_entry(
+    algo: str,
+    entry: Any,
+    rules: set[str] | None = None,
+    audit_bf16: bool = False,
+) -> JitReport:
+    """Abstract-eval one CompilePlan entry (fn + example thunk) and analyze
+    it. No execution: `trace` + `lower` only."""
+    from ..compile.plan import avals_of
+
+    report = JitReport(algo=algo, name=entry.name)
+    fn, example = entry.fn, entry.example
+    if example is None:
+        report.error = "no example thunk (registered for timing only)"
+        return report
+    if not hasattr(fn, "trace") or not hasattr(fn, "lower"):
+        report.error = "not traceable (wrapped callable without .trace/.lower)"
+        return report
+    try:
+        specs = avals_of(example())
+        traced = fn.trace(*specs)
+        closed = traced.jaxpr
+        lowered = traced.lower()
+    except Exception as err:
+        report.error = f"trace failed: {type(err).__name__}: {err}"[:300]
+        return report
+    report.fingerprint = fingerprint_jaxpr(closed, lowered)
+    report.findings = analyze_closed_jaxpr(
+        closed,
+        algo=algo,
+        name=entry.name,
+        donated=_donated_flags(lowered, closed),
+        rules=rules,
+        audit_bf16=audit_bf16,
+    )
+    return report
+
+
+def build_budget(reports: list[JitReport], op_count_frac: float = 0.25) -> dict:
+    """The committed ledger: per-jit fingerprints + the drift tolerances
+    they are gated with."""
+    import jax
+
+    return {
+        "version": 1,
+        "jax_version": jax.__version__,
+        "tolerance": {"op_count_frac": op_count_frac},
+        "jits": {
+            f"{r.algo}/{r.name}": r.fingerprint
+            for r in reports
+            if r.fingerprint is not None
+        },
+    }
+
+
+def check_budget(ledger: dict, derived: dict) -> tuple[list[str], list[str]]:
+    """Compare a freshly derived budget against the committed ledger.
+
+    Returns `(failures, notes)`. Failures are the ISSUE-gated drift classes
+    — added/removed jits, new dtypes, op-count growth past tolerance, lost
+    donations. Improvements (shrinking op counts, new donations) and
+    primitive-mix changes are notes: visible, not blocking, and a prompt to
+    refresh the ledger with `--update-budget`."""
+    failures: list[str] = []
+    notes: list[str] = []
+    tol = float(ledger.get("tolerance", {}).get("op_count_frac", 0.25))
+    old, new = ledger.get("jits", {}), derived.get("jits", {})
+    for key in sorted(set(old) - set(new)):
+        failures.append(f"{key}: jit disappeared from the plan (ledger has it)")
+    for key in sorted(set(new) - set(old)):
+        failures.append(f"{key}: new jit not in the ledger")
+    for key in sorted(set(old) & set(new)):
+        o, n = old[key], new[key]
+        new_dtypes = sorted(set(n.get("dtypes", [])) - set(o.get("dtypes", [])))
+        if new_dtypes:
+            failures.append(f"{key}: new dtypes {new_dtypes}")
+        oc, nc = int(o.get("op_count", 0)), int(n.get("op_count", 0))
+        if nc > oc * (1.0 + tol):
+            failures.append(
+                f"{key}: op count grew {oc} -> {nc} "
+                f"(+{(nc - oc) / max(oc, 1):.0%}, tolerance {tol:.0%})"
+            )
+        elif nc < oc * (1.0 - tol):
+            notes.append(
+                f"{key}: op count shrank {oc} -> {nc} — refresh the ledger"
+            )
+        od, nd = int(o.get("donated", 0)), int(n.get("donated", 0))
+        if nd < od:
+            failures.append(f"{key}: lost donations ({od} -> {nd})")
+        elif nd > od:
+            notes.append(f"{key}: gained donations ({od} -> {nd})")
+        if o.get("primitives") != n.get("primitives") and not (
+            new_dtypes or nc > oc * (1.0 + tol)
+        ):
+            changed = {
+                p
+                for p in set(o.get("primitives", {})) ^ set(n.get("primitives", {}))
+            }
+            if changed:
+                notes.append(
+                    f"{key}: primitive mix changed ({sorted(changed)[:6]})"
+                )
+    return failures, notes
+
+
+# ---------------------------------------------------------------------------
+# capture driver: instantiate a main's CompilePlan without running it
+# ---------------------------------------------------------------------------
+
+_DREAMER_TINY = [
+    "--env_id", "discrete_dummy",
+    "--num_envs", "1",
+    "--sync_env",
+    "--dry_run",
+    "--per_rank_batch_size", "2",
+    "--per_rank_sequence_length", "8",
+    "--buffer_size", "64",
+    "--learning_starts", "0",
+    "--train_every", "1",
+    "--horizon", "4",
+    "--dense_units", "8",
+    "--cnn_channels_multiplier", "2",
+    "--recurrent_state_size", "8",
+    "--hidden_size", "8",
+    "--stochastic_size", "4",
+    "--mlp_layers", "1",
+    "--cnn_keys", "rgb",
+]
+
+_SAC_TINY = [
+    "--env_id", "Pendulum-v1",
+    "--num_envs", "1",
+    "--sync_env",
+    "--dry_run",
+    "--per_rank_batch_size", "4",
+    "--buffer_size", "16",
+    "--learning_starts", "0",
+    "--gradient_steps", "1",
+    "--actor_hidden_size", "16",
+    "--critic_hidden_size", "16",
+]
+
+# The shape-capture argv per algo main: tiny widths, dummy/classic-control
+# envs, single data device (decoupled topologies need 2: player + trainer
+# sub-meshes). These define the avals the committed budget.json fingerprints
+# are derived at — change them and the ledger must be refreshed.
+CAPTURE_ARGV: dict[str, list[str]] = {
+    "ppo": [
+        "--env_id", "discrete_dummy",
+        "--num_envs", "1",
+        "--sync_env",
+        "--dry_run",
+        "--num_devices", "1",
+        "--rollout_steps", "8",
+        "--per_rank_batch_size", "4",
+        "--update_epochs", "1",
+        "--dense_units", "8",
+        "--mlp_layers", "1",
+        "--actor_hidden_size", "8",
+        "--critic_hidden_size", "8",
+        "--cnn_channels_multiplier", "1",
+        "--cnn_features_dim", "16",
+        "--mlp_features_dim", "16",
+    ],
+    "ppo_decoupled": [
+        "--env_id", "CartPole-v1",
+        "--num_envs", "1",
+        "--sync_env",
+        "--dry_run",
+        "--num_devices", "2",
+        "--rollout_steps", "8",
+        "--per_rank_batch_size", "4",
+        "--update_epochs", "1",
+        "--dense_units", "8",
+        "--mlp_layers", "1",
+        "--actor_hidden_size", "8",
+        "--critic_hidden_size", "8",
+    ],
+    "ppo_recurrent": [
+        "--env_id", "CartPole-v1",
+        "--num_envs", "2",
+        "--sync_env",
+        "--dry_run",
+        "--num_devices", "1",
+        "--rollout_steps", "8",
+        "--per_rank_batch_size", "4",
+        "--per_rank_num_batches", "2",
+        "--update_epochs", "2",
+        "--dense_units", "8",
+        "--mlp_layers", "1",
+    ],
+    "sac": ["--num_devices", "1", *_SAC_TINY],
+    "sac_decoupled": ["--num_devices", "2", *_SAC_TINY],
+    "droq": ["--num_devices", "1", *_SAC_TINY],
+    "sac_ae": [
+        "--env_id", "continuous_dummy",
+        "--num_envs", "1",
+        "--sync_env",
+        "--dry_run",
+        "--num_devices", "1",
+        "--per_rank_batch_size", "2",
+        "--buffer_size", "8",
+        "--learning_starts", "0",
+        "--gradient_steps", "1",
+        "--actor_hidden_size", "16",
+        "--critic_hidden_size", "16",
+        "--features_dim", "16",
+        "--dense_units", "8",
+        "--mlp_layers", "1",
+        "--cnn_channels_multiplier", "1",
+    ],
+    "dreamer_v1": ["--num_devices", "1", *_DREAMER_TINY],
+    "dreamer_v2": ["--num_devices", "1", *_DREAMER_TINY, "--discrete_size", "4"],
+    "dreamer_v3": ["--num_devices", "1", *_DREAMER_TINY, "--discrete_size", "4"],
+    "dreamer_v3_decoupled": [
+        "--num_devices", "2", *_DREAMER_TINY, "--discrete_size", "4",
+    ],
+    "p2e_dv1": ["--num_devices", "1", *_DREAMER_TINY],
+    "p2e_dv2": ["--num_devices", "1", *_DREAMER_TINY, "--discrete_size", "4"],
+}
+
+# Named capture VARIANTS: flag combinations of the same mains that register
+# ADDITIONAL jits the default argv never builds — today the PR-6 Anakin
+# path (`--env_backend jax`), whose fully-jitted rollout collector is
+# exactly the kind of program sheepcheck exists for. Variant argv is
+# APPENDED to the base algo's CAPTURE_ARGV (later flags win), and reports/
+# ledger keys use the variant name (`ppo@anakin/anakin_rollout`).
+CAPTURE_VARIANTS: dict[str, tuple[str, list[str]]] = {
+    "ppo@anakin": ("ppo", ["--env_backend", "jax", "--env_id", "CartPole-v1"]),
+    "dreamer_v3@anakin": (
+        "dreamer_v3",
+        ["--env_backend", "jax", "--env_id", "pixeltoy"],
+    ),
+}
+
+
+def resolve_capture(spec: str) -> tuple[str, list[str]]:
+    """Map a capture spec (an algo name or a CAPTURE_VARIANTS key) to the
+    `(algo, extra_argv)` pair `capture_plan` consumes."""
+    if spec in CAPTURE_VARIANTS:
+        return CAPTURE_VARIANTS[spec]
+    return spec, []
+
+
+def capture_plan(algo: str, root_dir: str, extra_argv: list[str] | None = None):
+    """Run `algo`'s main in capture mode and return its CompilePlan.
+
+    Sets `SHEEPRL_TPU_PLAN_MODE=capture` (CompilePlan.start() raises
+    CaptureComplete before the first collection step) and
+    `SHEEPRL_TPU_DONATE=1` (donation metadata must survive into the
+    lowering for SC003/the donation fingerprint — nothing executes, so the
+    CPU persistent-cache donation hazard is moot)."""
+    import sheeprl_tpu.algos  # noqa: F401 — fire @register_algorithm decorators
+    from sheeprl_tpu.utils.registry import tasks
+
+    from ..compile.plan import CaptureComplete
+
+    if algo not in tasks:
+        raise KeyError(f"unknown algo {algo!r}; registered: {sorted(tasks)}")
+    argv = [
+        *CAPTURE_ARGV.get(algo, []),
+        "--platform", "cpu",
+        "--root_dir", root_dir,
+        "--run_name", f"sheepcheck_{algo}",
+        *(extra_argv or []),
+    ]
+    saved = {
+        k: os.environ.get(k) for k in ("SHEEPRL_TPU_PLAN_MODE", "SHEEPRL_TPU_DONATE")
+    }
+    os.environ["SHEEPRL_TPU_PLAN_MODE"] = "capture"
+    os.environ["SHEEPRL_TPU_DONATE"] = "1"
+    try:
+        tasks[algo](argv)
+    except CaptureComplete as done:
+        return done.plan
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    raise RuntimeError(
+        f"{algo}: main returned without calling plan.start() — no plan captured"
+    )
+
+
+def analyze_plan(
+    algo: str,
+    plan: Any,
+    rules: set[str] | None = None,
+    audit_bf16: bool = False,
+) -> list[JitReport]:
+    return [
+        analyze_entry(algo, entry, rules=rules, audit_bf16=audit_bf16)
+        for entry in plan._entries
+    ]
+
+
+def load_budget(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_budget(budget: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(budget, fh, indent=1, sort_keys=True)
+        fh.write("\n")

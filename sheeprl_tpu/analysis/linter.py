@@ -51,6 +51,15 @@ _BODY_ARG_TRANSFORMS = {
 }
 _HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _SHAPE_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+# callback entry points that trace a host round-trip into the program
+_CALLBACK_LEAVES = {
+    "print",  # jax.debug.print (scoped below to jax.debug/debug roots)
+    "callback",  # jax.debug.callback
+    "io_callback",
+    "pure_callback",
+    "id_tap",  # legacy host_callback
+    "call",  # host_callback.call (scoped to host_callback root)
+}
 
 
 def _parse_suppressions(src: str) -> tuple[dict[int, set[str]], set[str]]:
@@ -634,6 +643,148 @@ def _check_sl007(a: _FileAnalysis) -> None:
             )
 
 
+def _callback_label(a: _FileAnalysis, node: ast.Call) -> Optional[str]:
+    """The dotted name when `node` calls a host-callback entry point
+    (jax.debug.print/callback, io_callback, pure_callback, host_callback)."""
+    d = a._dotted(node.func)
+    if d is None:
+        return None
+    root, _, leaf = d.rpartition(".")
+    if leaf not in _CALLBACK_LEAVES:
+        return None
+    if leaf in ("io_callback", "pure_callback"):
+        return d  # distinctive names; aliases already resolved to jax paths
+    if leaf in ("print", "callback") and "debug" in root.split("."):
+        return d
+    if leaf in ("call", "id_tap") and "host_callback" in root:
+        return d
+    return None
+
+
+def _check_sl008(a: _FileAnalysis) -> None:
+    """Host callbacks traced into HOT jit/scan bodies. SL002 flags blocking
+    syncs anywhere in traced code; callbacks are non-blocking-looking (they
+    trace fine and run "async") which is exactly why a `jax.debug.print`
+    left in an Anakin scan body survives review — at dispatch it costs one
+    host round-trip PER SCAN ITERATION. Scope: only bodies marked
+    `# sheeplint: hotloop` or named like hot loops, so intentional
+    callbacks elsewhere stay lintable by sheepcheck SC002 instead."""
+    marked = _hotloop_marked_lines(a.src)
+    reported: set[int] = set()
+    for ctx in a.jit_contexts:
+        if not isinstance(ctx, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        anchor = {ctx.lineno, ctx.lineno - 1}
+        for dec in ctx.decorator_list:
+            anchor |= {dec.lineno, dec.lineno - 1}
+        hot = bool(_HOTLOOP_NAME_RE.match(ctx.name)) or bool(anchor & marked)
+        if not hot:
+            continue
+        for node in ast.walk(ctx):
+            if not isinstance(node, ast.Call) or id(node) in reported:
+                continue
+            label = _callback_label(a, node)
+            if label:
+                reported.add(id(node))
+                a.report(
+                    "SL008", node,
+                    f"`{label}` traced into hot-loop body `{ctx.name}` — "
+                    "every scan iteration pays a host round-trip",
+                )
+
+
+def _is_numeric_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    return (
+        isinstance(node, ast.Constant)
+        and type(node.value) in (int, float)  # bools are static flags, skip
+    )
+
+
+def _collect_jit_bound(a: _FileAnalysis) -> tuple[set[str], set[tuple[str, object]]]:
+    """Names (and `dict[key]` slots) assigned from jit-building calls:
+    `x = jax.jit(...)`, `x = donating_jit(...)`, `x = plan.register(...)`,
+    `jits["critic"] = plan.register(...)`."""
+    names: set[str] = set()
+    subs: set[tuple[str, object]] = set()
+    for node in ast.walk(a.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        jity = a._jit_like_call(call)
+        if not jity:
+            d = a._dotted(call.func)
+            if (
+                d
+                and d.rsplit(".", 1)[-1] == "register"
+                and "plan" in d.rsplit(".", 1)[0].lower()
+            ):
+                jity = True
+        if not jity:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and isinstance(t.slice, ast.Constant)
+            ):
+                subs.add((t.value.id, t.slice.value))
+    return names, subs
+
+
+def _check_sl009(a: _FileAnalysis) -> None:
+    """Bare Python numeric constants passed to jit-bound callables. The
+    scalar enters the jit as a WEAK-typed 0-d array: mixing such a call
+    site with one passing `jnp.float32(x)` retraces the whole executable
+    (weak vs strong avals are different cache keys), and every call pays an
+    implicit host->device put of the constant — the exact gamma/lambda
+    class --sanitize caught in PR 2."""
+    names, subs = _collect_jit_bound(a)
+    if not names and not subs:
+        return
+    for node in ast.walk(a.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target: Optional[str] = None
+        args: list[ast.expr] = list(node.args)
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in names:
+            target = f.id
+        elif (
+            isinstance(f, ast.Subscript)
+            and isinstance(f.value, ast.Name)
+            and isinstance(f.slice, ast.Constant)
+            and (f.value.id, f.slice.value) in subs
+        ):
+            target = f"{f.value.id}[{f.slice.value!r}]"
+        else:
+            # sanitizer.checked("phase", jit_w, *args) forwards to the jit
+            d = a._dotted(f)
+            if (
+                d
+                and d.rsplit(".", 1)[-1] == "checked"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Name)
+                and node.args[1].id in names
+            ):
+                target = node.args[1].id
+                args = list(node.args[2:])
+        if target is None:
+            continue
+        for arg in (*args, *(kw.value for kw in node.keywords)):
+            if _is_numeric_literal(arg):
+                a.report(
+                    "SL009", arg,
+                    f"bare numeric constant `{ast.unparse(arg)}` passed to "
+                    f"jitted `{target}` — enters as a weak-typed scalar "
+                    "(retrace hazard + per-call h2d put); wrap once as "
+                    "jnp.float32(...)",
+                )
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -651,6 +802,8 @@ def lint_source(
     _check_sl005(analysis)
     _check_sl006(analysis)
     _check_sl007(analysis)
+    _check_sl008(analysis)
+    _check_sl009(analysis)
     for ctx in analysis._top_level_contexts():
         _check_sl002(analysis, ctx)
         _check_sl003(analysis, ctx)

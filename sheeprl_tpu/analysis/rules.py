@@ -166,6 +166,44 @@ _RULES = [
             "(timing fences) get a justified suppression"
         ),
     ),
+    Rule(
+        id="SL008",
+        name="host-callback-in-hotloop-scan",
+        severity=ERROR,
+        summary=(
+            "host callback (jax.debug.print / jax.debug.callback / "
+            "io_callback / pure_callback / host_callback) traced into a "
+            "hot-loop scan/jit body (a body named one_cycle/one_step/"
+            "one_update/*hot_loop* or marked `# sheeplint: hotloop`) — "
+            "each scan iteration pays a device->host round-trip, "
+            "serializing the fully-jitted rollout the Anakin path exists "
+            "for (sheepcheck SC002 is the IR-level twin over every "
+            "registered jit)"
+        ),
+        autofix=(
+            "drop the callback from the hot body (aggregate on device and "
+            "pull once per rollout), or keep it behind a debug flag with "
+            "a justified suppression"
+        ),
+    ),
+    Rule(
+        id="SL009",
+        name="weak-constant-to-jit",
+        severity=WARNING,
+        summary=(
+            "bare Python numeric constant passed to a jit-bound callable "
+            "(a name assigned from jax.jit/donating_jit/plan.register) — "
+            "the scalar enters as a weak-typed 0-d array, so mixing the "
+            "call with strong-typed call sites retraces the whole jit, "
+            "and every call pays an implicit host->device put (the PR-2 "
+            "gamma/lambda class)"
+        ),
+        autofix=(
+            "wrap the constant once outside the loop: jnp.float32(x) / "
+            "jnp.asarray(x, dtype) — a committed device scalar with a "
+            "strong dtype"
+        ),
+    ),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

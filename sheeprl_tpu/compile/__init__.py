@@ -15,7 +15,7 @@ from .partition import (
     lowered_op_counts,
     predicted_cpu_compile_seconds,
 )
-from .plan import CompilePlan, WarmJit, avals_of, sds
+from .plan import CaptureComplete, CompilePlan, WarmJit, avals_of, sds
 from .specs import dict_obs_spec, dreamer_sample_spec
 
 __all__ = [
@@ -23,6 +23,7 @@ __all__ = [
     "dreamer_sample_spec",
     "MIN_COMPILE_SECS",
     "CacheStats",
+    "CaptureComplete",
     "CompilePlan",
     "PartitionDecision",
     "WarmJit",

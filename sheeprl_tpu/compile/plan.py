@@ -47,6 +47,7 @@ plus `compile` events in telemetry.jsonl, and the plan stamps
 
 from __future__ import annotations
 
+import atexit
 import os
 import threading
 import time
@@ -54,7 +55,20 @@ from typing import Any, Callable
 
 from .cache import CacheStats
 
-__all__ = ["CompilePlan", "WarmJit", "avals_of", "sds"]
+__all__ = ["CaptureComplete", "CompilePlan", "WarmJit", "avals_of", "sds"]
+
+
+class CaptureComplete(BaseException):
+    """Raised by `CompilePlan.start()` in capture mode
+    (`SHEEPRL_TPU_PLAN_MODE=capture`): unwinds the algo main at the exact
+    point where the training loop would begin — every hot jit is registered
+    with its example thunk, nothing has executed — carrying the plan to the
+    caller (tools/sheepcheck.py). BaseException on purpose: a stray
+    `except Exception` in a main must not swallow the unwind."""
+
+    def __init__(self, plan: "CompilePlan"):
+        super().__init__("compile plan captured (SHEEPRL_TPU_PLAN_MODE=capture)")
+        self.plan = plan
 
 
 def sds(shape, dtype, sharding=None):
@@ -213,8 +227,13 @@ class CompilePlan:
         enabled: bool = False,
         telem: Any = None,
         threads: int | None = None,
+        capture_only: bool = False,
     ):
         self.enabled = enabled
+        # capture mode (sheepcheck): record EVERY register() with its example
+        # thunk regardless of --warm_compile, compile nothing, and raise
+        # CaptureComplete from start() so the main never runs a step
+        self.capture_only = capture_only
         self._telem = telem
         self._threads = threads
         self._entries: list[_Entry] = []
@@ -229,9 +248,12 @@ class CompilePlan:
 
     @classmethod
     def from_args(cls, args: Any, telem: Any = None) -> "CompilePlan":
-        enabled = getattr(args, "warm_compile", "off") == "on"
+        capture_only = os.environ.get("SHEEPRL_TPU_PLAN_MODE") == "capture"
+        enabled = getattr(args, "warm_compile", "off") == "on" and not capture_only
         threads = int(os.environ.get("SHEEPRL_TPU_WARM_THREADS", "0")) or None
-        return cls(enabled=enabled, telem=telem, threads=threads)
+        return cls(
+            enabled=enabled, telem=telem, threads=threads, capture_only=capture_only
+        )
 
     # ---- registration ------------------------------------------------------
     def register(
@@ -246,6 +268,15 @@ class CompilePlan:
         compile worker). Returns the callable the main should use in place
         of `fn`. A fn without `.lower` (e.g. a checkify wrapper) or without
         an example is tracked for first-update timing only."""
+        if self.capture_only:
+            # shape capture: keep the raw entry (fn + example thunk) for
+            # sheepcheck's abstract eval; the main keeps its plain callable
+            # (it never runs — start() raises CaptureComplete)
+            entry = _Entry(name, fn, example, role)
+            entry.done.set()
+            with self._lock:
+                self._entries.append(entry)
+            return fn
         if not self.enabled and role is None:
             return fn
         entry = _Entry(name, fn, example, role)
@@ -270,11 +301,19 @@ class CompilePlan:
         both arms and outside the subsystem's control."""
         if self._started:
             return
+        if self.capture_only:
+            self._started = True
+            raise CaptureComplete(self)
         self._t0 = time.perf_counter()
         if not self.enabled:
             self._started = True
             return
         self._cache_stats.attach()
+        # a run that dies (or returns) without plan.close() must still join
+        # the compile workers: a daemon thread mid-XLA-compile at interpreter
+        # teardown aborts the process (`terminate called without an active
+        # exception`) — the registered-but-never-called-jit exit abort
+        atexit.register(self.close)
         with self._lock:
             self._queue = [e for e in self._entries if not e.done.is_set()]
             # interaction jits (player/policy/gae) are needed from the FIRST
@@ -425,13 +464,42 @@ class CompilePlan:
         return out
 
     # ---- lifecycle ---------------------------------------------------------
-    def close(self) -> None:
-        """End-of-run teardown: emit the summary event, detach listeners.
-        Worker threads are daemons — an unfinished compile cannot block
-        process exit."""
+    def close(self, join_timeout: float | None = None) -> None:
+        """End-of-run teardown: cancel queued compiles, join the workers
+        (bounded), emit the summary event, detach listeners.
+
+        The join is the exit-abort fix: a WarmJit whose jit is never called
+        never waits on its entry, so a run could reach interpreter teardown
+        with a worker daemon thread still inside an XLA compile — which
+        aborts the process with `terminate called without an active
+        exception`. Cancelling the queue bounds the wait to the ONE compile
+        already in flight; the join waits for it up to
+        `SHEEPRL_TPU_WARM_JOIN_S` (default 120 s — every measured XLA:CPU
+        compile in this repo is well under that). `start()` wires this to
+        `atexit` so even an exception path gets the join."""
         if self._closed:
             return
         self._closed = True
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+        # cancel entries the workers have not picked up yet; their barrier
+        # waiters (if any raced close) fall back to the cold jitted fn
+        with self._lock:
+            cancelled, self._queue = self._queue, []
+        for e in cancelled:
+            if not e.done.is_set():
+                e.error = e.error or "cancelled: plan closed before compile started"
+                e.done.set()
+        if join_timeout is None:
+            try:
+                join_timeout = float(os.environ.get("SHEEPRL_TPU_WARM_JOIN_S", "120"))
+            except ValueError:
+                join_timeout = 120.0
+        deadline = time.monotonic() + max(join_timeout, 0.0)
+        for t in self._workers:
+            t.join(max(deadline - time.monotonic(), 0.0))
         self._cache_stats.detach()
         if self.enabled or self._first_update_s is not None:
             self._event("compile.summary", **_jsonable(self.stats()))

@@ -404,9 +404,87 @@ def test_sl007_suppression_with_justification():
 # ---------------------------------------------------------------------------
 
 
+def test_sl008_positive_callback_in_hot_scan_body():
+    src = """
+    import jax
+
+    @jax.jit
+    def rollout(carry):
+        def one_cycle(c, _):
+            jax.debug.print("c = {}", c)
+            return c + 1, c
+        return jax.lax.scan(one_cycle, carry, None, length=8)
+    """
+    assert ids(src) == ["SL008"]
+
+
+def test_sl008_positive_io_callback_marker():
+    src = """
+    import jax
+    from jax.experimental import io_callback
+
+    @jax.jit
+    # sheeplint: hotloop
+    def hot_inner(c):
+        io_callback(print, None, c)
+        return c
+    """
+    assert ids(src) == ["SL008"]
+
+
+def test_sl008_negative_cold_jit_and_suppression():
+    src = """
+    import jax
+
+    @jax.jit
+    def diagnostics(c):
+        jax.debug.print("c = {}", c)  # cold jit: sheepcheck SC002's turf
+        return c
+
+    @jax.jit
+    def one_update(c):
+        jax.debug.print("c = {}", c)  # sheeplint: disable=SL008 — debug build only
+        return c
+    """
+    assert ids(src) == []
+
+
+def test_sl009_positive_literal_to_jit_bound_names():
+    src = """
+    import jax
+
+    train_step = jax.jit(lambda s, lr: s * lr)
+    jits = {}
+    jits["gae"] = plan.register("gae", train_step)
+
+    def loop(state):
+        a = train_step(state, 3e-4)
+        b = jits["gae"](state, 0.95)
+        return a, b
+    """
+    assert ids(src) == ["SL009", "SL009"]
+
+
+def test_sl009_negative_wrapped_scalars_and_plain_calls():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    train_step = jax.jit(lambda s, lr: s * lr)
+
+    def loop(state, helper):
+        good = train_step(state, jnp.float32(3e-4))
+        other = helper(state, 3e-4)  # not jit-bound: no finding
+        flag = train_step(state, True)  # bools are static flags
+        return good, other, flag
+    """
+    assert ids(src) == []
+
+
 def test_rule_catalog_complete():
     assert rule_ids() == [
         "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
+        "SL008", "SL009",
     ]
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")

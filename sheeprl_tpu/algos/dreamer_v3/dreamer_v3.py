@@ -689,6 +689,15 @@ def main(argv: Sequence[str] | None = None) -> None:
             args.per_rank_sequence_length,
             max(args.train_every // args.num_envs, 1),
         )
+        # the divisibility check at mesh build time saw the PRE-clamp value;
+        # a clamped window that no longer divides the seq axis would shard-
+        # fail at trace time (sheepshard found this via the train_step
+        # example spec) — fail loudly at config time instead
+        assert_divisible(
+            args.per_rank_sequence_length,
+            args.seq_devices,
+            "per_rank_sequence_length (dry-run clamped to train_every/num_envs)",
+        )
     buffer_size = (
         args.buffer_size // (args.num_envs * world) if not args.dry_run else 2
     )
@@ -857,6 +866,24 @@ def main(argv: Sequence[str] | None = None) -> None:
                 ),
                 key, jnp.float32(0.0), None,
             ),
+        )
+    # data edges (ISSUE 8): collection reaches the train step through the
+    # replay ring + sampler on every backend — the reshuffle is the
+    # documented contract, recorded so sheepshard keeps drift visible.
+    if use_jax_env:
+        plan.declare_edge(
+            "anakin_rollout", "train_step", expect="reshard",
+            note="device replay ring (reserve/add_direct) + sequence sampler",
+        )
+    elif use_blob:
+        plan.declare_edge(
+            "blob_step", "train_step", expect="reshard",
+            note="replay buffer + sequence sampler",
+        )
+    else:
+        plan.declare_edge(
+            "player_step", "train_step", expect="reshard",
+            note="replay buffer + sequence sampler",
         )
     plan.start()
 

@@ -320,6 +320,13 @@ def main(argv: Sequence[str] | None = None) -> None:
             key, jnp.float32(0.0), None,
         ),
     )
+    # data edge (ISSUE 8): player rollouts reach the update through the
+    # replay buffer + the explicit meshes.to_trainers put — the sharding
+    # change across the edge is the decoupled contract.
+    plan.declare_edge(
+        "player_step", "train_step", expect="reshard",
+        note="replay buffer + meshes.to_trainers: player -> trainer mesh",
+    )
     plan.start()
 
     gradient_steps = 0

@@ -341,23 +341,36 @@ def main(argv: Sequence[str] | None = None) -> None:
     act_sum = int(sum(actions_dim))
     obs_space = observation_space
 
-    def _obs_leaf(lead, k):
+    def _obs_leaf(lead, k, sharding=None):
         dt = jnp.uint8 if k in cnn_keys else jnp.float32
-        return sds(lead + tuple(obs_space[k].shape), dt)
+        return sds(lead + tuple(obs_space[k].shape), dt, sharding)
 
     def _gae_example():
         T, N = args.rollout_steps, args.num_envs
-        data = {k: _obs_leaf((T, N), k) for k in obs_keys}
+        # under the Anakin backend the trajectory flows straight off the
+        # sharded rollout scan: [T, N, ...] leaves with the env axis over
+        # "data", bootstrap obs/done [N, ...] over "data". The example must
+        # declare that layout or the AOT executable is built for unsharded
+        # inputs and EVERY live call falls back at the aval check — the
+        # warm start silently loses its head start (sheepshard SC008
+        # caught exactly this drift on the anakin_rollout->gae edge).
+        row_sh = env_sh = None
+        if use_jax_env and n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            row_sh = NamedSharding(mesh, PartitionSpec(None, "data"))
+            env_sh = NamedSharding(mesh, PartitionSpec("data"))
+        data = {k: _obs_leaf((T, N), k, row_sh) for k in obs_keys}
         data.update(
-            actions=sds((T, N, act_sum), jnp.float32),
-            logprobs=sds((T, N, 1), jnp.float32),
-            values=sds((T, N, 1), jnp.float32),
-            rewards=sds((T, N, 1), jnp.float32),
-            dones=sds((T, N, 1), jnp.float32),
+            actions=sds((T, N, act_sum), jnp.float32, row_sh),
+            logprobs=sds((T, N, 1), jnp.float32, row_sh),
+            values=sds((T, N, 1), jnp.float32, row_sh),
+            rewards=sds((T, N, 1), jnp.float32, row_sh),
+            dones=sds((T, N, 1), jnp.float32, row_sh),
         )
-        next_obs = {k: _obs_leaf((N,), k) for k in obs_keys}
+        next_obs = {k: _obs_leaf((N,), k, env_sh) for k in obs_keys}
         return (
-            state.agent, data, next_obs, sds((N, 1), jnp.float32),
+            state.agent, data, next_obs, sds((N, 1), jnp.float32, env_sh),
             jnp.float32(args.gamma), jnp.float32(args.gae_lambda),
         )
 
@@ -425,6 +438,16 @@ def main(argv: Sequence[str] | None = None) -> None:
     compute_gae_w = plan.register("gae", compute_gae_returns, example=_gae_example)
     train_step = plan.register(
         "train_step", train_step, example=_train_example, role="update"
+    )
+    # data edges (ISSUE 8): the cross-jit sharding contracts sheepshard
+    # gates. On the Anakin path the trajectory moves device-to-device from
+    # the rollout scan into gae, so the shardings must MATCH (SC008); the
+    # gae->train handoff reshuffles on purpose (host reshape + shard_batch).
+    if use_jax_env:
+        plan.declare_edge("anakin_rollout", "gae", expect="match")
+    plan.declare_edge(
+        "gae", "train_step", expect="reshard",
+        note="host reshape [T,N]->[T*N] + shard_batch onto the mesh",
     )
     plan.start()
 
@@ -533,6 +556,9 @@ def main(argv: Sequence[str] | None = None) -> None:
             device_next_obs = carry.obs
             next_done_dev = carry.prev_done
         else:
+            # sheeplint: disable=SL010 — host-path GAE runs whole-rollout on
+            # the default device by design; the update batch is resharded
+            # right after (shard_batch on `flat`, the declared gae->train edge)
             data = {k: jnp.asarray(rb[k]) for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")}
             device_next_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
             next_done_dev = jnp.asarray(next_done)[:, None]

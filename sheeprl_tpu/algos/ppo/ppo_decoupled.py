@@ -212,6 +212,13 @@ def main(argv: Sequence[str] | None = None) -> None:
     train_step = plan.register(
         "train_step", train_step, example=_train_example, role="update"
     )
+    # data edge (ISSUE 8): gae runs on the player, the update on the
+    # trainer mesh — the handoff is the explicit meshes.to_trainers put
+    # (the decoupled data path), so a sharding change IS the contract.
+    plan.declare_edge(
+        "gae", "train_step", expect="reshard",
+        note="meshes.to_trainers: player device -> trainer mesh (ICI)",
+    )
     plan.start()
 
     aggregator = MetricAggregator()
@@ -291,6 +298,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         # ---- player: GAE, then ship the rollout to the trainer mesh ---------
         telem.mark("host_to_device")
         data = {
+            # sheeplint: disable=SL010 — player-side GAE on the player
+            # device IS the decoupled contract; the explicit reshard is the
+            # meshes.to_trainers put below (the declared gae->train edge)
             k: jnp.asarray(rb[k])
             for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")
         }

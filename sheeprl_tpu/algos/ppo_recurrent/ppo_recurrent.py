@@ -407,6 +407,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         # ---- GAE + one-jit update -------------------------------------------
         telem.mark("host_to_device")
         data = {
+            # sheeplint: disable=SL010 — whole-rollout GAE runs on the
+            # default device by design; the windowed update batch is
+            # resharded right after (shard_batch on `windows`)
             k: jnp.asarray(rb[k])
             for k in (
                 "observations", "dones", "actions", "logprobs", "values", "rewards",

@@ -190,6 +190,13 @@ def main(argv: Sequence[str] | None = None) -> None:
             key,
         ),
     )
+    # data edge (ISSUE 8): the player's transitions reach the update
+    # through the replay buffer + the explicit meshes.to_trainers put, so
+    # the sharding change across the edge is the decoupled contract.
+    plan.declare_edge(
+        "policy_step", "train_step", expect="reshard",
+        note="replay buffer + meshes.to_trainers: player -> trainer mesh",
+    )
     plan.start()
 
     aggregator = MetricAggregator()

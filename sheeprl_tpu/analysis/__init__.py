@@ -18,10 +18,17 @@ Two halves of one invariant set (ISSUE 3):
     the AST cannot see through the jit boundary (SC001-SC005: dtype
     promotion, host callbacks, donation aliasing, scan-carry weak types,
     CPU conv pathology), plus the compile-cost fingerprints behind the
-    CI-gated `analysis/budget.json` ledger.
+    CI-gated `analysis/budget/` ledger.
+  - `shard_check`: the SPMD half (ISSUE 8, `tools/sheepshard.py`) — every
+    mesh-bearing registered jit is lowered AND compiled under its declared
+    mesh (still zero execution) and the partitioned HLO is analyzed for
+    communication hazards the jaxpr cannot show (SC006-SC009: hot-loop
+    collectives, silent full replication, cross-jit resharding thrash on
+    declared data edges, eager host-loop collectives), plus the per-jit
+    comms ledger behind the CI-gated comms drift budget.
 """
 
-from . import jaxpr_check
+from . import jaxpr_check, shard_check
 from .linter import lint_file, lint_paths, lint_source
 from .rules import RULES, Rule, Violation
 from .sanitizer import Sanitizer
@@ -29,6 +36,7 @@ from .sanitizer import Sanitizer
 __all__ = [
     "RULES",
     "jaxpr_check",
+    "shard_check",
     "Rule",
     "Violation",
     "Sanitizer",

@@ -45,7 +45,7 @@ keyed `(algo, jit, rule)`, each with a mandatory justification):
 Each analyzable jit also yields a *fingerprint* — primitive histogram, op
 count, dtype set, donation map, FLOP/byte estimates from XLA's
 `cost_analysis` — which `tools/sheepcheck.py` writes to the committed
-`analysis/budget.json` ledger. CI re-derives the fingerprints and fails on
+`analysis/budget/` ledger. CI re-derives the fingerprints and fails on
 unexplained drift (new dtypes, op-count growth past tolerance, lost
 donations): "did this PR quietly bloat or de-optimize a jit?" becomes a
 gated check instead of a bench regression three rounds later.
@@ -71,11 +71,15 @@ __all__ = [
     "analyze_closed_jaxpr",
     "analyze_entry",
     "analyze_plan",
+    "budget_dir_of",
+    "budget_exists",
     "build_budget",
     "capture_plan",
     "check_budget",
     "fingerprint_jaxpr",
     "iter_eqns",
+    "load_budget",
+    "save_budget",
 ]
 
 ERROR = "error"
@@ -841,13 +845,110 @@ def analyze_plan(
     ]
 
 
+# ---------------------------------------------------------------------------
+# ledger persistence: per-algo dir layout (+ legacy single-blob reading)
+# ---------------------------------------------------------------------------
+#
+# The ledger lives in `analysis/budget/` as one file per algo/variant spec
+# (`ppo.json`, `ppo@anakin.json`, ...) plus `_meta.json` (version,
+# jax_version, tolerances) — deterministic key order, one jit per block, so
+# a PR's ledger diff reads as "which jits of which algo changed". Each spec
+# file can hold several SECTIONS: `jits` (sheepcheck's compile-cost
+# fingerprints), `comms` and `edges` (sheepshard's collective/contract
+# fingerprints); savers only rewrite their own sections. The pre-split
+# single-blob `analysis/budget.json` is still readable for one release so
+# older branches keep gating.
+
+_LEDGER_SECTIONS = ("jits", "comms", "edges")
+
+
+def budget_dir_of(path: str) -> str:
+    """Map a ledger path to its dir-layout root: `analysis/budget.json` ->
+    `analysis/budget`; a dir path passes through."""
+    if os.path.isdir(path):
+        return path
+    root, ext = os.path.splitext(path)
+    return root if ext == ".json" else path
+
+
+def budget_exists(path: str) -> bool:
+    return os.path.isdir(budget_dir_of(path)) or os.path.exists(path)
+
+
 def load_budget(path: str) -> dict:
+    """Read the ledger in either layout (the per-algo dir is preferred
+    when both exist). Empty sections are dropped so a jits-only ledger
+    round-trips exactly."""
+    d = budget_dir_of(path)
+    if os.path.isdir(d):
+        out: dict = {section: {} for section in _LEDGER_SECTIONS}
+        meta_path = os.path.join(d, "_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path, encoding="utf-8") as fh:
+                out.update(json.load(fh))
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".json") or name == "_meta.json":
+                continue
+            with open(os.path.join(d, name), encoding="utf-8") as fh:
+                blob = json.load(fh)
+            for section in _LEDGER_SECTIONS:
+                out[section].update(blob.get(section, {}))
+        for section in _LEDGER_SECTIONS:
+            if not out.get(section):
+                out.pop(section, None)
+        return out
     with open(path, encoding="utf-8") as fh:
         return json.load(fh)
 
 
-def save_budget(budget: dict, path: str) -> None:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+def save_budget(
+    budget: dict, path: str, sections: tuple[str, ...] = ("jits",)
+) -> None:
+    """Write `budget` in the per-algo dir layout. Only `sections` are
+    rewritten — and they are rewritten COMPLETELY: a spec file whose
+    entries vanished from `budget` has that section stripped (callers
+    doing partial sweeps merge into the loaded ledger first). Other
+    sections in the files, and a legacy blob at `path`, are left alone."""
+    d = budget_dir_of(path)
+    os.makedirs(d, exist_ok=True)
+    meta_path = os.path.join(d, "_meta.json")
+    meta: dict = {}
+    if os.path.exists(meta_path):
+        with open(meta_path, encoding="utf-8") as fh:
+            meta = json.load(fh)
+    tol = dict(meta.get("tolerance", {}))
+    tol.update(budget.get("tolerance", {}))
+    meta.update({k: budget[k] for k in ("version", "jax_version") if k in budget})
+    if tol:
+        meta["tolerance"] = tol
+    _write_json(meta, meta_path)
+    by_spec: dict[str, dict[str, dict]] = {}
+    for section in sections:
+        for key, val in budget.get(section, {}).items():
+            spec = key.split("/", 1)[0]
+            by_spec.setdefault(spec, {}).setdefault(section, {})[key] = val
+    existing = {
+        name[: -len(".json")]
+        for name in os.listdir(d)
+        if name.endswith(".json") and name != "_meta.json"
+    }
+    for spec in sorted(existing | set(by_spec)):
+        spec_path = os.path.join(d, f"{spec}.json")
+        blob: dict = {}
+        if os.path.exists(spec_path):
+            with open(spec_path, encoding="utf-8") as fh:
+                blob = json.load(fh)
+        for section in sections:
+            blob.pop(section, None)
+            if by_spec.get(spec, {}).get(section):
+                blob[section] = by_spec[spec][section]
+        if any(blob.get(section) for section in _LEDGER_SECTIONS):
+            _write_json(blob, spec_path)
+        elif os.path.exists(spec_path):
+            os.remove(spec_path)
+
+
+def _write_json(obj: dict, path: str) -> None:
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump(budget, fh, indent=1, sort_keys=True)
+        json.dump(obj, fh, indent=1, sort_keys=True)
         fh.write("\n")

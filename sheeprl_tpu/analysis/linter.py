@@ -785,6 +785,111 @@ def _check_sl009(a: _FileAnalysis) -> None:
                 )
 
 
+_SL010_BATCHISH_RE = re.compile(
+    r"(?:^|[^A-Za-z0-9_])_?(?:batch|batched|global_batch|sample|samples|"
+    r"rollout|rollouts|traj|trajectory|windows|transitions|rb|replay|"
+    r"buffer|buffers|data)(?:[^A-Za-z0-9]|_batch|$)"
+)
+# helpers that ARE the explicit-sharding path: a value handed to one of
+# these downstream is committed properly, so its construction site is clean
+_SL010_SHARD_HELPERS = {
+    "shard_batch", "shard_time_batch", "shard_env_batch", "to_trainers",
+}
+_SL010_MESH_BUILDERS = {"make_mesh", "build_mesh", "Mesh", "create_device_mesh"}
+
+
+def _check_sl010(a: _FileAnalysis) -> None:
+    """Unsharded puts of batch-sized values in mesh-aware host code. A bare
+    `jnp.asarray(batch)` / one-arg `jax.device_put(batch)` in a function
+    that builds or holds a mesh lands the batch UNCOMMITTED on the default
+    device: sharded consumers then replicate or single-device it silently —
+    the host-side twin of sheepshard SC007. Scope: only batch-shaped names
+    (replay reads, sample/rollout/batch/data values); a value the same
+    function later routes through shard_batch / shard_time_batch /
+    shard_env_batch / to_trainers is the explicit-sharding idiom and
+    exempt."""
+
+    def fn_of(node: ast.AST) -> ast.AST:
+        for p in a._parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return p
+        return a.tree
+
+    meshy: set[ast.AST] = set()
+    sharded_names: dict[ast.AST, set[str]] = {}
+    for node in ast.walk(a.tree):
+        if isinstance(node, ast.Name) and node.id in ("mesh", "meshes"):
+            meshy.add(fn_of(node))
+        elif isinstance(node, ast.Call):
+            d = a._dotted(node.func) or ""
+            leaf = d.rsplit(".", 1)[-1]
+            if leaf in _SL010_MESH_BUILDERS:
+                meshy.add(fn_of(node))
+            if (
+                leaf in _SL010_SHARD_HELPERS
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                sharded_names.setdefault(fn_of(node), set()).add(node.args[0].id)
+    if not meshy:
+        return
+
+    for node in ast.walk(a.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        d = a._dotted(node.func)
+        if d is None:
+            continue
+        root, _, leaf = d.rpartition(".")
+        is_put = (
+            d == "jax.device_put"
+            and len(node.args) == 1
+            and not any(kw.arg in ("device", "sharding") for kw in node.keywords)
+        )
+        is_asarray = leaf == "asarray" and root == "jax.numpy"
+        if not (is_put or is_asarray):
+            continue
+        if a._in_jit_context(node):
+            continue  # in-jit constants are SC-rule jurisdiction
+        owner = fn_of(node)
+        if owner not in meshy:
+            continue
+        # batch-shaped? match the argument text, plus the iterables of any
+        # enclosing comprehension (`{k: jnp.asarray(v) for k, v in
+        # sample.items()}` — the batch name lives on the generator)
+        pool = [ast.unparse(node.args[0])]
+        for p in a._parents(node):
+            if isinstance(
+                p, (ast.DictComp, ast.ListComp, ast.SetComp, ast.GeneratorExp)
+            ):
+                pool.extend(ast.unparse(g.iter) for g in p.generators)
+            elif isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                break
+        if not _SL010_BATCHISH_RE.search(" ".join(pool)):
+            continue
+        # explicit-sharding idiom: the nearest enclosing assignment's target
+        # is later handed to a shard helper in the same function
+        target: Optional[str] = None
+        for p in a._parents(node):
+            if isinstance(p, ast.Assign):
+                for t in p.targets:
+                    if isinstance(t, ast.Name):
+                        target = t.id
+                break
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                break
+        if target is not None and target in sharded_names.get(owner, set()):
+            continue
+        label = "jax.device_put" if is_put else f"{d.rsplit('.', 1)[0]}.asarray"
+        a.report(
+            "SL010", node,
+            f"`{label}` of a batch-sized value in mesh-aware host code "
+            "without an explicit sharding — the put lands uncommitted on "
+            "the default device and sharded consumers silently replicate "
+            "or single-device it (host-side twin of sheepshard SC007)",
+        )
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -804,6 +909,7 @@ def lint_source(
     _check_sl007(analysis)
     _check_sl008(analysis)
     _check_sl009(analysis)
+    _check_sl010(analysis)
     for ctx in analysis._top_level_contexts():
         _check_sl002(analysis, ctx)
         _check_sl003(analysis, ctx)

@@ -204,6 +204,25 @@ _RULES = [
             "strong dtype"
         ),
     ),
+    Rule(
+        id="SL010",
+        name="unsharded-batch-put",
+        severity=WARNING,
+        summary=(
+            "jax.device_put / jnp.asarray of a batch-sized array (a "
+            "replay-buffer read or batch/sample/rollout-named value) "
+            "inside a mesh-building function without an explicit sharding "
+            "— the put lands uncommitted on the default device, so sharded "
+            "consumers silently replicate or single-device the batch (the "
+            "host-side twin of sheepshard SC007)"
+        ),
+        autofix=(
+            "route the put through shard_batch / shard_time_batch / "
+            "shard_env_batch (or device_put with a NamedSharding); where "
+            "the unsharded put IS the design (player-side data, an "
+            "explicit reshard downstream), suppress with the justification"
+        ),
+    ),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

@@ -15,7 +15,7 @@ from .partition import (
     lowered_op_counts,
     predicted_cpu_compile_seconds,
 )
-from .plan import CaptureComplete, CompilePlan, WarmJit, avals_of, sds
+from .plan import CaptureComplete, CompilePlan, DataEdge, WarmJit, avals_of, sds
 from .specs import dict_obs_spec, dreamer_sample_spec
 
 __all__ = [
@@ -25,6 +25,7 @@ __all__ = [
     "CacheStats",
     "CaptureComplete",
     "CompilePlan",
+    "DataEdge",
     "PartitionDecision",
     "WarmJit",
     "arm_compile_cache",

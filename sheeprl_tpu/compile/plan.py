@@ -55,7 +55,14 @@ from typing import Any, Callable
 
 from .cache import CacheStats
 
-__all__ = ["CaptureComplete", "CompilePlan", "WarmJit", "avals_of", "sds"]
+__all__ = [
+    "CaptureComplete",
+    "CompilePlan",
+    "DataEdge",
+    "WarmJit",
+    "avals_of",
+    "sds",
+]
 
 
 class CaptureComplete(BaseException):
@@ -102,6 +109,52 @@ def avals_of(tree: Any) -> Any:
         return x
 
     return jax.tree_util.tree_map(one, tree)
+
+
+class DataEdge:
+    """A declared producer->consumer contract between two registered jits:
+    "(some of) `src`'s outputs become `dst`'s inputs". The sheepshard
+    analyzer (analysis/shard_check.py) resolves both ends to their
+    compiled SPMD shardings and checks the contract:
+
+      - `expect="match"`: the data flows device-to-device with no host
+        reshuffle in between (the Anakin rollout->gae path), so the
+        producer's output sharding and the consumer's input sharding must
+        agree — a disagreement forces an implicit reshard (all-gather +
+        re-slice) on EVERY handoff (rule SC008);
+      - `expect="reshard"`: the main reshuffles the data on purpose between
+        the two jits (host reshape + shard_batch, a replay ring, a
+        decoupled to_trainers put), so a sharding change across the edge is
+        the documented contract; the resolved pair is still recorded in
+        the comms ledger so drift stays visible.
+
+    `pairs` optionally names exact (src_output_index, dst_input_index)
+    flat positions; when None the analyzer matches outputs to inputs by
+    (shape, dtype) groups. This is the first concrete slice of the
+    ROADMAP-4 fragment graph: the data edges of the fragment dataflow,
+    declared once per main, machine-checkable."""
+
+    __slots__ = ("src", "dst", "pairs", "expect", "note")
+
+    def __init__(
+        self,
+        src: str,
+        dst: str,
+        pairs: list[tuple[int, int]] | None = None,
+        expect: str = "match",
+        note: str | None = None,
+    ):
+        if expect not in ("match", "reshard"):
+            raise ValueError(f"expect must be 'match' or 'reshard', got {expect!r}")
+        self.src = src
+        self.dst = dst
+        self.pairs = pairs
+        self.expect = expect
+        self.note = note
+
+    @property
+    def key(self) -> str:
+        return f"{self.src}->{self.dst}"
 
 
 class _Entry:
@@ -237,6 +290,7 @@ class CompilePlan:
         self._telem = telem
         self._threads = threads
         self._entries: list[_Entry] = []
+        self._edges: list[DataEdge] = []
         self._lock = threading.Lock()
         self._started = False
         self._closed = False
@@ -287,6 +341,25 @@ class CompilePlan:
         with self._lock:
             self._entries.append(entry)
         return WarmJit(entry, self)
+
+    def declare_edge(
+        self,
+        src: str,
+        dst: str,
+        pairs: list[tuple[int, int]] | None = None,
+        expect: str = "match",
+        note: str | None = None,
+    ) -> None:
+        """Declare that (some of) `src`'s outputs feed `dst`'s inputs — the
+        cross-jit dataflow contract sheepshard's SC008 checks against the
+        compiled SPMD shardings (see DataEdge). Zero-cost at runtime:
+        edges are metadata, recorded in every plan mode."""
+        with self._lock:
+            self._edges.append(DataEdge(src, dst, pairs=pairs, expect=expect, note=note))
+
+    @property
+    def edges(self) -> list[DataEdge]:
+        return list(self._edges)
 
     # ---- background compilation -------------------------------------------
     def start(self) -> None:

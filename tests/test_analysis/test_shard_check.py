@@ -1,0 +1,587 @@
+"""sheepshard receipts (ISSUE 8 tentpole): each SC006-SC009 rule fires on a
+known-bad fixture and stays silent on a clean control; the comms ledger
+round-trips and its CI drift gate fails on the injected regressions the
+ISSUE names (an extra hot-loop all-gather, a newly replicated large param);
+the ppo@anakin producer->consumer data edge resolves as a real cross-jit
+sharding contract.
+
+Fixture jits are lowered AND compiled under real NamedShardings on the
+conftest 8-virtual-CPU-device mesh — the analyzers read the partitioned HLO
+XLA actually emits, not a mock of it."""
+
+import json
+import textwrap
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.analysis import jaxpr_check as jc
+from sheeprl_tpu.analysis import shard_check as sc
+from sheeprl_tpu.compile import DataEdge, sds
+
+
+def _mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def _entry(name, fn, example):
+    # analyze_entry only reads .name/.fn/.example — a namespace stands in
+    # for a CompilePlan._Entry without the capture-mode env dance
+    return SimpleNamespace(name=name, fn=fn, example=example)
+
+
+def _rules_hit(report):
+    return {f.rule.id for f in report.findings}
+
+
+# ---------------------------------------------------------------------------
+# clean control
+# ---------------------------------------------------------------------------
+
+
+def test_clean_control_data_parallel_elementwise():
+    """Purely data-parallel math over a sharded batch: zero collectives,
+    zero findings, and the fingerprint says so."""
+    mesh = _mesh8()
+    row = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def step(x):
+        return jnp.tanh(x * 2.0) + 1.0
+
+    report, compiled = sc.analyze_entry(
+        "fix@clean", _entry("step", step, lambda: (sds((8, 4), jnp.float32, row),))
+    )
+    assert report.error is None and compiled is not None
+    assert report.findings == []
+    assert report.comms["num_partitions"] == 8
+    assert report.comms["collectives"] == {}
+    assert report.comms["wire_bytes"] == 0
+    assert report.comms["mesh"] == {"data": 8}
+    json.dumps(report.comms)  # the ledger must be committable as-is
+
+
+def test_not_mesh_bearing_skipped_unless_forced():
+    @jax.jit
+    def f(x):
+        return x + 1.0
+
+    ex = lambda: (sds((4,), jnp.float32),)  # noqa: E731 — no sharding
+    report, _ = sc.analyze_entry("fix@clean", _entry("f", f, ex))
+    assert report.error is not None and "not mesh-bearing" in report.error
+    forced, _ = sc.analyze_entry("fix@clean", _entry("f", f, ex), force=True)
+    assert forced.error is None and forced.comms is not None
+
+
+# ---------------------------------------------------------------------------
+# SC006: collective inside a hot (while/scan) loop body
+# ---------------------------------------------------------------------------
+
+
+def _sc006_fixture():
+    """Carry [B, H] sharded over H: each scan iteration contracts the
+    sharded axis (c @ w), so the partitioner must all-reduce the partial
+    products INSIDE the loop body — the textbook hot-loop collective."""
+    mesh = _mesh8()
+    col = NamedSharding(mesh, P(None, "data"))
+    row = NamedSharding(mesh, P("data", None))
+
+    @jax.jit
+    def step(c, w):
+        def body(c, _):
+            return jnp.tanh(c @ w), ()
+
+        c, _ = jax.lax.scan(body, c, None, length=4)
+        return c
+
+    example = lambda: (  # noqa: E731
+        sds((8, 16), jnp.float32, col), sds((16, 16), jnp.float32, row)
+    )
+    return _entry("step", step, example)
+
+
+def test_sc006_collective_in_scan_body():
+    report, _ = sc.analyze_entry("fix@hot", _sc006_fixture())
+    assert report.error is None
+    assert "SC006" in _rules_hit(report)
+    assert report.comms["hot_collectives"].get("all-reduce", 0) >= 1
+    assert report.comms["wire_bytes_hot"] > 0
+    # the trip count multiplies the committed wire bytes
+    hot = [c for c in report.findings if c.rule.id == "SC006"]
+    assert any("while/scan body" in f.message for f in hot)
+
+
+def test_sc006_same_math_outside_loop_is_cold():
+    """The identical contraction OUTSIDE a loop: the all-reduce is cold —
+    recorded in the histogram but no SC006."""
+    mesh = _mesh8()
+    col = NamedSharding(mesh, P(None, "data"))
+    row = NamedSharding(mesh, P("data", None))
+
+    @jax.jit
+    def step(c, w):
+        return jnp.tanh(c @ w)
+
+    report, _ = sc.analyze_entry(
+        "fix@cold",
+        _entry(
+            "step", step,
+            lambda: (sds((8, 16), jnp.float32, col), sds((16, 16), jnp.float32, row)),
+        ),
+    )
+    assert report.error is None
+    assert "SC006" not in _rules_hit(report)
+    assert report.comms["collectives"].get("all-reduce", 0) >= 1
+    assert report.comms["hot_collectives"] == {}
+
+
+def test_sc006_suppression_carries_justification(monkeypatch):
+    monkeypatch.setitem(
+        sc.SHARD_SUPPRESSIONS, ("fix@hot", "step", "SC006"), "designed reduce"
+    )
+    report, _ = sc.analyze_entry("fix@hot", _sc006_fixture())
+    hot = [f for f in report.findings if f.rule.id == "SC006"]
+    assert hot and all(f.suppressed == "designed reduce" for f in hot)
+    assert report.failing == []
+
+
+# ---------------------------------------------------------------------------
+# SC007: silent full replication of an undeclared large input
+# ---------------------------------------------------------------------------
+
+
+def test_sc007_silent_replication(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_SHARD_REPLICATED_FLOOR", "1024")
+    mesh = _mesh8()
+    row = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def step(x, w):
+        return x @ w
+
+    report, _ = sc.analyze_entry(
+        "fix@repl",
+        _entry(
+            "step", step,
+            # w left UNSPECIFIED: the partitioner replicates all 16KiB of it
+            lambda: (sds((8, 64), jnp.float32, row), sds((64, 64), jnp.float32)),
+        ),
+    )
+    assert report.error is None
+    assert "SC007" in _rules_hit(report)
+    assert report.comms["replicated_inputs"], report.comms
+    assert report.comms["replicated_bytes"] >= 64 * 64 * 4
+
+
+def test_sc007_declared_replication_is_chosen_not_silent(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_SHARD_REPLICATED_FLOOR", "1024")
+    mesh = _mesh8()
+    row = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+
+    @jax.jit
+    def step(x, w):
+        return x @ w
+
+    report, _ = sc.analyze_entry(
+        "fix@repl",
+        _entry(
+            "step", step,
+            # same layout, but COMMITTED: P() says "replicate me" out loud
+            lambda: (
+                sds((8, 64), jnp.float32, row), sds((64, 64), jnp.float32, repl)
+            ),
+        ),
+    )
+    assert report.error is None
+    assert "SC007" not in _rules_hit(report)
+    assert report.comms["replicated_inputs"] == []
+
+
+def test_sc007_small_replicated_input_below_floor():
+    # default floor is 1MiB: a 16KiB weight replicating is normal, not a finding
+    mesh = _mesh8()
+    row = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def step(x, w):
+        return x @ w
+
+    report, _ = sc.analyze_entry(
+        "fix@repl",
+        _entry(
+            "step", step,
+            lambda: (sds((8, 64), jnp.float32, row), sds((64, 64), jnp.float32)),
+        ),
+    )
+    assert "SC007" not in _rules_hit(report)
+
+
+# ---------------------------------------------------------------------------
+# SC008: cross-jit data-edge sharding contracts
+# ---------------------------------------------------------------------------
+
+
+def _edge_plan(consumer_constraint):
+    """A two-jit plan with a declared producer->consumer edge. The producer
+    emits [8, 32] sharded over 'data'; the consumer's example leaves its
+    input UNDECLARED, and `consumer_constraint` decides what layout the
+    consumer's compiled executable actually wants."""
+    mesh = _mesh8()
+    row = NamedSharding(mesh, P("data", None))
+
+    @jax.jit
+    def produce(x):
+        return x * 2.0
+
+    @jax.jit
+    def consume(y):
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, consumer_constraint)
+        )
+        return y.sum()
+
+    entries = [
+        _entry("produce", produce, lambda: (sds((8, 32), jnp.float32, row),)),
+        _entry("consume", consume, lambda: (sds((8, 32), jnp.float32),)),
+    ]
+    return SimpleNamespace(
+        _entries=entries, edges=[DataEdge("produce", "consume", expect="match")]
+    )
+
+
+def test_sc008_matching_contract_ok():
+    reports, records, findings = sc.analyze_shard_plan(
+        "fix@edge", _edge_plan(P("data", None))
+    )
+    assert [r.error for r in reports] == [None, None]
+    assert records["produce->consume"]["status"] == "ok"
+    assert records["produce->consume"]["contract"]  # resolved pairs committed
+    assert findings == []
+
+
+def test_sc008_broken_contract_fires():
+    _, records, findings = sc.analyze_shard_plan(
+        "fix@edge", _edge_plan(P(None, "data"))  # consumer wants the OTHER axis
+    )
+    assert records["produce->consume"]["status"] == "mismatch"
+    assert [f.rule.id for f in findings] == ["SC008"]
+    assert "implicit reshard" in findings[0].message
+
+
+def test_sc008_reshard_edge_is_documented_contract():
+    plan = _edge_plan(P(None, "data"))
+    plan.edges = [DataEdge("produce", "consume", expect="reshard", note="on purpose")]
+    _, records, findings = sc.analyze_shard_plan("fix@edge", plan)
+    rec = records["produce->consume"]
+    assert rec["status"] == "ok" and rec["expect"] == "reshard"
+    assert rec["note"] == "on purpose"
+    assert findings == []  # the reshuffle is declared, not silent
+
+
+def test_sc008_unresolved_endpoint_recorded():
+    plan = _edge_plan(P("data", None))
+    plan.edges = [DataEdge("produce", "ghost", expect="match")]
+    _, records, findings = sc.analyze_shard_plan("fix@edge", plan)
+    assert records["produce->ghost"]["status"] == "unresolved"
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SC009: eager collectives in un-jitted host loops (source pass)
+# ---------------------------------------------------------------------------
+
+_SC009_BAD = """
+import jax
+
+def sync_loop(xs):
+    out = []
+    for x in xs:
+        out.append(jax.lax.psum(x, "i"))  # one dispatch per iteration
+    return out
+"""
+
+_SC009_CLEAN = """
+import jax
+
+def fused(xs):
+    def body(c, x):
+        return c + jax.lax.psum(x, "i"), ()
+    return jax.lax.scan(body, 0.0, xs)
+
+def hoisted(xs):
+    total = jax.lax.psum(xs, "i")
+    for x in total:
+        print(x)
+    return total
+"""
+
+_SC009_SUPPRESSED = """
+import jax
+from jax.experimental import multihost_utils
+
+def barrier_loop(steps):
+    for _ in range(steps):
+        # sheeplint: disable=SC009 — intentional per-step host barrier
+        multihost_utils.sync_global_devices("step")
+"""
+
+
+def test_sc009_eager_collective_in_host_loop(tmp_path):
+    path = tmp_path / "bad.py"
+    path.write_text(_SC009_BAD)
+    findings = sc.check_source_collectives([str(path)])
+    assert [f.rule.id for f in findings] == ["SC009"]
+    assert "jax.lax.psum" in findings[0].message
+
+
+def test_sc009_jitted_and_hoisted_are_clean(tmp_path):
+    path = tmp_path / "clean.py"
+    path.write_text(_SC009_CLEAN)
+    assert sc.check_source_collectives([str(path)]) == []
+
+
+def test_sc009_comment_suppression(tmp_path):
+    path = tmp_path / "sup.py"
+    path.write_text(_SC009_SUPPRESSED)
+    assert sc.check_source_collectives([str(path)]) == []
+
+
+def test_sc009_repo_is_clean():
+    import sheeprl_tpu
+
+    root = str(jc.os.path.dirname(sheeprl_tpu.__file__))
+    findings = sc.check_source_collectives([root])
+    assert findings == [], [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# HLO comms parsing + the wire model (deterministic unit receipts)
+# ---------------------------------------------------------------------------
+
+_HLO_FIXTURE = textwrap.dedent("""\
+    HloModule fix, num_partitions=8
+
+    %body (p: (f32[4,16], s32[])) -> (f32[4,16], s32[]) {
+      %p = parameter(0)
+      %ar = f32[4,16] all-reduce(f32[4,16] %x), replica_groups=[1,8]<=[8], to_apply=%sum
+      ROOT %t = tuple(%ar)
+    }
+
+    %cond (p: (f32[4,16], s32[])) -> pred[] {
+      %p = parameter(0)
+      ROOT %lt = pred[] compare(%i, %n), direction=LT
+    }
+
+    ENTRY %main (x: f32[4,16]) -> f32[4,16] {
+      %x = parameter(0)
+      %w = f32[4,16] while(%init), condition=%cond, body=%body, backend_config={"known_trip_count":{"n":"5"}}
+      %ag = f32[32,16] all-gather(f32[4,16] %x), replica_groups=[1,8]<=[8], dimensions={0}
+      ROOT %r = f32[4,16] add(%w, %x)
+    }
+""")
+
+
+def test_parse_hlo_comms_hot_and_cold():
+    parsed = sc.parse_hlo_comms(_HLO_FIXTURE)
+    assert parsed["num_partitions"] == 8
+    by_kind = {c.kind: c for c in parsed["collectives"]}
+    ar, ag = by_kind["all-reduce"], by_kind["all-gather"]
+    assert ar.hot and ar.trip_count == 5
+    assert not ag.hot
+    assert ar.groups == 1 and ar.group_size == 8
+    # ring model: all-reduce 2*(s-1)*B over the 4*16*4-byte payload
+    assert ar.wire_bytes == 2 * 7 * 4 * 16 * 4
+    # all-gather's full logical payload is its RESULT (32x16)
+    assert ag.wire_bytes == 7 * 32 * 16 * 4
+
+
+def test_estimate_wire_bytes_models():
+    b = 1024
+    assert sc.estimate_wire_bytes("all-reduce", b, b, 1, 8) == 2 * 7 * b
+    assert sc.estimate_wire_bytes("all-gather", 8 * b, b, 1, 8) == 7 * 8 * b
+    assert sc.estimate_wire_bytes("reduce-scatter", b, 8 * b, 1, 8) == 7 * 8 * b
+    assert sc.estimate_wire_bytes("collective-permute", b, b, 8, 1) == 8 * b
+    # two disjoint groups of 4 each
+    assert sc.estimate_wire_bytes("all-reduce", b, b, 2, 4) == 2 * 2 * 3 * b
+
+
+def test_replica_groups_both_syntaxes():
+    assert sc._replica_groups("replica_groups=[2,4]<=[8]", 8) == (2, 4)
+    assert sc._replica_groups("replica_groups={{0,1},{2,3}}", 8) == (2, 2)
+    assert sc._replica_groups("", 8) == (1, 8)
+
+
+# ---------------------------------------------------------------------------
+# the comms ledger: round-trip + drift gate on injected regressions
+# ---------------------------------------------------------------------------
+
+
+def _fixture_ledger():
+    report, _ = sc.analyze_entry("fix@hot", _sc006_fixture())
+    assert report.comms is not None
+    edges = {"fix@hot": {"a->b": {"expect": "match", "status": "ok", "contract": {}}}}
+    return sc.build_comms_budget([report], edges)
+
+
+def test_comms_budget_round_trip_clean():
+    ledger = _fixture_ledger()
+    failures, notes = sc.check_comms_budget(
+        ledger, json.loads(json.dumps(ledger))
+    )
+    assert failures == [] and notes == []
+
+
+def test_comms_gate_fails_on_injected_hot_all_gather():
+    """ISSUE acceptance: an extra all-gather appearing in a hot loop must
+    fail the gate — both as a new collective kind and as hot-loop growth."""
+    ledger = _fixture_ledger()
+    drifted = json.loads(json.dumps(ledger))
+    fp = drifted["comms"]["fix@hot/step"]
+    fp["collectives"]["all-gather"] = 1
+    fp["hot_collectives"]["all-gather"] = 1
+    failures, _ = sc.check_comms_budget(ledger, drifted)
+    assert any("new collective kind" in f and "all-gather" in f for f in failures)
+    assert any("hot-loop all-gather count grew" in f for f in failures)
+
+
+def test_comms_gate_fails_on_injected_replicated_param():
+    ledger = _fixture_ledger()
+    drifted = json.loads(json.dumps(ledger))
+    fp = drifted["comms"]["fix@hot/step"]
+    fp["replicated_inputs"] = ["1:float32[4096,4096]"]
+    failures, _ = sc.check_comms_budget(ledger, drifted)
+    assert any("newly replicated large tensor" in f for f in failures)
+
+
+def test_comms_gate_wire_bytes_tolerance():
+    ledger = _fixture_ledger()
+    grown = json.loads(json.dumps(ledger))
+    fp = grown["comms"]["fix@hot/step"]
+    fp["wire_bytes"] = int(ledger["comms"]["fix@hot/step"]["wire_bytes"] * 1.5) + 4096
+    failures, _ = sc.check_comms_budget(ledger, grown)
+    assert any("comms bytes grew" in f for f in failures)
+
+    shrunk = json.loads(json.dumps(ledger))
+    shrunk["comms"]["fix@hot/step"]["wire_bytes"] = 0
+    failures, notes = sc.check_comms_budget(ledger, shrunk)
+    assert failures == []
+    assert any("shrank" in n for n in notes)
+
+
+def test_comms_gate_fails_on_broken_edge_and_new_jit():
+    ledger = _fixture_ledger()
+    drifted = json.loads(json.dumps(ledger))
+    drifted["edges"]["fix@hot/a->b"]["status"] = "mismatch"
+    drifted["comms"]["fix@hot/new_jit"] = drifted["comms"]["fix@hot/step"]
+    failures, _ = sc.check_comms_budget(ledger, drifted)
+    assert any("contract broke" in f for f in failures)
+    assert any("new mesh-bearing jit" in f for f in failures)
+    gone = json.loads(json.dumps(ledger))
+    del gone["comms"]["fix@hot/step"]
+    failures, _ = sc.check_comms_budget(ledger, gone)
+    assert any("disappeared" in f for f in failures)
+
+
+def test_comms_reductions_are_notes():
+    ledger = _fixture_ledger()
+    improved = json.loads(json.dumps(ledger))
+    fp = improved["comms"]["fix@hot/step"]
+    fp["hot_collectives"] = {}
+    fp["collectives"] = {}
+    fp["wire_bytes"] = 0
+    failures, notes = sc.check_comms_budget(ledger, improved)
+    assert failures == []
+    assert any("eliminated" in n for n in notes)
+    assert any("hot-loop all-reduce count shrank" in n for n in notes)
+
+
+# ---------------------------------------------------------------------------
+# ledger persistence: per-algo dir layout <-> legacy blob
+# ---------------------------------------------------------------------------
+
+
+def test_budget_dir_layout_sections_coexist(tmp_path):
+    """sheepcheck owns `jits`, sheepshard owns `comms`+`edges` — each
+    saver rewrites only its sections and the other's survive."""
+    path = str(tmp_path / "budget.json")
+    jits = {
+        "version": 1, "jax_version": jax.__version__,
+        "tolerance": {"op_count_frac": 0.25},
+        "jits": {"algoX/train_step": {"op_count": 3, "dtypes": ["float32"]}},
+    }
+    jc.save_budget(jits, path, sections=("jits",))
+    comms = _fixture_ledger()
+    jc.save_budget(comms, path, sections=("comms", "edges"))
+    merged = jc.load_budget(path)
+    assert merged["jits"] == jits["jits"]
+    assert merged["comms"] == comms["comms"]
+    assert merged["edges"] == comms["edges"]
+    # tolerances merge rather than clobber
+    assert merged["tolerance"]["op_count_frac"] == 0.25
+    assert merged["tolerance"]["wire_bytes_frac"] == 0.25
+    # one file per spec, deterministic key order
+    assert sorted(p.name for p in tmp_path.glob("budget/*.json")) == [
+        "_meta.json", "algoX.json", "fix@hot.json",
+    ]
+    first = (tmp_path / "budget" / "algoX.json").read_text()
+    jc.save_budget(jits, path, sections=("jits",))
+    assert (tmp_path / "budget" / "algoX.json").read_text() == first
+
+
+def test_budget_legacy_blob_still_readable(tmp_path):
+    path = str(tmp_path / "budget.json")
+    blob = {"version": 1, "jits": {"a/b": {"op_count": 1}}}
+    with open(path, "w") as fh:
+        json.dump(blob, fh)
+    assert jc.budget_exists(path)
+    assert jc.load_budget(path) == blob
+    # the dir layout wins once it exists
+    jc.save_budget(blob, path, sections=("jits",))
+    assert jc.load_budget(path)["jits"] == blob["jits"]
+
+
+def test_committed_ledger_loads_in_dir_layout():
+    import sheeprl_tpu
+
+    repo = jc.os.path.dirname(jc.os.path.dirname(sheeprl_tpu.__file__))
+    ledger = jc.load_budget(jc.os.path.join(repo, "analysis", "budget.json"))
+    assert len(ledger["jits"]) >= 39
+    assert len(ledger["comms"]) >= 16
+    assert len(ledger["edges"]) >= 8
+    # every edge record resolved to a non-mismatch status at HEAD
+    for key, rec in ledger["edges"].items():
+        assert rec["status"] in ("ok", "unresolved"), (key, rec)
+
+
+# ---------------------------------------------------------------------------
+# the ppo@anakin cross-jit contract, end-to-end (the ROADMAP-4 slice)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(600)
+def test_ppo_anakin_edge_contract_end_to_end(tmp_path):
+    """Capture the real ppo@anakin main under the 8-mesh and resolve its
+    declared data edges: the rollout->gae handoff is device-to-device and
+    must MATCH; gae->train reshuffles on purpose (expect='reshard')."""
+    algo, extra_argv = sc.resolve_capture("ppo@anakin")
+    plan = jc.capture_plan(algo, str(tmp_path), extra_argv=extra_argv)
+    assert plan.edges, "ppo main declared no data edges"
+    reports, records, findings = sc.analyze_shard_plan("ppo@anakin", plan)
+    by_name = {r.name: r for r in reports}
+    assert by_name["anakin_rollout"].comms is not None
+    assert by_name["anakin_rollout"].comms["mesh"] == {"data": 8}
+    match_edge = records["anakin_rollout->gae"]
+    assert match_edge["expect"] == "match"
+    assert match_edge["status"] == "ok", match_edge
+    assert match_edge["contract"], "no aval groups resolved on the edge"
+    reshard_edge = records["gae->train_step"]
+    assert reshard_edge["expect"] == "reshard"
+    assert [f.format() for f in findings] == []
+    # and the whole spec is finding-free modulo justified suppressions
+    for r in reports:
+        assert r.failing == [], [f.format() for f in r.failing]

@@ -481,10 +481,64 @@ def test_sl009_negative_wrapped_scalars_and_plain_calls():
     assert ids(src) == []
 
 
+def test_sl010_positive_unsharded_batch_puts():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    from sheeprl_tpu.parallel import make_mesh
+
+    def main(rb, sampler):
+        mesh = make_mesh(8)
+        data = {k: jnp.asarray(v) for k, v in sampler(rb).sample(64).items()}
+        rows = jax.device_put(rb["observations"])
+        return data, rows
+    """
+    assert ids(src) == ["SL010", "SL010"]
+
+
+def test_sl010_negative_sharded_idiom_and_no_mesh():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    from sheeprl_tpu.parallel import make_mesh, shard_batch
+
+    def main(rb, sampler):
+        mesh = make_mesh(8)
+        # batch put + explicit shard downstream: the sanctioned idiom
+        data = {k: jnp.asarray(v) for k, v in sampler(rb).sample(64).items()}
+        data = shard_batch(data, mesh, axis=1)
+        # committed placement: device_put WITH a sharding
+        rows = jax.device_put(rb["observations"], mesh_sharding)
+        # not batch-shaped: per-step obs put
+        obs = {k: jnp.asarray(o[k]) for k in keys}
+        return data, rows, obs
+
+    def meshless(rb):
+        # no mesh in scope: single-device code is out of SL010's scope
+        return jnp.asarray(rb["observations"])
+    """
+    assert ids(src) == []
+
+
+def test_sl010_suppression_with_justification():
+    src = """
+    import jax.numpy as jnp
+    from sheeprl_tpu.parallel import make_mesh
+
+    def main(rb):
+        mesh = make_mesh(8)
+        # sheeplint: disable=SL010 — player-side GAE runs on one device by
+        # design; the update batch is resharded right after
+        data = {k: jnp.asarray(rb[k]) for k in keys}
+        return data
+    """
+    assert ids(src) == []
+
+
 def test_rule_catalog_complete():
     assert rule_ids() == [
         "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
-        "SL008", "SL009",
+        "SL008", "SL009", "SL010",
     ]
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")

@@ -6,7 +6,7 @@ Usage:
     python tools/sheepcheck.py                      # all 13 mains, SC rules
     python tools/sheepcheck.py ppo sac_ae           # a subset
     python tools/sheepcheck.py --list-rules
-    python tools/sheepcheck.py --update-budget      # refresh analysis/budget.json
+    python tools/sheepcheck.py --update-budget      # refresh analysis/budget/
     python tools/sheepcheck.py --check-budget       # the CI drift gate
     python tools/sheepcheck.py --rules SC001,SC002 --json
 
@@ -19,7 +19,11 @@ the algorithm executes. Each jit is then abstract-evaled to a ClosedJaxpr
 sheeprl_tpu/analysis/jaxpr_check.py + howto/static_analysis.md), and its
 compile-cost fingerprint (primitive histogram, op count, dtype set,
 donation map, cost_analysis FLOPs/bytes) is compared against — or written
-to — the committed `analysis/budget.json` ledger.
+to — the committed ledger: one file per algo/variant under
+`analysis/budget/` (the pre-split single-blob `analysis/budget.json` is
+still readable for one release). The SPMD/collective half of the ledger
+(`comms`/`edges` sections) belongs to tools/sheepshard.py and is preserved
+untouched by `--update-budget` here.
 
 Exit codes: 0 clean, 1 findings or budget drift, 2 capture/usage error.
 """
@@ -152,7 +156,7 @@ def main(argv: list[str] | None = None) -> int:
     budget_notes: list[str] = []
     derived = jc.build_budget([r for r in reports if r.fingerprint is not None])
     if ns.update_budget:
-        if ns.algos and os.path.exists(ns.budget):
+        if ns.algos and jc.budget_exists(ns.budget):
             # partial refresh: replace only the captured specs' entries —
             # a subset run must not drop the other mains from the ledger
             ledger = jc.load_budget(ns.budget)
@@ -168,7 +172,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"wrote {len(derived['jits'])} fingerprints to {ns.budget}",
               file=sys.stderr)
     elif ns.check_budget:
-        if not os.path.exists(ns.budget):
+        if not jc.budget_exists(ns.budget):
             print(f"no ledger at {ns.budget} (run --update-budget first)",
                   file=sys.stderr)
             return 2

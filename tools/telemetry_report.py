@@ -17,7 +17,13 @@ finished OR crashed run:
     (total compiles, compile seconds, recompiles AFTER the first logging
     interval — the retrace-storm signal);
   - health findings: `health.nan` events with the offending metric keys,
-    peak device memory.
+    peak device memory;
+  - a comms-budget summary (ISSUE 8) sourced from the COMMITTED sheepshard
+    ledger (`analysis/budget/`, `comms`/`edges` sections): per mesh-bearing
+    jit of the run's algo, its collective histogram, hot-loop collectives,
+    and estimated bytes-on-the-wire per dispatch, plus the declared data
+    edges' contract status — what the mesh costs per step, next to what the
+    run measured.
 
 Pure stdlib + the repo's telemetry package (no jax import), so it runs
 anywhere the JSONL can be copied to. `--selftest` synthesizes a small run
@@ -149,6 +155,95 @@ def summarize(events: list[dict]) -> dict:
                     summary["phase_seconds"].get(phase, 0.0) + secs
                 )
     return summary
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_comms_ledger(path: str | None = None) -> tuple[dict, dict]:
+    """`(comms, edges)` from the committed sheepshard ledger — the
+    `analysis/budget/` per-algo dir layout, with the legacy single-blob
+    fallback. Stdlib-only (this report must run anywhere the JSONL can be
+    copied to); missing ledger -> empty dicts."""
+    base = path or os.path.join(_REPO, "analysis", "budget")
+    comms: dict = {}
+    edges: dict = {}
+    try:
+        if os.path.isdir(base):
+            for name in sorted(os.listdir(base)):
+                if not name.endswith(".json") or name == "_meta.json":
+                    continue
+                with open(os.path.join(base, name), encoding="utf-8") as fh:
+                    blob = json.load(fh)
+                comms.update(blob.get("comms", {}))
+                edges.update(blob.get("edges", {}))
+        elif os.path.exists(base + ".json"):
+            with open(base + ".json", encoding="utf-8") as fh:
+                blob = json.load(fh)
+            comms = blob.get("comms", {})
+            edges = blob.get("edges", {})
+    except (OSError, json.JSONDecodeError):
+        return {}, {}
+    return comms, edges
+
+
+def _fmt_wire(n: float) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KiB"
+    return f"{n:.0f}B"
+
+
+def _hist(h: dict) -> str:
+    return (
+        ",".join(f"{k}x{v}" for k, v in sorted(h.items())) if h else "-"
+    )
+
+
+def render_comms_budget(comms: dict, edges: dict, algo: str | None = None) -> str:
+    """The comms-budget section: the committed per-jit collective ledger
+    filtered to `algo`'s mesh specs (a spec key is `<algo>[@variant]/<jit>`)."""
+
+    def of_algo(key: str) -> bool:
+        return algo is None or key.split("/", 1)[0].split("@", 1)[0] == algo
+
+    lines = ["== comms budget (committed sheepshard ledger) =="]
+    rows = [(k, v) for k, v in sorted(comms.items()) if of_algo(k)]
+    if not rows:
+        lines.append(
+            f"no mesh-bearing specs in the ledger for algo={algo!r} "
+            "(see howto/static_analysis.md, sheepshard)"
+        )
+        return "\n".join(lines)
+    widths = (
+        max(len("spec/jit"), *(len(k) for k, _ in rows)) + 2, 6, 26, 22, 12,
+    )
+    lines.append(
+        _fmt_row(("spec/jit", "parts", "collectives", "hot(in-loop)", "wire/step"), widths)
+    )
+    for key, fp in rows:
+        lines.append(_fmt_row(
+            (
+                key,
+                fp.get("num_partitions", 1),
+                _hist(fp.get("collectives", {})),
+                _hist(fp.get("hot_collectives", {})),
+                _fmt_wire(fp.get("wire_bytes", 0)),
+            ),
+            widths,
+        ))
+        for item in fp.get("replicated_inputs", []):
+            lines.append(f"  SILENTLY REPLICATED input {item}")
+    for key, rec in sorted(edges.items()):
+        if not of_algo(key):
+            continue
+        status = rec.get("status", "?")
+        flag = " <- RESHARD THRASH" if status == "mismatch" else ""
+        lines.append(
+            f"edge {key}: expect={rec.get('expect', '?')} status={status}{flag}"
+        )
+    return "\n".join(lines)
 
 
 def _fmt_row(cols, widths):
@@ -293,6 +388,12 @@ def report(path: str) -> dict:
     """Load + summarize + print; returns the summary (tests use it)."""
     summary = summarize(load_events(path))
     print(render(summary))
+    comms, edges = load_comms_ledger()
+    if comms or edges:
+        print()
+        print(render_comms_budget(
+            comms, edges, algo=(summary["start"] or {}).get("algo")
+        ))
     return summary
 
 
@@ -337,6 +438,29 @@ def selftest() -> int:
     assert len(summary["compile_events"]) == 1
     assert summary["compile_events"][0]["jit"] == "train_step"
     assert summary["compile_events"][0]["cache_misses"] == 1
+
+    # comms-budget section: writer (sheepshard ledger schema) and this
+    # reader stay in sync — rendered from a synthetic ledger, and the
+    # committed repo ledger must load without error wherever it exists
+    section = render_comms_budget(
+        {
+            "selftest@mesh8/train_step": {
+                "num_partitions": 8,
+                "collectives": {"all-reduce": 5},
+                "hot_collectives": {"all-reduce": 5},
+                "wire_bytes": 4 << 20,
+                "replicated_inputs": ["3:float32[1024,1024]"],
+            }
+        },
+        {"selftest@mesh8/rollout->train_step": {"expect": "match", "status": "mismatch"}},
+        algo="selftest",
+    )
+    assert "all-reducex5" in section and "4.0MiB" in section, section
+    assert "SILENTLY REPLICATED" in section and "RESHARD THRASH" in section
+    comms, edges = load_comms_ledger()
+    if comms:
+        assert all("/" in k for k in comms), "comms keys must be spec/jit"
+        assert all(r.get("status") for r in edges.values())
     print("\nselftest OK", file=sys.stderr)
     return 0
 

@@ -1841,7 +1841,250 @@ _METRIC_OF_ALGO = {
     ),
     "warm_compile": ("time_to_first_update_seconds", "seconds"),
     "anakin": ("anakin_env_steps_per_sec", "env-steps/sec"),
+    "train_speed": ("rssm_scan_step_seconds", "seconds/step"),
 }
+
+
+def _child_env(*, cold_compile: bool = False, **overrides) -> dict:
+    """Environment for measurement subprocesses (ISSUE 9 satellite).
+
+    `cold_compile=True` scrubs the operator's ambient persistent-cache
+    location (JAX_COMPILATION_CACHE_DIR — which `arm_compile_cache`
+    EXPORTS into this process's environ — and SHEEPRL_TPU_COMPILE_CACHE)
+    so a cold-compile arm actually pays its compile: jax honors the env
+    var natively, and a leaked warm disk cache was observed dropping the
+    warm_compile off-arm's train compile 27s -> 5s, voiding the
+    cold-vs-warm receipt. String overrides are applied last."""
+    import os
+
+    env = dict(os.environ)
+    if cold_compile:
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        env.pop("SHEEPRL_TPU_COMPILE_CACHE", None)
+    env.update({k: str(v) for k, v in overrides.items()})
+    return env
+
+
+def bench_train_speed() -> None:
+    """ISSUE 9 headline: per-kernel exec-time probes of the RSSM train-step
+    hot path (à la `sac_ae_compile_probe --sweep`) — CPU-receiptable, chip
+    numbers harvested opportunistically like every other rung.
+
+    Three arms over a real DV3-module RSSM at bench shapes:
+
+      1. **unroll ladder** (tentpole c receipt): `ops.scan.autotune_unroll`
+         on `rssm.scan_dynamic` — per-rung AOT compile + median exec
+         seconds, bit-exactness receipts, the measured winner and its
+         speedup vs unroll=1 (BENCHES.md round-4 hypothesis #2, now a
+         measured decision instead of a hypothesis);
+      2. **precision A/B** (tentpole a receipt): the same scan exec-timed
+         under f32 vs bf16 inputs (SHEEPRL_TPU_TRAIN_SPEED_PRECISION=
+         off|on|ab, default ab). On XLA:CPU bf16 is EMULATED and usually
+         loses — the ratio is recorded honestly either way; the chip arm
+         is where it pays;
+      3. **single-step probes**: one dynamic step as the decomposed module
+         calls vs the fused-step math (`rssm_step_reference`, the plain-XLA
+         twin of the Pallas kernel) as one jit each — what step-level
+         fusion buys BEFORE Pallas, i.e. the XLA-fallback floor the kernel
+         must beat on chip.
+
+    Shapes via env: SHEEPRL_TPU_TRAIN_SPEED_{T,B,R,HIDDEN,STOCH,DISCRETE,
+    EMB,ACT} (defaults T=32 B=8 R=256 — sized so the 5-rung ladder runs in
+    seconds on a 1-vCPU CPU host; chip runs raise them to DV3 defaults).
+    The ladder is forced fresh (no winner-store shortcut) and its store is
+    pointed at a throwaway file so a bench never pollutes a training run's
+    persisted winners."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu import nn, ops
+    from sheeprl_tpu.algos.dreamer_v3.agent import RSSM, RecurrentModel
+
+    T = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_T", "32"))
+    B = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_B", "8"))
+    R = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_R", "256"))
+    hidden = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_HIDDEN", "256"))
+    stoch = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_STOCH", "16"))
+    discrete = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_DISCRETE", "16"))
+    emb_dim = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_EMB", "256"))
+    act_dim = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_ACT", "4"))
+    precision_mode = os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_PRECISION", "ab")
+    repeats = int(os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_REPEATS", "5"))
+
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 4)
+    sd = stoch * discrete
+    rm = RecurrentModel.init(ks[0], sd + act_dim, R, R, layer_norm=True, activation="silu")
+    tm = nn.MLP.init(ks[1], R, [hidden], sd, act="silu", layer_norm=True,
+                     use_bias=False, norm_eps=1e-3)
+    pm = nn.MLP.init(ks[2], R + emb_dim, [hidden], sd, act="silu",
+                     layer_norm=True, use_bias=False, norm_eps=1e-3)
+    rssm = RSSM(recurrent_model=rm, representation_model=pm,
+                transition_model=tm, discrete=discrete, unimix=0.01)
+
+    def scan_example(dtype):
+        return (
+            rssm,
+            jnp.zeros((B, stoch, discrete), dtype),
+            jnp.zeros((B, R), dtype),
+            jnp.zeros((T, B, act_dim), dtype),
+            jnp.zeros((T, B, emb_dim), dtype),
+            jnp.zeros((T, B, 1), jnp.float32),
+            ks[3],
+        )
+
+    def probe(mod, post0, rec0, acts, emb, first, k):
+        return mod.scan_dynamic(post0, rec0, acts, emb, first, k)
+
+    store = os.path.join(tempfile.mkdtemp(prefix="bench_train_speed_"),
+                         "scan_unroll.json")
+
+    # ---- arm 1: the measured unroll ladder ---------------------------------
+    decision = ops.autotune_unroll(
+        "bench.rssm_dynamic", probe, scan_example(jnp.float32),
+        repeats=repeats, store_path=store, force=True, apply=False,
+    )
+    ladder = {str(r): t for r, t in sorted(decision.timings.items())}
+    win_speedup = (
+        decision.timings[1] / decision.timings[decision.winner]
+        if decision.timings.get(decision.winner) else 1.0
+    )
+
+    # ---- arm 1b: width sweep (SHEEPRL_TPU_TRAIN_SPEED_SWEEP=r1,r2,...) -----
+    # the unroll trade flips with arithmetic intensity: at DV3 widths the
+    # matmuls dominate and unroll=1 can win on CPU, at narrow widths the
+    # while-loop overhead dominates and rung 4+ wins big — the sweep shows
+    # the crossover instead of one point
+    sweep_spec = os.environ.get("SHEEPRL_TPU_TRAIN_SPEED_SWEEP", "")
+    sweep = {}
+    for r_width in [int(v) for v in sweep_spec.split(",") if v.strip()]:
+        s_rm = RecurrentModel.init(
+            ks[0], sd + act_dim, r_width, r_width, layer_norm=True,
+            activation="silu",
+        )
+        s_tm = nn.MLP.init(ks[1], r_width, [r_width], sd, act="silu",
+                           layer_norm=True, use_bias=False, norm_eps=1e-3)
+        s_pm = nn.MLP.init(ks[2], r_width + r_width, [r_width], sd,
+                           act="silu", layer_norm=True, use_bias=False,
+                           norm_eps=1e-3)
+        s_rssm = RSSM(recurrent_model=s_rm, representation_model=s_pm,
+                      transition_model=s_tm, discrete=discrete, unimix=0.01)
+        s_example = (
+            s_rssm,
+            jnp.zeros((B, stoch, discrete), jnp.float32),
+            jnp.zeros((B, r_width), jnp.float32),
+            jnp.zeros((T, B, act_dim), jnp.float32),
+            jnp.zeros((T, B, r_width), jnp.float32),
+            jnp.zeros((T, B, 1), jnp.float32),
+            ks[3],
+        )
+        d = ops.autotune_unroll(
+            f"bench.rssm_dynamic.R{r_width}", probe, s_example,
+            repeats=repeats, store_path=store, force=True, apply=False,
+        )
+        sweep[str(r_width)] = {
+            "ladder_s": {str(r): t for r, t in sorted(d.timings.items())},
+            "winner": d.winner,
+            "speedup_vs_1": (
+                d.timings[1] / d.timings[d.winner] if d.timings.get(d.winner) else 1.0
+            ),
+            "bit_exact": all(d.bit_exact.values()),
+        }
+
+    # ---- arm 2: precision A/B on the same scan -----------------------------
+    precision_ab = None
+    if precision_mode in ("on", "ab"):
+        def timed(dtype):
+            with ops.scan.unroll(1):
+                compiled = jax.jit(probe).lower(*scan_example(dtype)).compile()
+                ex = scan_example(dtype)
+                jax.block_until_ready(compiled(*ex))
+                samples = []
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(compiled(*ex))
+                    samples.append(time.perf_counter() - t0)
+                samples.sort()
+                return samples[len(samples) // 2]
+
+        bf16_s = timed(jnp.bfloat16)
+        f32_s = decision.timings[1] if precision_mode == "ab" else timed(jnp.float32)
+        precision_ab = {
+            "f32_s": f32_s,
+            "bf16_s": bf16_s,
+            "bf16_speedup": f32_s / bf16_s if bf16_s else 0.0,
+        }
+
+    # ---- arm 3: single-step probes (module path vs fused-step math) --------
+    from sheeprl_tpu.ops.pallas_kernels import rssm_step_reference
+
+    x1 = jax.random.normal(ks[3], (B, sd + act_dim))
+    h1 = jax.random.normal(ks[3], (B, R))
+    e1 = jax.random.normal(ks[3], (B, emb_dim))
+
+    def step_modules(x, h, emb):
+        h2 = rssm.recurrent_model(x, h)
+        return h2, rssm.transition_model(h2), rssm.representation_model(
+            jnp.concatenate([h2, emb], axis=-1)
+        )
+
+    def step_fused_math(x, h, emb):
+        mlp, rnn = rm.mlp, rm.rnn
+        return rssm_step_reference(
+            x, h, emb,
+            mlp.layers[0].weight, mlp.norms[0].scale, mlp.norms[0].offset,
+            rnn.proj.weight, rnn.norm.scale, rnn.norm.offset,
+            tm.layers[0].weight, tm.norms[0].scale, tm.norms[0].offset,
+            tm.head.weight, tm.head.bias,
+            pm.layers[0].weight, pm.norms[0].scale, pm.norms[0].offset,
+            pm.head.weight, pm.head.bias,
+        )
+
+    def time_step(fn):
+        compiled = jax.jit(fn).lower(x1, h1, e1).compile()
+        jax.block_until_ready(compiled(x1, h1, e1))
+        samples = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(compiled(x1, h1, e1))
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    step_probes = {
+        "module_path_s": time_step(step_modules),
+        "fused_math_s": time_step(step_fused_math),
+    }
+
+    per_step = decision.timings[decision.winner] / T
+    print(json.dumps({
+        "metric": "rssm_scan_step_seconds",
+        "value": per_step,
+        "unit": "seconds/step",
+        "vs_baseline": 0.0,
+        "config": {
+            "T": T, "B": B, "R": R, "hidden": hidden, "stoch": stoch,
+            "discrete": discrete, "emb": emb_dim, "act": act_dim,
+            "repeats": repeats, "backend": jax.default_backend(),
+            "host_cpus": os.cpu_count(),
+        },
+        "unroll_ladder_s": ladder,
+        "unroll_compile_s": {
+            str(r): t for r, t in sorted(decision.compile_seconds.items())
+        },
+        "unroll_bit_exact": {
+            str(r): v for r, v in sorted(decision.bit_exact.items())
+        },
+        "unroll_winner": decision.winner,
+        "unroll_winner_speedup_vs_1": win_speedup,
+        "unroll_width_sweep": sweep or None,
+        "precision_ab": precision_ab,
+        "step_probes": step_probes,
+        "baseline_note": BASELINE_NOTE,
+    }))
 
 
 def bench_anakin() -> None:
@@ -1879,7 +2122,9 @@ def bench_anakin() -> None:
         and jax.local_device_count() == 1
         and os.environ.get("SHEEPRL_TPU_ANAKIN_NO_REEXEC") != "1"
     ):
-        env = dict(os.environ)
+        # cold_compile: the re-exec'd measurement records its compile
+        # seconds in the artifact — don't let an ambient cache zero them
+        env = _child_env(cold_compile=True)
         env["XLA_FLAGS"] = (
             env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
         ).strip()
@@ -2101,13 +2346,10 @@ def bench_warm_compile() -> None:
     unroll = int(os.environ.get("SHEEPRL_TPU_WARM_BENCH_UNROLL", "8"))
     budget_s = float(os.environ.get("SHEEPRL_TPU_WARM_BENCH_BUDGET_S", "900"))
     root = tempfile.mkdtemp(prefix="bench_warm_compile_")
-    env = dict(os.environ)
-    # a leaked cache location would hand either arm a warm DISK cache and
-    # void the measurement — jax honors JAX_COMPILATION_CACHE_DIR natively
-    # even when our own arming is disabled (observed: the off arm's train
-    # compile dropped 27s -> 5s through the bench parent's exported cache)
-    env.pop("JAX_COMPILATION_CACHE_DIR", None)
-    env.pop("SHEEPRL_TPU_COMPILE_CACHE", None)
+    # cold_compile: a leaked cache location would hand either arm a warm
+    # DISK cache and void the measurement (the observed 27s -> 5s
+    # pollution _child_env documents)
+    env = _child_env(cold_compile=True)
     env.update(
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
@@ -2553,7 +2795,9 @@ def _cpu_fallback_receipt(timeout_s: float = 1500.0) -> dict | None:
 
     if os.environ.get("SHEEPRL_TPU_BENCH_CPU_FALLBACK") == "1":
         return None  # we ARE the fallback: no recursion
-    env = dict(os.environ)
+    # cold_compile: the smoke's compile_seconds_total/cache-hit receipt
+    # must reflect ITS cache arming, not the operator's exported one
+    env = _child_env(cold_compile=True)
     env.update(
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
@@ -2740,6 +2984,8 @@ def main() -> None:
         bench_warm_compile()
     elif opts.algo == "anakin":
         bench_anakin()
+    elif opts.algo == "train_speed":
+        bench_train_speed()
     else:
         bench_dreamer_v3(tiny=opts.tiny, pipeline_mode=opts.pipeline)
 

@@ -18,16 +18,6 @@ from typing import Any, Optional
 from ..utils.parser import Arg
 
 
-def require_float32(args: "StandardArgs") -> None:
-    """Guard for tasks without a bf16 compute path: reject the flag loudly
-    instead of silently training in f32 (call at the top of `main()`)."""
-    if args.precision != "float32":
-        raise NotImplementedError(
-            "--precision bfloat16 is currently implemented for "
-            "dreamer_v2/dreamer_v3/p2e_dv2 only"
-        )
-
-
 @dataclasses.dataclass
 class StandardArgs:
     exp_name: str = Arg(default="default", help="name of this experiment")
@@ -73,7 +63,17 @@ class StandardArgs:
     num_devices: int = Arg(
         default=-1, help="number of devices in the data mesh axis; -1 = all local devices"
     )
-    precision: str = Arg(default="float32", help="compute dtype for the train step (float32|bfloat16)")
+    precision: str = Arg(
+        default="float32",
+        help="compute dtype for the train step (float32|bfloat16). "
+        "'bfloat16' is accepted by ALL tasks (the old "
+        "dreamer-family-only guard is lifted, ISSUE 9): network "
+        "forwards+backwards run in bf16 while master params, optimizer "
+        "moments, losses and return/advantage math stay float32 "
+        "(ops/precision.py); checkpoints always hold the fp32 master "
+        "weights. Audit the fp32 islands with "
+        "`tools/sheepcheck.py --audit-bf16`",
+    )
     profile: bool = Arg(
         default=False,
         help="capture a jax.profiler trace (XProf/TensorBoard 'profile' "

@@ -88,18 +88,25 @@ class RSSMV1(nn.Module):
     min_std: float = nn.static(default=0.1)
 
     def _representation(self, recurrent_state, embedded_obs, key=None):
-        return compute_stochastic_state(
+        """Mean/std/sampling run in f32 even under bf16 compute (the KL and
+        reparameterized gradients need the precision); the sample is cast
+        back to the compute dtype for the recurrent path."""
+        (mean, std), state = compute_stochastic_state(
             self.representation_model(
                 jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
-            ),
+            ).astype(jnp.float32),
             min_std=self.min_std,
             key=key,
         )
+        return (mean, std), state.astype(recurrent_state.dtype)
 
     def _transition(self, recurrent_out, key=None):
-        return compute_stochastic_state(
-            self.transition_model(recurrent_out), min_std=self.min_std, key=key
+        (mean, std), state = compute_stochastic_state(
+            self.transition_model(recurrent_out).astype(jnp.float32),
+            min_std=self.min_std,
+            key=key,
         )
+        return (mean, std), state.astype(recurrent_out.dtype)
 
     def dynamic(self, posterior, recurrent_state, action, embedded_obs, key):
         """One dynamic-learning step (reference agent.py:81-118). Returns
@@ -158,10 +165,11 @@ class PlayerDV1(PlayerDV3):
     reshape). `discrete_size` is unused (the state is continuous)."""
 
     def init_states(self, n_envs: int) -> PlayerState:
+        dt = jnp.dtype(self.compute_dtype)
         return PlayerState(
-            actions=jnp.zeros((n_envs, int(sum(self.actions_dim)))),
-            recurrent_state=jnp.zeros((n_envs, self.recurrent_state_size)),
-            stochastic_state=jnp.zeros((n_envs, self.stochastic_size)),
+            actions=jnp.zeros((n_envs, int(sum(self.actions_dim))), dt),
+            recurrent_state=jnp.zeros((n_envs, self.recurrent_state_size), dt),
+            stochastic_state=jnp.zeros((n_envs, self.stochastic_size), dt),
         )
 
     def step(
@@ -175,6 +183,8 @@ class PlayerDV1(PlayerDV3):
     ) -> tuple[PlayerState, jax.Array]:
         """One greedy+exploration action step (reference agent.py:261-315)."""
         k_repr, k_act, k_expl = jax.random.split(key, 3)
+        dt = jnp.dtype(self.compute_dtype)
+        obs = {k: v.astype(dt) for k, v in obs.items()}
         embedded = self.encoder(obs)
         recurrent = self.rssm.recurrent_model(
             jnp.concatenate([state.stochastic_state, state.actions], axis=-1),
@@ -185,7 +195,8 @@ class PlayerDV1(PlayerDV3):
         actions, _ = self.actor(latent, key=k_act, is_training=is_training, mask=mask)
         cat = exploration_actions(actions, self.is_continuous, expl_amount, k_expl)
         return PlayerState(
-            actions=cat, recurrent_state=recurrent, stochastic_state=stochastic
+            actions=cat.astype(dt), recurrent_state=recurrent,
+            stochastic_state=stochastic,
         ), cat
 
 

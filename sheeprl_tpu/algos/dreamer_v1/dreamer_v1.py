@@ -52,7 +52,6 @@ from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from ..ppo.agent import (
     buffer_actions,
     env_action_indices,
@@ -104,24 +103,31 @@ def make_train_step(
     dreamer_v1.py:40-356)."""
     constrain = make_constrain(mesh)
     horizon = args.horizon
+    # --precision bfloat16: model forwards run in bf16, params stay f32,
+    # Gaussian means/stds, losses and lambda-return math stay f32
+    # (ops/precision.py — the shared mixed-precision policy)
+    compute_dtype = ops.precision.compute_dtype(args.precision)
 
     def train_step(state: DV1TrainState, data: dict, key):
         T, B = data["dones"].shape[:2]
         scan_spec = scan_batch_spec(mesh, B)
         k_wm, k_img = jax.random.split(key)
-        batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
-        batch_obs.update({k: data[k] for k in mlp_keys})
+        obs_targets = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
+        obs_targets.update({k: data[k] for k in mlp_keys})
+        batch_obs = {k: v.astype(compute_dtype) for k, v in obs_targets.items()}
 
         # ---- world model -----------------------------------------------------
         def world_loss_fn(wm: WorldModel):
             embedded = constrain_scan_inputs(constrain, scan_spec, wm.encoder(batch_obs))
-            posterior0 = jnp.zeros((B, args.stochastic_size))
-            recurrent0 = jnp.zeros((B, args.recurrent_state_size))
+            posterior0 = jnp.zeros((B, args.stochastic_size), compute_dtype)
+            recurrent0 = jnp.zeros((B, args.recurrent_state_size), compute_dtype)
             recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds = (
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain_scan_inputs(constrain, scan_spec, data["actions"]),
+                    constrain_scan_inputs(
+                        constrain, scan_spec, data["actions"].astype(compute_dtype)
+                    ),
                     embedded,
                     k_wm,
                     remat=args.remat,
@@ -135,7 +141,11 @@ def make_train_step(
                 from_spec=scan_spec,
             )
             latent_states = jnp.concatenate([posteriors, recurrent_states], axis=-1)
-            decoded = wm.observation_model(latent_states)
+            # fp32 island: likelihood/KL math runs full width
+            decoded = {
+                k: v.astype(jnp.float32)
+                for k, v in wm.observation_model(latent_states).items()
+            }
             qo = {
                 k: Independent(
                     base=Normal(loc=decoded[k], scale=jnp.ones_like(decoded[k])),
@@ -143,13 +153,15 @@ def make_train_step(
                 )
                 for k in decoded
             }
-            qr_mean = wm.reward_model(latent_states)
+            qr_mean = wm.reward_model(latent_states).astype(jnp.float32)
             qr = Independent(
                 base=Normal(loc=qr_mean, scale=jnp.ones_like(qr_mean)), event_ndims=1
             )
             if args.use_continues:
                 qc = Independent(
-                    base=Bernoulli(logits=wm.continue_model(latent_states)),
+                    base=Bernoulli(
+                        logits=wm.continue_model(latent_states).astype(jnp.float32)
+                    ),
                     event_ndims=1,
                 )
                 continue_targets = (1.0 - data["dones"]) * args.gamma
@@ -157,7 +169,7 @@ def make_train_step(
                 qc = continue_targets = None
             losses = reconstruction_loss(
                 qo,
-                batch_obs,
+                obs_targets,
                 qr,
                 data["rewards"],
                 (post_means, post_stds),
@@ -199,7 +211,9 @@ def make_train_step(
                 latent = jnp.concatenate([prior, recurrent], axis=-1)
                 k_act, k_trans = jax.random.split(k)
                 acts, _ = actor(jax.lax.stop_gradient(latent), key=k_act)
-                action = jnp.concatenate(acts, axis=-1)
+                # actions sample from f32 logits; the imagination recurrence
+                # runs in the compute dtype
+                action = jnp.concatenate(acts, axis=-1).astype(prior.dtype)
                 new_prior, new_recurrent = world_model.rssm.imagination(
                     prior, recurrent, action, k_trans
                 )
@@ -215,12 +229,16 @@ def make_train_step(
                 unroll=ops.scan_unroll(),
             )  # [H, T*B, L]
 
-            predicted_values = state.critic(imagined_trajectories)
-            predicted_rewards = world_model.reward_model(imagined_trajectories)
+            predicted_values = state.critic(imagined_trajectories).astype(jnp.float32)
+            predicted_rewards = world_model.reward_model(
+                imagined_trajectories
+            ).astype(jnp.float32)
             if args.use_continues:
                 predicted_continues = Independent(
                     base=Bernoulli(
-                        logits=world_model.continue_model(imagined_trajectories)
+                        logits=world_model.continue_model(
+                            imagined_trajectories
+                        ).astype(jnp.float32)
                     ),
                     event_ndims=1,
                 ).mean
@@ -262,7 +280,7 @@ def make_train_step(
         lambda_sg = jax.lax.stop_gradient(lambda_values)
 
         def critic_loss_fn(critic):
-            qv_mean = critic(traj_sg)[:-1]
+            qv_mean = critic(traj_sg).astype(jnp.float32)[:-1]
             qv = Independent(
                 base=Normal(loc=qv_mean, scale=jnp.ones_like(qv_mean)), event_ndims=1
             )
@@ -317,7 +335,6 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV1Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -424,6 +441,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             stochastic_size=args.stochastic_size,
             recurrent_state_size=args.recurrent_state_size,
             is_continuous=is_continuous,
+            compute_dtype=args.precision,
         )
 
     player = make_player(state)

@@ -75,7 +75,13 @@ from ..dreamer_v3.dreamer_v3 import _random_actions
 from .agent import PlayerDV2, build_models
 from .args import DreamerV2Args
 from .loss import reconstruction_loss
-from .utils import make_device_preprocess, make_row_codec, substitute_step_obs, test
+from .utils import (
+    make_device_preprocess,
+    make_row_codec,
+    maybe_autotune_scan_unroll,
+    substitute_step_obs,
+    test,
+)
 
 
 class DV2TrainState(nn.Module):
@@ -130,7 +136,7 @@ def make_train_step(
     action_splits = np.cumsum(actions_dim)[:-1]
     # --precision bfloat16: model forwards run in bf16, params stay f32,
     # logits/losses stay f32 (same policy as dreamer_v3.make_train_step)
-    compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
+    compute_dtype = ops.precision.compute_dtype(args.precision)
 
     constrain = make_constrain(mesh)
 
@@ -479,6 +485,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         envs.single_observation_space.spaces,
         cnn_keys,
         mlp_keys,
+    )
+    maybe_autotune_scan_unroll(
+        "dreamer_v2", world_model, args, int(sum(actions_dim)), telem
     )
     world_optimizer, actor_optimizer, critic_optimizer = make_optimizers(args)
     state = DV2TrainState(

@@ -14,11 +14,64 @@ from ..ppo.agent import one_hot_to_env_actions
 __all__ = [
     "preprocess_obs",
     "make_device_preprocess",
+    "maybe_autotune_scan_unroll",
     "substitute_step_obs",
     "make_row_codec",
     "make_blob_row",
     "test",
 ]
+
+
+def maybe_autotune_scan_unroll(algo, world_model, args, act_dim, telem):
+    """SHEEPRL_TPU_SCAN_UNROLL=auto: run the measured unroll ladder
+    (ops/scan.py, ISSUE 9) on this run's RSSM dynamic scan at its EXACT
+    shapes BEFORE the train jit traces, install the winner as the process
+    override, and record the ladder (per-rung exec/compile seconds,
+    bit-exactness receipts) as a `scan_unroll` telemetry event.
+
+    The probe is the scan alone — the train step's dominant while-loop —
+    not the whole update: five trial compiles of the full train jit would
+    cost more than they save, while the scan segment compiles in well
+    under a second per rung and its winner transfers (the imagination scan
+    shares shapes' order of magnitude and reads the same knob). A repeat
+    run with the same shapes skips the ladder through the winner store
+    next to the compile cache."""
+    import jax.numpy as jnp
+
+    from ... import ops
+
+    if ops.unroll_mode() != "auto":
+        return None
+    T = int(args.per_rank_sequence_length)
+    B = int(args.per_rank_batch_size)
+    cdt = ops.precision.compute_dtype(args.precision)
+    emb_dim = world_model.encoder.output_dim
+    discrete = getattr(args, "discrete_size", 0) or 0
+    stoch = (
+        (B, args.stochastic_size, discrete)
+        if discrete
+        else (B, args.stochastic_size)
+    )
+
+    def probe(wm, post0, rec0, acts, emb, first, k):
+        return wm.rssm.scan_dynamic(post0, rec0, acts, emb, first, k)
+
+    example = (
+        world_model,
+        jnp.zeros(stoch, cdt),
+        jnp.zeros((B, args.recurrent_state_size), cdt),
+        jnp.zeros((T, B, int(act_dim)), cdt),
+        jnp.zeros((T, B, emb_dim), cdt),
+        jnp.zeros((T, B, 1), jnp.float32),
+        jax.random.PRNGKey(args.seed),
+    )
+    decision = ops.autotune_unroll(
+        f"{algo}.rssm_dynamic[T={T},B={B},R={args.recurrent_state_size}]",
+        probe,
+        example,
+    )
+    telem.event("scan_unroll", **decision.as_event())
+    return decision
 
 
 def preprocess_obs(obs: dict, cnn_keys, mlp_keys) -> dict:

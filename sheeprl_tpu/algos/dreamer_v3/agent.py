@@ -354,26 +354,93 @@ class RSSM(nn.Module):
         mixed = unimix_logits(shaped, self.unimix)
         return mixed.reshape(logits.shape)
 
+    def _mix_sample(self, raw: jax.Array, key, out_dtype):
+        """Raw head output -> (unimixed f32 logits, sampled one-hot state in
+        the compute dtype). The fp32 island shared by the plain-XLA heads
+        and the fused Pallas step (which emits raw logits already in f32)."""
+        logits = self._uniform_mix(raw.astype(jnp.float32))
+        state = compute_stochastic_state(logits, self.discrete, key)
+        return logits, state.astype(out_dtype)
+
     def _transition(self, recurrent_out: jax.Array, key=None):
         """-> (prior_logits [..., S*D], prior [..., S, D]); mode when key=None.
 
         Logits/unimix/sampling run in f32 even under bf16 compute (the KL and
         straight-through gradients need the precision); the sampled one-hot
         state is cast back to the compute dtype for the recurrent path."""
-        logits = self._uniform_mix(
-            self.transition_model(recurrent_out).astype(jnp.float32)
+        return self._mix_sample(
+            self.transition_model(recurrent_out), key, recurrent_out.dtype
         )
-        state = compute_stochastic_state(logits, self.discrete, key)
-        return logits, state.astype(recurrent_out.dtype)
 
     def _representation(self, recurrent_state: jax.Array, embedded_obs: jax.Array, key=None):
-        logits = self._uniform_mix(
+        return self._mix_sample(
             self.representation_model(
                 jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
-            ).astype(jnp.float32)
+            ),
+            key,
+            recurrent_state.dtype,
         )
-        state = compute_stochastic_state(logits, self.discrete, key)
-        return logits, state.astype(recurrent_state.dtype)
+
+    def _fused_step_weights(self, x: jax.Array, embedded_obs: jax.Array):
+        """The fused-kernel weight tuple when this RSSM's module structure
+        matches the kernel's contract (ops/pallas_kernels.fused_rssm_step),
+        else None -> the caller stays on the plain-XLA path.
+
+        Contract: single-hidden-layer LN MLPs without hidden biases (the
+        DV3 `use_bias=not layer_norm` layout), a bias-free LN-GRU, one
+        shared activation, and a weight set that fits the VMEM budget."""
+        from ...ops.pallas_kernels import fused_rssm_supported, use_pallas
+
+        if not use_pallas("rssm") or x.ndim != 2:
+            return None
+        rm, tm, pm = self.recurrent_model, self.transition_model, self.representation_model
+        mlp = getattr(rm, "mlp", None)
+        rnn = getattr(rm, "rnn", None)
+        if mlp is None or rnn is None:
+            return None
+
+        def one_hidden(m):
+            return (
+                len(m.layers) == 1
+                and m.norms[0] is not None
+                and m.norms[0].scale is not None
+                and m.layers[0].bias is None
+            )
+
+        if not (one_hidden(mlp) and one_hidden(tm) and one_hidden(pm)):
+            return None
+        if mlp.head is not None or tm.head is None or pm.head is None:
+            return None
+        if tm.head.bias is None or pm.head.bias is None:
+            return None
+        norm = getattr(rnn, "norm", None)
+        if norm is None or norm.scale is None or rnn.proj.bias is not None:
+            return None
+        if not (mlp.act == tm.act == pm.act):
+            return None
+        dt = x.dtype
+        weights = (
+            mlp.layers[0].weight.astype(dt),
+            mlp.norms[0].scale,
+            mlp.norms[0].offset,
+            rnn.proj.weight.astype(dt),
+            norm.scale,
+            norm.offset,
+            tm.layers[0].weight.astype(dt),
+            tm.norms[0].scale,
+            tm.norms[0].offset,
+            tm.head.weight.astype(dt),
+            tm.head.bias,
+            pm.layers[0].weight.astype(dt),
+            pm.norms[0].scale,
+            pm.norms[0].offset,
+            pm.head.weight.astype(dt),
+            pm.head.bias,
+        )
+        if not fused_rssm_supported(mlp.act or "identity", *weights):
+            return None
+        eps = (mlp.norms[0].eps, norm.eps, tm.norms[0].eps)
+        return weights, (mlp.act or "identity"), eps
 
     def dynamic(
         self,
@@ -398,13 +465,30 @@ class RSSM(nn.Module):
         init_post = self._transition(recurrent_state, key=None)[1]
         init_post = init_post.reshape(posterior_flat.shape)
         posterior_flat = (1.0 - is_first) * posterior_flat + is_first * init_post
-        recurrent_state = self.recurrent_model(
-            jnp.concatenate([posterior_flat, action], axis=-1), recurrent_state
-        )
-        prior_logits, prior = self._transition(recurrent_state, key=k_prior)
-        posterior_logits, posterior = self._representation(
-            recurrent_state, embedded_obs, key=k_post
-        )
+        x = jnp.concatenate([posterior_flat, action], axis=-1)
+        fused = self._fused_step_weights(x, embedded_obs)
+        if fused is not None:
+            # fused Pallas step (ISSUE 9): pre-MLP + LN-GRU + both head
+            # stacks in ONE kernel, VMEM-resident; raw logits come back in
+            # f32 and share the same unimix/sampling island as the XLA path
+            from ...ops.pallas_kernels import fused_rssm_step
+
+            weights, act, eps = fused
+            recurrent_state, prior_raw, post_raw = fused_rssm_step(
+                x, recurrent_state, embedded_obs, *weights, act, eps
+            )
+            prior_logits, prior = self._mix_sample(
+                prior_raw, k_prior, recurrent_state.dtype
+            )
+            posterior_logits, posterior = self._mix_sample(
+                post_raw, k_post, recurrent_state.dtype
+            )
+        else:
+            recurrent_state = self.recurrent_model(x, recurrent_state)
+            prior_logits, prior = self._transition(recurrent_state, key=k_prior)
+            posterior_logits, posterior = self._representation(
+                recurrent_state, embedded_obs, key=k_post
+            )
         return recurrent_state, posterior, prior, posterior_logits, prior_logits
 
     def scan_dynamic(

@@ -92,6 +92,7 @@ from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from .agent import PlayerDV3, WorldModel, build_models
 from .args import DreamerV3Args
 from .loss import reconstruction_loss
+from ..dreamer_v2.utils import maybe_autotune_scan_unroll
 from .utils import make_device_preprocess, test
 
 
@@ -159,7 +160,7 @@ def make_train_step(
     # --precision bfloat16: model forwards (conv trunks, RSSM scan,
     # imagination) run in bf16 — params stay f32 (every layer casts its
     # weights to the input dtype), normalizations/logits/losses stay f32
-    compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
+    compute_dtype = ops.precision.compute_dtype(args.precision)
 
     constrain = make_constrain(mesh)
 
@@ -587,6 +588,11 @@ def main(argv: Sequence[str] | None = None) -> None:
         observation_space.spaces,
         cnn_keys,
         mlp_keys,
+    )
+    # SHEEPRL_TPU_SCAN_UNROLL=auto: measure the unroll ladder on this run's
+    # RSSM scan shapes and install the winner before any train jit traces
+    maybe_autotune_scan_unroll(
+        "dreamer_v3", world_model, args, int(sum(actions_dim)), telem
     )
     world_optimizer, actor_optimizer, critic_optimizer = make_optimizers(args)
     moments = ops.Moments.init(

@@ -23,22 +23,26 @@ class DROQCritic(nn.Module):
     (reference agent.py:16-56)."""
 
     model: nn.MLP
+    compute_dtype: str = nn.static(default="float32")
 
     @classmethod
     def init(
         cls, key, input_dim: int, *, hidden_size: int = 256,
-        num_outputs: int = 1, dropout: float = 0.0,
+        num_outputs: int = 1, dropout: float = 0.0, precision: str = "float32",
     ):
         return cls(
             model=nn.MLP.init(
                 key, input_dim, [hidden_size, hidden_size], num_outputs,
                 act="relu", layer_norm=True, dropout_rate=dropout,
-            )
+            ),
+            compute_dtype=precision,
         )
 
     def __call__(self, obs, action, *, key=None, training: bool = False):
-        x = jnp.concatenate([obs, action], axis=-1)
-        return self.model(x, key=key, training=training)
+        dt = jnp.dtype(self.compute_dtype)
+        x = jnp.concatenate([obs.astype(dt), action.astype(dt)], axis=-1)
+        # fp32 island: Q-values feed Bellman targets and MSE reductions
+        return self.model(x, key=key, training=training).astype(jnp.float32)
 
 
 class DROQCriticEnsemble(nn.Module):
@@ -48,10 +52,14 @@ class DROQCriticEnsemble(nn.Module):
     n: int = nn.static()
 
     @classmethod
-    def init(cls, key, n: int, input_dim: int, *, hidden_size: int = 256, dropout: float = 0.0):
+    def init(
+        cls, key, n: int, input_dim: int, *, hidden_size: int = 256,
+        dropout: float = 0.0, precision: str = "float32",
+    ):
         members = jax.vmap(
             lambda k: DROQCritic.init(
-                k, input_dim, hidden_size=hidden_size, dropout=dropout
+                k, input_dim, hidden_size=hidden_size, dropout=dropout,
+                precision=precision,
             )
         )(jax.random.split(key, n))
         return cls(members=members, n=n)
@@ -95,16 +103,19 @@ class DROQAgent(nn.Module):
         alpha: float = 1.0,
         tau: float = 0.005,
         target_entropy: float | None = None,
+        precision: str = "float32",
     ):
         k_actor, k_critic = jax.random.split(key)
         actor = SACActor.init(
             k_actor, observation_dim, action_dim,
             hidden_size=actor_hidden_size,
             action_low=action_low, action_high=action_high,
+            precision=precision,
         )
         critics = DROQCriticEnsemble.init(
             k_critic, num_critics, observation_dim + action_dim,
             hidden_size=critic_hidden_size, dropout=dropout,
+            precision=precision,
         )
         return cls(
             actor=actor,

@@ -48,7 +48,6 @@ from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from ..sac.loss import critic_loss, entropy_loss, policy_loss
 from ..sac.sac import make_optimizers, policy_step
 from ..sac.utils import test
@@ -149,7 +148,6 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DROQArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -204,6 +202,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         action_low=envs.single_action_space.low,
         action_high=envs.single_action_space.high,
         alpha=args.alpha, tau=args.tau,
+        precision=args.precision,
     )
     qf_optim, actor_optim, alpha_optim = make_optimizers(args)
     state = TrainState(

@@ -60,7 +60,6 @@ from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from ..ppo.agent import (
     buffer_actions,
     env_action_indices,
@@ -130,6 +129,10 @@ def make_train_step(
      actor_expl_optimizer, critic_expl_optimizer, ensemble_optimizer) = optimizers
     horizon = args.horizon
     constrain = make_constrain(mesh)
+    # --precision bfloat16: same policy as dreamer_v1 — forwards (incl. the
+    # disagreement ensembles) in bf16, params/losses/means/stds f32
+    # (ops/precision.py)
+    compute_dtype = ops.precision.compute_dtype(args.precision)
 
     def behaviour_update(
         actor, critic, actor_opt, critic_opt, actor_optimizer_, critic_optimizer_,
@@ -145,7 +148,9 @@ def make_train_step(
                 latent = jnp.concatenate([prior, recurrent], axis=-1)
                 k_act, k_trans = jax.random.split(k)
                 acts, _ = actor(jax.lax.stop_gradient(latent), key=k_act)
-                action = jnp.concatenate(acts, axis=-1)
+                # actions sample from f32 logits; the imagination recurrence
+                # runs in the compute dtype
+                action = jnp.concatenate(acts, axis=-1).astype(prior.dtype)
                 new_prior, new_recurrent = world_model.rssm.imagination(
                     prior, recurrent, action, k_trans
                 )
@@ -158,12 +163,14 @@ def make_train_step(
                 img_step, (imagined_prior0, recurrent0), img_keys,
                 unroll=ops.scan_unroll(),
             )  # [H, T*B, L] / [H, T*B, A]
-            predicted_values = critic(imagined_trajectories)
+            predicted_values = critic(imagined_trajectories).astype(jnp.float32)
             rewards = reward_fn(imagined_trajectories, imagined_actions)
             if args.use_continues:
                 predicted_continues = Independent(
                     base=Bernoulli(
-                        logits=world_model.continue_model(imagined_trajectories)
+                        logits=world_model.continue_model(
+                            imagined_trajectories
+                        ).astype(jnp.float32)
                     ),
                     event_ndims=1,
                 ).mean
@@ -201,7 +208,7 @@ def make_train_step(
         lambda_sg = jax.lax.stop_gradient(lambda_values)
 
         def critic_loss_fn(critic):
-            qv_mean = critic(traj_sg)[:-1]
+            qv_mean = critic(traj_sg).astype(jnp.float32)[:-1]
             qv = Independent(
                 base=Normal(loc=qv_mean, scale=jnp.ones_like(qv_mean)), event_ndims=1
             )
@@ -224,19 +231,22 @@ def make_train_step(
         T, B = data["dones"].shape[:2]
         scan_spec = scan_batch_spec(mesh, B)
         k_wm, k_expl, k_task = jax.random.split(key, 3)
-        batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
-        batch_obs.update({k: data[k] for k in mlp_keys})
+        obs_targets = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
+        obs_targets.update({k: data[k] for k in mlp_keys})
+        batch_obs = {k: v.astype(compute_dtype) for k, v in obs_targets.items()}
 
         # ---- world model (reward/continue on detached latents) --------------
         def world_loss_fn(wm: WorldModel):
             embedded = constrain_scan_inputs(constrain, scan_spec, wm.encoder(batch_obs))
-            posterior0 = jnp.zeros((B, args.stochastic_size))
-            recurrent0 = jnp.zeros((B, args.recurrent_state_size))
+            posterior0 = jnp.zeros((B, args.stochastic_size), compute_dtype)
+            recurrent0 = jnp.zeros((B, args.recurrent_state_size), compute_dtype)
             recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds = (
                 wm.rssm.scan_dynamic(
                     posterior0,
                     recurrent0,
-                    constrain_scan_inputs(constrain, scan_spec, data["actions"]),
+                    constrain_scan_inputs(
+                        constrain, scan_spec, data["actions"].astype(compute_dtype)
+                    ),
                     embedded,
                     k_wm,
                     remat=args.remat,
@@ -251,7 +261,11 @@ def make_train_step(
             )
             latent_states = jnp.concatenate([posteriors, recurrent_states], axis=-1)
             latents_sg = jax.lax.stop_gradient(latent_states)
-            decoded = wm.observation_model(latent_states)
+            # fp32 island: likelihood/KL math runs full width
+            decoded = {
+                k: v.astype(jnp.float32)
+                for k, v in wm.observation_model(latent_states).items()
+            }
             qo = {
                 k: Independent(
                     base=Normal(loc=decoded[k], scale=jnp.ones_like(decoded[k])),
@@ -259,20 +273,23 @@ def make_train_step(
                 )
                 for k in decoded
             }
-            qr_mean = wm.reward_model(latents_sg)
+            qr_mean = wm.reward_model(latents_sg).astype(jnp.float32)
             qr = Independent(
                 base=Normal(loc=qr_mean, scale=jnp.ones_like(qr_mean)), event_ndims=1
             )
             if args.use_continues:
                 qc = Independent(
-                    base=Bernoulli(logits=wm.continue_model(latents_sg)), event_ndims=1
+                    base=Bernoulli(
+                        logits=wm.continue_model(latents_sg).astype(jnp.float32)
+                    ),
+                    event_ndims=1,
                 )
                 continue_targets = (1.0 - data["dones"]) * args.gamma
             else:
                 qc = continue_targets = None
             losses = reconstruction_loss(
                 qo,
-                batch_obs,
+                obs_targets,
                 qr,
                 data["rewards"],
                 (post_means, post_stds),
@@ -328,16 +345,17 @@ def make_train_step(
             ens_input = jnp.concatenate(
                 [jax.lax.stop_gradient(posteriors).reshape(T, B, -1),
                  jax.lax.stop_gradient(recurrent_states),
-                 jax.lax.stop_gradient(data["actions"])],
+                 jax.lax.stop_gradient(data["actions"]).astype(compute_dtype)],
                 axis=-1,
             )
             embedded_sg = jax.lax.stop_gradient(embedded)
 
             def ensemble_loss_fn(ens):
-                out = ensemble_apply(ens, ens_input)[:, :-1]  # [N, T-1, B, E]
+                # fp32 island: Gaussian log-prob over the f32 targets
+                out = ensemble_apply(ens, ens_input)[:, :-1].astype(jnp.float32)
                 log_prob = Independent(
                     base=Normal(loc=out, scale=jnp.ones_like(out)), event_ndims=1
-                ).log_prob(embedded_sg[1:])
+                ).log_prob(embedded_sg.astype(jnp.float32)[1:])
                 return -log_prob.mean(axis=(1, 2)).sum()
 
             ensemble_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(ensembles)
@@ -357,8 +375,9 @@ def make_train_step(
                         axis=-1,
                     ),
                 )  # [N_ens, H, T*B, E]
+                # fp32 island: the disagreement variance is a reduction
                 return (
-                    preds.var(axis=0).mean(axis=-1, keepdims=True)
+                    preds.astype(jnp.float32).var(axis=0).mean(axis=-1, keepdims=True)
                     * args.intrinsic_reward_multiplier
                 )
 
@@ -385,7 +404,7 @@ def make_train_step(
 
         # ---- task behaviour (zero-shot, extrinsic reward model) -------------
         def extrinsic_reward_fn(traj, actions):
-            return world_model.reward_model(traj)
+            return world_model.reward_model(traj).astype(jnp.float32)
 
         actor_task, critic_task, actor_task_opt, critic_task_opt, task_metrics = (
             behaviour_update(
@@ -431,7 +450,6 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(P2EDV1Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -552,6 +570,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             stochastic_size=args.stochastic_size,
             recurrent_state_size=args.recurrent_state_size,
             is_continuous=is_continuous,
+            compute_dtype=args.precision,
         )
 
     # raw obs puts (uint8 pixels), normalized inside the jit; the same

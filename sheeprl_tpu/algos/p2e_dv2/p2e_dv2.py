@@ -135,7 +135,7 @@ def make_train_step(
     action_splits = np.cumsum(actions_dim)[:-1]
     # --precision bfloat16: same policy as dreamer_v2/dreamer_v3 — forwards
     # in bf16, f32 master params, f32 logits/losses/ensemble-disagreement
-    compute_dtype = jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32
+    compute_dtype = ops.precision.compute_dtype(args.precision)
     constrain = make_constrain(mesh)
 
     def behaviour_update(

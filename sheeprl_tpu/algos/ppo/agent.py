@@ -41,9 +41,12 @@ class CNNEncoder(nn.Module):
         )
         return cls(model=model, keys=tuple(keys))
 
-    def __call__(self, obs: dict) -> jax.Array:
+    def __call__(self, obs: dict, dtype=jnp.float32) -> jax.Array:
         x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
-        return self.model(x.astype(jnp.float32) / 255.0)
+        # uint8 pixels normalize straight into the compute dtype (bf16
+        # under --precision bfloat16): [0,1] is exactly representable and
+        # the conv trunk follows its input
+        return self.model(x.astype(dtype) / 255.0)
 
     @property
     def output_dim(self) -> int:
@@ -67,9 +70,9 @@ class MLPEncoder(nn.Module):
         )
         return cls(model=model, keys=tuple(keys))
 
-    def __call__(self, obs: dict) -> jax.Array:
+    def __call__(self, obs: dict, dtype=jnp.float32) -> jax.Array:
         x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
-        return self.model(x)
+        return self.model(x.astype(dtype))
 
     @property
     def output_dim(self) -> int:
@@ -84,6 +87,9 @@ class PPOAgent(nn.Module):
     critic: nn.MLP
     actions_dim: tuple[int, ...] = nn.static()
     is_continuous: bool = nn.static(default=False)
+    # mixed precision (ops/precision.py): encoders/backbone/critic trunk run
+    # in this dtype; logits and values upcast to the fp32 island
+    compute_dtype: str = nn.static(default="float32")
 
     @classmethod
     def init(
@@ -105,6 +111,7 @@ class PPOAgent(nn.Module):
         actor_hidden_size: int | None = None,
         critic_hidden_size: int | None = None,
         cnn_channels_multiplier: int = 1,
+        precision: str = "float32",
     ):
         if actor_hidden_size is None:
             actor_hidden_size = dense_units
@@ -158,20 +165,24 @@ class PPOAgent(nn.Module):
             critic=critic,
             actions_dim=tuple(int(d) for d in actions_dim),
             is_continuous=is_continuous,
+            compute_dtype=precision,
         )
 
     # -- internals -----------------------------------------------------------
     def features(self, obs: dict) -> jax.Array:
+        dt = jnp.dtype(self.compute_dtype)
         feats = []
         if self.cnn_encoder is not None:
-            feats.append(self.cnn_encoder(obs))
+            feats.append(self.cnn_encoder(obs, dtype=dt))
         if self.mlp_encoder is not None:
-            feats.append(self.mlp_encoder(obs))
+            feats.append(self.mlp_encoder(obs, dtype=dt))
         return jnp.concatenate(feats, axis=-1)
 
     def _pre_dist(self, feat: jax.Array) -> list[jax.Array]:
         out = self.actor_backbone(feat)
-        return [head(out) for head in self.actor_heads]
+        # fp32 island: distribution math (log-softmax, Gaussian log-probs,
+        # entropies) always runs full width, whatever the trunk dtype
+        return [head(out).astype(jnp.float32) for head in self.actor_heads]
 
     # -- public API ----------------------------------------------------------
     def __call__(self, obs: dict, actions: jax.Array | None = None, *, key=None):
@@ -184,7 +195,7 @@ class PPOAgent(nn.Module):
         """
         feat = self.features(obs)
         pre_dist = self._pre_dist(feat)
-        values = self.critic(feat)
+        values = self.critic(feat).astype(jnp.float32)
         if self.is_continuous:
             mean, log_std = jnp.split(pre_dist[0], 2, axis=-1)
             normal = D.Independent(
@@ -215,7 +226,8 @@ class PPOAgent(nn.Module):
         )
 
     def get_value(self, obs: dict) -> jax.Array:
-        return self.critic(self.features(obs))
+        # fp32 island: values feed GAE/returns
+        return self.critic(self.features(obs)).astype(jnp.float32)
 
     def get_greedy_actions(self, obs: dict) -> jax.Array:
         feat = self.features(obs)

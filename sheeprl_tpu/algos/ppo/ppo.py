@@ -66,7 +66,6 @@ from ...utils.logger import create_logger
 from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from ...utils.parser import DataclassArgumentParser
 from .agent import (
     PPOAgent,
@@ -229,7 +228,6 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(PPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -303,6 +301,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         actor_hidden_size=args.actor_hidden_size,
         critic_hidden_size=args.critic_hidden_size,
         cnn_channels_multiplier=args.cnn_channels_multiplier,
+        precision=args.precision,
     )
     optimizer = make_optimizer(args)
     state = TrainState(agent=agent, opt_state=optimizer.init(agent))

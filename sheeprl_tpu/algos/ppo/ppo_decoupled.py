@@ -41,7 +41,6 @@ from ...utils.profiler import StepProfiler
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from .agent import PPOAgent, buffer_actions, indices_to_env_actions
 from .args import PPOArgs
 from .ppo import (
@@ -66,7 +65,6 @@ def main(argv: Sequence[str] | None = None) -> None:
         from .ppo import main as coupled_main
 
         return coupled_main(argv)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -117,6 +115,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         actor_hidden_size=args.actor_hidden_size,
         critic_hidden_size=args.critic_hidden_size,
         cnn_channels_multiplier=args.cnn_channels_multiplier,
+        precision=args.precision,
     )
     optimizer = make_optimizer(args)
     state = TrainState(agent=agent, opt_state=optimizer.init(agent))

@@ -28,6 +28,9 @@ class RecurrentPPOAgent(nn.Module):
     critic_rnn: nn.LSTMCell
     critic: nn.MLP
     lstm_hidden_size: int = nn.static(default=64)
+    # mixed precision (ops/precision.py): pre-LSTM projections, both LSTM
+    # scans and the trunks run in this dtype; logits/values upcast to f32
+    compute_dtype: str = nn.static(default="float32")
 
     @classmethod
     def init(
@@ -41,6 +44,7 @@ class RecurrentPPOAgent(nn.Module):
         actor_pre_lstm_hidden_size: int | None = None,
         critic_hidden_size: int = 128,
         critic_pre_lstm_hidden_size: int | None = None,
+        precision: str = "float32",
     ):
         keys = jax.random.split(key, 6)
         actor_fc = None
@@ -77,26 +81,32 @@ class RecurrentPPOAgent(nn.Module):
             critic_rnn=critic_rnn,
             critic=critic,
             lstm_hidden_size=lstm_hidden_size,
+            compute_dtype=precision,
         )
 
     def initial_states(self, n_envs: int) -> RecurrentState:
-        z = jnp.zeros((n_envs, self.lstm_hidden_size))
+        # the LSTM carry must live in the compute dtype — a stray f32 state
+        # would promote every scan step back to full width
+        z = jnp.zeros((n_envs, self.lstm_hidden_size), jnp.dtype(self.compute_dtype))
         return ((z, z), (z, z))
 
     # -- sequence forwards ([L, B, D] inputs) --------------------------------
     def get_logits(self, obs, actor_state, reset_mask=None):
+        obs = obs.astype(jnp.dtype(self.compute_dtype))
         x = self.actor_fc(obs) if self.actor_fc is not None else obs
         actor_state, hidden = nn.scan_cell(
             self.actor_rnn, x, actor_state, reset_mask=reset_mask
         )
-        return self.actor_logits(hidden), actor_state
+        # fp32 island: log-softmax/ratio math runs full width
+        return self.actor_logits(hidden).astype(jnp.float32), actor_state
 
     def get_values(self, obs, critic_state, reset_mask=None):
+        obs = obs.astype(jnp.dtype(self.compute_dtype))
         x = self.critic_fc(obs) if self.critic_fc is not None else obs
         critic_state, hidden = nn.scan_cell(
             self.critic_rnn, x, critic_state, reset_mask=reset_mask
         )
-        return self.critic(hidden), critic_state
+        return self.critic(hidden).astype(jnp.float32), critic_state
 
     def __call__(self, obs, state: RecurrentState, reset_mask=None):
         """-> (logits [L,B,A], values [L,B,1], new state)."""
@@ -110,12 +120,13 @@ class RecurrentPPOAgent(nn.Module):
         """-> (action [N], logprob [N,1], value [N,1], new state); greedy
         when `key` is None (reference get_greedy_action, agent.py:86-92)."""
         (ah, ac), (ch, cc) = state
+        obs = obs.astype(jnp.dtype(self.compute_dtype))
         x_a = self.actor_fc(obs) if self.actor_fc is not None else obs
         _, (ah, ac) = self.actor_rnn(x_a, (ah, ac))
-        logits = self.actor_logits(ah)
+        logits = self.actor_logits(ah).astype(jnp.float32)
         x_c = self.critic_fc(obs) if self.critic_fc is not None else obs
         _, (ch, cc) = self.critic_rnn(x_c, (ch, cc))
-        value = self.critic(ch)
+        value = self.critic(ch).astype(jnp.float32)
         log_probs = jax.nn.log_softmax(logits, axis=-1)
         if key is None:
             action = jnp.argmax(logits, axis=-1)

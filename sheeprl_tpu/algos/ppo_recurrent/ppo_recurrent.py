@@ -57,7 +57,6 @@ from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from ..ppo.loss import entropy_loss, policy_loss, value_loss
 from ..ppo.ppo import make_optimizer
 from .agent import RecurrentPPOAgent
@@ -182,7 +181,6 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(RecurrentPPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -243,6 +241,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         actor_pre_lstm_hidden_size=args.actor_pre_lstm_hidden_size,
         critic_hidden_size=args.critic_hidden_size,
         critic_pre_lstm_hidden_size=args.critic_pre_lstm_hidden_size,
+        precision=args.precision,
     )
     optimizer = make_optimizer(args)
     state = TrainState(agent=agent, opt_state=optimizer.init(agent))
@@ -290,19 +289,23 @@ def main(argv: Sequence[str] | None = None) -> None:
 
             sharding = NamedSharding(mesh, PartitionSpec(None, "data"))
 
-        def leaf(shape):
-            return sds((seq_len, n_sequences) + shape, jnp.float32, sharding=sharding)
+        def leaf(shape, dtype=jnp.float32):
+            return sds((seq_len, n_sequences) + shape, dtype, sharding=sharding)
 
+        # the stored LSTM states ride the ring in the compute dtype
+        # (ops/precision.py): under --precision bfloat16 the windows arrive
+        # bf16 and the registered avals must match for the warm AOT path
+        cdt = ops.precision.compute_dtype(args.precision)
         windows = {
             "observations": leaf(obs_dim_t),
             "dones": leaf((1,)),
             "actions": leaf((1,)),
             "logprobs": leaf((1,)),
             "values": leaf((1,)),
-            "actor_hxs": leaf((lstm_hidden,)),
-            "actor_cxs": leaf((lstm_hidden,)),
-            "critic_hxs": leaf((lstm_hidden,)),
-            "critic_cxs": leaf((lstm_hidden,)),
+            "actor_hxs": leaf((lstm_hidden,), cdt),
+            "actor_cxs": leaf((lstm_hidden,), cdt),
+            "critic_hxs": leaf((lstm_hidden,), cdt),
+            "critic_cxs": leaf((lstm_hidden,), cdt),
             "returns": leaf((1,)),
             "advantages": leaf((1,)),
         }
@@ -394,8 +397,11 @@ def main(argv: Sequence[str] | None = None) -> None:
             next_done = dones[:, None]
             if args.reset_recurrent_state_on_done:
                 d = jnp.asarray(dones)[:, None]
+                # per-leaf dtype cast: a f32 mask would promote bf16 LSTM
+                # states and drift the policy jit's avals (retrace + warm
+                # AOT fallback)
                 agent_state = jax.tree_util.tree_map(
-                    lambda s: (1.0 - d) * s, new_state
+                    lambda s: (1.0 - d).astype(s.dtype) * s, new_state
                 )
             else:
                 agent_state = new_state

@@ -39,6 +39,10 @@ class SACActor(nn.Module):
     fc_logstd: nn.Linear
     action_scale: jax.Array
     action_bias: jax.Array
+    # mixed precision (ops/precision.py): the MLP trunk runs in this dtype
+    # (weights follow the input), the mean/log_std heads upcast to f32 so
+    # the tanh-Gaussian log-prob math stays full width
+    compute_dtype: str = nn.static(default="float32")
 
     @classmethod
     def init(
@@ -50,6 +54,7 @@ class SACActor(nn.Module):
         hidden_size: int = 256,
         action_low=-1.0,
         action_high=1.0,
+        precision: str = "float32",
     ):
         k_m, k_mu, k_std = jax.random.split(key, 3)
         model = nn.MLP.init(
@@ -59,6 +64,7 @@ class SACActor(nn.Module):
             model=model,
             fc_mean=nn.Linear.init(k_mu, hidden_size, action_dim),
             fc_logstd=nn.Linear.init(k_std, hidden_size, action_dim),
+            compute_dtype=precision,
             action_scale=jnp.asarray(
                 (np.asarray(action_high) - np.asarray(action_low)) / 2.0,
                 dtype=jnp.float32,
@@ -70,9 +76,13 @@ class SACActor(nn.Module):
         )
 
     def dist_params(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
-        x = self.model(obs)
-        mean = self.fc_mean(x)
-        log_std = jnp.clip(self.fc_logstd(x), LOG_STD_MIN, LOG_STD_MAX)
+        x = self.model(obs.astype(jnp.dtype(self.compute_dtype)))
+        # fp32 island: distribution parameters (and everything downstream —
+        # sampling, log-prob, tanh correction) stay full width
+        mean = self.fc_mean(x).astype(jnp.float32)
+        log_std = jnp.clip(
+            self.fc_logstd(x).astype(jnp.float32), LOG_STD_MIN, LOG_STD_MAX
+        )
         return mean, jnp.exp(log_std)
 
     @property
@@ -113,17 +123,25 @@ class SACCritic(nn.Module):
     (reference agent.py:16-50)."""
 
     model: nn.MLP
+    compute_dtype: str = nn.static(default="float32")
 
     @classmethod
-    def init(cls, key, input_dim: int, *, hidden_size: int = 256, num_outputs: int = 1):
+    def init(
+        cls, key, input_dim: int, *, hidden_size: int = 256,
+        num_outputs: int = 1, precision: str = "float32",
+    ):
         return cls(
             model=nn.MLP.init(
                 key, input_dim, [hidden_size, hidden_size], num_outputs, act="relu"
-            )
+            ),
+            compute_dtype=precision,
         )
 
     def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
-        return self.model(jnp.concatenate([obs, action], axis=-1))
+        dt = jnp.dtype(self.compute_dtype)
+        x = jnp.concatenate([obs.astype(dt), action.astype(dt)], axis=-1)
+        # fp32 island: Q-values feed Bellman targets and MSE reductions
+        return self.model(x).astype(jnp.float32)
 
 
 class CriticEnsemble(nn.Module):
@@ -134,9 +152,14 @@ class CriticEnsemble(nn.Module):
     n: int = nn.static()
 
     @classmethod
-    def init(cls, key, n: int, input_dim: int, *, hidden_size: int = 256):
+    def init(
+        cls, key, n: int, input_dim: int, *, hidden_size: int = 256,
+        precision: str = "float32",
+    ):
         members = jax.vmap(
-            lambda k: SACCritic.init(k, input_dim, hidden_size=hidden_size)
+            lambda k: SACCritic.init(
+                k, input_dim, hidden_size=hidden_size, precision=precision
+            )
         )(jax.random.split(key, n))
         return cls(members=members, n=n)
 
@@ -172,6 +195,7 @@ class SACAgent(nn.Module):
         alpha: float = 1.0,
         tau: float = 0.005,
         target_entropy: float | None = None,
+        precision: str = "float32",
     ):
         k_actor, k_critic = jax.random.split(key)
         actor = SACActor.init(
@@ -181,12 +205,14 @@ class SACAgent(nn.Module):
             hidden_size=actor_hidden_size,
             action_low=action_low,
             action_high=action_high,
+            precision=precision,
         )
         critics = CriticEnsemble.init(
             k_critic,
             num_critics,
             observation_dim + action_dim,
             hidden_size=critic_hidden_size,
+            precision=precision,
         )
         return cls(
             actor=actor,

@@ -55,7 +55,6 @@ from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from .agent import SACAgent
 from .args import SACArgs
 from .loss import critic_loss, entropy_loss, policy_loss
@@ -167,7 +166,6 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -224,6 +222,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         action_low=envs.single_action_space.low,
         action_high=envs.single_action_space.high,
         alpha=args.alpha, tau=args.tau,
+        precision=args.precision,
     )
     qf_optim, actor_optim, alpha_optim = make_optimizers(args)
     state = TrainState(

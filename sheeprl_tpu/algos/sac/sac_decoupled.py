@@ -37,7 +37,6 @@ from ...utils.profiler import StepProfiler
 from ...utils.metric import MetricAggregator
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from .agent import SACAgent
 from .args import SACArgs
 from ...compile import CompilePlan
@@ -55,7 +54,6 @@ def main(argv: Sequence[str] | None = None) -> None:
         from .sac import main as coupled_main
 
         return coupled_main(argv)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -111,6 +109,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         action_low=envs.single_action_space.low,
         action_high=envs.single_action_space.high,
         alpha=args.alpha, tau=args.tau,
+        precision=args.precision,
     )
     qf_optim, actor_optim, alpha_optim = make_optimizers(args)
     state = TrainState(

@@ -265,7 +265,10 @@ class SACAEQFunction(nn.Module):
         )
 
     def __call__(self, features: jax.Array, action: jax.Array) -> jax.Array:
-        return self.model(jnp.concatenate([features, action], axis=-1))
+        # the action follows the encoder features' (compute) dtype; the
+        # Q-value upcasts to the fp32 island for Bellman/MSE math
+        x = jnp.concatenate([features, action.astype(features.dtype)], axis=-1)
+        return self.model(x).astype(jnp.float32)
 
 
 class SACAEQEnsemble(nn.Module):
@@ -354,8 +357,10 @@ class SACAEContinuousActor(nn.Module):
 
     def dist_params(self, encoder, obs: dict, detach: bool = False):
         x = self.model(self.features(encoder, obs, detach))
-        mean = self.fc_mean(x)
-        log_std = jnp.tanh(self.fc_logstd(x))
+        # fp32 island: distribution parameters and the tanh-Gaussian
+        # log-prob math stay full width under bf16 compute
+        mean = self.fc_mean(x).astype(jnp.float32)
+        log_std = jnp.tanh(self.fc_logstd(x).astype(jnp.float32))
         log_std = LOG_STD_MIN + 0.5 * (LOG_STD_MAX - LOG_STD_MIN) * (log_std + 1.0)
         return mean, jnp.exp(log_std)
 

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ... import nn
+from ... import nn, ops
 from ...data import ReplayBuffer
 from ...envs import make_vector_env
 from ...parallel import (
@@ -54,7 +54,6 @@ from ...utils.metric import MetricAggregator
 from ...utils.profiler import StepProfiler
 from ...utils.parser import DataclassArgumentParser
 from ...utils.registry import register_algorithm
-from ..args import require_float32
 from ..ppo.ppo import validate_obs_keys
 from ..sac.loss import critic_loss, entropy_loss, policy_loss
 from .agent import (
@@ -104,17 +103,20 @@ def _select(flag, new_tree, old_tree):
     )
 
 
-def _make_normalize(cnn_keys, mlp_keys):
+def _make_normalize(cnn_keys, mlp_keys, compute_dtype=jnp.float32):
     """Shared by the fused and split train-step factories: the two paths'
-    parity guarantee requires identical preprocessing."""
+    parity guarantee requires identical preprocessing. `compute_dtype` is
+    the mixed-precision policy's network dtype (ops/precision.py): the
+    encoder/critic/actor trunks follow their inputs, so normalizing
+    straight into bf16 runs every forward at half width."""
     obs_keys = (*cnn_keys, *mlp_keys)
 
     def normalize(batch, prefix=""):
         return {
             k: (
-                batch[prefix + k].astype(jnp.float32) / 255.0
+                batch[prefix + k].astype(compute_dtype) / 255.0
                 if k in cnn_keys
-                else batch[prefix + k].astype(jnp.float32)
+                else batch[prefix + k].astype(compute_dtype)
             )
             for k in obs_keys
         }
@@ -142,7 +144,10 @@ def _make_loss_fns(args: SACAEArgs, cnn_keys, mlp_keys):
         enc, dec = enc_dec
         hidden = enc(obs)
         recon = dec(hidden)
-        l2 = jnp.mean(0.5 * jnp.sum(jnp.square(hidden), axis=-1))
+        # fp32 island: MSE/L2 reductions run full width whatever the
+        # encoder/decoder compute dtype
+        hidden32 = hidden.astype(jnp.float32)
+        l2 = jnp.mean(0.5 * jnp.sum(jnp.square(hidden32), axis=-1))
         loss = 0.0
         for k in obs_keys:
             if k in cnn_keys:
@@ -152,7 +157,7 @@ def _make_loss_fns(args: SACAEArgs, cnn_keys, mlp_keys):
                 )
             else:
                 target = batch[k].astype(jnp.float32)
-            loss += jnp.mean(jnp.square(target - recon[k]))
+            loss += jnp.mean(jnp.square(target - recon[k].astype(jnp.float32)))
             loss += args.decoder_l2_lambda * l2
         return loss
 
@@ -161,7 +166,9 @@ def _make_loss_fns(args: SACAEArgs, cnn_keys, mlp_keys):
 
 def make_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys):
     qf_optim, actor_optim, alpha_optim, encoder_optim, decoder_optim = optimizers
-    normalize = _make_normalize(cnn_keys, mlp_keys)
+    normalize = _make_normalize(
+        cnn_keys, mlp_keys, ops.precision.compute_dtype(args.precision)
+    )
     actor_loss_fn, recon_loss_fn = _make_loss_fns(args, cnn_keys, mlp_keys)
 
     def gradient_step(carry, inp):
@@ -287,7 +294,9 @@ def make_split_train_step(args: SACAEArgs, optimizers, cnn_keys, mlp_keys, recon
     warm-start CompilePlan can AOT-compile each piece, and ``.recon_chunk``.
     """
     qf_optim, actor_optim, alpha_optim, encoder_optim, decoder_optim = optimizers
-    normalize = _make_normalize(cnn_keys, mlp_keys)
+    normalize = _make_normalize(
+        cnn_keys, mlp_keys, ops.precision.compute_dtype(args.precision)
+    )
     actor_loss_fn, recon_loss_fn = _make_loss_fns(args, cnn_keys, mlp_keys)
     obs_keys = (*cnn_keys, *mlp_keys)
 
@@ -513,7 +522,6 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACAEArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
-    require_float32(args)
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:

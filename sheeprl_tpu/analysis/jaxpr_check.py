@@ -76,6 +76,7 @@ __all__ = [
     "build_budget",
     "capture_plan",
     "check_budget",
+    "declares_bf16",
     "fingerprint_jaxpr",
     "iter_eqns",
     "load_budget",
@@ -503,6 +504,22 @@ def analyze_closed_jaxpr(
 # ---------------------------------------------------------------------------
 
 
+def _count_bf16_upcasts(closed: Any) -> int:
+    """Number of bf16->f32 `convert_element_type` eqns in the program —
+    the per-jit mixed-precision fingerprint. For an f32-only jit this is
+    0; for a declared-bf16 jit it is exactly the committed fp32-island
+    count the audit gate (`--gate-bf16` / check_budget) enforces."""
+    count = 0
+    for eqn in iter_eqns(closed):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = getattr(eqn.invars[0].aval.dtype, "name", "")
+        dst = getattr(eqn.outvars[0].aval.dtype, "name", "")
+        if src == "bfloat16" and dst == "float32":
+            count += 1
+    return count
+
+
 def fingerprint_jaxpr(closed: Any, lowered: Any = None) -> dict:
     """The compile-cost fingerprint of one jit: what the budget ledger
     commits and the CI drift gate compares."""
@@ -524,6 +541,11 @@ def fingerprint_jaxpr(closed: Any, lowered: Any = None) -> dict:
         "op_count": op_count,
         "primitives": dict(sorted(prims.items())),
         "dtypes": dtypes,
+        # the DECLARED fp32 islands of a mixed-precision jit: every
+        # committed bf16->f32 convert is an intended loss/logit/moment
+        # boundary; the gate fails when a derived program exceeds this
+        # count (a new SILENT upcast) — see check_budget
+        "bf16_upcasts": _count_bf16_upcasts(closed),
         "donated": 0,
         "flops": None,
         "bytes_accessed": None,
@@ -623,6 +645,31 @@ def check_budget(ledger: dict, derived: dict) -> tuple[list[str], list[str]]:
         new_dtypes = sorted(set(n.get("dtypes", [])) - set(o.get("dtypes", [])))
         if new_dtypes:
             failures.append(f"{key}: new dtypes {new_dtypes}")
+        # mixed-precision drift (ISSUE 9): a jit whose ledger entry declares
+        # bf16 compute must keep it — losing bfloat16 from the dtype set is
+        # a silent full-width regression, and growing the bf16->f32 convert
+        # count beyond the committed fp32 islands is a silent upcast
+        if "bfloat16" in o.get("dtypes", []):
+            if "bfloat16" not in n.get("dtypes", []):
+                failures.append(
+                    f"{key}: declared-bf16 jit lost its bfloat16 compute "
+                    "(silently upcast to full width)"
+                )
+            ou = o.get("bf16_upcasts")
+            nu = n.get("bf16_upcasts")
+            if ou is not None and nu is not None:
+                if int(nu) > int(ou):
+                    failures.append(
+                        f"{key}: bf16->f32 upcasts grew {ou} -> {nu} — "
+                        "undeclared fp32 island(s) inside a declared-bf16 "
+                        "jit (audit with tools/sheepcheck.py --audit-bf16, "
+                        "then --update-budget if intended)"
+                    )
+                elif int(nu) < int(ou):
+                    notes.append(
+                        f"{key}: bf16 upcasts shrank {ou} -> {nu} — refresh "
+                        "the ledger"
+                    )
         oc, nc = int(o.get("op_count", 0)), int(n.get("op_count", 0))
         if nc > oc * (1.0 + tol):
             failures.append(
@@ -769,18 +816,46 @@ CAPTURE_ARGV: dict[str, list[str]] = {
 }
 
 # Named capture VARIANTS: flag combinations of the same mains that register
-# ADDITIONAL jits the default argv never builds — today the PR-6 Anakin
-# path (`--env_backend jax`), whose fully-jitted rollout collector is
-# exactly the kind of program sheepcheck exists for. Variant argv is
-# APPENDED to the base algo's CAPTURE_ARGV (later flags win), and reports/
-# ledger keys use the variant name (`ppo@anakin/anakin_rollout`).
+# ADDITIONAL jits the default argv never builds — the PR-6 Anakin path
+# (`--env_backend jax`), whose fully-jitted rollout collector is exactly
+# the kind of program sheepcheck exists for, and since ISSUE 9 one
+# `<algo>@bf16` variant PER MAIN (`--precision bfloat16`): the same jits
+# traced under the mixed-precision policy, whose committed fingerprints
+# (dtype set incl. bfloat16 + the `bf16_upcasts` fp32-island count) are
+# what the bf16 half of check_budget and `--gate-bf16` enforce. Variant
+# argv is APPENDED to the base algo's CAPTURE_ARGV (later flags win), and
+# reports/ledger keys use the variant name (`ppo@anakin/anakin_rollout`).
+_BF16 = ["--precision", "bfloat16"]
+
 CAPTURE_VARIANTS: dict[str, tuple[str, list[str]]] = {
     "ppo@anakin": ("ppo", ["--env_backend", "jax", "--env_id", "CartPole-v1"]),
     "dreamer_v3@anakin": (
         "dreamer_v3",
         ["--env_backend", "jax", "--env_id", "pixeltoy"],
     ),
+    **{f"{algo}@bf16": (algo, list(_BF16)) for algo in (
+        "ppo",
+        "ppo_decoupled",
+        "ppo_recurrent",
+        "sac",
+        "sac_decoupled",
+        "droq",
+        "sac_ae",
+        "dreamer_v1",
+        "dreamer_v2",
+        "dreamer_v3",
+        "dreamer_v3_decoupled",
+        "p2e_dv1",
+        "p2e_dv2",
+    )},
 }
+
+
+def declares_bf16(fingerprint: dict) -> bool:
+    """True when a ledger entry declares bf16 compute (the `--gate-bf16`
+    population: its upcast count is enforced, f32-only jits stay
+    audit-only)."""
+    return "bfloat16" in (fingerprint or {}).get("dtypes", [])
 
 
 def resolve_capture(spec: str) -> tuple[str, list[str]]:

@@ -24,7 +24,26 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Module", "static", "field", "activation", "Activation"]
+__all__ = ["Module", "static", "field", "activation", "Activation", "cast_floating"]
+
+
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast every floating-point array leaf of `tree` to `dtype`, leaving
+    integer/bool/uint8 leaves (and non-arrays) untouched.
+
+    This is the one leaf-casting primitive of the mixed-precision policy
+    (`ops/precision.py`): train steps cast their INPUTS to the compute
+    dtype with it, heads cast their outputs back to the fp32 island, and
+    `Module.astype` reuses it for whole-model inference casts. It is a
+    no-op (returns the identical leaves, no `convert` in the jaxpr) when
+    dtypes already match, so f32 runs trace byte-identical programs."""
+
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
 
 
 def static(default: Any = dataclasses.MISSING, **kwargs: Any) -> Any:
@@ -65,14 +84,12 @@ class Module:
         )
 
     def astype(self, dtype: jnp.dtype) -> "Module":
-        """Cast all floating-point leaves (e.g. to bfloat16 for inference)."""
+        """Cast all floating-point leaves (e.g. to bfloat16 for inference).
 
-        def cast(x):
-            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
-                return x.astype(dtype)
-            return x
-
-        return jax.tree_util.tree_map(cast, self)
+        Training never uses this — the mixed-precision policy
+        (`ops/precision.py`) keeps fp32 master params and casts
+        activations instead (the layers follow their input dtype)."""
+        return cast_floating(self, dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -141,8 +141,12 @@ def scan_cell(cell, xs: jax.Array, h0, *, reset_mask: jax.Array | None = None):
             x = inp
         else:
             x, m = inp
-            m = m[..., None].astype(jnp.float32)
-            h = jax.tree_util.tree_map(lambda s: s * (1.0 - m), h)
+            # keep the reset arithmetic in each state leaf's dtype — a f32
+            # mask would promote a bf16 carry and destabilize the scan
+            m = m[..., None]
+            h = jax.tree_util.tree_map(
+                lambda s: s * (1.0 - m.astype(s.dtype)), h
+            )
         out = cell(x, h)
         # GRU cells return the new state directly; LSTM returns (out, state)
         if isinstance(out, tuple):

@@ -10,8 +10,10 @@ from .math import (
     two_hot,
 )
 from .moments import Moments
-from .scan import scan_unroll
+from .scan import autotune_unroll, scan_unroll, set_unroll, unroll_mode
 from . import distributions
+from . import precision
+from . import scan
 
 __all__ = [
     "gae",
@@ -24,6 +26,11 @@ __all__ = [
     "symlog",
     "two_hot",
     "Moments",
+    "autotune_unroll",
     "scan_unroll",
+    "set_unroll",
+    "unroll_mode",
     "distributions",
+    "precision",
+    "scan",
 ]

@@ -5,7 +5,9 @@ The BASELINE.md north star names four kernel targets: the LayerNorm-GRU cell
 symlog/symexp (reference utils/utils.py:125-133), the two-hot log-prob
 (reference utils/distribution.py:220-266), and the CNN encoder/decoder
 stages (ops/pallas_cnn.py — fused conv/deconv + LayerNorm + SiLU,
-per-family switch SHEEPRL_TPU_PALLAS_CNN). Each kernel here
+per-family switch SHEEPRL_TPU_PALLAS_CNN). ISSUE 9 adds the fifth: the
+whole RSSM dynamic step (pre-MLP + LN-GRU + prior/posterior head stacks)
+as ONE kernel, `fused_rssm_step` below. Each kernel here
 
   - fuses what XLA would otherwise stage through HBM: the GRU kernel keeps the
     [B, 3H] pre-activation entirely in VMEM between the MXU matmul, the
@@ -44,6 +46,8 @@ __all__ = [
     "use_pallas",
     "set_pallas",
     "layernorm_gru_cell",
+    "fused_rssm_step",
+    "rssm_step_reference",
     "two_hot_log_prob",
     "symlog",
     "symexp",
@@ -85,9 +89,9 @@ def _env_flag(name: str) -> bool | None:
 
 def use_pallas(kind: str | None = None) -> bool:
     """Master gate, optionally refined per kernel family via
-    SHEEPRL_TPU_PALLAS_<KIND> (KIND in GRU|TWO_HOT|SYMLOG|CNN) — the bench
-    uses the per-kernel switches to attribute wins/losses and keep only
-    winners."""
+    SHEEPRL_TPU_PALLAS_<KIND> (KIND in GRU|RSSM|TWO_HOT|SYMLOG|CNN) — the
+    bench uses the per-kernel switches to attribute wins/losses and keep
+    only winners."""
     if _FORCED is not None:
         enabled = _FORCED
     else:
@@ -286,6 +290,241 @@ def _gru_bwd(eps, residuals, g):
 
 
 layernorm_gru_cell.defvjp(_gru_fwd, _gru_bwd)
+
+
+# =============================================================================
+# Fused RSSM dynamic step (ISSUE 9 tentpole b)
+# =============================================================================
+#
+# The DreamerV3 dynamic step is six tiny matmuls with elementwise/LN glue:
+#
+#   z        = act(LN(x @ Wm))                      # RecurrentModel.mlp
+#   h'       = LayerNormGRU(z, h; Wg, sg, og)       # the recurrence
+#   prior    = (act(LN(h' @ Wt1)) @ Wt2) + bt2      # transition head
+#   post     = (act(LN([h', emb] @ Wr1)) @ Wr2)+br2 # representation head
+#
+# At RSSM shapes ([B=16] rows through 512-wide layers, T=64 sequential scan
+# steps) each stage is far below the MXU's efficient arithmetic intensity
+# and XLA stages every intermediate through HBM inside the scan body — the
+# per-step launch+memory overhead rivals the math (the round-4 duty-cycle
+# analysis; same diagnosis as the RL-kernel fusion results of
+# arXiv:2311.09445). This kernel runs the whole step out of VMEM: matmul
+# operands stay in the input dtype (bf16 under the mixed-precision policy —
+# the MXU's native reduced-precision path), every accumulation/normalization
+# runs in f32 (`preferred_element_type`), and only three arrays leave the
+# kernel: h' in the compute dtype and the two raw head outputs in f32 (the
+# unimix/sampling fp32 island consumes them directly, so the bf16 audit
+# sees no extra upcasts).
+#
+# The backward differentiates `rssm_step_reference` — a plain-XLA twin with
+# IDENTICAL accumulation semantics — via jax.vjp (recompute-in-XLA, the
+# same policy as the GRU kernel's documented backward): gradients are exact
+# w.r.t. the twin, and the [B, ·] residuals never need saving.
+
+_FUSED_VMEM_BUDGET_BYTES = 10 * 1024 * 1024  # weights must co-reside in VMEM
+
+_KERNEL_ACTS = {
+    "silu": jax.nn.silu,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "identity": lambda x: x,
+}
+
+
+def _ln(x32, scale, offset, eps):
+    """f32 layernorm over the trailing axis (in-kernel and in the twin)."""
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    centered = x32 - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    return centered * jax.lax.rsqrt(var + eps) * scale + offset
+
+
+def _rssm_step_math(
+    x, h, emb, wm, sm, om, wg, sg, og,
+    wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+    act, eps,
+):
+    """The shared step math: matmul operands in the input dtype, f32
+    accumulations/normalizations/gates. Used verbatim by the Pallas kernel
+    body and the XLA reference twin so the two are the same function."""
+    act_fn = _KERNEL_ACTS[act]
+    mlp_eps, gru_eps, head_eps = eps
+    dt = x.dtype
+
+    # RecurrentModel.mlp: Linear -> LN -> act
+    z = jnp.dot(x, wm, preferred_element_type=jnp.float32)
+    z = act_fn(_ln(z, sm, om, mlp_eps)).astype(dt)
+
+    # LayerNorm-GRU (the _gru_kernel math)
+    xh = jnp.concatenate([z, h], axis=-1)
+    parts = _ln(
+        jnp.dot(xh, wg, preferred_element_type=jnp.float32), sg, og, gru_eps
+    )
+    hidden = h.shape[-1]
+    r = parts[:, :hidden]
+    c = parts[:, hidden : 2 * hidden]
+    u = parts[:, 2 * hidden :]
+    update = jax.nn.sigmoid(u - 1.0)  # Hafner update-bias trick
+    cand = jnp.tanh(jax.nn.sigmoid(r) * c)
+    h_new32 = update * cand + (1.0 - update) * h.astype(jnp.float32)
+    h_new = h_new32.astype(dt)
+
+    # transition head (prior): MLP hidden -> LN -> act -> logits Linear
+    t1 = jnp.dot(h_new, wt1, preferred_element_type=jnp.float32)
+    t1 = act_fn(_ln(t1, st1, ot1, head_eps)).astype(dt)
+    prior_raw = jnp.dot(t1, wt2, preferred_element_type=jnp.float32) + bt2
+
+    # representation head (posterior): same shape over [h', emb]
+    he = jnp.concatenate([h_new, emb], axis=-1)
+    r1 = jnp.dot(he, wr1, preferred_element_type=jnp.float32)
+    r1 = act_fn(_ln(r1, sr1, or1, head_eps)).astype(dt)
+    post_raw = jnp.dot(r1, wr2, preferred_element_type=jnp.float32) + br2
+
+    return h_new, prior_raw, post_raw
+
+
+def _fused_rssm_kernel(
+    x_ref, h_ref, emb_ref, wm_ref, sm_ref, om_ref, wg_ref, sg_ref, og_ref,
+    wt1_ref, st1_ref, ot1_ref, wt2_ref, bt2_ref,
+    wr1_ref, sr1_ref, or1_ref, wr2_ref, br2_ref,
+    h_out_ref, prior_ref, post_ref, *, act, eps,
+):
+    h_new, prior_raw, post_raw = _rssm_step_math(
+        x_ref[:], h_ref[:], emb_ref[:],
+        wm_ref[:], sm_ref[:], om_ref[:],
+        wg_ref[:], sg_ref[:], og_ref[:],
+        wt1_ref[:], st1_ref[:], ot1_ref[:], wt2_ref[:], bt2_ref[:],
+        wr1_ref[:], sr1_ref[:], or1_ref[:], wr2_ref[:], br2_ref[:],
+        act, eps,
+    )
+    h_out_ref[:] = h_new.astype(h_out_ref.dtype)
+    prior_ref[:] = prior_raw
+    post_ref[:] = post_raw
+
+
+def rssm_step_reference(
+    x, h, emb, wm, sm, om, wg, sg, og,
+    wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+    act="silu", eps=(1e-3, 1e-5, 1e-3),
+):
+    """Plain-XLA twin of the fused kernel: the numerics oracle for the
+    parity tests and the function the custom VJP differentiates."""
+    return _rssm_step_math(
+        x, h, emb, wm, sm, om, wg, sg, og,
+        wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+        act, tuple(eps),
+    )
+
+
+_RSSM_BLOCK_ROWS = 128  # [128 rows x (3R + heads)] f32 working set in VMEM
+
+
+def _fused_rssm_forward(
+    x, h, emb, wm, sm, om, wg, sg, og,
+    wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+    act, eps,
+):
+    batch, hidden = h.shape
+    sd = wt2.shape[-1]
+    bn = min(_RSSM_BLOCK_ROWS, batch)
+
+    def rows(a):
+        return pl.BlockSpec((bn, a.shape[-1]), lambda i: (i, 0), memory_space=_VMEM)
+
+    def whole(a):
+        if a.ndim == 1:
+            return pl.BlockSpec(a.shape, lambda i: (0,), memory_space=_VMEM)
+        return pl.BlockSpec(a.shape, lambda i: (0, 0), memory_space=_VMEM)
+
+    return pl.pallas_call(
+        functools.partial(_fused_rssm_kernel, act=act, eps=eps),
+        grid=(_cdiv(batch, bn),),
+        out_shape=(
+            jax.ShapeDtypeStruct((batch, hidden), x.dtype),
+            jax.ShapeDtypeStruct((batch, sd), jnp.float32),
+            jax.ShapeDtypeStruct((batch, sd), jnp.float32),
+        ),
+        in_specs=[
+            rows(x), rows(h), rows(emb),
+            whole(wm), whole(sm), whole(om),
+            whole(wg), whole(sg), whole(og),
+            whole(wt1), whole(st1), whole(ot1), whole(wt2), whole(bt2),
+            whole(wr1), whole(sr1), whole(or1), whole(wr2), whole(br2),
+        ],
+        out_specs=(
+            pl.BlockSpec((bn, hidden), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((bn, sd), lambda i: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((bn, sd), lambda i: (i, 0), memory_space=_VMEM),
+        ),
+        interpret=_INTERPRET,
+    )(
+        x, h, emb, wm, sm, om, wg, sg, og,
+        wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(19, 20))
+def fused_rssm_step(
+    x, h, emb, wm, sm, om, wg, sg, og,
+    wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+    act="silu", eps=(1e-3, 1e-5, 1e-3),
+):
+    """One fused RSSM dynamic step.
+
+    x [B, Dx] (posterior_flat ++ action), h [B, R], emb [B, E]; weights in
+    the compute dtype (callers cast their f32 masters, like the Linear
+    layers do), LN scales/offsets and head biases in f32.
+    Returns (h' [B, R] compute dtype, prior_raw [B, S*D] f32,
+    post_raw [B, S*D] f32) — raw pre-unimix logits; sampling stays outside
+    (it needs PRNG keys and the f32 island).
+    `eps` is (mlp_eps, gru_eps, head_eps); `act` must be a _KERNEL_ACTS key.
+    """
+    return _fused_rssm_forward(
+        x, h, emb, wm, sm, om, wg, sg, og,
+        wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+        act, tuple(eps),
+    )
+
+
+def _fused_rssm_fwd(
+    x, h, emb, wm, sm, om, wg, sg, og,
+    wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+    act, eps,
+):
+    out = _fused_rssm_forward(
+        x, h, emb, wm, sm, om, wg, sg, og,
+        wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+        act, tuple(eps),
+    )
+    residuals = (
+        x, h, emb, wm, sm, om, wg, sg, og,
+        wt1, st1, ot1, wt2, bt2, wr1, sr1, or1, wr2, br2,
+    )
+    return out, residuals
+
+
+def _fused_rssm_bwd(act, eps, residuals, g):
+    """Recompute-in-XLA backward: one extra forward through the twin, exact
+    gradients w.r.t. the kernel's accumulation semantics."""
+    _, vjp = jax.vjp(
+        lambda *args: _rssm_step_math(*args, act, tuple(eps)), *residuals
+    )
+    return vjp(g)
+
+
+fused_rssm_step.defvjp(_fused_rssm_fwd, _fused_rssm_bwd)
+
+
+def fused_rssm_supported(act: str, *weights) -> bool:
+    """Trace-time dispatch guard shared with the RSSM module: the activation
+    must have an in-kernel implementation and the step's weights must
+    co-reside in VMEM with room for the row blocks."""
+    if act not in _KERNEL_ACTS:
+        return False
+    total = sum(int(w.size) * w.dtype.itemsize for w in weights)
+    return total <= _FUSED_VMEM_BUDGET_BYTES
 
 
 # =============================================================================

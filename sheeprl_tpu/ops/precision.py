@@ -1,0 +1,112 @@
+"""The ONE mixed-precision policy for every train step (ISSUE 9 tentpole a).
+
+Before this module, `--precision bfloat16` existed only for the discrete-
+latent Dreamer family, each main hand-rolling the same
+``jnp.bfloat16 if args.precision == "bfloat16" else jnp.float32`` line and
+its own cast sites — and `algos.args.require_float32` rejected the flag
+everywhere else. This module centralizes the policy so all 13 mains share
+one contract, the same one the software–hardware co-optimization toolkit
+(arXiv:2311.09445) measures as the biggest single-chip lever after kernel
+fusion:
+
+  - **bf16 compute**: network forwards AND backwards (encoders, RSSM /
+    LSTM recurrences, actor/critic trunks, imagination) run in bfloat16.
+    The parameter story rides the dtype-following layer design
+    (`nn/layers.py`: every layer casts its weights to the input dtype), so
+    "run in bf16" means exactly "cast the inputs" — there is no second
+    copy of the model.
+  - **fp32 master params + optimizer moments**: parameters are created
+    and stored in float32 and NEVER cast in place; the `convert` the
+    layers insert is differentiable, so cotangents arrive back in f32 and
+    optax moments/updates stay full width. Checkpoints therefore always
+    hold fp32 master weights (`--precision bfloat16` round-trips exactly).
+  - **fp32 islands**: loss reductions, logits/distribution math,
+    return/advantage/Bellman math, KL and moments run in float32 — heads
+    upcast with `to_float32` at the boundary. These are the *declared*
+    upcasts the sheepcheck `--audit-bf16` ledger commits per jit
+    (`bf16_upcasts` in `analysis/budget/`); a new silent upcast beyond the
+    declared count fails CI.
+
+All casts are no-ops when the policy is f32 (``jnp.astype`` returns the
+operand unchanged when dtypes already match), so wiring a main through the
+policy leaves its f32 jaxpr — and its committed budget fingerprint —
+byte-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.core import cast_floating
+
+__all__ = ["Policy", "policy", "compute_dtype", "to_compute", "to_float32"]
+
+
+def compute_dtype(precision: str) -> Any:
+    """Map a StandardArgs `precision` string to the compute dtype."""
+    if precision == "bfloat16":
+        return jnp.bfloat16
+    if precision == "float32":
+        return jnp.float32
+    raise ValueError(
+        f"precision must be 'float32' or 'bfloat16', got {precision!r}"
+    )
+
+
+def to_compute(tree: Any, dtype: Any) -> Any:
+    """Cast the floating leaves of `tree` to the compute dtype (ints, bools
+    and uint8 pixels pass through; pixel normalization casts them itself).
+    No-op when `dtype` is float32 and the leaves already are."""
+    return cast_floating(tree, dtype)
+
+
+def to_float32(tree: Any) -> Any:
+    """Upcast head outputs / pre-loss values to the fp32 island. This is
+    the DECLARED upcast of the mixed-precision contract: every call site
+    is a loss/logit/return boundary the bf16 audit expects to see."""
+    return cast_floating(tree, jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """The resolved mixed-precision policy of one run.
+
+    `compute` is what forwards/backwards trace in; `param` / `reduce` are
+    fixed at float32 by design (master weights, moments, losses). The
+    object is cheap and hashable — mains build it once in
+    `make_train_step` and close over it."""
+
+    compute: Any
+    param: Any = jnp.float32
+    reduce: Any = jnp.float32
+
+    @property
+    def mixed(self) -> bool:
+        return jnp.dtype(self.compute) != jnp.dtype(self.param)
+
+    # -- cast helpers (all no-ops under the f32 policy) ---------------------
+    def cast_in(self, tree: Any) -> Any:
+        """Inputs entering the network trunk -> compute dtype."""
+        return cast_floating(tree, self.compute)
+
+    def cast_out(self, tree: Any) -> Any:
+        """Head outputs leaving the trunk -> the fp32 island."""
+        return cast_floating(tree, self.reduce)
+
+    def zeros(self, shape: tuple[int, ...]) -> jax.Array:
+        """Recurrent/carry initializers in the compute dtype (a stray f32
+        carry would promote the whole recurrence back to full width)."""
+        return jnp.zeros(shape, self.compute)
+
+    @property
+    def name(self) -> str:
+        return jnp.dtype(self.compute).name
+
+
+def policy(precision: str) -> Policy:
+    """Resolve a StandardArgs `precision` string into the shared Policy."""
+    return Policy(compute=compute_dtype(precision))

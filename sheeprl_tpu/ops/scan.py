@@ -1,31 +1,295 @@
-"""Trace-time knob for `lax.scan` unrolling on the time/horizon recurrences.
+"""Scan-unroll control: trace-time knob + a measured per-jit autotuner
+(ISSUE 9 tentpole c).
 
 The Dreamer-family train step is dominated by sequential scans with TINY
 step bodies (RSSM dynamic: T=64 steps of [B=16]-row matmuls through
 512-wide layers; imagination: horizon 15 of the same shapes). XLA lowers
 `lax.scan` to a while-loop with per-iteration control overhead that rivals
 the step's compute at these shapes, so modest unrolls (4-8) can win real
-throughput — at the cost of compile time and code size, which is why the
-factor is a knob with a bench keep-decision (BENCHES.md) rather than a
-hardcoded value.
+throughput — at the cost of compile time and code size. That trade is
+hardware- and shape-dependent, which is why it was a knob with a bench
+keep-decision (BENCHES.md round 4, hypothesis #2) rather than a hardcoded
+value.
 
-Read at trace time like the Pallas kernel switches
-(`ops/pallas_kernels.py`): flipping `SHEEPRL_TPU_SCAN_UNROLL` between
-measurements re-traces with the new factor.
+This module grows the knob into a measured ladder:
+
+  - `scan_unroll()` stays the trace-time read (Pallas-switch style): the
+    process-global override (autotuner / `unroll()` context) wins, then the
+    `SHEEPRL_TPU_SCAN_UNROLL` env var, then 1.
+  - `SHEEPRL_TPU_SCAN_UNROLL=auto` arms the autotuner: the dreamer mains
+    call `autotune_unroll` on their RSSM scan with the run's EXACT shapes
+    before tracing the train step. For each rung in `RUNGS` the scan is
+    AOT-compiled (`jit.lower().compile()` — the PR-5 trial-compile
+    machinery) and executed `repeats` times; the fastest rung wins and is
+    installed as the process override, and every rung carries a
+    BIT-EXACTNESS receipt vs rung 1 (unrolling reorders nothing — a rung
+    that fails the receipt is disqualified, never silently kept).
+  - winners persist NEXT TO the compile cache (`scan_unroll.json` in the
+    jax compilation-cache directory, compile/cache.py): a re-run with the
+    same (name, avals, jax version, backend) key skips the ladder and
+    reuses the measured winner, exactly like a warm compile cache skips the
+    compile.
 """
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
+import json
 import os
+import time
+from typing import Any, Callable, Sequence
 
-__all__ = ["scan_unroll"]
+__all__ = [
+    "RUNGS",
+    "UnrollDecision",
+    "autotune_unroll",
+    "scan_unroll",
+    "set_unroll",
+    "unroll",
+    "unroll_mode",
+]
+
+RUNGS = (1, 4, 8, 16, 32)
+
+_OVERRIDE: int | None = None
+
+
+def unroll_mode() -> str:
+    """The env knob's raw mode: 'auto' (measured ladder), 'env' (a fixed
+    integer is set), or 'off' (unset/default)."""
+    raw = os.environ.get("SHEEPRL_TPU_SCAN_UNROLL", "").strip().lower()
+    if raw == "auto":
+        return "auto"
+    if raw:
+        return "env"
+    return "off"
 
 
 def scan_unroll() -> int:
     """Unroll factor for the framework's time/horizon scans (default 1 =
-    plain while-loop). Set `SHEEPRL_TPU_SCAN_UNROLL=k` to unroll k steps
-    per loop iteration; `lax.scan` handles non-divisible lengths."""
+    plain while-loop). Read at trace time like the Pallas kernel switches:
+    the autotuner's installed winner (or an `unroll()` context) takes
+    precedence, then `SHEEPRL_TPU_SCAN_UNROLL=k`; `lax.scan` handles
+    non-divisible lengths."""
+    if _OVERRIDE is not None:
+        return _OVERRIDE
     try:
         return max(1, int(os.environ.get("SHEEPRL_TPU_SCAN_UNROLL", "1")))
     except ValueError:
         return 1
+
+
+def set_unroll(k: int | None) -> None:
+    """Install (or clear, with None) the process-global unroll override —
+    what the autotuner does with the measured winner."""
+    global _OVERRIDE
+    _OVERRIDE = None if k is None else max(1, int(k))
+
+
+@contextlib.contextmanager
+def unroll(k: int | None):
+    """Scoped override: trace/compile under a specific rung, then restore."""
+    global _OVERRIDE
+    prev = _OVERRIDE
+    _OVERRIDE = None if k is None else max(1, int(k))
+    try:
+        yield
+    finally:
+        _OVERRIDE = prev
+
+
+@dataclasses.dataclass
+class UnrollDecision:
+    """One measured ladder: per-rung compile/exec seconds, per-rung
+    bit-exactness receipts vs rung 1, and the winner."""
+
+    name: str
+    winner: int
+    timings: dict[int, float]  # rung -> median exec seconds
+    compile_seconds: dict[int, float]  # rung -> AOT compile seconds
+    bit_exact: dict[int, bool]  # rung -> outputs identical to rung 1
+    source: str  # "measured" | "cache" | "env"
+    key: str
+
+    def as_event(self) -> dict[str, Any]:
+        # "probe", not "name": the payload rides telemetry.event(name=...)
+        return {
+            "probe": self.name,
+            "winner": int(self.winner),
+            "timings_s": {str(k): v for k, v in self.timings.items()},
+            "compile_s": {str(k): v for k, v in self.compile_seconds.items()},
+            "bit_exact": {str(k): bool(v) for k, v in self.bit_exact.items()},
+            "source": self.source,
+        }
+
+    def as_dict(self) -> dict[str, Any]:
+        return {**self.as_event(), "key": self.key}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "UnrollDecision":
+        return cls(
+            name=d.get("probe") or d.get("name", ""),
+            winner=int(d["winner"]),
+            timings={int(k): float(v) for k, v in d.get("timings_s", {}).items()},
+            compile_seconds={
+                int(k): float(v) for k, v in d.get("compile_s", {}).items()
+            },
+            bit_exact={int(k): bool(v) for k, v in d.get("bit_exact", {}).items()},
+            source="cache",
+            key=d.get("key", ""),
+        )
+
+
+def _store_path(explicit: str | None = None) -> str:
+    """The winner store lives next to the persistent compile cache — same
+    resolution order as compile/cache.py, without arming anything."""
+    if explicit:
+        return explicit
+    base = (
+        os.environ.get("SHEEPRL_TPU_COMPILE_CACHE")
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    )
+    if not base:
+        from ..compile.cache import default_cache_dir
+
+        base = default_cache_dir()
+    return os.path.join(base, "scan_unroll.json")
+
+
+def _load_store(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except Exception:
+        return {}
+
+
+def _save_store(path: str, store: dict) -> None:
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(store, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # the store is an optimization; never fail the run on it
+
+
+def _decision_key(name: str, example: Sequence[Any]) -> str:
+    import jax
+
+    avals = ",".join(
+        f"{getattr(getattr(a, 'dtype', None), 'name', type(a).__name__)}"
+        f"{list(getattr(a, 'shape', []))}"
+        for a in jax.tree_util.tree_leaves(example)
+    )
+    return f"{name}|{avals}|jax{jax.__version__}|{jax.default_backend()}"
+
+
+def _bit_exact(a: Any, b: Any) -> bool:
+    import jax
+    import numpy as np
+
+    la = [np.asarray(x) for x in jax.tree_util.tree_leaves(a)]
+    lb = [np.asarray(x) for x in jax.tree_util.tree_leaves(b)]
+    if len(la) != len(lb):
+        return False
+    return all(np.array_equal(x, y, equal_nan=True) for x, y in zip(la, lb))
+
+
+def autotune_unroll(
+    name: str,
+    fn: Callable,
+    example: Sequence[Any],
+    *,
+    rungs: Sequence[int] = RUNGS,
+    repeats: int = 3,
+    store_path: str | None = None,
+    force: bool = False,
+    apply: bool = True,
+) -> UnrollDecision:
+    """Measure the unroll ladder for one scan-bearing function and return
+    (and by default install) the winner.
+
+    `fn(*example)` must be jittable and contain scans whose `unroll=` reads
+    `scan_unroll()` at trace time. Per rung: AOT `lower().compile()` (so
+    compile time is measured apart from exec), one untimed warm-up call,
+    then `repeats` timed calls (median). Rung 1 is the reference: any rung
+    whose outputs are not bit-identical is disqualified. The winner is the
+    fastest surviving rung; ties break toward the SMALLER rung (less code).
+    """
+    import jax
+
+    path = _store_path(store_path)
+    key = _decision_key(name, example)
+    if not force:
+        store = _load_store(path)
+        hit = store.get(key)
+        if hit:
+            decision = UnrollDecision.from_dict({**hit, "key": key})
+            if apply:
+                set_unroll(decision.winner)
+            return decision
+
+    timings: dict[int, float] = {}
+    compile_seconds: dict[int, float] = {}
+    bit_exact: dict[int, bool] = {}
+    outputs: dict[int, Any] = {}
+    rungs = list(dict.fromkeys(int(r) for r in rungs))
+    if 1 not in rungs:
+        rungs.insert(0, 1)
+    # throwaway lower + trivial compile: absorb the process's one-time
+    # tracing/MLIR/LLVM-backend warmup so it doesn't bias the first rung's
+    # compile_seconds (the same first-call attribution trap as the r4/r5
+    # compile-vs-exec mixup)
+    import jax.numpy as jnp
+
+    def fresh(_rung):
+        # a NEW callable per rung: jax caches traces by function identity,
+        # so re-jitting the same `fn` under a different unroll context
+        # would silently reuse rung 1's jaxpr and the whole ladder would
+        # measure one program five times
+        return lambda *a: fn(*a)
+
+    with unroll(rungs[0]):
+        jax.jit(fresh(0)).lower(*example)
+    jax.block_until_ready(jax.jit(lambda v: v + 1.0)(jnp.float32(0.0)))
+    for rung in rungs:
+        with unroll(rung):
+            t0 = time.perf_counter()
+            # sheeplint: disable=SL004 — a fresh jit per rung is the POINT:
+            # each rung must trace its own program (jax's trace cache keys
+            # on fn identity; reusing one jit would measure rung 1 five
+            # times), and the ladder runs once per (shape, backend) key
+            compiled = jax.jit(fresh(rung)).lower(*example).compile()
+            compile_seconds[rung] = time.perf_counter() - t0
+            out = jax.block_until_ready(compiled(*example))  # warm-up
+            samples = []
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                out = jax.block_until_ready(compiled(*example))
+                samples.append(time.perf_counter() - t0)
+            samples.sort()
+            timings[rung] = samples[len(samples) // 2]
+            outputs[rung] = out
+    reference = outputs[1]
+    for rung in rungs:
+        bit_exact[rung] = True if rung == 1 else _bit_exact(reference, outputs[rung])
+    eligible = [r for r in rungs if bit_exact[r]]
+    winner = min(eligible, key=lambda r: (timings[r], r))
+    decision = UnrollDecision(
+        name=name,
+        winner=winner,
+        timings=timings,
+        compile_seconds=compile_seconds,
+        bit_exact=bit_exact,
+        source="measured",
+        key=key,
+    )
+    store = _load_store(path)
+    store[key] = decision.as_dict()
+    _save_store(path, store)
+    if apply:
+        set_unroll(winner)
+    return decision

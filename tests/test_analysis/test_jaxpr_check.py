@@ -421,3 +421,102 @@ def test_capture_mode_register_returns_raw_fn():
         assert exc.value.plan is plan
     finally:
         os.environ.pop("SHEEPRL_TPU_PLAN_MODE", None)
+
+
+# =============================================================================
+# bf16 mixed-precision gate (ISSUE 9)
+# =============================================================================
+
+
+def _bf16_ledger():
+    """A hand-built ledger with one declared-bf16 jit and one f32-only jit."""
+    return {
+        "version": 1,
+        "tolerance": {"op_count_frac": 0.25},
+        "jits": {
+            "algo@bf16/train_step": {
+                "op_count": 40,
+                "dtypes": ["bfloat16", "float32"],
+                "bf16_upcasts": 5,
+                "donated": 0,
+                "primitives": {},
+            },
+            "algo/train_step": {
+                "op_count": 40,
+                "dtypes": ["float32"],
+                "bf16_upcasts": 0,
+                "donated": 0,
+                "primitives": {},
+            },
+        },
+    }
+
+
+def test_bf16_gate_clean_on_identical_budget():
+    ledger = _bf16_ledger()
+    failures, notes = jc.check_budget(ledger, json.loads(json.dumps(ledger)))
+    assert failures == [] and notes == []
+
+
+def test_bf16_gate_fails_on_new_silent_upcast():
+    ledger = _bf16_ledger()
+    drifted = json.loads(json.dumps(ledger))
+    drifted["jits"]["algo@bf16/train_step"]["bf16_upcasts"] = 7
+    failures, _ = jc.check_budget(ledger, drifted)
+    assert any("upcasts grew 5 -> 7" in f for f in failures)
+
+
+def test_bf16_gate_fails_on_lost_bfloat16_compute():
+    ledger = _bf16_ledger()
+    drifted = json.loads(json.dumps(ledger))
+    drifted["jits"]["algo@bf16/train_step"]["dtypes"] = ["float32"]
+    drifted["jits"]["algo@bf16/train_step"]["bf16_upcasts"] = 0
+    failures, _ = jc.check_budget(ledger, drifted)
+    assert any("lost its bfloat16 compute" in f for f in failures)
+
+
+def test_bf16_gate_shrink_is_a_note_and_f32_jits_exempt():
+    ledger = _bf16_ledger()
+    drifted = json.loads(json.dumps(ledger))
+    # fewer upcasts in the declared jit: improvement, not failure
+    drifted["jits"]["algo@bf16/train_step"]["bf16_upcasts"] = 3
+    # an f32-only jit growing an upcast count is NOT gated (audit-only)
+    drifted["jits"]["algo/train_step"]["bf16_upcasts"] = 2
+    failures, notes = jc.check_budget(ledger, drifted)
+    assert failures == []
+    assert any("bf16 upcasts shrank" in n for n in notes)
+
+
+def test_declares_bf16_predicate():
+    ledger = _bf16_ledger()
+    assert jc.declares_bf16(ledger["jits"]["algo@bf16/train_step"])
+    assert not jc.declares_bf16(ledger["jits"]["algo/train_step"])
+    assert not jc.declares_bf16({})
+    assert not jc.declares_bf16(None)
+
+
+def test_bf16_capture_variants_cover_all_mains():
+    """The @bf16 sweep is the gate's population: one variant per main."""
+    import sheeprl_tpu.algos  # noqa: F401
+    from sheeprl_tpu.utils.registry import tasks
+
+    bf16_specs = {s for s in jc.CAPTURE_VARIANTS if s.endswith("@bf16")}
+    assert {s.split("@")[0] for s in bf16_specs} == set(tasks)
+    for spec in bf16_specs:
+        algo, extra = jc.resolve_capture(spec)
+        assert extra[-2:] == ["--precision", "bfloat16"]
+
+
+def test_fingerprint_counts_bf16_upcasts():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        y = (x.astype(jnp.bfloat16) * 2).astype(jnp.float32)  # one upcast
+        z = (x.astype(jnp.bfloat16) + 1).astype(jnp.float32)  # another
+        return y + z
+
+    closed = jax.jit(f).trace(jax.ShapeDtypeStruct((4,), jnp.float32)).jaxpr
+    fp = jc.fingerprint_jaxpr(closed)
+    assert fp["bf16_upcasts"] == 2
+    assert "bfloat16" in fp["dtypes"]

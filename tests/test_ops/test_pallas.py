@@ -126,3 +126,136 @@ def test_pallas_disabled_on_cpu_by_default():
     # auto mode: CPU backend -> kernels off, the plain paths serve
     pk.set_pallas(None)
     assert not pk.use_pallas()
+
+
+# =============================================================================
+# Fused RSSM dynamic step (ISSUE 9 tentpole b)
+# =============================================================================
+
+
+def _rssm_fixture(dtype=jnp.float32, seed=0):
+    """A DV3-shaped RSSM (single-hidden LN MLPs, bias-free LN-GRU) plus a
+    random dynamic-step input batch."""
+    from sheeprl_tpu import nn
+    from sheeprl_tpu.algos.dreamer_v3.agent import RSSM, RecurrentModel
+
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 8)
+    R, D, Hd, S, Dd, A, E, B = 16, 12, 10, 4, 4, 3, 8, 5
+    rm = RecurrentModel.init(ks[0], S * Dd + A, R, D, layer_norm=True, activation="silu")
+    tm = nn.MLP.init(ks[1], R, [Hd], S * Dd, act="silu", layer_norm=True,
+                     use_bias=False, norm_eps=1e-3)
+    pm = nn.MLP.init(ks[2], R + E, [Hd], S * Dd, act="silu", layer_norm=True,
+                     use_bias=False, norm_eps=1e-3)
+    rssm = RSSM(recurrent_model=rm, representation_model=pm,
+                transition_model=tm, discrete=Dd, unimix=0.01)
+    batch = dict(
+        post=jax.random.normal(ks[3], (B, S, Dd), dtype),
+        rec=jax.random.normal(ks[4], (B, R), dtype),
+        act=jax.random.normal(ks[5], (B, A), dtype),
+        emb=jax.random.normal(ks[6], (B, E), dtype),
+        first=jnp.zeros((B, 1), jnp.float32),
+        key=ks[7],
+    )
+    return rssm, batch
+
+
+def _fused_args(rssm, x, emb):
+    weights, act, eps = rssm._fused_step_weights(x, emb)
+    return weights, act, eps
+
+
+def test_fused_rssm_forward_matches_reference(pallas_interpret):
+    rssm, b = _rssm_fixture()
+    x = jnp.concatenate([b["post"].reshape(b["post"].shape[0], -1), b["act"]], -1)
+    weights, act, eps = _fused_args(rssm, x, b["emb"])
+    got = pk.fused_rssm_step(x, b["rec"], b["emb"], *weights, act, eps)
+    want = pk.rssm_step_reference(x, b["rec"], b["emb"], *weights, act, eps)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+
+def test_fused_rssm_vjp_matches_reference(pallas_interpret):
+    rssm, b = _rssm_fixture(seed=1)
+    x = jnp.concatenate([b["post"].reshape(b["post"].shape[0], -1), b["act"]], -1)
+    weights, act, eps = _fused_args(rssm, x, b["emb"])
+
+    def total(fn, *leading):
+        out = fn(*leading, *weights, act, eps)
+        return sum(jnp.sum(o.astype(jnp.float32) ** 2) for o in out)
+
+    # d/d(x, h, emb) and d/d(every weight)
+    argnums = tuple(range(3 + len(weights)))
+
+    def total_all(fn, *args):
+        out = fn(*args, act, eps)
+        return sum(jnp.sum(o.astype(jnp.float32) ** 2) for o in out)
+
+    g_kernel = jax.grad(lambda *a: total_all(pk.fused_rssm_step, *a), argnums)(
+        x, b["rec"], b["emb"], *weights
+    )
+    g_ref = jax.grad(lambda *a: total_all(pk.rssm_step_reference, *a), argnums)(
+        x, b["rec"], b["emb"], *weights
+    )
+    for gk, gr in zip(g_kernel, g_ref):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=1e-4)
+
+
+def test_fused_rssm_dynamic_dispatch_matches_xla_path(pallas_interpret):
+    """RSSM.dynamic with the fused kernel vs the plain module path: same
+    states/logits (value AND gradient) — the swap-in is behavior-preserving."""
+    rssm, b = _rssm_fixture(seed=2)
+    inputs = (b["post"], b["rec"], b["act"], b["emb"], b["first"], b["key"])
+
+    pk.set_pallas(False)
+    ref = rssm.dynamic(*inputs)
+    pk.set_pallas(True, interpret=True)
+    fused = rssm.dynamic(*inputs)
+    for r, f in zip(ref, fused):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(f), atol=1e-5)
+
+    def loss(mod, use):
+        pk.set_pallas(use, interpret=use)
+        out = mod.dynamic(*inputs)
+        return sum(jnp.sum(o.astype(jnp.float32) ** 2) for o in out)
+
+    g_ref = jax.grad(lambda m: loss(m, False))(rssm)
+    g_fused = jax.grad(lambda m: loss(m, True))(rssm)
+    for a, c in zip(
+        jax.tree_util.tree_leaves(g_ref), jax.tree_util.tree_leaves(g_fused)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=1e-4)
+
+
+def test_fused_rssm_bf16_dtypes(pallas_interpret):
+    """bf16-aware block contract: compute-dtype state out, f32 raw logits
+    out (the fp32 island starts INSIDE the kernel — no extra upcasts)."""
+    rssm, b = _rssm_fixture(dtype=jnp.bfloat16, seed=3)
+    out = rssm.dynamic(
+        b["post"], b["rec"], b["act"], b["emb"], b["first"], b["key"]
+    )
+    recurrent, posterior, prior, post_logits, prior_logits = out
+    assert recurrent.dtype == jnp.bfloat16
+    assert posterior.dtype == jnp.bfloat16 and prior.dtype == jnp.bfloat16
+    assert post_logits.dtype == jnp.float32 and prior_logits.dtype == jnp.float32
+
+
+def test_fused_rssm_dispatch_falls_back_on_mismatch(pallas_interpret):
+    """A module shape outside the kernel contract (biased GRU projection)
+    must return None from the dispatch guard — the XLA path serves."""
+    from sheeprl_tpu import nn
+
+    rssm, b = _rssm_fixture(seed=4)
+    biased = rssm.recurrent_model.rnn.replace(
+        proj=nn.Linear.init(jax.random.PRNGKey(9), 16 + 12, 3 * 16, use_bias=True)
+    )
+    rssm_biased = rssm.replace(
+        recurrent_model=rssm.recurrent_model.replace(rnn=biased)
+    )
+    x = jnp.concatenate([b["post"].reshape(b["post"].shape[0], -1), b["act"]], -1)
+    assert rssm_biased._fused_step_weights(x, b["emb"]) is None
+    # and the full step still runs (plain path)
+    out = rssm_biased.dynamic(
+        b["post"], b["rec"], b["act"], b["emb"], b["first"], b["key"]
+    )
+    assert all(np.all(np.isfinite(np.asarray(o, dtype=np.float32))) for o in out)

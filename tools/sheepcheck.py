@@ -71,6 +71,15 @@ def main(argv: list[str] | None = None) -> int:
         "--audit-bf16", action="store_true",
         help="also flag bf16->f32 upcasts (the ROADMAP-5c mixed-precision audit)",
     )
+    ap.add_argument(
+        "--gate-bf16", action="store_true",
+        help="CI gate for declared-bf16 jits (ISSUE 9): capture the @bf16 "
+        "variants, count each jit's bf16->f32 upcasts and FAIL when a jit "
+        "whose budget entry declares bf16 compute exceeds its committed "
+        "fp32-island count (or loses bfloat16 entirely). f32-only jits "
+        "stay audit-only. Implies --audit-bf16; SC findings are reported, "
+        "not gated, in this mode (the default run gates them)",
+    )
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     ap.add_argument(
@@ -112,8 +121,16 @@ def main(argv: list[str] | None = None) -> int:
 
     # default sweep: every registered main at its capture argv, plus the
     # named variants (flag combinations that register extra jits — the
-    # Anakin `--env_backend jax` rollout collectors)
-    specs = ns.algos or [*sorted(tasks), *sorted(jc.CAPTURE_VARIANTS)]
+    # Anakin `--env_backend jax` rollout collectors and the ISSUE-9
+    # `@bf16` mixed-precision traces). --gate-bf16 narrows the default
+    # sweep to the bf16 variants (that's the gated population).
+    if ns.gate_bf16:
+        ns.audit_bf16 = True
+        specs = ns.algos or sorted(
+            s for s in jc.CAPTURE_VARIANTS if s.endswith("@bf16")
+        )
+    else:
+        specs = ns.algos or [*sorted(tasks), *sorted(jc.CAPTURE_VARIANTS)]
     unknown = set(specs) - set(tasks) - set(jc.CAPTURE_VARIANTS)
     if unknown:
         print(f"unknown algos: {sorted(unknown)}", file=sys.stderr)
@@ -155,6 +172,35 @@ def main(argv: list[str] | None = None) -> int:
     budget_failures: list[str] = []
     budget_notes: list[str] = []
     derived = jc.build_budget([r for r in reports if r.fingerprint is not None])
+
+    gate_failures: list[str] = []
+    if ns.gate_bf16:
+        # findings under the gate are the DECLARED islands — report, don't
+        # fail; the gate compares each declared-bf16 jit's upcast count to
+        # its committed ledger entry
+        failing = []
+        if not jc.budget_exists(ns.budget):
+            print(f"no ledger at {ns.budget} (run --update-budget first)",
+                  file=sys.stderr)
+            return 2
+        committed = jc.load_budget(ns.budget).get("jits", {})
+        for key, fp in sorted(derived["jits"].items()):
+            entry = committed.get(key)
+            if entry is None:
+                gate_failures.append(f"{key}: not in the budget ledger")
+                continue
+            if not jc.declares_bf16(entry):
+                continue  # f32-only jit: audit-only by design
+            if not jc.declares_bf16(fp):
+                gate_failures.append(
+                    f"{key}: lost its declared bfloat16 compute"
+                )
+            elif int(fp.get("bf16_upcasts", 0)) > int(entry.get("bf16_upcasts", 0)):
+                gate_failures.append(
+                    f"{key}: bf16->f32 upcasts {entry.get('bf16_upcasts')} "
+                    f"-> {fp.get('bf16_upcasts')} — undeclared upcast inside "
+                    "a declared-bf16 jit"
+                )
     if ns.update_budget:
         if ns.algos and jc.budget_exists(ns.budget):
             # partial refresh: replace only the captured specs' entries —
@@ -195,6 +241,15 @@ def main(argv: list[str] | None = None) -> int:
             "suppressed": [f.as_dict() for f in suppressed],
             "budget_failures": budget_failures,
             "budget_notes": budget_notes,
+            "bf16_gate_failures": gate_failures,
+            "bf16_upcasts": (
+                {
+                    k: fp.get("bf16_upcasts")
+                    for k, fp in sorted(derived["jits"].items())
+                }
+                if ns.gate_bf16
+                else None
+            ),
             "capture_errors": capture_errors,
             "jits": sorted(derived["jits"]),
         }, indent=2))
@@ -208,14 +263,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"budget note: {note}", file=sys.stderr)
         for failure in budget_failures:
             print(f"BUDGET DRIFT: {failure}")
+        for failure in gate_failures:
+            print(f"BF16 GATE: {failure}")
 
     if capture_errors:
         return 2
-    if failing or budget_failures:
+    if failing or budget_failures or gate_failures:
         n = len(failing)
         print(
             f"sheepcheck: {n} finding(s), {len(suppressed)} suppressed, "
-            f"{len(budget_failures)} budget drift(s)",
+            f"{len(budget_failures)} budget drift(s), "
+            f"{len(gate_failures)} bf16 gate failure(s)",
             file=sys.stderr,
         )
         return 1

@@ -75,7 +75,12 @@ def policy_step(agent: RecurrentPPOAgent, obs, state, key):
 
 @jax.jit
 def bootstrap_values(agent: RecurrentPPOAgent, obs, state):
-    return agent.get_values(obs, state)
+    # values only: the advanced LSTM state was computed, materialized, and
+    # discarded at the lone call site, while the INPUT state stayed live for
+    # the next rollout — so every dispatch held a dead state-sized output
+    # next to its undonatable input (sheepmem SC010's first catch)
+    values, _ = agent.get_values(obs, state)
+    return values
 
 
 def make_train_step(args: RecurrentPPOArgs, optimizer, seq_len: int, num_minibatches: int):
@@ -425,7 +430,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         # module-level jit on (agent, ...) — `jax.jit(state.agent.get_values)`
         # here would build a fresh bound-method closure (and a fresh trace)
         # every update (sheeplint SL004)
-        next_value, _ = bootstrap_values_w(
+        next_value = bootstrap_values_w(
             state.agent, jnp.asarray(next_obs)[None], agent_state[1]
         )
         returns, advantages = ops.gae(

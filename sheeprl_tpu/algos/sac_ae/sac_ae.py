@@ -671,7 +671,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             args, optimizers, tuple(cnn_keys), tuple(mlp_keys)
         )
         chunk = args.recon_chunk
-        if chunk < 0:  # auto: measure the recon lowering, predict, decide
+        if chunk < 0:  # auto: ledger bytes first, measured lowering fallback
             decision = decide_batch_chunk(
                 train_step.jits["recon"],
                 (
@@ -679,6 +679,11 @@ def main(argv: Sequence[str] | None = None) -> None:
                     state.decoder_opt, _data_spec((global_batch,)), key,
                 ),
                 global_batch,
+                # the committed sheepmem fingerprint of this jit (tiny
+                # capture avals): its measured temp bytes, scaled by
+                # argument-byte ratio, decide the chunk without a trial
+                # compile; absent entry -> the measured ladder as before
+                ledger_key="sac_ae/recon_step",
             )
             telem.event("compile.partition", jit="recon", **decision.as_event())
             chunk = decision.chunk

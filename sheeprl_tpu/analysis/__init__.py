@@ -26,9 +26,15 @@ Two halves of one invariant set (ISSUE 3):
     collectives, silent full replication, cross-jit resharding thrash on
     declared data edges, eager host-loop collectives), plus the per-jit
     comms ledger behind the CI-gated comms drift budget.
+  - `memory_check`: the device-memory half (ISSUE 10, `tools/sheepmem.py`)
+    — every registered jit is compiled and its memory fingerprint read off
+    XLA's `memory_analysis()` + the optimized HLO (SC010-SC013: missed and
+    dropped donations, executable-embedded constants, per-shard peaks over
+    budget), plus the `memory` ledger section behind the CI-gated HBM
+    drift budget and the bf16 activation-byte receipt.
 """
 
-from . import jaxpr_check, shard_check
+from . import jaxpr_check, memory_check, shard_check
 from .linter import lint_file, lint_paths, lint_source
 from .rules import RULES, Rule, Violation
 from .sanitizer import Sanitizer
@@ -36,6 +42,7 @@ from .sanitizer import Sanitizer
 __all__ = [
     "RULES",
     "jaxpr_check",
+    "memory_check",
     "shard_check",
     "Rule",
     "Violation",

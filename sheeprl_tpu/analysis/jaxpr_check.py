@@ -930,11 +930,12 @@ def analyze_plan(
 # a PR's ledger diff reads as "which jits of which algo changed". Each spec
 # file can hold several SECTIONS: `jits` (sheepcheck's compile-cost
 # fingerprints), `comms` and `edges` (sheepshard's collective/contract
-# fingerprints); savers only rewrite their own sections. The pre-split
-# single-blob `analysis/budget.json` is still readable for one release so
-# older branches keep gating.
+# fingerprints), and `memory` (sheepmem's buffer-lifetime fingerprints);
+# savers only rewrite their own sections. The pre-split single-blob
+# `analysis/budget.json` is still readable for one release so older
+# branches keep gating.
 
-_LEDGER_SECTIONS = ("jits", "comms", "edges")
+_LEDGER_SECTIONS = ("jits", "comms", "edges", "memory")
 
 
 def budget_dir_of(path: str) -> str:

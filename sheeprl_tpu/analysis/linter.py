@@ -890,6 +890,93 @@ def _check_sl010(a: _FileAnalysis) -> None:
         )
 
 
+# array constructors whose module-level result is an ndarray constant —
+# closing over one from a jit body bakes it into every compiled executable
+# (the sheepmem SC012 class, caught here before trace time)
+_SL011_BUILDER_LEAVES = {
+    "array", "asarray", "zeros", "ones", "full", "arange", "linspace",
+    "logspace", "eye", "identity", "tri", "diag", "stack", "concatenate",
+    "meshgrid", "load", "loadtxt", "fromfunction", "frombuffer",
+}
+
+
+def _check_sl011(a: _FileAnalysis) -> None:
+    """Module-level ndarray constants referenced inside jit bodies. Only
+    names ASSIGNED at module scope from a numpy/jax.numpy array constructor
+    count — imported names, scalars, and locals are out of scope, so what
+    this catches is near-certainly a baked-in executable constant."""
+    globals_: dict[str, str] = {}
+    for node in a.tree.body:
+        targets: list[ast.expr] = []
+        value: ast.AST | None = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if not isinstance(value, ast.Call):
+            continue
+        d = a._dotted(value.func)
+        if d is None:
+            continue
+        root, _, leaf = d.rpartition(".")
+        root_head = root.split(".", 1)[0]
+        is_builder = leaf in _SL011_BUILDER_LEAVES and (
+            root_head in a.np_roots
+            or root_head in a.jnp_roots
+            or root.startswith(("numpy", "jax.numpy"))
+        )
+        if not is_builder:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                globals_[t.id] = d
+    if not globals_:
+        return
+    reported: set[tuple[int, str]] = set()
+    for ctx in a._top_level_contexts():
+        # names bound locally anywhere under the context (params, assigns,
+        # comprehension vars) shadow the module constant
+        local: set[str] = set()
+        for node in ast.walk(ctx):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                for p in (
+                    *args.posonlyargs, *args.args, *args.kwonlyargs,
+                    *filter(None, (args.vararg, args.kwarg)),
+                ):
+                    local.add(p.arg)
+            elif isinstance(node, ast.Lambda):
+                for p in (*node.args.args, *node.args.kwonlyargs):
+                    local.add(p.arg)
+            elif isinstance(node, (ast.Name, ast.Global)) and (
+                isinstance(node, ast.Global)
+                or isinstance(node.ctx, ast.Store)
+            ):
+                local.update(
+                    node.names if isinstance(node, ast.Global) else [node.id]
+                )
+        for node in ast.walk(ctx):
+            if not (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in globals_
+                and node.id not in local
+            ):
+                continue
+            key = (node.lineno, node.id)
+            if key in reported:
+                continue
+            reported.add(key)
+            owner = getattr(ctx, "name", "<lambda>")
+            a.report(
+                "SL011", node,
+                f"jitted `{owner}` closes over module-level ndarray "
+                f"`{node.id}` (= {globals_[node.id]}(...)) — baked into "
+                "every compiled executable as an embedded constant; pass "
+                "it as an argument instead",
+            )
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -910,6 +997,7 @@ def lint_source(
     _check_sl008(analysis)
     _check_sl009(analysis)
     _check_sl010(analysis)
+    _check_sl011(analysis)
     for ctx in analysis._top_level_contexts():
         _check_sl002(analysis, ctx)
         _check_sl003(analysis, ctx)

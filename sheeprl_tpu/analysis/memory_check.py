@@ -1,0 +1,698 @@
+"""sheepmem: static memory & buffer-lifetime analysis over the compiled plan.
+
+The ledger family audits compute (sheepcheck, jaxpr_check.py) and
+collectives (sheepshard, shard_check.py) but was blind to the resource that
+actually caps a TPU run: device memory. MSRL (arXiv:2210.00882) and
+MindSpeed RL (arXiv:2507.19017) treat per-fragment memory footprints as
+first-class placement inputs — the replay service, serving tier, and
+fragment graph on the ROADMAP all need to know, per jit, "how many bytes
+does one dispatch of you hold live?" before anything can be placed or
+admission-controlled. This module closes that gap: every registered jit of
+every capture spec (the 13 mains, the `@bf16`/Anakin CAPTURE_VARIANTS, and
+the mesh-bearing SHARD_SWEEP configurations) is lowered AND compiled (CPU
+virtual mesh, zero execution) and two sources are read off the executable:
+
+  - XLA's own `memory_analysis()` (CompiledMemoryStats): argument / output
+    / temp / generated-code bytes, summed into the peak the runtime must
+    provision (`peak = args + outputs + temps + code`; the alias counter is
+    skipped — XLA only reports it on fresh compiles, so netting it out
+    would drift with persistent-cache state);
+  - the post-optimization HLO text: the realized `input_output_alias`
+    table (which DECLARED donations XLA actually honored), every
+    executable-embedded array constant, and each `while` loop's carried
+    buffers with `known_trip_count` — the buffers that stay live across
+    every iteration of the dreamer imagination/RSSM scans, i.e. the remat
+    advisor's input.
+
+Rule catalog (continues the SC numbering; suppressions in
+`MEM_SUPPRESSIONS`, keyed `(spec, jit, rule)`, justification mandatory):
+
+  SC010  missed donation — an undonated input whose (shape, dtype) byte-
+         matches an output, above a size floor: the caller's buffer could
+         be reused in place, instead the dispatch holds both copies live.
+  SC011  dropped donation — an argument DECLARED donated whose param index
+         never appears in the executable's realized input_output_alias
+         table: XLA silently refused the alias, so the jit's peak holds
+         donor and output simultaneously (silent peak doubling). Checked
+         against the compiled module, not the jaxpr — sheepcheck SC003 is
+         the jaxpr-level screen, this is the receipt.
+  SC012  large closure-captured constant baked into the executable — a
+         big array literal in the optimized HLO bloats every persistent-
+         cache entry, is re-materialized per executable, and can never be
+         donated or sharded. sheeplint SL011 is the source-level twin.
+  SC013  per-shard peak over budget — a mesh-bearing jit whose per-
+         participant peak exceeds the configured HBM budget: the config
+         would OOM on a real chip of that size regardless of schedule.
+
+Fingerprints are committed as the `memory` section of the per-spec
+`analysis/budget/` files; `tools/sheepmem.py --check-budget` is the CI
+drift gate: peak growth past tolerance, lost realized aliases, new large
+constants, per-shard budget breaches, and any `@bf16` variant whose
+full-width activation bytes are not measurably below its f32 twin
+(`wide_activation_bytes` — the byte-level receipt of the ISSUE-9 mixed-
+precision contract) all fail; reductions are notes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Any, Iterable, Iterator
+
+from .rules import Rule
+from . import jaxpr_check as jc
+from . import shard_check as sc
+
+__all__ = [
+    "MEM_RULES",
+    "MEM_SUPPRESSIONS",
+    "MemReport",
+    "analyze_entry",
+    "analyze_mem_plan",
+    "build_memory_budget",
+    "check_memory_budget",
+    "constant_floor",
+    "donation_floor",
+    "memory_fingerprint",
+    "memory_sweep_specs",
+    "parse_embedded_constants",
+    "parse_io_aliases",
+    "parse_scan_buffers",
+    "peak_budget_bytes",
+    "remat_advice",
+    "resolve_capture",
+]
+
+ERROR = "error"
+WARNING = "warning"
+
+_MEM_RULES = [
+    Rule(
+        id="SC010",
+        name="missed-donation",
+        severity=WARNING,
+        summary=(
+            "undonated input whose (shape, dtype) byte-matches an output "
+            "above the size floor — the caller's buffer could be reused in "
+            "place, instead the dispatch holds input and output copies "
+            "live simultaneously"
+        ),
+        autofix=(
+            "donate the argument (donating_jit / donate_argnums) when the "
+            "caller discards it after the call; suppress with the "
+            "justification where the caller genuinely re-reads the buffer"
+        ),
+    ),
+    Rule(
+        id="SC011",
+        name="dropped-donation",
+        severity=WARNING,
+        summary=(
+            "argument declared donated but ABSENT from the executable's "
+            "realized input_output_alias table — XLA silently refused the "
+            "alias, so the jit's peak holds donor and output buffers "
+            "simultaneously (silent peak doubling)"
+        ),
+        autofix=(
+            "make the donated argument's aval exactly match a returned "
+            "output (same shape, dtype, and sharding) so XLA can realize "
+            "the alias, or drop the donation"
+        ),
+    ),
+    Rule(
+        id="SC012",
+        name="embedded-constant",
+        severity=WARNING,
+        summary=(
+            "large array constant baked into the compiled executable "
+            "(a closure-captured module-level ndarray, a materialized "
+            "table) — bloats every persistent-cache entry, re-materializes "
+            "per executable, and can never be donated or sharded"
+        ),
+        autofix=(
+            "pass the array as a jit argument (it becomes a device buffer "
+            "shared across executables), or construct it inside the jit "
+            "from an iota/broadcast; sheeplint SL011 catches the closure "
+            "pattern at source level"
+        ),
+    ),
+    Rule(
+        id="SC013",
+        name="per-shard-peak-over-budget",
+        severity=ERROR,
+        summary=(
+            "mesh-bearing jit whose per-participant peak bytes exceed the "
+            "configured HBM budget (SHEEPRL_TPU_MEM_PEAK_BUDGET_MB) — the "
+            "sharded config would OOM on a chip of that size regardless "
+            "of schedule"
+        ),
+        autofix=(
+            "shard the offending operands over more axes, chunk the batch "
+            "(decide_batch_chunk), or remat the scan bodies the peak "
+            "report names"
+        ),
+    ),
+]
+
+MEM_RULES: dict[str, Rule] = {r.id: r for r in _MEM_RULES}
+
+# (spec, jit, rule) -> justification; same auditable contract as
+# jaxpr_check.SUPPRESSIONS and shard_check.SHARD_SUPPRESSIONS.
+MEM_SUPPRESSIONS: dict[tuple[str, str, str], str] = {
+    # The recurrent player carries its LSTM state through the policy step:
+    # (h, c) in -> (h, c) out every env step. The caller (the collection
+    # loop) immediately overwrites its reference, so donation WOULD be
+    # legal — but the same buffers also feed the stored trajectory, and at
+    # 8-unit capture widths the pair is <2KiB; the floor only trips here
+    # because the obs history window byte-matches. Revisit with ROADMAP-2's
+    # replay service, which owns those buffers explicitly.
+    ("ppo_recurrent", "policy_step", "SC010"): (
+        "LSTM carry is also referenced by the stored trajectory; donation "
+        "would invalidate the replay view"
+    ),
+    ("ppo_recurrent@bf16", "policy_step", "SC010"): (
+        "LSTM carry is also referenced by the stored trajectory; donation "
+        "would invalidate the replay view"
+    ),
+}
+
+# ---------------------------------------------------------------------------
+# floors / budgets (env-tunable, mirroring shard_check's replicated floor)
+# ---------------------------------------------------------------------------
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def donation_floor() -> int:
+    """SC010 fires only for buffers at least this large. The default
+    (512 B) is sized to the TINY capture avals the committed sweep runs at
+    — an LSTM carry at capture width is ~512 B but scales with
+    envs x hidden at live widths, so the capture-scale finding is the real
+    one. Raise via env for production-scale one-off audits."""
+    return _env_int("SHEEPRL_TPU_MEM_DONATION_FLOOR", 512)
+
+
+def alias_floor() -> int:
+    """SC011 ignores dropped donations smaller than this (default 1 KiB —
+    a refused scalar alias costs nothing)."""
+    return _env_int("SHEEPRL_TPU_MEM_ALIAS_FLOOR", 1 << 10)
+
+
+def constant_floor() -> int:
+    """SC012 fires for embedded constants at least this large (default
+    16 KiB per constant)."""
+    return _env_int("SHEEPRL_TPU_MEM_CONSTANT_FLOOR", 1 << 14)
+
+
+def peak_budget_bytes() -> int:
+    """SC013 per-shard peak budget (default 512 MiB — far above any tiny-
+    width capture, sized so a pathological sharded config still trips)."""
+    return _env_int("SHEEPRL_TPU_MEM_PEAK_BUDGET_MB", 512) * (1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing: realized aliases, embedded constants, scan buffers
+# ---------------------------------------------------------------------------
+
+# `input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, must-alias) }`
+# on the HloModule header line; inner braces force the non-greedy nested
+# scan below.
+_ALIAS_TABLE_RE = re.compile(
+    r"input_output_alias=\{((?:\{[^{}]*\}|[^{}])*)\}"
+)
+_ALIAS_PAIR_RE = re.compile(r"\{([0-9,\s]*)\}:\s*\((\d+),\s*\{[0-9,\s]*\}")
+
+# `%constant.3 = f32[64,64]{1,0} constant(...)` — the result type token
+# carries the full shape; the literal itself may be elided (`{...}`).
+_CONST_RE = re.compile(
+    r"=\s*([a-z0-9]+\[[0-9,]*\])(?:\{[^}]*\})?\s+constant\("
+)
+
+# `%w = (s32[], f32[4,16]{1,0}) while((...) %t), condition=..., body=...`
+_WHILE_RE = re.compile(r"=\s*(\([^=]*?\)|\S+)\s+while\(")
+
+
+def parse_io_aliases(hlo_text: str) -> list[str]:
+    """The realized input->output aliases of a compiled module, as stable
+    `out{<output index>}<-arg<param>` strings (what the ledger commits and
+    the SC011/lost-alias gates compare)."""
+    header = hlo_text.split("\n", 1)[0]
+    m = _ALIAS_TABLE_RE.search(header)
+    if m is None:
+        return []
+    out = []
+    for out_idx, param in _ALIAS_PAIR_RE.findall(m.group(1)):
+        out.append(f"out{{{out_idx.replace(' ', '')}}}<-arg{param}")
+    return sorted(out)
+
+
+def aliased_params(aliases: Iterable[str]) -> set[int]:
+    """Param indexes that realized at least one alias."""
+    out: set[int] = set()
+    for a in aliases:
+        m = re.search(r"<-arg(\d+)$", a)
+        if m:
+            out.add(int(m.group(1)))
+    return out
+
+
+def parse_embedded_constants(hlo_text: str) -> list[tuple[int, str]]:
+    """Every array constant instruction of the optimized module as
+    `(bytes, "f32[64,64]")`, largest first. Scalars are included (they
+    cost almost nothing and the SC012 floor screens them)."""
+    out: list[tuple[int, str]] = []
+    for token in _CONST_RE.findall(hlo_text):
+        out.append((sc._shape_bytes(token), token))
+    out.sort(key=lambda t: (-t[0], t[1]))
+    return out
+
+
+def parse_scan_buffers(hlo_text: str) -> list[dict]:
+    """Per `while` loop of the optimized module: the carried buffers that
+    stay live across EVERY iteration, with the loop's `known_trip_count`
+    when XLA printed one. Returns one record per carried buffer (largest
+    first): `{"shape", "bytes", "trip_count"}` — the remat advisor's raw
+    material for the dreamer imagination/RSSM scans."""
+    records: list[dict] = []
+    for line in hlo_text.splitlines():
+        m = _WHILE_RE.search(line)
+        if m is None:
+            continue
+        trip_m = sc._TRIP_RE.search(line)
+        trip = int(trip_m.group(1)) if trip_m else None
+        for dtype, dims in sc._SHAPE_RE.findall(m.group(1)):
+            n = 1
+            for d in dims.split(","):
+                if d.strip():
+                    n *= int(d)
+            nbytes = n * sc._DTYPE_BYTES.get(dtype, 4)
+            shape = f"{dtype}[{dims}]"
+            records.append(
+                {"shape": shape, "bytes": nbytes, "trip_count": trip}
+            )
+    records.sort(key=lambda r: (-r["bytes"], r["shape"]))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# the memory fingerprint
+# ---------------------------------------------------------------------------
+
+
+def _activation_bytes(closed: Any) -> tuple[int, int]:
+    """`(total, wide)` bytes over every intermediate (eqn output) aval of
+    the traced program, recursively through scan/cond bodies. `wide` counts
+    only float32/float64 leaves — under the ISSUE-9 bf16 policy the compute
+    moves to half width, so a `@bf16` jit's wide bytes MUST undercut its
+    f32 twin even though cast buffers grow the total. That strict
+    inequality is the byte-level receipt `check_memory_budget` enforces."""
+    total = wide = 0
+    for eqn in jc.iter_eqns(closed):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            shape = getattr(aval, "shape", None)
+            dtype = getattr(aval, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            n = 1
+            for d in shape:
+                n *= int(d)
+            nbytes = n * int(getattr(dtype, "itemsize", 4))
+            total += nbytes
+            if getattr(dtype, "name", "") in ("float32", "float64"):
+                wide += nbytes
+    return total, wide
+
+
+_SCAN_BUFFERS_KEPT = 4
+
+
+def memory_fingerprint(compiled: Any, closed: Any, donated: list[bool]) -> dict:
+    """The committed per-jit memory fingerprint: CompiledMemoryStats
+    counters, realized aliases, embedded constants, live-across-scan
+    buffers, and the jaxpr-level activation footprint."""
+    ma = compiled.memory_analysis()
+    arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+    out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+    temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+    gen = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+    text = compiled.as_text()
+    aliases = parse_io_aliases(text)
+    constants = parse_embedded_constants(text)
+    floor = constant_floor()
+    total_act, wide_act = _activation_bytes(closed)
+    header = text.split("\n", 1)[0]
+    m = re.search(r"num_partitions=(\d+)", header)
+    dtypes = sorted(
+        {
+            getattr(getattr(a, "dtype", None), "name", "")
+            for a in jc._all_avals(closed)
+        }
+        - {""}
+    )
+    return {
+        # the bytes one dispatch must have provisioned. Deliberately does
+        # NOT subtract CompiledMemoryStats.alias_size_in_bytes: XLA reports
+        # it only on FRESH compiles (a persistent-cache deserialization
+        # returns 0), so a peak that nets it out drifts with cache state —
+        # the realized aliasing lives in the stable `aliases` table instead
+        "peak_bytes": arg + out + temp + gen,
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "generated_code_bytes": gen,
+        "aliases": aliases,
+        "donated": int(sum(donated)),
+        "constant_bytes": int(sum(b for b, _ in constants)),
+        "large_constants": sorted(
+            f"{shape}:{b}" for b, shape in constants if b >= floor
+        ),
+        "activation_bytes": total_act,
+        "wide_activation_bytes": wide_act,
+        "declares_bf16": "bfloat16" in dtypes,
+        "num_partitions": int(m.group(1)) if m else 1,
+        "scan_buffers": parse_scan_buffers(text)[:_SCAN_BUFFERS_KEPT],
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-entry analysis
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MemReport:
+    spec: str
+    name: str
+    memory: dict | None = None  # the committed memory fingerprint
+    findings: list[jc.Finding] = dataclasses.field(default_factory=list)
+    error: str | None = None
+
+    @property
+    def failing(self) -> list[jc.Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+
+def _check_sc010(closed: Any, donated: list[bool]) -> Iterator[str]:
+    """Undonated inputs whose aval byte-matches an output, above the floor.
+    Outputs already claimed by a donated input (the realized or intended
+    alias) are taken out of the pool first, mirroring SC003's greedy
+    matching, so a properly donated train state never double-reports."""
+    floor = donation_floor()
+    inner = closed.jaxpr
+
+    def key_of(var):
+        aval = getattr(var, "aval", None)
+        return (getattr(aval, "shape", None), getattr(aval, "dtype", None))
+
+    pool = [key_of(v) for v in inner.outvars if hasattr(v, "aval")]
+    for var, is_donated in zip(inner.invars, donated):
+        if is_donated and key_of(var) in pool:
+            pool.remove(key_of(var))
+    for i, (var, is_donated) in enumerate(zip(inner.invars, donated)):
+        if is_donated:
+            continue
+        nbytes = sc._aval_bytes(getattr(var, "aval", None))
+        if nbytes < floor:
+            continue
+        key = key_of(var)
+        if key in pool:
+            pool.remove(key)
+            yield (
+                f"input {i} ({jc._aval_str(var.aval)}, {_fmt(nbytes)}) is "
+                "not donated but byte-matches an output — one dispatch "
+                "holds both copies live; donate it if the caller discards "
+                "its reference"
+            )
+
+
+def _check_sc011(
+    closed: Any, donated: list[bool], aliases: list[str]
+) -> Iterator[str]:
+    realized = aliased_params(aliases)
+    floor = alias_floor()
+    for i, (var, is_donated) in enumerate(zip(closed.jaxpr.invars, donated)):
+        if not is_donated or i in realized:
+            continue
+        nbytes = sc._aval_bytes(getattr(var, "aval", None))
+        if nbytes < floor:
+            continue
+        yield (
+            f"donated arg {i} ({jc._aval_str(var.aval)}, {_fmt(nbytes)}) "
+            "has NO realized input_output_alias in the executable — XLA "
+            "dropped the donation, the dispatch holds donor and output "
+            "simultaneously"
+        )
+
+
+def _check_sc012(fingerprint: dict) -> Iterator[str]:
+    for item in fingerprint.get("large_constants", []):
+        shape, _, nbytes = item.rpartition(":")
+        yield (
+            f"embedded constant {shape} ({_fmt(int(nbytes))}) baked into "
+            "the executable — bloats every cache entry and can never be "
+            "donated or sharded; pass it as an argument instead"
+        )
+
+
+def _check_sc013(fingerprint: dict) -> Iterator[str]:
+    if fingerprint.get("num_partitions", 1) <= 1:
+        return
+    budget = peak_budget_bytes()
+    peak = int(fingerprint.get("peak_bytes", 0))
+    if peak > budget:
+        yield (
+            f"per-shard peak {_fmt(peak)} exceeds the "
+            f"{_fmt(budget)} HBM budget on the "
+            f"{fingerprint['num_partitions']}-device mesh — this config "
+            "OOMs on a chip of that size regardless of schedule"
+        )
+
+
+def _fmt(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KiB"
+    return f"{n}B"
+
+
+def analyze_entry(
+    spec: str,
+    entry: Any,
+    rules: set[str] | None = None,
+) -> MemReport:
+    """Lower-and-compile one CompilePlan entry and extract its memory
+    fingerprint + SC010-SC013 findings. Unlike sheepshard, every entry is
+    analyzable — single-device jits have peaks too."""
+    from ..compile.plan import avals_of
+
+    report = MemReport(spec=spec, name=entry.name)
+    fn, example = entry.fn, entry.example
+    if example is None:
+        report.error = "no example thunk (registered for timing only)"
+        return report
+    if not hasattr(fn, "trace") or not hasattr(fn, "lower"):
+        report.error = "not traceable (wrapped callable without .trace/.lower)"
+        return report
+    try:
+        specs = avals_of(example())
+        traced = fn.trace(*specs)
+        closed = traced.jaxpr
+        lowered = traced.lower()
+        compiled = lowered.compile()
+    except Exception as err:
+        report.error = f"lower/compile failed: {type(err).__name__}: {err}"[:300]
+        return report
+    donated = jc._donated_flags(lowered, closed)
+    report.memory = memory_fingerprint(compiled, closed, donated)
+
+    def emit(rule_id: str, messages: Iterable[str]) -> None:
+        if rules is not None and rule_id not in rules:
+            return
+        for message in messages:
+            finding = jc.Finding(MEM_RULES[rule_id], spec, entry.name, message)
+            finding.suppressed = MEM_SUPPRESSIONS.get((spec, entry.name, rule_id))
+            report.findings.append(finding)
+
+    emit("SC010", _check_sc010(closed, donated))
+    emit("SC011", _check_sc011(closed, donated, report.memory["aliases"]))
+    emit("SC012", _check_sc012(report.memory))
+    emit("SC013", _check_sc013(report.memory))
+    return report
+
+
+def analyze_mem_plan(
+    spec: str, plan: Any, rules: set[str] | None = None
+) -> list[MemReport]:
+    return [analyze_entry(spec, entry, rules=rules) for entry in plan._entries]
+
+
+# ---------------------------------------------------------------------------
+# the sweep: every capture population the other ledgers use, unified
+# ---------------------------------------------------------------------------
+
+
+def memory_sweep_specs() -> list[str]:
+    """The full memory-sweep population: all registered mains at their
+    CAPTURE_ARGV, every CAPTURE_VARIANT (`@bf16`, Anakin), and every
+    mesh-bearing SHARD_SWEEP spec. Where a spec name appears in both
+    (ppo@anakin, dreamer_v3@anakin) the SHARD_SWEEP mesh argv wins — the
+    per-shard peak is the TPU-relevant quantity (SC013)."""
+    import sheeprl_tpu.algos  # noqa: F401 — fire registrations
+    from sheeprl_tpu.utils.registry import tasks
+
+    specs = [*sorted(tasks), *sorted(jc.CAPTURE_VARIANTS)]
+    specs += [s for s in sorted(sc.SHARD_SWEEP) if s not in specs]
+    return specs
+
+
+def resolve_capture(spec: str) -> tuple[str, list[str]]:
+    """Capture argv for a memory-sweep spec: SHARD_SWEEP (mesh overrides)
+    first, then CAPTURE_VARIANTS, then the plain algo."""
+    return sc.resolve_capture(spec)
+
+
+# ---------------------------------------------------------------------------
+# remat advisor
+# ---------------------------------------------------------------------------
+
+
+def remat_advice(memory: dict[str, dict], top: int = 8) -> list[str]:
+    """Rank every live-across-scan buffer of a memory section by bytes and
+    render the top candidates: the buffers `jax.checkpoint` on the scan
+    body would stop keeping live for the whole trip count (the dreamer
+    imagination/RSSM scans are the intended audience)."""
+    rows: list[tuple[int, str]] = []
+    for key, fp in memory.items():
+        for buf in fp.get("scan_buffers", []):
+            trip = buf.get("trip_count")
+            trip_s = f"x{trip} known iterations" if trip else "unknown trip count"
+            rows.append(
+                (
+                    int(buf["bytes"]),
+                    f"{key}: {buf['shape']} ({_fmt(int(buf['bytes']))}) live "
+                    f"across a while/scan body ({trip_s}) — a remat "
+                    "(jax.checkpoint) candidate if the peak report names "
+                    "this jit",
+                )
+            )
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    return [msg for _, msg in rows[:top]]
+
+
+# ---------------------------------------------------------------------------
+# memory ledger: build + drift gate
+# ---------------------------------------------------------------------------
+
+
+def build_memory_budget(
+    reports: list[MemReport], peak_bytes_frac: float = 0.25
+) -> dict:
+    import jax
+
+    return {
+        "version": 1,
+        "jax_version": jax.__version__,
+        "tolerance": {"peak_bytes_frac": peak_bytes_frac},
+        "memory": {
+            f"{r.spec}/{r.name}": r.memory
+            for r in reports
+            if r.memory is not None
+        },
+    }
+
+
+def _bf16_twin(key: str) -> str | None:
+    spec, _, jit = key.partition("/")
+    if not spec.endswith("@bf16"):
+        return None
+    return f"{spec[: -len('@bf16')]}/{jit}"
+
+
+def check_memory_budget(ledger: dict, derived: dict) -> tuple[list[str], list[str]]:
+    """The CI memory drift gate. Failures are the ISSUE-gated classes:
+    added/removed entries, peak growth past tolerance, lost realized
+    aliases, new large constants, per-shard peaks over the HBM budget, and
+    a `@bf16` variant whose full-width activation bytes do not undercut
+    its f32 twin. Reductions and new aliases are notes."""
+    failures: list[str] = []
+    notes: list[str] = []
+    tol = float(ledger.get("tolerance", {}).get("peak_bytes_frac", 0.25))
+    old, new = ledger.get("memory", {}), derived.get("memory", {})
+    for key in sorted(set(old) - set(new)):
+        failures.append(f"{key}: memory fingerprint disappeared (ledger has it)")
+    for key in sorted(set(new) - set(old)):
+        failures.append(f"{key}: new jit not in the memory ledger")
+    for key in sorted(set(old) & set(new)):
+        o, n = old[key], new[key]
+        op, np_ = int(o.get("peak_bytes", 0)), int(n.get("peak_bytes", 0))
+        if np_ > op * (1.0 + tol) and np_ - op > 4096:
+            failures.append(
+                f"{key}: peak bytes grew {op} -> {np_} "
+                f"(+{(np_ - op) / max(op, 1):.0%}, tolerance {tol:.0%})"
+            )
+        elif np_ < op * (1.0 - tol) and op - np_ > 4096:
+            notes.append(
+                f"{key}: peak bytes shrank {op} -> {np_} — refresh the ledger"
+            )
+        lost = sorted(set(o.get("aliases", [])) - set(n.get("aliases", [])))
+        if lost:
+            failures.append(
+                f"{key}: realized alias(es) lost {lost} — a donation XLA "
+                "used to honor is gone (silent peak doubling)"
+            )
+        gained = sorted(set(n.get("aliases", [])) - set(o.get("aliases", [])))
+        if gained:
+            notes.append(f"{key}: new realized alias(es) {gained}")
+        new_consts = sorted(
+            set(n.get("large_constants", [])) - set(o.get("large_constants", []))
+        )
+        if new_consts:
+            failures.append(
+                f"{key}: new large embedded constant(s) {new_consts} — "
+                "baked into every cache entry (SC012)"
+            )
+        dropped = sorted(
+            set(o.get("large_constants", [])) - set(n.get("large_constants", []))
+        )
+        if dropped:
+            notes.append(f"{key}: embedded constant(s) eliminated {dropped}")
+    budget = peak_budget_bytes()
+    for key in sorted(new):
+        n = new[key]
+        if int(n.get("num_partitions", 1)) > 1 and int(n.get("peak_bytes", 0)) > budget:
+            failures.append(
+                f"{key}: per-shard peak {n['peak_bytes']} exceeds the "
+                f"{budget}-byte HBM budget on the "
+                f"{n['num_partitions']}-device mesh"
+            )
+    # the bf16 byte receipt: a declared-bf16 jit must move enough compute
+    # to half width that its full-width intermediate footprint undercuts
+    # the f32 twin — strictly, at any capture scale
+    for key in sorted(new):
+        twin = _bf16_twin(key)
+        if twin is None or twin not in new:
+            continue
+        if not new[key].get("declares_bf16"):
+            continue
+        bw = int(new[key].get("wide_activation_bytes", 0))
+        fw = int(new[twin].get("wide_activation_bytes", 0))
+        if bw >= fw:
+            failures.append(
+                f"{key}: full-width activation bytes {bw} not below the "
+                f"f32 twin's {fw} ({twin}) — the bf16 policy is not "
+                "actually narrowing the activations"
+            )
+        else:
+            notes.append(
+                f"{key}: wide activation bytes {bw} vs f32 twin {fw} "
+                f"(-{(fw - bw) / max(fw, 1):.0%})"
+            )
+    return failures, notes

@@ -223,6 +223,26 @@ _RULES = [
             "explicit reshard downstream), suppress with the justification"
         ),
     ),
+    Rule(
+        id="SL011",
+        name="ndarray-constant-closure",
+        severity=WARNING,
+        summary=(
+            "jit-wrapped function closes over a module-level/global "
+            "ndarray constant (a name assigned at module scope from "
+            "np.*/jnp.* array constructors) — the array is baked into "
+            "EVERY compiled executable as an embedded constant: it bloats "
+            "each persistent-cache entry, re-materializes per executable, "
+            "and can never be donated or sharded (sheepmem SC012 is the "
+            "compiled-level twin that measures the bytes)"
+        ),
+        autofix=(
+            "pass the array as a jit argument (one shared device buffer "
+            "across executables), construct it inside the jit from "
+            "iota/broadcast, or suppress with a justification for small "
+            "lookup tables"
+        ),
+    ),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

@@ -11,7 +11,9 @@ from .cache import MIN_COMPILE_SECS, CacheStats, arm_compile_cache, default_cach
 from .partition import (
     PartitionDecision,
     chunk_for_budget,
+    compiled_memory_stats,
     decide_batch_chunk,
+    ledger_entry,
     lowered_op_counts,
     predicted_cpu_compile_seconds,
 )
@@ -31,8 +33,10 @@ __all__ = [
     "arm_compile_cache",
     "avals_of",
     "chunk_for_budget",
+    "compiled_memory_stats",
     "decide_batch_chunk",
     "default_cache_dir",
+    "ledger_entry",
     "lowered_op_counts",
     "predicted_cpu_compile_seconds",
     "sds",

@@ -54,6 +54,14 @@ partition therefore decides on MEASURED quantities that still matter:
 
 Budgets: SHEEPRL_TPU_COMPILE_BUDGET_S (default 120 s) and
 SHEEPRL_TPU_PARTITION_MEM_MB (default 512 MiB).
+
+Since ISSUE 10 the committed sheepmem ledger (`analysis/budget/`, section
+`memory`) is the PREFERRED decision input: when the caller names its jit's
+ledger key, the measured `memory_analysis()` temp bytes — scaled from the
+capture avals to the live config by argument-byte ratio — decide the chunk
+directly, with the conv-count predictor cross-validating from the
+committed primitive histogram. The lower/trial-compile ladder below
+remains the fallback for jits without a ledger entry.
 """
 
 from __future__ import annotations
@@ -70,7 +78,9 @@ __all__ = [
     "DEFAULT_COMPILE_BUDGET_S",
     "PartitionDecision",
     "chunk_for_budget",
+    "compiled_memory_stats",
     "decide_batch_chunk",
+    "ledger_entry",
     "lowered_op_counts",
     "partition_mem_budget_bytes",
     "predicted_cpu_compile_seconds",
@@ -163,6 +173,79 @@ def partition_mem_budget_bytes() -> int:
     return int(mb * 2**20)
 
 
+def compiled_memory_stats(compiled: Any) -> dict[str, int] | None:
+    """XLA's `memory_analysis()` of a Compiled, as plain ints (None when
+    the backend does not expose it). `peak_bytes` is the bytes one dispatch
+    must have provisioned: arguments + outputs + temps + generated code.
+    `alias_size_in_bytes` is deliberately not netted out — XLA reports it
+    only on fresh compiles (persistent-cache deserializations return 0),
+    so subtracting it makes the number drift with cache state."""
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+        out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+        temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        gen = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+    except Exception:
+        return None
+    return {
+        "peak_bytes": arg + out + temp + gen,
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "generated_code_bytes": gen,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the committed memory ledger as a decision input (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def _budget_dir() -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "analysis",
+        "budget",
+    )
+    return os.environ.get("SHEEPRL_TPU_BUDGET_DIR", default)
+
+
+def ledger_entry(key: str, section: str = "memory") -> dict | None:
+    """The committed `analysis/budget/` entry for `key` ('spec/jit'), from
+    the given section — stdlib JSON only, None on any miss. This is how
+    the partition heuristic reads sheepmem's measured bytes without
+    importing the analysis package (which imports this module)."""
+    import json
+
+    spec = key.split("/", 1)[0]
+    path = os.path.join(_budget_dir(), f"{spec}.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh).get(section, {}).get(key)
+    except (OSError, ValueError):
+        return None
+
+
+def _example_arg_bytes(example: tuple) -> int:
+    """Total argument bytes of an example's avals — cheap (no lowering),
+    used to scale the ledger's measured temp bytes from the tiny capture
+    avals to the live config."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(avals_of(example)):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * int(getattr(dtype, "itemsize", 4))
+    return total
+
+
 def _chunk_for_ratio(batch: int, ratio: float) -> int:
     """Largest divisor of `batch` at or below `batch * ratio` (>=1)."""
     target = max(int(batch * min(ratio, 1.0)), 1)
@@ -179,15 +262,24 @@ def decide_batch_chunk(
     budget_s: float | None = None,
     backend: str | None = None,
     mem_budget_bytes: int | None = None,
+    ledger_key: str | None = None,
 ) -> PartitionDecision:
     """Measure `fn` and decide whether (and how finely) to partition its
     batch axis on this backend. Non-CPU backends never partition — TPU
     compiles and runs the fused program fine and prefers the fusion.
 
-    The measurement ladder on CPU:
-      1. lower (sub-second) and count convolutions; if the conv-count x
-         batch predictor says even ONE trial compile could be pathological
-         on this toolchain, chunk by the predictor without probing further;
+    The decision ladder on CPU:
+      0. `ledger_key` ('spec/jit') names a committed sheepmem fingerprint:
+         its MEASURED temp bytes, scaled from the capture avals to the
+         live config by argument-byte ratio, decide the chunk directly —
+         byte-driven, zero lowering, zero trial compile. The conv-count x
+         batch predictor still cross-validates from the committed
+         primitive histogram (a superlinear-compile toolchain chunks by
+         whichever constraint is tighter);
+      1. no ledger entry: lower (sub-second) and count convolutions; if
+         the conv-count x batch predictor says even ONE trial compile
+         could be pathological on this toolchain, chunk by the predictor
+         without probing further;
       2. otherwise trial-AOT-compile the lowered module (seconds on a
          healthy toolchain) and read XLA's own `memory_analysis()`: when
          peak temp bytes exceed the memory budget, chunk proportionally —
@@ -207,6 +299,12 @@ def decide_batch_chunk(
             chunk=0, backend=backend, batch=batch, predicted_seconds=0.0,
             budget_s=budget, reason="non-cpu backend: keep fused",
         )
+    if ledger_key is not None:
+        decision = _decide_from_ledger(
+            ledger_key, example, batch, budget, mem_budget, backend
+        )
+        if decision is not None:
+            return decision
     try:
         from .plan import avals_of
 
@@ -271,5 +369,69 @@ def decide_batch_chunk(
         chunk = 0
     return PartitionDecision(
         chunk=chunk, backend=backend, batch=batch, predicted_seconds=pred,
+        budget_s=budget, counts=counts, reason=reason,
+    )
+
+
+def _decide_from_ledger(
+    ledger_key: str,
+    example: tuple,
+    batch: int,
+    budget: float,
+    mem_budget: int,
+    backend: str,
+) -> PartitionDecision | None:
+    """Byte-driven partition decision from the committed sheepmem ledger
+    (decision-ladder step 0). None when the ledger has no usable entry —
+    the caller falls back to the measured lower/trial-compile ladder.
+
+    The ledger's temp bytes were measured at the tiny capture avals; the
+    live config's footprint is predicted by scaling with the argument-byte
+    ratio (activations scale with the data, parameters cancel out of the
+    ratio). The conv predictor cross-validates from the committed
+    primitive histogram in the same spec file's `jits` section; the chunk
+    honors whichever constraint is tighter."""
+    mem = ledger_entry(ledger_key, "memory")
+    if not mem or not mem.get("argument_bytes"):
+        return None
+    try:
+        live_args = _example_arg_bytes(example)
+    except Exception:
+        return None
+    ratio = max(live_args / max(int(mem["argument_bytes"]), 1), 1.0)
+    predicted_temp = int(int(mem.get("temp_bytes", 0)) * ratio)
+    jits = ledger_entry(ledger_key, "jits") or {}
+    convs = int(jits.get("primitives", {}).get("conv_general_dilated", 0))
+    pred_s = predicted_cpu_compile_seconds(convs, batch)
+    counts = {
+        "ledger_temp_bytes": int(mem.get("temp_bytes", 0)),
+        "ledger_argument_bytes": int(mem["argument_bytes"]),
+        "live_argument_bytes": live_args,
+        "predicted_temp_bytes": predicted_temp,
+        "convolutions": convs,
+    }
+    candidates = []
+    if predicted_temp > mem_budget:
+        candidates.append(_chunk_for_ratio(batch, mem_budget / predicted_temp))
+    if pred_s > budget:
+        candidates.append(chunk_for_budget(batch, convs, budget) or 1)
+    chunk = min((c for c in candidates if c), default=0)
+    if chunk >= batch:
+        chunk = 0
+    if chunk:
+        reason = (
+            f"ledger {ledger_key}: predicted temp "
+            f"{predicted_temp / 2**20:.0f}MiB vs budget "
+            f"{mem_budget / 2**20:.0f}MiB (predictor {pred_s:.0f}s): "
+            f"chunk {batch} -> {chunk}"
+        )
+    else:
+        reason = (
+            f"ledger {ledger_key}: predicted temp "
+            f"{predicted_temp / 2**20:.1f}MiB and predictor {pred_s:.0f}s "
+            "within budget"
+        )
+    return PartitionDecision(
+        chunk=chunk, backend=backend, batch=batch, predicted_seconds=pred_s,
         budget_s=budget, counts=counts, reason=reason,
     )

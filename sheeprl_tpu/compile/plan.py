@@ -161,7 +161,7 @@ class _Entry:
     __slots__ = (
         "name", "fn", "example", "role", "executable", "compile_seconds",
         "cache_hits", "cache_misses", "error", "done", "aot_calls",
-        "fallbacks", "barrier_wait_s", "warmed",
+        "fallbacks", "barrier_wait_s", "warmed", "memory",
     )
 
     def __init__(self, name: str, fn: Callable, example: Callable | None, role: str | None):
@@ -179,6 +179,7 @@ class _Entry:
         self.fallbacks = 0
         self.barrier_wait_s = 0.0
         self.warmed = False
+        self.memory: dict | None = None  # memory_analysis of the AOT exe
 
 
 def _materialize(specs: Any) -> Any:
@@ -441,6 +442,12 @@ class CompilePlan:
                 e.warmed = True
             else:
                 e.executable = e.fn.lower(*specs).compile()
+                # the ISSUE-10 memory-capture hook: every AOT executable
+                # reports its static footprint (the runtime half of the
+                # sheepmem ledger — telemetry_report compares the two)
+                from .partition import compiled_memory_stats
+
+                e.memory = compiled_memory_stats(e.executable)
         except Exception as err:
             e.error = f"{type(err).__name__}: {err}"[:300]
         e.compile_seconds = time.perf_counter() - t0
@@ -507,6 +514,7 @@ class CompilePlan:
                     "aot_calls": e.aot_calls,
                     "fallbacks": e.fallbacks,
                     "error": e.error,
+                    "memory": e.memory,
                 }
                 for e in entries
             },
@@ -532,6 +540,13 @@ class CompilePlan:
         for e in entries:
             if e.compile_seconds:
                 out[f"Compile/exe/{e.name}_seconds"] = e.compile_seconds
+            if e.memory is not None:
+                out[f"Compile/exe/{e.name}_peak_bytes"] = float(
+                    e.memory["peak_bytes"]
+                )
+        peaks = [e.memory["peak_bytes"] for e in entries if e.memory is not None]
+        if peaks:
+            out["Compile/plan_peak_bytes_max"] = float(max(peaks))
         if self._first_update_s is not None:
             out["Compile/time_to_first_update_seconds"] = self._first_update_s
         return out

@@ -535,10 +535,80 @@ def test_sl010_suppression_with_justification():
     assert ids(src) == []
 
 
+# ---------------------------------------------------------------------------
+# SL011 — module-level ndarray constants closed over by jit bodies
+# ---------------------------------------------------------------------------
+
+
+def test_sl011_positive_closure_over_module_constant():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    TABLE = jnp.arange(4096, dtype=jnp.float32)
+    GRID: np.ndarray = np.linspace(0.0, 1.0, 1024)
+
+    @jax.jit
+    def f(x):
+        return x + TABLE
+
+    def body(c, x):
+        return c + GRID, ()
+
+    def scanner(xs):
+        return jax.lax.scan(body, 0.0, xs)
+    """
+    assert sorted(ids(src)) == ["SL011", "SL011"]
+
+
+def test_sl011_negative_args_locals_shadowing_unjitted():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    TABLE = jnp.arange(4096)
+    scale = 3.0  # scalar: not an ndarray constant
+
+    @jax.jit
+    def passed(x, TABLE):
+        return x + TABLE  # param shadows the module constant
+
+    @jax.jit
+    def local(x):
+        TABLE = jnp.zeros_like(x)  # local rebind
+        return x + TABLE
+
+    @jax.jit
+    def scalar_ok(x):
+        return x * scale  # SL009's jurisdiction, not SL011's
+
+    def unjitted(x):
+        return x + TABLE  # eager: the constant is a plain device array
+    """
+    assert ids(src) == []
+
+
+def test_sl011_suppression_with_justification():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    TINY_LUT = jnp.asarray([0.0, 1.0, 4.0, 9.0])
+
+    @jax.jit
+    def f(x):
+        # sheeplint: disable=SL011 — 16-byte lookup table, embedding is fine
+        return TINY_LUT[x]
+    """
+    assert ids(src) == []
+
+
 def test_rule_catalog_complete():
     assert rule_ids() == [
         "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
-        "SL008", "SL009", "SL010",
+        "SL008", "SL009", "SL010", "SL011",
     ]
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")

@@ -1,12 +1,16 @@
 """The measured partition heuristic (compile/partition.py)."""
 
+import json
+
 import jax
 import jax.numpy as jnp
 import pytest
 
 from sheeprl_tpu.compile import (
     chunk_for_budget,
+    compiled_memory_stats,
     decide_batch_chunk,
+    ledger_entry,
     lowered_op_counts,
     predicted_cpu_compile_seconds,
     sds,
@@ -73,3 +77,99 @@ def test_decide_batch_chunk_cpu_vs_other_backend():
 def test_decide_handles_unlowerable_fn():
     d = decide_batch_chunk(lambda x: x, (jnp.zeros(2),), batch=8, backend="cpu")
     assert d.chunk == 0 and "lowering failed" in d.reason
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: the committed sheepmem ledger as the byte-driven decision input
+# ---------------------------------------------------------------------------
+
+
+def _write_ledger(tmp_path, spec, jit, temp_bytes, arg_bytes, convs=0):
+    blob = {
+        "memory": {
+            f"{spec}/{jit}": {
+                "temp_bytes": temp_bytes,
+                "argument_bytes": arg_bytes,
+                "peak_bytes": temp_bytes + arg_bytes,
+            }
+        },
+    }
+    if convs:
+        blob["jits"] = {
+            f"{spec}/{jit}": {
+                "primitives": {"conv_general_dilated": convs},
+            }
+        }
+    (tmp_path / f"{spec}.json").write_text(json.dumps(blob))
+
+
+def test_ledger_entry_reads_committed_sections(tmp_path, monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_BUDGET_DIR", str(tmp_path))
+    _write_ledger(tmp_path, "algox", "recon_step", 1000, 2000, convs=5)
+    mem = ledger_entry("algox/recon_step")
+    assert mem["temp_bytes"] == 1000 and mem["argument_bytes"] == 2000
+    jits = ledger_entry("algox/recon_step", "jits")
+    assert jits["primitives"]["conv_general_dilated"] == 5
+    assert ledger_entry("algox/ghost") is None
+    assert ledger_entry("missing_spec/x") is None
+
+
+def test_decide_from_ledger_memory_scaled_by_argument_ratio(tmp_path, monkeypatch):
+    """Ledger temp bytes measured at tiny avals decide the chunk for the
+    live config without lowering or trial-compiling anything — the fn may
+    even be unlowerable, proving no measurement ran."""
+    monkeypatch.setenv("SHEEPRL_TPU_BUDGET_DIR", str(tmp_path))
+    # capture avals: 1 KiB of args, 1 MiB of temps
+    _write_ledger(tmp_path, "algox", "recon_step", 1 << 20, 1 << 10)
+    # live config: 16x the argument bytes -> predicted temp 16 MiB
+    example = (sds((4, 1024), jnp.float32),)  # 16 KiB
+    d = decide_batch_chunk(
+        None, example, batch=32, backend="cpu",
+        mem_budget_bytes=4 << 20,  # 4 MiB budget: needs chunk <= batch/4
+        ledger_key="algox/recon_step",
+    )
+    assert d.chunk == 8, d
+    assert "ledger algox/recon_step" in d.reason
+    assert d.counts["predicted_temp_bytes"] == 16 << 20
+    # same ledger, roomy budget: whole batch stays fused, still no lowering
+    d = decide_batch_chunk(
+        None, example, batch=32, backend="cpu",
+        mem_budget_bytes=1 << 30, ledger_key="algox/recon_step",
+    )
+    assert d.chunk == 0 and "within budget" in d.reason
+
+
+def test_decide_from_ledger_conv_predictor_cross_validates(tmp_path, monkeypatch):
+    """The committed conv histogram still guards superlinear-compile
+    toolchains: the tighter of the byte and compile constraints wins."""
+    monkeypatch.setenv("SHEEPRL_TPU_BUDGET_DIR", str(tmp_path))
+    _write_ledger(tmp_path, "algox", "recon_step", 64, 1 << 10, convs=10)
+    example = (sds((256,), jnp.float32),)
+    budget = predicted_cpu_compile_seconds(10, 4)  # compile fits 4 elements
+    d = decide_batch_chunk(
+        None, example, batch=32, backend="cpu", budget_s=budget,
+        mem_budget_bytes=1 << 30, ledger_key="algox/recon_step",
+    )
+    assert d.chunk == 4, d
+    assert d.counts["convolutions"] == 10
+
+
+def test_decide_without_ledger_entry_falls_back_to_measurement(tmp_path, monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_BUDGET_DIR", str(tmp_path))
+    d = decide_batch_chunk(
+        jax.jit(lambda x: x * 2.0), (sds((8, 4), jnp.float32),), batch=8,
+        backend="cpu", ledger_key="nowhere/none",
+    )
+    # fell through to the trial-compile ladder (reason names the budget)
+    assert "ledger" not in d.reason
+    assert d.chunk == 0
+
+
+def test_compiled_memory_stats_reads_executable():
+    fn = jax.jit(lambda x: jnp.tanh(x) @ x)
+    compiled = fn.lower(jnp.zeros((64, 64), jnp.float32)).compile()
+    stats = compiled_memory_stats(compiled)
+    assert stats is not None
+    assert stats["argument_bytes"] == 64 * 64 * 4
+    assert stats["peak_bytes"] >= stats["argument_bytes"] + stats["output_bytes"]
+    assert compiled_memory_stats(object()) is None

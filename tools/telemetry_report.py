@@ -23,7 +23,12 @@ finished OR crashed run:
     jit of the run's algo, its collective histogram, hot-loop collectives,
     and estimated bytes-on-the-wire per dispatch, plus the declared data
     edges' contract status — what the mesh costs per step, next to what the
-    run measured.
+    run measured;
+  - a memory-budget summary (ISSUE 10) sourced from the committed sheepmem
+    ledger (`memory` section): per jit of the run's algo, its static
+    peak/temp/argument bytes, realized-vs-declared donation aliases,
+    embedded-constant bytes and the largest scan-carried buffer — compared
+    against the run's `Memory/*` gauges when present.
 
 Pure stdlib + the repo's telemetry package (no jax import), so it runs
 anywhere the JSONL can be copied to. `--selftest` synthesizes a small run
@@ -160,14 +165,15 @@ def summarize(events: list[dict]) -> dict:
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def load_comms_ledger(path: str | None = None) -> tuple[dict, dict]:
-    """`(comms, edges)` from the committed sheepshard ledger — the
-    `analysis/budget/` per-algo dir layout, with the legacy single-blob
-    fallback. Stdlib-only (this report must run anywhere the JSONL can be
-    copied to); missing ledger -> empty dicts."""
+def load_ledger_sections(
+    sections: tuple[str, ...], path: str | None = None
+) -> list[dict]:
+    """The requested sections of the committed `analysis/budget/` ledger —
+    per-algo dir layout, with the legacy single-blob fallback. Stdlib-only
+    (this report must run anywhere the JSONL can be copied to); missing
+    ledger -> empty dicts."""
     base = path or os.path.join(_REPO, "analysis", "budget")
-    comms: dict = {}
-    edges: dict = {}
+    out: list[dict] = [dict() for _ in sections]
     try:
         if os.path.isdir(base):
             for name in sorted(os.listdir(base)):
@@ -175,16 +181,27 @@ def load_comms_ledger(path: str | None = None) -> tuple[dict, dict]:
                     continue
                 with open(os.path.join(base, name), encoding="utf-8") as fh:
                     blob = json.load(fh)
-                comms.update(blob.get("comms", {}))
-                edges.update(blob.get("edges", {}))
+                for i, section in enumerate(sections):
+                    out[i].update(blob.get(section, {}))
         elif os.path.exists(base + ".json"):
             with open(base + ".json", encoding="utf-8") as fh:
                 blob = json.load(fh)
-            comms = blob.get("comms", {})
-            edges = blob.get("edges", {})
+            out = [dict(blob.get(section, {})) for section in sections]
     except (OSError, json.JSONDecodeError):
-        return {}, {}
+        return [dict() for _ in sections]
+    return out
+
+
+def load_comms_ledger(path: str | None = None) -> tuple[dict, dict]:
+    """`(comms, edges)` from the committed sheepshard ledger."""
+    comms, edges = load_ledger_sections(("comms", "edges"), path)
     return comms, edges
+
+
+def load_memory_ledger(path: str | None = None) -> dict:
+    """The committed sheepmem `memory` section (ISSUE 10)."""
+    (memory,) = load_ledger_sections(("memory",), path)
+    return memory
 
 
 def _fmt_wire(n: float) -> str:
@@ -242,6 +259,65 @@ def render_comms_budget(comms: dict, edges: dict, algo: str | None = None) -> st
         flag = " <- RESHARD THRASH" if status == "mismatch" else ""
         lines.append(
             f"edge {key}: expect={rec.get('expect', '?')} status={status}{flag}"
+        )
+    return "\n".join(lines)
+
+
+def render_memory_budget(
+    memory: dict, algo: str | None = None, runtime_peak_bytes: float = 0.0
+) -> str:
+    """The memory-budget section (ISSUE 10): the committed per-jit sheepmem
+    ledger filtered to `algo`'s specs — static peak/temp/alias/constant
+    bytes per jit — plus the static-vs-runtime comparison when the run
+    recorded `Memory/*` gauges."""
+
+    def of_algo(key: str) -> bool:
+        return algo is None or key.split("/", 1)[0].split("@", 1)[0] == algo
+
+    lines = ["== memory budget (committed sheepmem ledger) =="]
+    rows = [(k, v) for k, v in sorted(memory.items()) if of_algo(k)]
+    if not rows:
+        lines.append(
+            f"no memory fingerprints in the ledger for algo={algo!r} "
+            "(run tools/sheepmem.py --update-budget)"
+        )
+        return "\n".join(lines)
+    widths = (
+        max(len("spec/jit"), *(len(k) for k, _ in rows)) + 2,
+        10, 10, 10, 12, 10,
+    )
+    lines.append(_fmt_row(
+        ("spec/jit", "peak", "temp", "args", "aliases", "const"), widths
+    ))
+    static_peak = 0
+    for key, fp in rows:
+        static_peak = max(static_peak, int(fp.get("peak_bytes", 0)))
+        lines.append(_fmt_row(
+            (
+                key,
+                _fmt_wire(fp.get("peak_bytes", 0)),
+                _fmt_wire(fp.get("temp_bytes", 0)),
+                _fmt_wire(fp.get("argument_bytes", 0)),
+                f"{len(fp.get('aliases', []))}/{fp.get('donated', 0)}",
+                _fmt_wire(fp.get("constant_bytes", 0)),
+            ),
+            widths,
+        ))
+        for item in fp.get("large_constants", []):
+            lines.append(f"  LARGE EMBEDDED CONSTANT {item}")
+        for buf in fp.get("scan_buffers", [])[:1]:
+            trip = buf.get("trip_count")
+            lines.append(
+                f"  largest scan-carried buffer: {buf.get('shape')} "
+                f"({_fmt_wire(buf.get('bytes', 0))}"
+                + (f" x{trip} iterations)" if trip else ")")
+            )
+    if runtime_peak_bytes and static_peak:
+        ratio = runtime_peak_bytes / static_peak
+        lines.append(
+            f"runtime peak (Memory/* gauges) {_fmt_wire(runtime_peak_bytes)} "
+            f"vs static max peak {_fmt_wire(static_peak)} "
+            f"({ratio:.1f}x — buffers + executables beyond any single jit)"
         )
     return "\n".join(lines)
 
@@ -388,11 +464,17 @@ def report(path: str) -> dict:
     """Load + summarize + print; returns the summary (tests use it)."""
     summary = summarize(load_events(path))
     print(render(summary))
+    algo = (summary["start"] or {}).get("algo")
     comms, edges = load_comms_ledger()
     if comms or edges:
         print()
-        print(render_comms_budget(
-            comms, edges, algo=(summary["start"] or {}).get("algo")
+        print(render_comms_budget(comms, edges, algo=algo))
+    memory = load_memory_ledger()
+    if memory:
+        print()
+        print(render_memory_budget(
+            memory, algo=algo,
+            runtime_peak_bytes=summary["peak_memory_bytes"],
         ))
     return summary
 
@@ -461,6 +543,37 @@ def selftest() -> int:
     if comms:
         assert all("/" in k for k in comms), "comms keys must be spec/jit"
         assert all(r.get("status") for r in edges.values())
+
+    # memory-budget section (ISSUE 10): writer (sheepmem ledger schema) and
+    # this reader stay in sync — rendered from a synthetic ledger with a
+    # runtime gauge to compare against, and the committed repo ledger must
+    # load without error wherever it exists
+    mem_section = render_memory_budget(
+        {
+            "selftest/train_step": {
+                "peak_bytes": 8 << 20,
+                "temp_bytes": 3 << 20,
+                "argument_bytes": 4 << 20,
+                "donated": 12,
+                "aliases": ["out{0}<-arg0"] * 12,
+                "constant_bytes": 2048,
+                "large_constants": ["f32[4096,64]:1048576"],
+                "scan_buffers": [
+                    {"shape": "f32[64,64]", "bytes": 16384, "trip_count": 16}
+                ],
+            }
+        },
+        algo="selftest",
+        runtime_peak_bytes=float(24 << 20),
+    )
+    assert "8.0MiB" in mem_section and "12/12" in mem_section, mem_section
+    assert "LARGE EMBEDDED CONSTANT f32[4096,64]:1048576" in mem_section
+    assert "x16 iterations" in mem_section
+    assert "runtime peak" in mem_section and "3.0x" in mem_section
+    memory = load_memory_ledger()
+    if memory:
+        assert all("/" in k for k in memory), "memory keys must be spec/jit"
+        assert all("peak_bytes" in fp for fp in memory.values())
     print("\nselftest OK", file=sys.stderr)
     return 0
 

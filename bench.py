@@ -1842,6 +1842,7 @@ _METRIC_OF_ALGO = {
     "warm_compile": ("time_to_first_update_seconds", "seconds"),
     "anakin": ("anakin_env_steps_per_sec", "env-steps/sec"),
     "train_speed": ("rssm_scan_step_seconds", "seconds/step"),
+    "sheepopt": ("sheepopt_remat_peak_reduction_pct", "percent"),
 }
 
 
@@ -2085,6 +2086,150 @@ def bench_train_speed() -> None:
         "step_probes": step_probes,
         "baseline_note": BASELINE_NOTE,
     }))
+
+
+def bench_sheepopt() -> None:
+    """ISSUE 11 headline: the sheepopt auto-remat actuator A/B'd on a REAL
+    dreamer train step — the receipt that the unified measured-decision
+    framework (compile/decisions.py) turns sheepmem's remat advice into an
+    ACCEPTED, bit-exact peak-bytes win.
+
+    One `decide_remat` ladder (off / policy / on) over dreamer_v1's full
+    `make_train_step` at pixel bench shapes (T=64, B=16, R=256, 64x64x3
+    obs, cnn multiplier 4 — the conv encoder/decoder carries the exec time
+    while the RSSM/imagination scan backward carries the peak, exactly the
+    regime the remat knob exists for). Per candidate: AOT trial compile,
+    `compiled_memory_stats` peak/temp bytes, median step seconds, and a
+    bit-exactness receipt vs the non-remat baseline (new train state +
+    metrics compared leaf-for-leaf); the winner must clear the default
+    acceptance gate — STRICT peak reduction at <=5% exec-time cost. A
+    second call against the same store then receipts the unified decision
+    cache: the whole ladder (3 trial compiles) collapses into one cache
+    read. Shapes via SHEEPRL_TPU_SHEEPOPT_{T,B,R,MULT,REPEATS}; CPU
+    receipts here, chip numbers harvested opportunistically per ROADMAP."""
+    import dataclasses
+    import os
+    import tempfile
+
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v1 import dreamer_v1 as dv1
+    from sheeprl_tpu.algos.dreamer_v1.agent import build_models
+    from sheeprl_tpu.algos.dreamer_v1.args import DreamerV1Args
+    from sheeprl_tpu.compile import decisions as dec
+
+    T = int(os.environ.get("SHEEPRL_TPU_SHEEPOPT_T", "64"))
+    B = int(os.environ.get("SHEEPRL_TPU_SHEEPOPT_B", "16"))
+    R = int(os.environ.get("SHEEPRL_TPU_SHEEPOPT_R", "256"))
+    mult = int(os.environ.get("SHEEPRL_TPU_SHEEPOPT_MULT", "4"))
+    repeats = int(os.environ.get("SHEEPRL_TPU_SHEEPOPT_REPEATS", "5"))
+
+    args = DreamerV1Args(
+        env_id="discrete_dummy", per_rank_batch_size=B,
+        per_rank_sequence_length=T, horizon=15, dense_units=64,
+        recurrent_state_size=R, hidden_size=R, stochastic_size=64,
+        mlp_layers=1, cnn_keys=["rgb"], mlp_keys=[],
+        cnn_channels_multiplier=mult, use_continues=True,
+    )
+    spaces = {"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)}
+    key = jax.random.PRNGKey(0)
+    wm, actor, critic = build_models(key, [2], False, args, spaces, ["rgb"], [])
+    wo, ao, co = dv1.make_optimizers(args)
+    state = dv1.DV1TrainState(
+        world_model=wm, actor=actor, critic=critic, world_opt=wo.init(wm),
+        actor_opt=ao.init(actor), critic_opt=co.init(critic),
+    )
+    data = {
+        "rgb": jax.random.randint(
+            jax.random.PRNGKey(1), (T, B, 64, 64, 3), 0, 255, dtype=jnp.uint8
+        ),
+        "actions": jax.nn.one_hot(
+            jax.random.randint(jax.random.PRNGKey(2), (T, B), 0, 2), 2
+        ),
+        "rewards": jax.random.normal(jax.random.PRNGKey(3), (T, B, 1)),
+        "dones": jnp.zeros((T, B, 1)),
+    }
+    example = (state, data, jax.random.PRNGKey(7))
+
+    def build(mode):
+        # a fresh train step per candidate: make_train_step reads the
+        # remat mode at trace time, and the framework needs fresh trace
+        # identity anyway
+        return dv1.make_train_step(
+            dataclasses.replace(args, remat=mode), wo, ao, co, ["rgb"], [],
+        )
+
+    store = os.path.join(
+        tempfile.mkdtemp(prefix="bench_sheepopt_"), "decisions.json"
+    )
+    probe_name = f"bench.dv1_train_step[T={T},B={B},R={R},m={mult}]"
+    decision = dec.decide_remat(
+        probe_name, build, example, repeats=repeats, store_path=store,
+        force=True,
+    )
+    again = dec.decide_remat(
+        probe_name, build, example, repeats=repeats, store_path=store,
+    )
+
+    off = decision.candidate("off")
+    win = decision.candidate(decision.winner)
+    reduction_pct = (
+        100.0 * (1.0 - win["peak_bytes"] / off["peak_bytes"])
+        if off.get("peak_bytes") and win.get("peak_bytes") is not None
+        else 0.0
+    )
+    time_cost_pct = (
+        100.0 * (win["exec_seconds"] / off["exec_seconds"] - 1.0)
+        if off.get("exec_seconds") and win.get("exec_seconds") is not None
+        else 0.0
+    )
+    # the receipts the round stands on: the winner's numerics are
+    # bit-identical to the non-remat baseline, and the cache really does
+    # skip the ladder
+    assert win.get("bit_exact") is True, decision.as_dict()
+    assert again.source == "cache" and again.winner == decision.winner, (
+        again.as_dict()
+    )
+
+    candidates = {
+        lbl: {
+            "peak_bytes": rep.get("peak_bytes"),
+            "temp_bytes": rep.get("temp_bytes"),
+            "step_seconds": rep.get("exec_seconds"),
+            "compile_seconds": rep.get("compile_seconds"),
+            "bit_exact": rep.get("bit_exact"),
+        }
+        for lbl, rep in decision.candidates.items()
+    }
+    headline = {
+        "metric": "sheepopt_remat_peak_reduction_pct",
+        "value": reduction_pct if decision.accepted else 0.0,
+        "unit": "percent",
+        "vs_baseline": 0.0,
+        "config": {
+            "T": T, "B": B, "R": R, "cnn_mult": mult, "repeats": repeats,
+            "backend": jax.default_backend(), "host_cpus": os.cpu_count(),
+            "max_time_cost_frac": dec.remat_time_cost_frac(),
+        },
+        "winner": decision.winner,
+        "accepted": decision.accepted,
+        "peak_reduction_pct": reduction_pct,
+        "exec_time_cost_pct": time_cost_pct,
+        "winner_bit_exact": bool(win.get("bit_exact")),
+        "cache_hit_on_rerun": again.source == "cache",
+        "candidates": candidates,
+        "baseline_note": BASELINE_NOTE,
+    }
+    try:
+        os.makedirs("logs", exist_ok=True)
+        with open(os.path.join("logs", "bench_sheepopt_r9.json"), "w") as fh:
+            json.dump(headline, fh, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(headline))
 
 
 def bench_anakin() -> None:
@@ -2986,6 +3131,8 @@ def main() -> None:
         bench_anakin()
     elif opts.algo == "train_speed":
         bench_train_speed()
+    elif opts.algo == "sheepopt":
+        bench_sheepopt()
     else:
         bench_dreamer_v3(tiny=opts.tiny, pipeline_mode=opts.pipeline)
 

@@ -22,7 +22,7 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
-from ...ops.scan import scan_unroll
+from ...ops.scan import checkpoint_body, scan_unroll
 from ... import nn
 from ...nn.inits import init_kaiming_normal
 from ..dreamer_v3.agent import (
@@ -139,8 +139,7 @@ class RSSMV1(nn.Module):
             rec, post, _, (pm, ps), (qm, qs) = self.dynamic(post, rec, a, emb, k)
             return (post, rec), (rec, post, pm, ps, qm, qs)
 
-        if remat:
-            step = jax.checkpoint(step, prevent_cse=False)
+        step = checkpoint_body(step, remat)
         _, outs = jax.lax.scan(
             step,
             (posterior0, recurrent0),

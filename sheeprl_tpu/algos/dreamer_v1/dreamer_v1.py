@@ -38,7 +38,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
-from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec
+from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, remat_mode
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -58,7 +58,14 @@ from ..ppo.agent import (
     indices_to_env_actions,
 )
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
-from ..dreamer_v2.utils import make_device_preprocess, make_row_codec, substitute_step_obs, test
+from ..dreamer_v2.utils import (
+    make_device_preprocess,
+    make_row_codec,
+    maybe_autotune_scan_unroll,
+    maybe_decide_remat,
+    substitute_step_obs,
+    test,
+)
 from ..dreamer_v3.agent import WorldModel
 from ..dreamer_v3.dreamer_v3 import _random_actions
 from .agent import PlayerDV1, build_models
@@ -107,6 +114,7 @@ def make_train_step(
     # Gaussian means/stds, losses and lambda-return math stay f32
     # (ops/precision.py — the shared mixed-precision policy)
     compute_dtype = ops.precision.compute_dtype(args.precision)
+    use_remat = remat_mode(args.remat)
 
     def train_step(state: DV1TrainState, data: dict, key):
         T, B = data["dones"].shape[:2]
@@ -130,7 +138,7 @@ def make_train_step(
                     ),
                     embedded,
                     k_wm,
-                    remat=args.remat,
+                    remat=use_remat,
                 )
             )
             (recurrent_states, posteriors, post_means, post_stds,
@@ -220,8 +228,7 @@ def make_train_step(
                 new_latent = jnp.concatenate([new_prior, new_recurrent], axis=-1)
                 return (new_prior, new_recurrent), new_latent
 
-            if args.remat:
-                img_step = jax.checkpoint(img_step, prevent_cse=False)
+            img_step = ops.checkpoint_body(img_step, use_remat)
             # H imagination steps; trajectory entries are the POST-step
             # latents (reference dreamer_v1.py:252-258 — no entry for z0)
             _, imagined_trajectories = jax.lax.scan(
@@ -396,6 +403,15 @@ def main(argv: Sequence[str] | None = None) -> None:
         cnn_keys,
         mlp_keys,
     )
+    # SHEEPRL_TPU_SCAN_UNROLL=auto / --remat auto: both measured decisions
+    # run on this run's RSSM shapes BEFORE the train jit traces, through
+    # the shared decision cache (compile/decisions.py)
+    maybe_autotune_scan_unroll(
+        "dreamer_v1", world_model, args, int(sum(actions_dim)), telem
+    )
+    maybe_decide_remat(
+        "dreamer_v1", world_model, args, int(sum(actions_dim)), telem
+    )
     world_optimizer, actor_optimizer, critic_optimizer = make_optimizers(args)
     state = DV1TrainState(
         world_model=world_model,
@@ -460,7 +476,11 @@ def main(argv: Sequence[str] | None = None) -> None:
         # a few ints; the one-hot stays device-resident for rb.add
         return new_s, acts, env_action_indices(acts, actions_dim, is_continuous)
 
-    player_step = jax.jit(_player_step)
+    # sheepopt auto-donation (ISSUE 11, SC010 over the committed ledger):
+    # the caller rebinds player_state to this jit's output every step and
+    # never touches the old state again — donating it lets XLA alias the
+    # state buffers in place instead of holding both copies per dispatch
+    player_step = donating_jit(_player_step, donate_argnums=(1,))
     train_step = make_train_step(
         args, world_optimizer, actor_optimizer, critic_optimizer, cnn_keys,
         mlp_keys, mesh=mesh,

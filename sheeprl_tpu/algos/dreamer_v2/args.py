@@ -4,7 +4,7 @@
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import List, Literal, Optional
 
 from ...utils.parser import Arg
 from ..args import SeqParallelArgs, StandardArgs
@@ -72,12 +72,14 @@ class DreamerV2Args(SeqParallelArgs, StandardArgs):
     )
 
 
-    remat: bool = Arg(
-        default=False,
+    remat: Literal["off", "on", "policy", "auto"] = Arg(
+        default="off",
         help="rematerialize the RSSM/imagination scan bodies on backward (jax.checkpoint): "
         "recompute per-step MLP activations instead of storing them across "
         "all T steps, trading one extra forward for HBM to fit larger "
-        "batch/sequence sizes",
+        "batch/sequence sizes; `auto` runs the sheepopt measured decision "
+        "(accept on peak-bytes reduction at <=5% exec-time cost, bit-exact "
+        "receipt, winner cached next to the compile cache)",
     )
 
     # Environment settings

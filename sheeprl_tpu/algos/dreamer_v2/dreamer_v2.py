@@ -49,7 +49,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
-from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec
+from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, remat_mode
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -79,6 +79,7 @@ from .utils import (
     make_device_preprocess,
     make_row_codec,
     maybe_autotune_scan_unroll,
+    maybe_decide_remat,
     substitute_step_obs,
     test,
 )
@@ -137,6 +138,7 @@ def make_train_step(
     # --precision bfloat16: model forwards run in bf16, params stay f32,
     # logits/losses stay f32 (same policy as dreamer_v3.make_train_step)
     compute_dtype = ops.precision.compute_dtype(args.precision)
+    use_remat = remat_mode(args.remat)
 
     constrain = make_constrain(mesh)
 
@@ -175,7 +177,7 @@ def make_train_step(
                     embedded,
                     constrain_scan_inputs(constrain, scan_spec, is_first),
                     k_wm,
-                    remat=args.remat,
+                    remat=use_remat,
                 )
             )
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
@@ -271,8 +273,7 @@ def make_train_step(
             # H imagination steps; trajectory entry i is reached BY action i
             # (imagined_actions[0] is the zero action, reference
             # dreamer_v2.py:243-276)
-            if args.remat:
-                img_step = jax.checkpoint(img_step, prevent_cse=False)
+            img_step = ops.checkpoint_body(img_step, use_remat)
             _, (new_latents, actions_h) = jax.lax.scan(
                 img_step, (imagined_prior0, recurrent0), img_keys,
                 unroll=ops.scan_unroll(),
@@ -487,6 +488,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         mlp_keys,
     )
     maybe_autotune_scan_unroll(
+        "dreamer_v2", world_model, args, int(sum(actions_dim)), telem
+    )
+    maybe_decide_remat(
         "dreamer_v2", world_model, args, int(sum(actions_dim)), telem
     )
     world_optimizer, actor_optimizer, critic_optimizer = make_optimizers(args)

@@ -15,6 +15,7 @@ __all__ = [
     "preprocess_obs",
     "make_device_preprocess",
     "maybe_autotune_scan_unroll",
+    "maybe_decide_remat",
     "substitute_step_obs",
     "make_row_codec",
     "make_blob_row",
@@ -22,26 +23,18 @@ __all__ = [
 ]
 
 
-def maybe_autotune_scan_unroll(algo, world_model, args, act_dim, telem):
-    """SHEEPRL_TPU_SCAN_UNROLL=auto: run the measured unroll ladder
-    (ops/scan.py, ISSUE 9) on this run's RSSM dynamic scan at its EXACT
-    shapes BEFORE the train jit traces, install the winner as the process
-    override, and record the ladder (per-rung exec/compile seconds,
-    bit-exactness receipts) as a `scan_unroll` telemetry event.
+def _rssm_probe_example(world_model, args, act_dim):
+    """The RSSM dynamic scan's example at this run's EXACT shapes, shared
+    by the unroll ladder and the remat decision. Returns `(example,
+    has_is_first)`: the V2/V3 discrete RSSM threads an `is_first` reset
+    row through the scan, the V1 Gaussian RSSM does not — the probes
+    adapt to whichever family built the world model."""
+    import inspect
 
-    The probe is the scan alone — the train step's dominant while-loop —
-    not the whole update: five trial compiles of the full train jit would
-    cost more than they save, while the scan segment compiles in well
-    under a second per rung and its winner transfers (the imagination scan
-    shares shapes' order of magnitude and reads the same knob). A repeat
-    run with the same shapes skips the ladder through the winner store
-    next to the compile cache."""
     import jax.numpy as jnp
 
     from ... import ops
 
-    if ops.unroll_mode() != "auto":
-        return None
     T = int(args.per_rank_sequence_length)
     B = int(args.per_rank_batch_size)
     cdt = ops.precision.compute_dtype(args.precision)
@@ -52,25 +45,121 @@ def maybe_autotune_scan_unroll(algo, world_model, args, act_dim, telem):
         if discrete
         else (B, args.stochastic_size)
     )
-
-    def probe(wm, post0, rec0, acts, emb, first, k):
-        return wm.rssm.scan_dynamic(post0, rec0, acts, emb, first, k)
-
-    example = (
+    has_is_first = (
+        "is_first" in inspect.signature(world_model.rssm.scan_dynamic).parameters
+    )
+    example = [
         world_model,
         jnp.zeros(stoch, cdt),
         jnp.zeros((B, args.recurrent_state_size), cdt),
         jnp.zeros((T, B, int(act_dim)), cdt),
         jnp.zeros((T, B, emb_dim), cdt),
-        jnp.zeros((T, B, 1), jnp.float32),
-        jax.random.PRNGKey(args.seed),
-    )
+    ]
+    if has_is_first:
+        example.append(jnp.zeros((T, B, 1), jnp.float32))
+    example.append(jax.random.PRNGKey(args.seed))
+    return tuple(example), has_is_first
+
+
+def maybe_autotune_scan_unroll(algo, world_model, args, act_dim, telem):
+    """SHEEPRL_TPU_SCAN_UNROLL=auto: run the measured unroll ladder
+    (ops/scan.py, since ISSUE 11 riding the unified decision framework in
+    compile/decisions.py) on this run's RSSM dynamic scan at its EXACT
+    shapes BEFORE the train jit traces, install the winner as the process
+    override, and record the ladder (per-rung exec/compile seconds,
+    bit-exactness receipts) as a `scan_unroll` telemetry event.
+
+    The probe is the scan alone — the train step's dominant while-loop —
+    not the whole update: five trial compiles of the full train jit would
+    cost more than they save, while the scan segment compiles in well
+    under a second per rung and its winner transfers (the imagination scan
+    shares shapes' order of magnitude and reads the same knob). A repeat
+    run with the same shapes skips the ladder through the shared decision
+    cache next to the compile cache."""
+    from ... import ops
+
+    if ops.unroll_mode() != "auto":
+        return None
+    example, has_is_first = _rssm_probe_example(world_model, args, act_dim)
+
+    if has_is_first:
+        def probe(wm, post0, rec0, acts, emb, first, k):
+            return wm.rssm.scan_dynamic(post0, rec0, acts, emb, first, k)
+    else:
+        def probe(wm, post0, rec0, acts, emb, k):
+            return wm.rssm.scan_dynamic(post0, rec0, acts, emb, k)
+
+    T = int(args.per_rank_sequence_length)
+    B = int(args.per_rank_batch_size)
     decision = ops.autotune_unroll(
         f"{algo}.rssm_dynamic[T={T},B={B},R={args.recurrent_state_size}]",
         probe,
         example,
     )
     telem.event("scan_unroll", **decision.as_event())
+    return decision
+
+
+def maybe_decide_remat(algo, world_model, args, act_dim, telem):
+    """`--remat auto` (ISSUE 11 tentpole a): resolve the tri-state knob to
+    on/off by MEASUREMENT before any train jit traces, and write the
+    winner back into `args.remat` so every trace site reads a settled
+    value.
+
+    The probe is the gradient of the RSSM dynamic scan at this run's exact
+    shapes — the scan whose live-across-body buffers sheepmem's remat
+    advisor ranks. The full ladder (off / `policy` = dots-saveable
+    checkpoint / `on` = full checkpoint) is AOT trial-compiled and
+    exec-timed by the unified decision framework; a remat rung is
+    accepted only on a STRICT `memory_analysis()` peak-bytes reduction at
+    <=5% exec-time cost with a bit-exact receipt vs the non-remat
+    baseline (compile/decisions.py:decide_remat) — full remat pays a
+    whole recomputed forward, so on exec-bound hosts the policy rung is
+    the usual winner. The committed sheepmem ledger pre-screens: a train
+    step with NO live-across-scan buffers in its fingerprint has nothing
+    for remat to free, so the knob resolves to off without a single trial
+    compile. The winner persists in the shared decision cache — repeat
+    runs skip the whole ladder."""
+    import jax.numpy as jnp
+
+    from ...compile import decisions as dec
+    from ...compile.partition import ledger_entry
+
+    if str(args.remat).strip().lower() != "auto":
+        return None
+    mem = ledger_entry(f"{algo}/train_step", "memory")
+    if mem is not None and not mem.get("scan_buffers"):
+        args.remat = "off"
+        telem.event(
+            "sheepopt", family="remat", probe=f"{algo}.rssm_dynamic_grad",
+            winner="off", accepted=False, source="ledger",
+            reason="no live-across-scan buffers in the committed fingerprint",
+        )
+        return None
+    example, _ = _rssm_probe_example(world_model, args, act_dim)
+
+    def build(mode):
+        def grad_loss(wm, *rest):
+            def loss(wm):
+                outs = wm.rssm.scan_dynamic(*rest, remat=mode)
+                return sum(
+                    jnp.sum(o.astype(jnp.float32) ** 2)
+                    for o in jax.tree_util.tree_leaves(outs)
+                )
+
+            return jax.value_and_grad(loss)(wm)
+
+        return grad_loss
+
+    T = int(args.per_rank_sequence_length)
+    B = int(args.per_rank_batch_size)
+    decision = dec.decide_remat(
+        f"{algo}.rssm_dynamic_grad[T={T},B={B},R={args.recurrent_state_size}]",
+        build,
+        example,
+    )
+    args.remat = decision.winner  # "off" | "policy" | "on"
+    telem.event("sheepopt", **decision.as_event())
     return decision
 
 

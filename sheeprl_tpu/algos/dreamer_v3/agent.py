@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...ops.scan import scan_unroll
+from ...ops.scan import checkpoint_body, scan_unroll
 from ... import nn
 from ...nn.inits import init_xavier
 from ...ops.distributions import (
@@ -523,10 +523,7 @@ class RSSM(nn.Module):
             )
             return (post, rec), (rec, prior_logits, post, post_logits)
 
-        if remat:
-            # prevent_cse=False: under lax.scan the loop-carried dependence
-            # already blocks the CSE that flag guards against
-            step = jax.checkpoint(step, prevent_cse=False)
+        step = checkpoint_body(step, remat)
         _, outs = jax.lax.scan(
             step,
             (posterior0, recurrent0),

@@ -69,7 +69,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
-from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, sds
+from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, remat_mode, sds
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -92,7 +92,7 @@ from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from .agent import PlayerDV3, WorldModel, build_models
 from .args import DreamerV3Args
 from .loss import reconstruction_loss
-from ..dreamer_v2.utils import maybe_autotune_scan_unroll
+from ..dreamer_v2.utils import maybe_autotune_scan_unroll, maybe_decide_remat
 from .utils import make_device_preprocess, test
 
 
@@ -161,6 +161,7 @@ def make_train_step(
     # imagination) run in bf16 — params stay f32 (every layer casts its
     # weights to the input dtype), normalizations/logits/losses stay f32
     compute_dtype = ops.precision.compute_dtype(args.precision)
+    use_remat = remat_mode(args.remat)
 
     constrain = make_constrain(mesh)
 
@@ -207,7 +208,7 @@ def make_train_step(
                     embedded,
                     constrain_scan_inputs(constrain, scan_spec, is_first),
                     k_wm,
-                    remat=args.remat,
+                    remat=use_remat,
                 )
             )
             # back to time-sharded for the decoder/reward/continue heads
@@ -301,12 +302,10 @@ def make_train_step(
                 )
                 return (new_prior, new_recurrent), (latent, action)
 
-            if args.remat:
-                # --remat also covers the imagination backward: recompute the
-                # actor/transition activations of each horizon step instead
-                # of storing them across all H steps (same policy as the
-                # RSSM dynamic scan)
-                img_step = jax.checkpoint(img_step, prevent_cse=False)
+            # --remat also covers the imagination backward: recompute the
+            # actor/transition activations of each horizon step instead of
+            # storing them across all H steps (same mode as the RSSM scan)
+            img_step = ops.checkpoint_body(img_step, use_remat)
             # H imagination steps emitting the pre-step latent, plus the final
             # latent/action pair outside the scan: H+1 trajectory entries from
             # exactly H RSSM transitions (reference loop, dreamer_v3.py:217-223)
@@ -592,6 +591,9 @@ def main(argv: Sequence[str] | None = None) -> None:
     # SHEEPRL_TPU_SCAN_UNROLL=auto: measure the unroll ladder on this run's
     # RSSM scan shapes and install the winner before any train jit traces
     maybe_autotune_scan_unroll(
+        "dreamer_v3", world_model, args, int(sum(actions_dim)), telem
+    )
+    maybe_decide_remat(
         "dreamer_v3", world_model, args, int(sum(actions_dim)), telem
     )
     world_optimizer, actor_optimizer, critic_optimizer = make_optimizers(args)

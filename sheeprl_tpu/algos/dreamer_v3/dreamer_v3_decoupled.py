@@ -56,6 +56,7 @@ from ..ppo.agent import (
 )
 from ...compile import CompilePlan, dict_obs_spec
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
+from ..dreamer_v2.utils import maybe_autotune_scan_unroll, maybe_decide_remat
 from .agent import PlayerDV3, build_models
 from .args import DreamerV3Args
 from .dreamer_v3 import (
@@ -140,6 +141,14 @@ def main(argv: Sequence[str] | None = None) -> None:
     world_model, actor, critic, target_critic = build_models(
         model_key, actions_dim, is_continuous, args,
         envs.single_observation_space.spaces, cnn_keys, mlp_keys,
+    )
+    # SHEEPRL_TPU_SCAN_UNROLL=auto / --remat auto: measured decisions on
+    # this run's RSSM shapes before the trainer jit traces (shared cache)
+    maybe_autotune_scan_unroll(
+        "dreamer_v3_decoupled", world_model, args, int(sum(actions_dim)), telem
+    )
+    maybe_decide_remat(
+        "dreamer_v3_decoupled", world_model, args, int(sum(actions_dim)), telem
     )
     world_optimizer, actor_optimizer, critic_optimizer = make_optimizers(args)
     state = DV3TrainState(

@@ -46,7 +46,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
-from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec
+from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, remat_mode
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -70,7 +70,14 @@ from ..dreamer_v1.agent import PlayerDV1
 from ..dreamer_v1.loss import actor_loss as dv1_actor_loss
 from ..dreamer_v1.loss import critic_loss as dv1_critic_loss
 from ..dreamer_v1.loss import reconstruction_loss
-from ..dreamer_v2.utils import make_device_preprocess, make_row_codec, substitute_step_obs, test
+from ..dreamer_v2.utils import (
+    make_device_preprocess,
+    make_row_codec,
+    maybe_autotune_scan_unroll,
+    maybe_decide_remat,
+    substitute_step_obs,
+    test,
+)
 from ..dreamer_v3.agent import WorldModel
 from ..dreamer_v3.dreamer_v3 import _random_actions
 from .agent import build_models, ensemble_apply
@@ -133,6 +140,7 @@ def make_train_step(
     # disagreement ensembles) in bf16, params/losses/means/stds f32
     # (ops/precision.py)
     compute_dtype = ops.precision.compute_dtype(args.precision)
+    use_remat = remat_mode(args.remat)
 
     def behaviour_update(
         actor, critic, actor_opt, critic_opt, actor_optimizer_, critic_optimizer_,
@@ -157,8 +165,7 @@ def make_train_step(
                 new_latent = jnp.concatenate([new_prior, new_recurrent], axis=-1)
                 return (new_prior, new_recurrent), (new_latent, action)
 
-            if args.remat:
-                img_step = jax.checkpoint(img_step, prevent_cse=False)
+            img_step = ops.checkpoint_body(img_step, use_remat)
             _, (imagined_trajectories, imagined_actions) = jax.lax.scan(
                 img_step, (imagined_prior0, recurrent0), img_keys,
                 unroll=ops.scan_unroll(),
@@ -249,7 +256,7 @@ def make_train_step(
                     ),
                     embedded,
                     k_wm,
-                    remat=args.remat,
+                    remat=use_remat,
                 )
             )
             (recurrent_states, posteriors, post_means, post_stds,
@@ -506,6 +513,14 @@ def main(argv: Sequence[str] | None = None) -> None:
      critic_exploration, ensembles) = build_models(
         model_key, actions_dim, is_continuous, args,
         envs.single_observation_space.spaces, cnn_keys, mlp_keys,
+    )
+    # SHEEPRL_TPU_SCAN_UNROLL=auto / --remat auto: measured decisions on
+    # this run's RSSM shapes before any train jit traces (shared cache)
+    maybe_autotune_scan_unroll(
+        "p2e_dv1", world_model, args, int(sum(actions_dim)), telem
+    )
+    maybe_decide_remat(
+        "p2e_dv1", world_model, args, int(sum(actions_dim)), telem
     )
     optimizers = make_optimizers(args)
     state = P2EDV1TrainState(

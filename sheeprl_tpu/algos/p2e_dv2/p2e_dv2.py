@@ -44,7 +44,7 @@ from ...parallel import (
 )
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
-from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec
+from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, remat_mode
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -66,7 +66,14 @@ from ..ppo.agent import (
 from ..ppo.ppo import actions_dim_of, validate_obs_keys
 from ..dreamer_v2.agent import PlayerDV2
 from ..dreamer_v2.loss import reconstruction_loss
-from ..dreamer_v2.utils import make_device_preprocess, make_row_codec, substitute_step_obs, test
+from ..dreamer_v2.utils import (
+    make_device_preprocess,
+    make_row_codec,
+    maybe_autotune_scan_unroll,
+    maybe_decide_remat,
+    substitute_step_obs,
+    test,
+)
 from ..dreamer_v2.dreamer_v2 import _policy_entropy
 from ..dreamer_v3.agent import WorldModel
 from ..dreamer_v3.dreamer_v3 import _random_actions
@@ -136,6 +143,7 @@ def make_train_step(
     # --precision bfloat16: same policy as dreamer_v2/dreamer_v3 — forwards
     # in bf16, f32 master params, f32 logits/losses/ensemble-disagreement
     compute_dtype = ops.precision.compute_dtype(args.precision)
+    use_remat = remat_mode(args.remat)
     constrain = make_constrain(mesh)
 
     def behaviour_update(
@@ -163,8 +171,7 @@ def make_train_step(
                 new_latent = jnp.concatenate([new_prior, new_recurrent], axis=-1)
                 return (new_prior, new_recurrent), (new_latent, action)
 
-            if args.remat:
-                img_step = jax.checkpoint(img_step, prevent_cse=False)
+            img_step = ops.checkpoint_body(img_step, use_remat)
             _, (new_latents, actions_h) = jax.lax.scan(
                 img_step, (imagined_prior0, recurrent0), img_keys,
                 unroll=ops.scan_unroll(),
@@ -305,7 +312,7 @@ def make_train_step(
                     embedded,
                     constrain_scan_inputs(constrain, scan_spec, is_first),
                     k_wm,
-                    remat=args.remat,
+                    remat=use_remat,
                 )
             )
             recurrent_states, priors_logits, posteriors, posteriors_logits = (
@@ -588,6 +595,14 @@ def main(argv: Sequence[str] | None = None) -> None:
      critic_exploration, target_critic_exploration, ensembles) = build_models(
         model_key, actions_dim, is_continuous, args,
         envs.single_observation_space.spaces, cnn_keys, mlp_keys,
+    )
+    # SHEEPRL_TPU_SCAN_UNROLL=auto / --remat auto: measured decisions on
+    # this run's RSSM shapes before any train jit traces (shared cache)
+    maybe_autotune_scan_unroll(
+        "p2e_dv2", world_model, args, int(sum(actions_dim)), telem
+    )
+    maybe_decide_remat(
+        "p2e_dv2", world_model, args, int(sum(actions_dim)), telem
     )
     optimizers = make_optimizers(args)
     state = P2EDV2TrainState(

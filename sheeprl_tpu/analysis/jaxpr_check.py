@@ -866,6 +866,34 @@ def resolve_capture(spec: str) -> tuple[str, list[str]]:
     return spec, []
 
 
+def _compose_base(base: list[str], extra: list[str]) -> list[str]:
+    """`later flags win` for BOOL pairs too: argparse makes `--x`/`--no_x`
+    mutually exclusive within one argv, so a variant that flips a base
+    bool (e.g. the @remat twins' `--no_dry_run` over _DREAMER_TINY's
+    `--dry_run`) must DROP the base token rather than append its negation
+    after it. Only standalone flags (no following value token) are
+    dropped — value-bearing flags already compose by last-wins."""
+    negations = {f"--{t[5:]}" for t in extra if t.startswith("--no_")}
+    negations |= {
+        f"--no_{t[2:]}"
+        for t in extra
+        if t.startswith("--") and not t.startswith("--no_")
+    }
+    out: list[str] = []
+    i = 0
+    while i < len(base):
+        tok = base[i]
+        standalone = not (
+            i + 1 < len(base) and not str(base[i + 1]).startswith("--")
+        )
+        if tok.startswith("--") and tok in negations and standalone:
+            i += 1
+            continue
+        out.append(tok)
+        i += 1
+    return out
+
+
 def capture_plan(algo: str, root_dir: str, extra_argv: list[str] | None = None):
     """Run `algo`'s main in capture mode and return its CompilePlan.
 
@@ -882,7 +910,7 @@ def capture_plan(algo: str, root_dir: str, extra_argv: list[str] | None = None):
     if algo not in tasks:
         raise KeyError(f"unknown algo {algo!r}; registered: {sorted(tasks)}")
     argv = [
-        *CAPTURE_ARGV.get(algo, []),
+        *_compose_base(CAPTURE_ARGV.get(algo, []), extra_argv or []),
         "--platform", "cpu",
         "--root_dir", root_dir,
         "--run_name", f"sheepcheck_{algo}",
@@ -932,8 +960,9 @@ def analyze_plan(
 # fingerprints), `comms` and `edges` (sheepshard's collective/contract
 # fingerprints), and `memory` (sheepmem's buffer-lifetime fingerprints);
 # savers only rewrite their own sections. The pre-split single-blob
-# `analysis/budget.json` is still readable for one release so older
-# branches keep gating.
+# `analysis/budget.json` is NO LONGER readable (the PR-8 "one release"
+# grace period is over): a blob path without the dir layout raises with a
+# pointer at the migration, instead of silently gating against stale data.
 
 _LEDGER_SECTIONS = ("jits", "comms", "edges", "memory")
 
@@ -952,29 +981,40 @@ def budget_exists(path: str) -> bool:
 
 
 def load_budget(path: str) -> dict:
-    """Read the ledger in either layout (the per-algo dir is preferred
-    when both exist). Empty sections are dropped so a jits-only ledger
-    round-trips exactly."""
+    """Read the ledger in the per-algo dir layout. Empty sections are
+    dropped so a jits-only ledger round-trips exactly. A legacy pre-split
+    single-blob `budget.json` (without the dir next to it) is an ERROR —
+    rebuild the dir layout rather than gating against stale data."""
     d = budget_dir_of(path)
-    if os.path.isdir(d):
-        out: dict = {section: {} for section in _LEDGER_SECTIONS}
-        meta_path = os.path.join(d, "_meta.json")
-        if os.path.exists(meta_path):
-            with open(meta_path, encoding="utf-8") as fh:
-                out.update(json.load(fh))
-        for name in sorted(os.listdir(d)):
-            if not name.endswith(".json") or name == "_meta.json":
-                continue
-            with open(os.path.join(d, name), encoding="utf-8") as fh:
-                blob = json.load(fh)
-            for section in _LEDGER_SECTIONS:
-                out[section].update(blob.get(section, {}))
+    if not os.path.isdir(d):
+        if os.path.exists(path):
+            raise RuntimeError(
+                f"{path} is a legacy single-blob budget ledger; the blob "
+                "reader was removed (ISSUE 11). The ledger lives in the "
+                f"per-algo dir layout now ({d}/_meta.json + one "
+                "<spec>.json per algo/variant) — re-run "
+                "`tools/sheepcheck.py --update-budget`, "
+                "`tools/sheepshard.py --update-budget` and "
+                "`tools/sheepmem.py --update-budget` to rebuild it, then "
+                "delete the blob."
+            )
+        raise FileNotFoundError(f"no budget ledger dir at {d}")
+    out: dict = {section: {} for section in _LEDGER_SECTIONS}
+    meta_path = os.path.join(d, "_meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path, encoding="utf-8") as fh:
+            out.update(json.load(fh))
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json") or name == "_meta.json":
+            continue
+        with open(os.path.join(d, name), encoding="utf-8") as fh:
+            blob = json.load(fh)
         for section in _LEDGER_SECTIONS:
-            if not out.get(section):
-                out.pop(section, None)
-        return out
-    with open(path, encoding="utf-8") as fh:
-        return json.load(fh)
+            out[section].update(blob.get(section, {}))
+    for section in _LEDGER_SECTIONS:
+        if not out.get(section):
+            out.pop(section, None)
+    return out
 
 
 def save_budget(

@@ -67,6 +67,7 @@ from . import shard_check as sc
 __all__ = [
     "MEM_RULES",
     "MEM_SUPPRESSIONS",
+    "MEM_VARIANTS",
     "MemReport",
     "analyze_entry",
     "analyze_mem_plan",
@@ -531,7 +532,14 @@ def analyze_entry(
 def analyze_mem_plan(
     spec: str, plan: Any, rules: set[str] | None = None
 ) -> list[MemReport]:
-    return [analyze_entry(spec, entry, rules=rules) for entry in plan._entries]
+    entries = plan._entries
+    if spec in MEM_VARIANTS:
+        # the remat-receipt twins exist for ONE comparison: the train
+        # step's peak with and without remat. The other registered jits
+        # are identical to the base spec's programs at inflated shapes —
+        # fingerprinting them would only churn the ledger.
+        entries = [e for e in entries if e.name == "train_step"]
+    return [analyze_entry(spec, entry, rules=rules) for entry in entries]
 
 
 # ---------------------------------------------------------------------------
@@ -539,23 +547,62 @@ def analyze_mem_plan(
 # ---------------------------------------------------------------------------
 
 
+# Memory-only capture variants (ISSUE 11): the remat receipt twins. Both
+# run dreamer_v1 at SCAN-DOMINANT shapes — pixel obs so the conv
+# encoder/decoder carries the exec time while the RSSM/imagination scan
+# backward carries the peak (T=64 x B=16 rows live across both scans) —
+# once plain and once under `--remat on`. The capture drops `--dry_run`
+# (its T<=2 sequence clamp would collapse the scans; capture raises at
+# plan.start() before anything executes, so the full shapes are safe) and
+# `check_memory_budget` gates the pair: the @remat train step's peak must
+# undercut its @scan twin by `remat_peak_frac` (default 20%) — the
+# CI-ledgered receipt that the remat plumbing keeps buying its bytes.
+_SCAN_HEAVY = [
+    "--no_dry_run",
+    "--per_rank_sequence_length", "64",
+    "--per_rank_batch_size", "16",
+    "--recurrent_state_size", "256",
+    "--hidden_size", "256",
+    "--stochastic_size", "64",
+    "--horizon", "15",
+    "--dense_units", "64",
+    "--cnn_channels_multiplier", "4",
+    "--buffer_size", "128",
+    # with the continue predictor off, the imagination discount triangle
+    # is cumprod(ones*gamma) — XLA folds it into [H-1, T*B, 1] constants
+    # that trip SC012 at these shapes; with it on, the discount depends on
+    # data and the twins stay finding-free
+    "--use_continues",
+]
+
+MEM_VARIANTS: dict[str, tuple[str, list[str]]] = {
+    "dreamer_v1@scan": ("dreamer_v1", list(_SCAN_HEAVY)),
+    "dreamer_v1@remat": ("dreamer_v1", [*_SCAN_HEAVY, "--remat", "on"]),
+}
+
+
 def memory_sweep_specs() -> list[str]:
     """The full memory-sweep population: all registered mains at their
-    CAPTURE_ARGV, every CAPTURE_VARIANT (`@bf16`, Anakin), and every
-    mesh-bearing SHARD_SWEEP spec. Where a spec name appears in both
-    (ppo@anakin, dreamer_v3@anakin) the SHARD_SWEEP mesh argv wins — the
-    per-shard peak is the TPU-relevant quantity (SC013)."""
+    CAPTURE_ARGV, every CAPTURE_VARIANT (`@bf16`, Anakin), every
+    mesh-bearing SHARD_SWEEP spec, and the memory-only MEM_VARIANTS
+    (the `@scan`/`@remat` remat-receipt twins). Where a spec name appears
+    in both (ppo@anakin, dreamer_v3@anakin) the SHARD_SWEEP mesh argv
+    wins — the per-shard peak is the TPU-relevant quantity (SC013)."""
     import sheeprl_tpu.algos  # noqa: F401 — fire registrations
     from sheeprl_tpu.utils.registry import tasks
 
     specs = [*sorted(tasks), *sorted(jc.CAPTURE_VARIANTS)]
     specs += [s for s in sorted(sc.SHARD_SWEEP) if s not in specs]
+    specs += [s for s in sorted(MEM_VARIANTS) if s not in specs]
     return specs
 
 
 def resolve_capture(spec: str) -> tuple[str, list[str]]:
-    """Capture argv for a memory-sweep spec: SHARD_SWEEP (mesh overrides)
-    first, then CAPTURE_VARIANTS, then the plain algo."""
+    """Capture argv for a memory-sweep spec: MEM_VARIANTS first (memory-
+    only twins), then SHARD_SWEEP (mesh overrides), then CAPTURE_VARIANTS,
+    then the plain algo."""
+    if spec in MEM_VARIANTS:
+        return MEM_VARIANTS[spec]
     return sc.resolve_capture(spec)
 
 
@@ -593,14 +640,19 @@ def remat_advice(memory: dict[str, dict], top: int = 8) -> list[str]:
 
 
 def build_memory_budget(
-    reports: list[MemReport], peak_bytes_frac: float = 0.25
+    reports: list[MemReport],
+    peak_bytes_frac: float = 0.25,
+    remat_peak_frac: float = 0.20,
 ) -> dict:
     import jax
 
     return {
         "version": 1,
         "jax_version": jax.__version__,
-        "tolerance": {"peak_bytes_frac": peak_bytes_frac},
+        "tolerance": {
+            "peak_bytes_frac": peak_bytes_frac,
+            "remat_peak_frac": remat_peak_frac,
+        },
         "memory": {
             f"{r.spec}/{r.name}": r.memory
             for r in reports
@@ -614,6 +666,16 @@ def _bf16_twin(key: str) -> str | None:
     if not spec.endswith("@bf16"):
         return None
     return f"{spec[: -len('@bf16')]}/{jit}"
+
+
+def _remat_twin(key: str) -> str | None:
+    """`X@remat/train_step` -> `X@scan/train_step` (the remat receipt only
+    gates the train step — the other jits of the twin captures are
+    identical programs and would trivially fail a reduction gate)."""
+    spec, _, jit = key.partition("/")
+    if not spec.endswith("@remat") or jit != "train_step":
+        return None
+    return f"{spec[: -len('@remat')]}@scan/{jit}"
 
 
 def check_memory_budget(ledger: dict, derived: dict) -> tuple[list[str], list[str]]:
@@ -694,5 +756,26 @@ def check_memory_budget(ledger: dict, derived: dict) -> tuple[list[str], list[st
             notes.append(
                 f"{key}: wide activation bytes {bw} vs f32 twin {fw} "
                 f"(-{(fw - bw) / max(fw, 1):.0%})"
+            )
+    # the remat byte receipt (ISSUE 11): the @remat twin's train step must
+    # undercut its @scan twin's peak by at least `remat_peak_frac` — the
+    # accepted auto-remat's ledgered reduction, re-verified on every sweep
+    remat_frac = float(ledger.get("tolerance", {}).get("remat_peak_frac", 0.20))
+    for key in sorted(new):
+        twin = _remat_twin(key)
+        if twin is None or twin not in new:
+            continue
+        rp = int(new[key].get("peak_bytes", 0))
+        sp = int(new[twin].get("peak_bytes", 0))
+        if rp > sp * (1.0 - remat_frac):
+            failures.append(
+                f"{key}: remat peak {rp} is not {remat_frac:.0%} below the "
+                f"non-remat twin's {sp} ({twin}) — the remat plumbing "
+                "stopped buying its bytes"
+            )
+        else:
+            notes.append(
+                f"{key}: remat peak {rp} vs non-remat twin {sp} "
+                f"(-{(sp - rp) / max(sp, 1):.0%})"
             )
     return failures, notes

@@ -263,6 +263,7 @@ def decide_batch_chunk(
     backend: str | None = None,
     mem_budget_bytes: int | None = None,
     ledger_key: str | None = None,
+    store_path: str | None = None,
 ) -> PartitionDecision:
     """Measure `fn` and decide whether (and how finely) to partition its
     batch axis on this backend. Non-CPU backends never partition — TPU
@@ -305,46 +306,84 @@ def decide_batch_chunk(
         )
         if decision is not None:
             return decision
-    try:
-        from .plan import avals_of
 
-        lowered = fn.lower(*avals_of(example))
-        text = lowered.as_text()
-        counts = {
-            "convolutions": text.count("stablehlo.convolution"),
-            "dots": text.count("stablehlo.dot"),
-            "ops": text.count(" = "),
+    # the MEASUREMENT (lowering + trial compile) is memoized in the unified
+    # decision cache (compile/decisions.py, family `batch_chunk`, the same
+    # store the scan-unroll ladder and the remat gate use): a repeat run at
+    # the same (name, avals, jax version, backend) key skips every trial
+    # compile. Only the measurement is cached — the CHUNK is re-derived
+    # below from the budgets in force at call time, so a budget change
+    # never serves a stale decision.
+    from . import decisions as dec
+
+    def _measure() -> dict:
+        try:
+            lowered = fn.lower(*avals_of(example))
+            text = lowered.as_text()
+        except Exception as err:
+            return {"error": f"lowering failed: {type(err).__name__}"}
+        rec: dict = {
+            "counts": {
+                "convolutions": text.count("stablehlo.convolution"),
+                "dots": text.count("stablehlo.dot"),
+                "ops": text.count(" = "),
+            },
+            "trial": False,
         }
-    except Exception as err:
+        p = predicted_cpu_compile_seconds(rec["counts"]["convolutions"], batch)
+        if p > budget * 10:
+            # a toolchain with superlinear conv-grad compile would hang the
+            # trial compile itself: decide on the predictor alone
+            return rec
+        try:
+            t0 = _time.perf_counter()
+            exe = lowered.compile()
+            trial_s = _time.perf_counter() - t0
+            ma = exe.memory_analysis()
+            temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        except Exception as err:
+            rec["error"] = f"trial compile failed: {type(err).__name__}"
+            return rec
+        rec.update(trial=True, trial_seconds=trial_s, temp_bytes=temp)
+        return rec
+
+    probe_name = _probe_name(fn, ledger_key, batch)
+    record, source = dec.measured_probe(
+        "batch_chunk", probe_name, example, _measure, store_path=store_path
+    )
+    counts = dict(record.get("counts", {}))
+    if record.get("error") and not counts:
         return PartitionDecision(
             chunk=0, backend=backend, batch=batch, predicted_seconds=0.0,
-            budget_s=budget, reason=f"lowering failed: {type(err).__name__}",
+            budget_s=budget, reason=record["error"],
         )
-    pred = predicted_cpu_compile_seconds(counts["convolutions"], batch)
-    if pred > budget * 10:
-        # a toolchain with superlinear conv-grad compile would hang the
-        # trial compile itself: decide on the predictor alone
-        chunk = chunk_for_budget(batch, counts["convolutions"], budget)
+    pred = predicted_cpu_compile_seconds(counts.get("convolutions", 0), batch)
+    if not record.get("trial") and not record.get("error") and pred <= budget * 10:
+        # cached under a larger budget that skipped the trial; this budget
+        # wants the measured quantities — re-measure once
+        record, source = dec.measured_probe(
+            "batch_chunk", probe_name, example, _measure,
+            store_path=store_path, force=True,
+        )
+        counts = dict(record.get("counts", {}))
+    tag = " [probe cache]" if source == "cache" else ""
+    if record.get("error"):
+        return PartitionDecision(
+            chunk=0, backend=backend, batch=batch, predicted_seconds=pred,
+            budget_s=budget, counts=counts, reason=record["error"] + tag,
+        )
+    if not record.get("trial"):
+        chunk = chunk_for_budget(batch, counts.get("convolutions", 0), budget)
         return PartitionDecision(
             chunk=chunk, backend=backend, batch=batch, predicted_seconds=pred,
             budget_s=budget, counts=counts,
             reason=(
                 f"predicted {pred:.0f}s compile: chunk {batch} -> {chunk} "
-                "without trial compile"
+                f"without trial compile{tag}"
             ),
         )
-    try:
-        t0 = _time.perf_counter()
-        exe = lowered.compile()
-        trial_s = _time.perf_counter() - t0
-        ma = exe.memory_analysis()
-        temp_bytes = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
-    except Exception as err:
-        return PartitionDecision(
-            chunk=0, backend=backend, batch=batch, predicted_seconds=pred,
-            budget_s=budget, counts=counts,
-            reason=f"trial compile failed: {type(err).__name__}",
-        )
+    trial_s = float(record["trial_seconds"])
+    temp_bytes = int(record["temp_bytes"])
     counts["temp_bytes"] = temp_bytes
     counts["trial_compile_ms"] = int(trial_s * 1000)
     if trial_s > budget:
@@ -369,8 +408,22 @@ def decide_batch_chunk(
         chunk = 0
     return PartitionDecision(
         chunk=chunk, backend=backend, batch=batch, predicted_seconds=pred,
-        budget_s=budget, counts=counts, reason=reason,
+        budget_s=budget, counts=counts, reason=reason + tag,
     )
+
+
+def _probe_name(fn: Callable, ledger_key: str | None, batch: int) -> str:
+    """A stable per-jit probe name for the decision cache: the ledger key
+    when the caller has one, else the function's qualified name (locally
+    defined probes stay distinct through `<locals>`)."""
+    if ledger_key:
+        base = ledger_key
+    else:
+        base = (
+            f"{getattr(fn, '__module__', '')}."
+            f"{getattr(fn, '__qualname__', getattr(fn, '__name__', 'fn'))}"
+        )
+    return f"{base}[batch={batch}]"
 
 
 def _decide_from_ledger(

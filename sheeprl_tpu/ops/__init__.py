@@ -10,7 +10,7 @@ from .math import (
     two_hot,
 )
 from .moments import Moments
-from .scan import autotune_unroll, scan_unroll, set_unroll, unroll_mode
+from .scan import autotune_unroll, checkpoint_body, scan_unroll, set_unroll, unroll_mode
 from . import distributions
 from . import precision
 from . import scan
@@ -27,6 +27,7 @@ __all__ = [
     "two_hot",
     "Moments",
     "autotune_unroll",
+    "checkpoint_body",
     "scan_unroll",
     "set_unroll",
     "unroll_mode",

@@ -1,5 +1,4 @@
-"""Scan-unroll control: trace-time knob + a measured per-jit autotuner
-(ISSUE 9 tentpole c).
+"""Scan-unroll control: trace-time knob + a measured per-jit autotuner.
 
 The Dreamer-family train step is dominated by sequential scans with TINY
 step bodies (RSSM dynamic: T=64 steps of [B=16]-row matmuls through
@@ -7,48 +6,68 @@ step bodies (RSSM dynamic: T=64 steps of [B=16]-row matmuls through
 `lax.scan` to a while-loop with per-iteration control overhead that rivals
 the step's compute at these shapes, so modest unrolls (4-8) can win real
 throughput — at the cost of compile time and code size. That trade is
-hardware- and shape-dependent, which is why it was a knob with a bench
-keep-decision (BENCHES.md round 4, hypothesis #2) rather than a hardcoded
-value.
+hardware- and shape-dependent, which is why it is measured, not hardcoded.
 
-This module grows the knob into a measured ladder:
-
-  - `scan_unroll()` stays the trace-time read (Pallas-switch style): the
+  - `scan_unroll()` is the trace-time read (Pallas-switch style): the
     process-global override (autotuner / `unroll()` context) wins, then the
     `SHEEPRL_TPU_SCAN_UNROLL` env var, then 1.
   - `SHEEPRL_TPU_SCAN_UNROLL=auto` arms the autotuner: the dreamer mains
     call `autotune_unroll` on their RSSM scan with the run's EXACT shapes
-    before tracing the train step. For each rung in `RUNGS` the scan is
-    AOT-compiled (`jit.lower().compile()` — the PR-5 trial-compile
-    machinery) and executed `repeats` times; the fastest rung wins and is
-    installed as the process override, and every rung carries a
-    BIT-EXACTNESS receipt vs rung 1 (unrolling reorders nothing — a rung
-    that fails the receipt is disqualified, never silently kept).
-  - winners persist NEXT TO the compile cache (`scan_unroll.json` in the
-    jax compilation-cache directory, compile/cache.py): a re-run with the
-    same (name, avals, jax version, backend) key skips the ladder and
-    reuses the measured winner, exactly like a warm compile cache skips the
-    compile.
+    before tracing the train step.
+
+Since ISSUE 11 the ladder itself — per-rung AOT `lower().compile()`,
+exec timing, BIT-EXACTNESS receipts vs rung 1, winner persistence — is
+the unified measured-decision framework (`compile/decisions.py`, knob
+family `scan_unroll`): winners live in the ONE decision cache next to the
+compile cache (`decisions.json`) instead of the pre-ISSUE-11 private
+`scan_unroll.json`, whose entries are one-shot migrated on first use.
+`UnrollDecision` remains this module's typed view of the decision.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
-import json
 import os
-import time
 from typing import Any, Callable, Sequence
 
 __all__ = [
     "RUNGS",
     "UnrollDecision",
     "autotune_unroll",
+    "checkpoint_body",
     "scan_unroll",
     "set_unroll",
     "unroll",
     "unroll_mode",
 ]
+
+
+def checkpoint_body(step: Callable, remat: Any) -> Callable:
+    """The ONE place a scan body is wrapped for rematerialization, shared
+    by every dreamer-family RSSM/imagination scan. `remat` is the settled
+    mode (`compile.decisions.remat_mode`): "on" (or legacy True) = full
+    `jax.checkpoint` — store only the carry, recompute the whole step on
+    backward; "policy" = checkpoint with
+    `dots_with_no_batch_dims_saveable` — matmul outputs stay saved, only
+    the cheap elementwise ops recompute (most of full remat's byte
+    savings at near-zero exec cost, the rung the sheepopt ladder usually
+    accepts on exec-bound hosts); anything else = `step` unchanged.
+    `prevent_cse=False` throughout: under `lax.scan` the loop-carried
+    dependence already blocks the CSE that flag guards against."""
+    import jax
+
+    mode = remat if isinstance(remat, str) else ("on" if remat else "off")
+    mode = mode.strip().lower()
+    if mode == "policy":
+        return jax.checkpoint(
+            step,
+            prevent_cse=False,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    if mode in ("on", "true", "1", "yes"):
+        return jax.checkpoint(step, prevent_cse=False)
+    return step
 
 RUNGS = (1, 4, 8, 16, 32)
 
@@ -102,7 +121,8 @@ def unroll(k: int | None):
 @dataclasses.dataclass
 class UnrollDecision:
     """One measured ladder: per-rung compile/exec seconds, per-rung
-    bit-exactness receipts vs rung 1, and the winner."""
+    bit-exactness receipts vs rung 1, and the winner. A typed view of the
+    unified `compile/decisions.py` Decision for the scan_unroll family."""
 
     name: str
     winner: int
@@ -127,75 +147,27 @@ class UnrollDecision:
         return {**self.as_event(), "key": self.key}
 
     @classmethod
-    def from_dict(cls, d: dict[str, Any]) -> "UnrollDecision":
+    def from_decision(cls, decision: Any) -> "UnrollDecision":
+        """Build the typed view from a `compile.decisions.Decision`."""
+        timings: dict[int, float] = {}
+        compile_s: dict[int, float] = {}
+        bit_exact: dict[int, bool] = {}
+        for label, rep in decision.candidates.items():
+            rung = int(label)
+            if rep.get("exec_seconds") is not None:
+                timings[rung] = float(rep["exec_seconds"])
+            if rep.get("compile_seconds") is not None:
+                compile_s[rung] = float(rep["compile_seconds"])
+            bit_exact[rung] = bool(rep.get("bit_exact"))
         return cls(
-            name=d.get("probe") or d.get("name", ""),
-            winner=int(d["winner"]),
-            timings={int(k): float(v) for k, v in d.get("timings_s", {}).items()},
-            compile_seconds={
-                int(k): float(v) for k, v in d.get("compile_s", {}).items()
-            },
-            bit_exact={int(k): bool(v) for k, v in d.get("bit_exact", {}).items()},
-            source="cache",
-            key=d.get("key", ""),
+            name=decision.name,
+            winner=int(decision.winner),
+            timings=timings,
+            compile_seconds=compile_s,
+            bit_exact=bit_exact,
+            source=decision.source,
+            key=decision.key,
         )
-
-
-def _store_path(explicit: str | None = None) -> str:
-    """The winner store lives next to the persistent compile cache — same
-    resolution order as compile/cache.py, without arming anything."""
-    if explicit:
-        return explicit
-    base = (
-        os.environ.get("SHEEPRL_TPU_COMPILE_CACHE")
-        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-    )
-    if not base:
-        from ..compile.cache import default_cache_dir
-
-        base = default_cache_dir()
-    return os.path.join(base, "scan_unroll.json")
-
-
-def _load_store(path: str) -> dict:
-    try:
-        with open(path, encoding="utf-8") as fh:
-            return json.load(fh)
-    except Exception:
-        return {}
-
-
-def _save_store(path: str, store: dict) -> None:
-    try:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(store, fh, indent=1, sort_keys=True)
-        os.replace(tmp, path)
-    except OSError:
-        pass  # the store is an optimization; never fail the run on it
-
-
-def _decision_key(name: str, example: Sequence[Any]) -> str:
-    import jax
-
-    avals = ",".join(
-        f"{getattr(getattr(a, 'dtype', None), 'name', type(a).__name__)}"
-        f"{list(getattr(a, 'shape', []))}"
-        for a in jax.tree_util.tree_leaves(example)
-    )
-    return f"{name}|{avals}|jax{jax.__version__}|{jax.default_backend()}"
-
-
-def _bit_exact(a: Any, b: Any) -> bool:
-    import jax
-    import numpy as np
-
-    la = [np.asarray(x) for x in jax.tree_util.tree_leaves(a)]
-    lb = [np.asarray(x) for x in jax.tree_util.tree_leaves(b)]
-    if len(la) != len(lb):
-        return False
-    return all(np.array_equal(x, y, equal_nan=True) for x, y in zip(la, lb))
 
 
 def autotune_unroll(
@@ -213,83 +185,34 @@ def autotune_unroll(
     (and by default install) the winner.
 
     `fn(*example)` must be jittable and contain scans whose `unroll=` reads
-    `scan_unroll()` at trace time. Per rung: AOT `lower().compile()` (so
-    compile time is measured apart from exec), one untimed warm-up call,
-    then `repeats` timed calls (median). Rung 1 is the reference: any rung
-    whose outputs are not bit-identical is disqualified. The winner is the
-    fastest surviving rung; ties break toward the SMALLER rung (less code).
-    """
-    import jax
+    `scan_unroll()` at trace time. The ladder rides the unified decision
+    framework: per rung an AOT trial compile + timed execution + a
+    bit-exactness receipt vs rung 1 (a non-bit-exact rung is disqualified);
+    the winner is the fastest surviving rung, ties breaking toward the
+    SMALLER rung (less code), and persists in the shared decision cache —
+    a re-run with the same (name, avals, jax version, backend) key skips
+    the whole ladder."""
+    from ..compile import decisions as dec
 
-    path = _store_path(store_path)
-    key = _decision_key(name, example)
-    if not force:
-        store = _load_store(path)
-        hit = store.get(key)
-        if hit:
-            decision = UnrollDecision.from_dict({**hit, "key": key})
-            if apply:
-                set_unroll(decision.winner)
-            return decision
-
-    timings: dict[int, float] = {}
-    compile_seconds: dict[int, float] = {}
-    bit_exact: dict[int, bool] = {}
-    outputs: dict[int, Any] = {}
-    rungs = list(dict.fromkeys(int(r) for r in rungs))
-    if 1 not in rungs:
-        rungs.insert(0, 1)
-    # throwaway lower + trivial compile: absorb the process's one-time
-    # tracing/MLIR/LLVM-backend warmup so it doesn't bias the first rung's
-    # compile_seconds (the same first-call attribution trap as the r4/r5
-    # compile-vs-exec mixup)
-    import jax.numpy as jnp
-
-    def fresh(_rung):
-        # a NEW callable per rung: jax caches traces by function identity,
-        # so re-jitting the same `fn` under a different unroll context
-        # would silently reuse rung 1's jaxpr and the whole ladder would
-        # measure one program five times
-        return lambda *a: fn(*a)
-
-    with unroll(rungs[0]):
-        jax.jit(fresh(0)).lower(*example)
-    jax.block_until_ready(jax.jit(lambda v: v + 1.0)(jnp.float32(0.0)))
-    for rung in rungs:
-        with unroll(rung):
-            t0 = time.perf_counter()
-            # sheeplint: disable=SL004 — a fresh jit per rung is the POINT:
-            # each rung must trace its own program (jax's trace cache keys
-            # on fn identity; reusing one jit would measure rung 1 five
-            # times), and the ladder runs once per (shape, backend) key
-            compiled = jax.jit(fresh(rung)).lower(*example).compile()
-            compile_seconds[rung] = time.perf_counter() - t0
-            out = jax.block_until_ready(compiled(*example))  # warm-up
-            samples = []
-            for _ in range(max(1, repeats)):
-                t0 = time.perf_counter()
-                out = jax.block_until_ready(compiled(*example))
-                samples.append(time.perf_counter() - t0)
-            samples.sort()
-            timings[rung] = samples[len(samples) // 2]
-            outputs[rung] = out
-    reference = outputs[1]
-    for rung in rungs:
-        bit_exact[rung] = True if rung == 1 else _bit_exact(reference, outputs[rung])
-    eligible = [r for r in rungs if bit_exact[r]]
-    winner = min(eligible, key=lambda r: (timings[r], r))
-    decision = UnrollDecision(
-        name=name,
-        winner=winner,
-        timings=timings,
-        compile_seconds=compile_seconds,
-        bit_exact=bit_exact,
-        source="measured",
-        key=key,
+    path = dec.cache_path(store_path)
+    dec.migrate_legacy_scan_unroll(path)
+    ladder = list(dict.fromkeys(int(r) for r in rungs))
+    if 1 not in ladder:
+        ladder.insert(0, 1)
+    ladder.sort()  # rung 1 first (the baseline); ties break toward small
+    decision = dec.decide(
+        "scan_unroll",
+        name,
+        ladder,
+        lambda _rung: (lambda *a: fn(*a)),
+        example,
+        objective="seconds",
+        repeats=repeats,
+        store_path=path,
+        force=force,
+        candidate_context=unroll,
     )
-    store = _load_store(path)
-    store[key] = decision.as_dict()
-    _save_store(path, store)
+    result = UnrollDecision.from_decision(decision)
     if apply:
-        set_unroll(winner)
-    return decision
+        set_unroll(result.winner)
+    return result

@@ -533,13 +533,25 @@ def test_budget_dir_layout_sections_coexist(tmp_path):
     assert (tmp_path / "budget" / "algoX.json").read_text() == first
 
 
-def test_budget_legacy_blob_still_readable(tmp_path):
+def test_budget_legacy_blob_rejected_with_pointer(tmp_path):
+    """The PR-8 'readable for one release' grace period is over (ISSUE
+    11): a pre-split single-blob ledger raises a clear error naming the
+    dir layout and the rebuild commands, instead of silently gating
+    against stale data. Once the dir exists, it wins as before."""
+    import pytest
+
     path = str(tmp_path / "budget.json")
     blob = {"version": 1, "jits": {"a/b": {"op_count": 1}}}
     with open(path, "w") as fh:
         json.dump(blob, fh)
-    assert jc.budget_exists(path)
-    assert jc.load_budget(path) == blob
+    assert jc.budget_exists(path)  # exists -> tools route into the error
+    with pytest.raises(RuntimeError, match="legacy single-blob"):
+        jc.load_budget(path)
+    with pytest.raises(RuntimeError, match="--update-budget"):
+        jc.load_budget(path)
+    # a missing ledger is still a plain FileNotFoundError, not the hint
+    with pytest.raises(FileNotFoundError):
+        jc.load_budget(str(tmp_path / "absent.json"))
     # the dir layout wins once it exists
     jc.save_budget(blob, path, sections=("jits",))
     assert jc.load_budget(path)["jits"] == blob["jits"]

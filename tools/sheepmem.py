@@ -122,6 +122,7 @@ def main(argv: list[str] | None = None) -> int:
         if s not in tasks
         and s not in jc.CAPTURE_VARIANTS
         and s not in sc.SHARD_SWEEP
+        and s not in mc.MEM_VARIANTS
     }
     if unknown:
         print(f"unknown specs: {sorted(unknown)}", file=sys.stderr)

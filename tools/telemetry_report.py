@@ -28,7 +28,12 @@ finished OR crashed run:
     ledger (`memory` section): per jit of the run's algo, its static
     peak/temp/argument bytes, realized-vs-declared donation aliases,
     embedded-constant bytes and the largest scan-carried buffer — compared
-    against the run's `Memory/*` gauges when present.
+    against the run's `Memory/*` gauges when present;
+  - a sheepopt decisions summary (ISSUE 11) sourced from the unified
+    decision cache (`decisions.json` next to the compile cache,
+    compile/decisions.py): per measured knob decision (scan_unroll, remat,
+    batch_chunk, ...) the candidates tried, the winner, its bit-exactness
+    receipt status and bytes/seconds deltas vs the baseline.
 
 Pure stdlib + the repo's telemetry package (no jax import), so it runs
 anywhere the JSONL can be copied to. `--selftest` synthesizes a small run
@@ -169,9 +174,9 @@ def load_ledger_sections(
     sections: tuple[str, ...], path: str | None = None
 ) -> list[dict]:
     """The requested sections of the committed `analysis/budget/` ledger —
-    per-algo dir layout, with the legacy single-blob fallback. Stdlib-only
-    (this report must run anywhere the JSONL can be copied to); missing
-    ledger -> empty dicts."""
+    per-algo dir layout only (the legacy single-blob fallback is gone,
+    ISSUE 11). Stdlib-only (this report must run anywhere the JSONL can
+    be copied to); missing ledger -> empty dicts."""
     base = path or os.path.join(_REPO, "analysis", "budget")
     out: list[dict] = [dict() for _ in sections]
     try:
@@ -183,10 +188,6 @@ def load_ledger_sections(
                     blob = json.load(fh)
                 for i, section in enumerate(sections):
                     out[i].update(blob.get(section, {}))
-        elif os.path.exists(base + ".json"):
-            with open(base + ".json", encoding="utf-8") as fh:
-                blob = json.load(fh)
-            out = [dict(blob.get(section, {})) for section in sections]
     except (OSError, json.JSONDecodeError):
         return [dict() for _ in sections]
     return out
@@ -202,6 +203,78 @@ def load_memory_ledger(path: str | None = None) -> dict:
     """The committed sheepmem `memory` section (ISSUE 10)."""
     (memory,) = load_ledger_sections(("memory",), path)
     return memory
+
+
+def load_decision_cache(path: str | None = None) -> dict:
+    """The unified sheepopt decision cache (`decisions.json` next to the
+    compile cache, compile/decisions.py) — stdlib-only, empty dict when
+    absent. Resolution mirrors the writer: explicit path, then the
+    compile-cache env vars, then the tempdir default."""
+    if path is None:
+        base = (
+            os.environ.get("SHEEPRL_TPU_COMPILE_CACHE")
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        )
+        if not base:
+            import tempfile
+
+            uid = getattr(os, "getuid", lambda: "u")()
+            base = os.path.join(
+                tempfile.gettempdir(), f"sheeprl_tpu_xla_cache_{uid}"
+            )
+        path = os.path.join(base, "decisions.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def render_sheepopt_decisions(cache: dict) -> str:
+    """The sheepopt decisions section (ISSUE 11): per measured decision in
+    the unified cache, the knob family, candidates tried, winner, receipt
+    status and the winner's bytes/seconds deltas vs the baseline."""
+    lines = ["== sheepopt decisions (unified decision cache) =="]
+    ladders = {k: v for k, v in cache.items() if isinstance(v, dict) and "candidates" in v}
+    probes = {k: v for k, v in cache.items() if isinstance(v, dict) and "probe" in v}
+    if not ladders and not probes:
+        lines.append("decision cache empty (no measured decisions this host)")
+        return "\n".join(lines)
+    for key in sorted(ladders):
+        rec = ladders[key]
+        cands = rec.get("candidates", {})
+        winner = str(rec.get("winner"))
+        base = str(rec.get("baseline"))
+        wr, br = cands.get(winner, {}), cands.get(base, {})
+        disq = sorted(
+            lbl for lbl, c in cands.items() if c.get("bit_exact") is False
+        )
+        receipt = "bit-exact" if wr.get("bit_exact") else "baseline"
+        deltas = []
+        if wr.get("peak_bytes") is not None and br.get("peak_bytes"):
+            d = int(wr["peak_bytes"]) - int(br["peak_bytes"])
+            deltas.append(f"bytes {d:+d} ({d / max(br['peak_bytes'], 1):+.0%})")
+        if wr.get("exec_seconds") is not None and br.get("exec_seconds"):
+            d = float(wr["exec_seconds"]) - float(br["exec_seconds"])
+            deltas.append(
+                f"seconds {d:+.4f} ({d / max(br['exec_seconds'], 1e-12):+.1%})"
+            )
+        lines.append(
+            f"[{rec.get('family', '?')}] {rec.get('name', '?')}: "
+            f"{len(cands)} candidate(s) tried, winner={winner} "
+            f"({'ACCEPTED' if rec.get('accepted') else 'baseline kept'}, "
+            f"{receipt}"
+            + (f", disqualified: {','.join(disq)}" if disq else "")
+            + (f"; {' '.join(deltas)}" if deltas else "")
+            + ")"
+        )
+    for key in sorted(probes):
+        rec = probes[key]
+        lines.append(
+            f"[{rec.get('family', '?')}] {rec.get('name', '?')}: measured "
+            f"probe cached ({', '.join(sorted(rec['probe']))})"
+        )
+    return "\n".join(lines)
 
 
 def _fmt_wire(n: float) -> str:
@@ -476,6 +549,10 @@ def report(path: str) -> dict:
             memory, algo=algo,
             runtime_peak_bytes=summary["peak_memory_bytes"],
         ))
+    decisions = load_decision_cache()
+    if decisions:
+        print()
+        print(render_sheepopt_decisions(decisions))
     return summary
 
 
@@ -574,6 +651,48 @@ def selftest() -> int:
     if memory:
         assert all("/" in k for k in memory), "memory keys must be spec/jit"
         assert all("peak_bytes" in fp for fp in memory.values())
+
+    # sheepopt decisions section (ISSUE 11): writer schema
+    # (compile/decisions.py Decision.as_dict + measured_probe records) and
+    # this renderer stay in sync — a ladder with a disqualified rung, an
+    # accepted bytes-objective winner, and a cached probe
+    fake_cache = {
+        "remat|selftest.step|f32[4]|jax0|cpu": {
+            "family": "remat", "name": "selftest.step",
+            "winner": "on", "baseline": "off", "objective": "bytes",
+            "accepted": True, "source": "measured",
+            "candidates": {
+                "off": {"exec_seconds": 1.0, "compile_seconds": 0.5,
+                        "bit_exact": True, "peak_bytes": 100 << 20,
+                        "temp_bytes": 90 << 20},
+                "policy": {"exec_seconds": 1.0, "compile_seconds": 0.6,
+                           "bit_exact": False, "peak_bytes": 80 << 20,
+                           "temp_bytes": 70 << 20},
+                "on": {"exec_seconds": 1.04, "compile_seconds": 0.5,
+                       "bit_exact": True, "peak_bytes": 70 << 20,
+                       "temp_bytes": 60 << 20},
+            },
+        },
+        "batch_chunk|selftest.recon[batch=8]|f32[8]|jax0|cpu": {
+            "family": "batch_chunk", "name": "selftest.recon[batch=8]",
+            "probe": {"counts": {"convolutions": 23}, "trial": True,
+                      "trial_seconds": 2.0, "temp_bytes": 1 << 20},
+        },
+    }
+    opt_section = render_sheepopt_decisions(fake_cache)
+    assert "winner=on" in opt_section and "ACCEPTED" in opt_section, opt_section
+    assert "disqualified: policy" in opt_section, opt_section
+    assert "bytes -31457280" in opt_section, opt_section
+    assert "3 candidate(s) tried" in opt_section, opt_section
+    assert "measured probe cached" in opt_section, opt_section
+    import tempfile as _tf
+
+    opt_dir = _tf.mkdtemp(prefix="telemetry_selftest_dec_")
+    with open(os.path.join(opt_dir, "decisions.json"), "w") as fh:
+        json.dump(fake_cache, fh)
+    loaded = load_decision_cache(os.path.join(opt_dir, "decisions.json"))
+    assert loaded == fake_cache
+    assert load_decision_cache(os.path.join(opt_dir, "absent.json")) == {}
     print("\nselftest OK", file=sys.stderr)
     return 0
 

@@ -1630,6 +1630,8 @@ def _code_fingerprint() -> str:
                 diff = _git("diff", "HEAD").encode()
                 return f"{head}+{hashlib.sha1(diff).hexdigest()[:8]}"
             return head
+    # sheeplint: disable=SL012 — no git on the box is an expected environment;
+    # the source-digest fallback below IS the handling
     except Exception:
         pass
     h = hashlib.sha1()
@@ -1843,6 +1845,7 @@ _METRIC_OF_ALGO = {
     "anakin": ("anakin_env_steps_per_sec", "env-steps/sec"),
     "train_speed": ("rssm_scan_step_seconds", "seconds/step"),
     "sheepopt": ("sheepopt_remat_peak_reduction_pct", "percent"),
+    "resilience": ("resilience_preemption_grace_seconds", "seconds"),
 }
 
 
@@ -2610,6 +2613,154 @@ def bench_warm_compile() -> None:
     print(json.dumps(result))
 
 
+def bench_resilience() -> None:
+    """ISSUE 12 headline: what fault tolerance COSTS — the recovery-overhead
+    receipt behind every resilience claim. Three phases on tiny SAC
+    (Pendulum) subprocesses through the real `sac.py` main:
+
+      1. preemption grace: a run killed by an injected `sigterm@k` measures
+         (from telemetry.jsonl timestamps, flushed per event) the window
+         from the signal landing to the grace checkpoint committing, plus
+         the full signal->exit wall time; rc must be 75 (EX_TEMPFAIL).
+      2. resume: the SAME run directory relaunched with `--resume auto`
+         measures time-to-first-update after restore (process spawn ->
+         first Loss log event) against a fresh run's — the restore tax.
+      3. --on_nonfinite A/B: warn vs skip arms (no faults) compare steady
+         steps/sec — the price of the in-jit isfinite reduce + select per
+         update, the only overhead the policy adds when nothing fails.
+
+    CPU receipts (mechanism, not raw speed: signal handling, orbax commit
+    latency and the guard's jaxpr are backend-independent); knobs via
+    SHEEPRL_TPU_RESIL_{STEPS,SIGSTEP,WIDTH}."""
+    import json as _json
+    import os
+    import subprocess
+    import tempfile
+    import time
+
+    steps = int(os.environ.get("SHEEPRL_TPU_RESIL_STEPS", "80"))
+    sig_at = int(os.environ.get("SHEEPRL_TPU_RESIL_SIGSTEP", "40"))
+    width = int(os.environ.get("SHEEPRL_TPU_RESIL_WIDTH", "256"))
+    root = tempfile.mkdtemp(prefix="bench_resilience_")
+    env = _child_env(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        SHEEPRL_TPU_TELEMETRY="1",
+    )
+    env.pop("SHEEPRL_TPU_FAULTS", None)
+    env.pop("XLA_FLAGS", None)  # single-device children
+
+    def run_sac(run_name, extra):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "sheeprl_tpu", "sac",
+                "--env_id", "Pendulum-v1", "--num_envs", "1", "--sync_env",
+                "--total_steps", str(steps), "--learning_starts", "5",
+                "--per_rank_batch_size", "64", "--gradient_steps", "1",
+                "--actor_hidden_size", str(width),
+                "--critic_hidden_size", str(width),
+                "--checkpoint_every", "1000",  # only the grace/final saves
+                "--test_episodes", "0", "--seed", "7",
+                "--root_dir", root, "--run_name", run_name, *extra,
+            ],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        wall = time.perf_counter() - t0
+        events = []
+        jsonl = os.path.join(root, run_name, "telemetry.jsonl")
+        if os.path.exists(jsonl):
+            with open(jsonl) as fh:
+                for line in fh:
+                    try:
+                        events.append(_json.loads(line))
+                    except _json.JSONDecodeError:
+                        break
+        return proc, wall, events
+
+    def ts_of(events, kind, key=None):
+        for ev in events:
+            if ev.get("event") == kind and (key is None or key(ev)):
+                return ev.get("ts")
+        return None
+
+    def last_sps(events):
+        vals = [
+            ev["metrics"].get("Time/step_per_second")
+            for ev in events
+            if ev.get("event") == "log"
+            and isinstance(ev.get("metrics", {}).get("Time/step_per_second"), (int, float))
+        ]
+        return vals[-1] if vals else None
+
+    # -- phase 1: preemption grace ------------------------------------------
+    proc, _, ev = run_sac("grace", ["--faults", f"sigterm@{sig_at}"])
+    rc_ok = proc.returncode == 75
+    sig_ts = ts_of(ev, "preempt.signal")
+    ckpt_ts = ts_of(ev, "checkpoint")
+    preempt_ts = ts_of(ev, "preempt")
+    grace_s = (ckpt_ts - sig_ts) if (sig_ts and ckpt_ts) else None
+    exit_s = (preempt_ts - sig_ts) if (sig_ts and preempt_ts) else None
+
+    # -- phase 2: resume time-to-first-update vs fresh ----------------------
+    def ttfu(events):
+        loss_ts = ts_of(
+            events, "log",
+            key=lambda e: any(k.startswith("Loss/") for k in e.get("metrics", {})),
+        )
+        start_ts = ts_of(events, "start")
+        return (loss_ts - start_ts) if (loss_ts and start_ts) else None
+
+    proc_r, _, ev_r = run_sac("grace", ["--resume", "auto"])
+    resume_ok = proc_r.returncode == 0
+    resumed = [e for e in ev_r if e.get("event") == "resume"]
+    # the run dir's telemetry.jsonl now holds BOTH segments; measure the
+    # resumed one (after its own `start` event)
+    starts = [i for i, e in enumerate(ev_r) if e.get("event") == "start"]
+    resume_ttfu = ttfu(ev_r[starts[-1]:] if starts else ev_r)
+    _, _, ev_f = run_sac("fresh", [])
+    fresh_ttfu = ttfu(ev_f)
+
+    # -- phase 3: --on_nonfinite warn vs skip overhead ----------------------
+    _, _, ev_warn = run_sac("nf_warn", ["--on_nonfinite", "warn"])
+    _, _, ev_skip = run_sac("nf_skip", ["--on_nonfinite", "skip"])
+    sps_warn, sps_skip = last_sps(ev_warn), last_sps(ev_skip)
+    nf_overhead_pct = (
+        round(100.0 * (sps_warn - sps_skip) / sps_warn, 1)
+        if sps_warn and sps_skip
+        else None
+    )
+
+    result = {
+        "metric": "resilience_preemption_grace_seconds",
+        "value": round(grace_s, 3) if grace_s is not None else 0.0,
+        "unit": "seconds",
+        "algo": "sac",
+        "backend": "cpu",
+        "rc_preempted_ok": rc_ok,
+        "signal_to_checkpoint_s": round(grace_s, 3) if grace_s else None,
+        "signal_to_exit_s": round(exit_s, 3) if exit_s else None,
+        "resume_ok": resume_ok and bool(resumed),
+        "resume_checkpoint": resumed[-1].get("checkpoint") if resumed else None,
+        "resume_time_to_first_update_s": round(resume_ttfu, 3) if resume_ttfu else None,
+        "fresh_time_to_first_update_s": round(fresh_ttfu, 3) if fresh_ttfu else None,
+        "nonfinite_sps_warn": round(sps_warn, 1) if sps_warn else None,
+        "nonfinite_sps_skip": round(sps_skip, 1) if sps_skip else None,
+        "nonfinite_skip_overhead_pct": nf_overhead_pct,
+        "total_steps": steps, "sigterm_at": sig_at, "width": width,
+        "host_cpus": os.cpu_count(),
+        "note": BASELINE_NOTE,
+    }
+    if not (rc_ok and resume_ok):
+        result["error"] = {
+            "grace_rc": proc.returncode,
+            "grace_stderr": proc.stderr.strip().splitlines()[-3:],
+            "resume_rc": proc_r.returncode,
+            "resume_stderr": proc_r.stderr.strip().splitlines()[-3:],
+        }
+    print(json.dumps(result))
+
+
 def _arm_watchdog(metric: str, unit: str, budget_s: float) -> None:
     """Last-resort liveness bound: if the whole bench (backend init included)
     has not finished within `budget_s`, emit an artifact and hard-exit. Round
@@ -3133,6 +3284,8 @@ def main() -> None:
         bench_train_speed()
     elif opts.algo == "sheepopt":
         bench_sheepopt()
+    elif opts.algo == "resilience":
+        bench_resilience()
     else:
         bench_dreamer_v3(tiny=opts.tiny, pipeline_mode=opts.pipeline)
 

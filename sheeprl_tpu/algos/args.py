@@ -117,6 +117,43 @@ class StandardArgs:
         "across the mesh, trajectories scattered straight into the device "
         "replay ring. Supported by ppo and dreamer_v3",
     )
+    resume: str = Arg(
+        default="off",
+        help="crash-safe auto-resume (resilience/, ISSUE 12): 'auto' finds "
+        "the newest VALID checkpoint under the run directory "
+        "({root_dir}/{run_name}, or the most recently touched run under "
+        "the algo/env default root) and restores params/opt-state/"
+        "global-step plus whatever deep state the task checkpoints "
+        "(replay ring + sampler PRNG, collector carry, loop PRNG key); "
+        "a path resumes that exact checkpoint directory; 'off' (default) "
+        "starts fresh. Partial/corrupt checkpoints are skipped with a "
+        "checkpoint.corrupt event. Pairs with the preemption-grace "
+        "handler: SIGTERM/SIGINT -> finish the in-flight step, blocking "
+        "checkpoint, exit rc 75 (EX_TEMPFAIL) — a supervisor that "
+        "restarts the same command with --resume auto continues the run",
+    )
+    on_nonfinite: str = Arg(
+        default="warn",
+        help="NaN/inf recovery policy for the train step (resilience/, "
+        "ISSUE 12): 'warn' keeps the PR-1 watchdog behavior (log only); "
+        "'skip' drops a poisoned update via a donation-safe in-jit "
+        "jnp.where select (old state is kept when any floating leaf of "
+        "the new state/metrics is non-finite; Fault/updates_skipped "
+        "counts them); 'rollback' additionally restores the last-good "
+        "checkpoint and re-splits the loop PRNG (tasks wiring "
+        "resilience.rollback: ppo, sac)",
+    )
+    faults: Optional[str] = Arg(
+        default=None,
+        help="deterministic fault injection plan (resilience/inject.py): "
+        "comma-separated site@step[:param] clauses, e.g. "
+        "'env.step@12,nan.grad@3,sigterm@5' or 'transfer.stall@2:3.5'; "
+        "sites: env.step, nan.loss, nan.grad, sigterm, sigint, sigkill, "
+        "ckpt.write, transfer.stall. Each clause fires EXACTLY ONCE at "
+        "its declared step; a lo-hi step range is resolved by a seeded "
+        "site-keyed draw (SHEEPRL_TPU_FAULT_SEED). Exported as "
+        "SHEEPRL_TPU_FAULTS to env-worker subprocesses",
+    )
     sanitize: bool = Arg(
         default=False,
         help="runtime transfer/donation sanitizer (sheeplint's dynamic "
@@ -141,6 +178,10 @@ class StandardArgs:
         if name == "env_backend" and value not in ("host", "jax"):
             raise ValueError(
                 f"env_backend must be 'host' or 'jax', got {value!r}"
+            )
+        if name == "on_nonfinite" and value not in ("warn", "skip", "rollback"):
+            raise ValueError(
+                f"on_nonfinite must be 'warn', 'skip' or 'rollback', got {value!r}"
             )
         super().__setattr__(name, value)
         if name == "log_dir" and value:

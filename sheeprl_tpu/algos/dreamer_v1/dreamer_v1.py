@@ -37,6 +37,7 @@ from ...parallel import (
     shard_time_batch,
 )
 from ...telemetry import Telemetry
+from ... import resilience
 from ...analysis import Sanitizer
 from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, remat_mode
 from ...utils.jit import donating_jit
@@ -334,14 +335,19 @@ def make_train_step(
         }
         return new_state, metrics
 
+    # --on_nonfinite skip/rollback: donation-safe nonfinite select around
+    # the unjitted body (default 'warn' is identity - zero jaxpr drift)
+    train_step = resilience.guard_nonfinite(train_step, args.on_nonfinite)
     return donating_jit(train_step, donate_argnums=(0,))
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV1Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
+    resilience.prepare_run(args, "dreamer_v1")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -373,6 +379,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v1")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -582,6 +589,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
+        guard.tick(global_step)  # fires injected sig* faults for this step
         telem.mark("rollout")
         if (
             global_step <= learning_starts
@@ -686,7 +694,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                 if n_dev > 1:
                     sample = shard_time_batch(sample, mesh, time_axis=0, batch_axis=1)
                 key, train_key = jax.random.split(key)
+                sample = resilience.poison_batch(sample, global_step)  # nan.* sites
                 state, metrics = train_step(state, sample, train_key)
+                resilience.update_skipped(metrics, args.on_nonfinite)
                 gradient_steps += 1
                 for name, val in metrics.items():
                     aggregator.update(name, val)
@@ -715,6 +725,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
             or global_step == num_updates
+            or guard.preempted
         ):
             ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
             save_checkpoint(
@@ -731,11 +742,15 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "batch_size": args.per_rank_batch_size,
                 },
                 args=args,
-                block=args.dry_run or global_step == num_updates,
+                block=args.dry_run or global_step == num_updates or guard.preempted,
             )
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+        if guard.preempted:
+            # the in-flight step finished and its grace checkpoint
+            # committed: exit with the distinct resumable rc
+            raise resilience.Preempted(global_step, guard.preempt_signal or "")
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()

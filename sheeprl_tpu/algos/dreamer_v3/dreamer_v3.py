@@ -68,6 +68,7 @@ from ...parallel import (
     shard_time_batch,
 )
 from ...telemetry import Telemetry
+from ... import resilience
 from ...analysis import Sanitizer
 from ...compile import CompilePlan, dict_obs_spec, dreamer_sample_spec, remat_mode, sds
 from ...utils.jit import donating_jit
@@ -452,6 +453,9 @@ def make_train_step(
         }
         return new_state, metrics
 
+    # --on_nonfinite skip/rollback: donation-safe nonfinite select around
+    # the unjitted body (default 'warn' is identity - zero jaxpr drift)
+    train_step = resilience.guard_nonfinite(train_step, args.on_nonfinite)
     return donating_jit(train_step, donate_argnums=(0,))
 
 
@@ -496,10 +500,12 @@ def make_blob_step(codec, obs_keys, dev_preprocess, actions_dim, is_continuous):
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV3Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
+    resilience.prepare_run(args, "dreamer_v3")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -534,6 +540,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -909,6 +916,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     else:
         steps_iter = range(start_step, num_updates + 1)
     for global_step in steps_iter:
+        guard.tick(global_step)  # fires injected sig* faults for this step
         telem.mark("rollout")
         blob_added = False
         if use_jax_env:
@@ -1097,7 +1105,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                 if n_dev > 1:
                     sample = shard_time_batch(sample, mesh, time_axis=0, batch_axis=1)
                 key, train_key = jax.random.split(key)
+                sample = resilience.poison_batch(sample, global_step)  # nan.* sites
                 state, metrics = train_step(state, sample, train_key, jnp.float32(tau))
+                resilience.update_skipped(metrics, args.on_nonfinite)
                 gradient_steps += 1
                 for name, val in metrics.items():
                     aggregator.update(name, val)
@@ -1131,6 +1141,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
             or global_step == num_updates
+            or guard.preempted
         ):
             ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
             save_checkpoint(
@@ -1149,11 +1160,15 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "batch_size": args.per_rank_batch_size,
                 },
                 args=args,
-                block=args.dry_run or global_step == num_updates,
+                block=args.dry_run or global_step == num_updates or guard.preempted,
             )
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+        if guard.preempted:
+            # the in-flight step finished and its grace checkpoint
+            # committed: exit with the distinct resumable rc
+            raise resilience.Preempted(global_step, guard.preempt_signal or "")
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()

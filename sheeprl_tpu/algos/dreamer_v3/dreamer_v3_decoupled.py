@@ -41,6 +41,7 @@ from ...parallel import (
     process_index,
 )
 from ...telemetry import Telemetry
+from ... import resilience
 from ...analysis import Sanitizer
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_dict_env
@@ -69,6 +70,7 @@ from .utils import make_device_preprocess, test
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DreamerV3Args)
     (args,) = parser.parse_args_into_dataclasses(argv)
@@ -80,6 +82,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         from .dreamer_v3 import main as coupled_main
 
         return coupled_main(argv)
+    resilience.prepare_run(args, "dreamer_v3_decoupled")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -111,6 +114,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3_decoupled")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -198,7 +202,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     # the inference weights (encoder + RSSM + actor)
     state = meshes.replicated_on_trainers(state)
     player_weights = meshes.to_player(
-        (state.world_model.encoder, state.world_model.rssm, state.actor)
+        (state.world_model.encoder, state.world_model.rssm, state.actor),
+        deadline_s=float("inf"),
     )
     meshes.note_weights_applied()  # the setup copy is, by definition, applied
 
@@ -343,6 +348,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     prev_metrics = None
     start_time = time.perf_counter()
     for global_step in range(start_step, num_updates + 1):
+        guard.tick(global_step)  # fires injected sig* faults for this step
         telem.mark("rollout")
         # ---- player: swap in refreshed weights if the transfer landed -------
         if pending_weights is not None:
@@ -466,7 +472,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                     tau = 0.0
                 sample = {k: v[i] for k, v in staged.items()}
                 key, train_key = jax.random.split(key)
+                sample = resilience.poison_batch(sample, global_step)  # nan.* sites
                 state, metrics = train_step(state, sample, train_key, jnp.float32(tau))
+                resilience.update_skipped(metrics, args.on_nonfinite)
                 gradient_steps += 1
                 # log the PREVIOUS update's metrics — pulling this update's
                 # scalars would block the host on the trainer mesh and kill
@@ -477,10 +485,14 @@ def main(argv: Sequence[str] | None = None) -> None:
                 profiler.tick()
                 prev_metrics = metrics
             # the weight path: refreshed inference weights stream back to
-            # the player device behind the update; consumed when ready
-            pending_weights = meshes.to_player(
+            # the player device behind the update; consumed when ready. A
+            # deadline-dropped transfer (None) keeps the player on stale
+            # weights — graceful degradation instead of deadlock (ISSUE 12)
+            shipped_weights = meshes.to_player(
                 (state.world_model.encoder, state.world_model.rssm, state.actor)
             )
+            if shipped_weights is not None:
+                pending_weights = shipped_weights
             step_before_training = args.train_every // single_global_step
             if args.expl_decay:
                 expl_decay_steps += 1
@@ -505,6 +517,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
             or global_step == num_updates
+            or guard.preempted
         ):
             ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
             save_checkpoint(
@@ -523,11 +536,15 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "batch_size": args.per_rank_batch_size,
                 },
                 args=args,
-                block=args.dry_run or global_step == num_updates,
+                block=args.dry_run or global_step == num_updates or guard.preempted,
             )
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + "_buffer.npz")
 
+        if guard.preempted:
+            # the in-flight step finished and its grace checkpoint
+            # committed: exit with the distinct resumable rc
+            raise resilience.Preempted(global_step, guard.preempt_signal or "")
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()

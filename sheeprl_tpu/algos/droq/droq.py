@@ -33,6 +33,7 @@ from ...parallel import (
     shard_batch,
 )
 from ...telemetry import Telemetry
+from ... import resilience
 from ...analysis import Sanitizer
 from ...compile import CompilePlan, sds
 from ...utils.jit import donating_jit
@@ -140,14 +141,19 @@ def make_train_step(args: DROQArgs, qf_optim, actor_optim, alpha_optim):
             "Loss/alpha_loss": alpha_l,
         }
 
+    # --on_nonfinite skip/rollback: donation-safe nonfinite select around
+    # the unjitted body (default 'warn' is identity - zero jaxpr drift)
+    train_step = resilience.guard_nonfinite(train_step, args.on_nonfinite)
     return donating_jit(train_step, donate_argnums=(0,))
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(DROQArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
+    resilience.prepare_run(args, "droq")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -168,6 +174,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="droq")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -320,6 +327,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
+        guard.tick(global_step)  # fires injected sig* faults for this step
         telem.mark("rollout")
         if global_step < learning_starts:
             actions = np.stack(
@@ -381,7 +389,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                     actor_batch = shard_batch(actor_batch, mesh, axis=0)
                 key, train_key = jax.random.split(key)
                 telem.mark("train/dispatch")
+                data = resilience.poison_batch(data, global_step)  # nan.* sites
                 state, metrics = train_step(state, data, actor_batch, train_key)
+                resilience.update_skipped(metrics, args.on_nonfinite)
             for name, val in metrics.items():
                 aggregator.update(name, val)
             profiler.tick()
@@ -395,6 +405,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
             or global_step == num_updates
+            or guard.preempted
         ):
             ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
             save_checkpoint(
@@ -405,11 +416,15 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "global_step": global_step,
                 },
                 args=args,
-                block=args.dry_run or global_step == num_updates,
+                block=args.dry_run or global_step == num_updates or guard.preempted,
             )
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + ".buffer.npz")
 
+        if guard.preempted:
+            # the in-flight step finished and its grace checkpoint
+            # committed: exit with the distinct resumable rc
+            raise resilience.Preempted(global_step, guard.preempt_signal or "")
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     plan.close()

@@ -54,6 +54,7 @@ from ...parallel import (
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
 from ...compile import CompilePlan, sds
+from ... import resilience
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -185,6 +186,9 @@ def make_train_step(args: PPOArgs, optimizer, num_minibatches: int, sanitizer=No
             "Loss/entropy_loss": ent,
         }
 
+    # --on_nonfinite skip/rollback: the donation-safe in-jit select wraps the
+    # UNJITTED body (default 'warn' is identity — zero jaxpr/ledger drift)
+    train_step = resilience.guard_nonfinite(train_step, args.on_nonfinite)
     if sanitizer is not None and sanitizer.enabled:
         # sanitize mode: checkify NaN/div instrumentation replaces donation
         # (audit runs trade HBM reuse for a consumed error channel)
@@ -224,10 +228,12 @@ def test(agent: PPOAgent, env: gym.Env, logger, args: PPOArgs) -> float:
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(PPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
+    resilience.prepare_run(args, "ppo")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -251,6 +257,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -420,6 +427,14 @@ def main(argv: Sequence[str] | None = None) -> None:
         # env batch sharded over the mesh, policy replicated — each device
         # steps its env slice with zero cross-device traffic in the scan
         carry = shard_env_batch(carry, mesh)
+        if args.checkpoint_path:
+            # bit-exact resume: the collector carry (jax-env state pytree,
+            # bootstrap obs, prev_done) is the Anakin path's "ring head" —
+            # restoring it makes the next rollout identical to the one the
+            # uninterrupted twin would have collected
+            deep = resilience.load_resume_state(args.checkpoint_path, collector=carry)
+            if deep:
+                carry = shard_env_batch(deep["collector"], mesh)
         anakin = AnakinStats(
             scan_span=args.rollout_steps, env_batch=args.num_envs, devices=n_dev
         )
@@ -450,6 +465,17 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
     plan.start()
 
+    if args.checkpoint_path:
+        # deep state for bit-exact resume (ISSUE 12): the loop PRNG key rides
+        # a sidecar next to the orbax tree. Restored HERE — after every
+        # init-time split (agent_key, the jax-env reset_key) — so the resumed
+        # run continues the exact random stream the uninterrupted twin is on
+        # at this update boundary (old checkpoints without a sidecar resume
+        # params-only, as before)
+        deep = resilience.load_resume_state(args.checkpoint_path, prng_key=key)
+        if deep:
+            key = deep["prng_key"]
+
     aggregator = MetricAggregator()
     if use_jax_env:
         obs, next_done = None, None
@@ -462,6 +488,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_update - 1  # empty training loop: fall through to test
     for update in range(start_update, num_updates + 1):
+        guard.tick(update)  # fires injected sig* faults declared for this step
         # anneal schedules (host-side; traced scalars below)
         lr = ops.polynomial_decay(
             update, initial=args.lr, final=0.0, max_decay_steps=num_updates
@@ -574,6 +601,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             for k, v in data.items()
             if k not in ("rewards", "dones")
         }
+        flat = resilience.poison_batch(flat, update)  # nan.loss/nan.grad sites
         if n_dev > 1:
             flat = shard_batch(flat, mesh)
         key, train_key = jax.random.split(key)
@@ -583,6 +611,21 @@ def main(argv: Sequence[str] | None = None) -> None:
             state, flat, train_key,
             jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
         )
+        if resilience.update_skipped(metrics, args.on_nonfinite):
+            # the in-jit select already kept the pre-update state; rollback
+            # additionally restores the last-good checkpoint and re-splits
+            # the PRNG so the retried trajectory diverges from the blowup
+            if args.on_nonfinite == "rollback":
+                restored = resilience.rollback(
+                    {"agent": state.agent, "optimizer": state.opt_state, "update_step": 0},
+                    step=update,
+                )
+                if restored is not None:
+                    state = replicate(
+                        TrainState(agent=restored["agent"], opt_state=restored["optimizer"]),
+                        mesh,
+                    )
+                    key, _ = jax.random.split(key)
         for name, val in metrics.items():
             aggregator.update(name, val)
         profiler.tick()
@@ -596,13 +639,23 @@ def main(argv: Sequence[str] | None = None) -> None:
         logger.log("Info/learning_rate", lr, global_step)
         if (
             args.checkpoint_every > 0 and update % args.checkpoint_every == 0
-        ) or args.dry_run or update == num_updates:
+        ) or args.dry_run or update == num_updates or guard.preempted:
+            ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{update}")
             save_checkpoint(
-                os.path.join(log_dir, "checkpoints", f"ckpt_{update}"),
+                ckpt_path,
                 {"agent": state.agent, "optimizer": state.opt_state, "update_step": update},
                 args=args,
-                block=args.dry_run or update == num_updates,
+                # a preemption-grace checkpoint must be committed before the
+                # resumable exit below
+                block=args.dry_run or update == num_updates or guard.preempted,
             )
+            resilience.save_resume_state(
+                ckpt_path, prng_key=key, collector=carry if use_jax_env else None
+            )
+        if guard.preempted:
+            # the in-flight update finished and its checkpoint committed:
+            # exit with the distinct resumable rc (crashsafe maps this)
+            raise resilience.Preempted(update, guard.preempt_signal or "")
 
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)

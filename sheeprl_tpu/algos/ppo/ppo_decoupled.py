@@ -32,6 +32,7 @@ from ...parallel import (
     process_index,
 )
 from ...telemetry import Telemetry
+from ... import resilience
 from ...analysis import Sanitizer
 from ...compile import CompilePlan
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
@@ -56,6 +57,7 @@ from .ppo import (
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(PPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
@@ -65,6 +67,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         from .ppo import main as coupled_main
 
         return coupled_main(argv)
+    resilience.prepare_run(args, "ppo_decoupled")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -83,6 +86,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     profiler = StepProfiler.from_args(args, log_dir, rank)
     logger.log_hyperparams(args.as_dict())
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo_decoupled")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -129,7 +133,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         start_update = int(ckpt["update_step"]) + 1
     # trainers hold the replicated train state; the player holds a policy copy
     state = meshes.replicated_on_trainers(state)
-    player_agent = meshes.to_player(state.agent)
+    player_agent = meshes.to_player(state.agent, deadline_s=float("inf"))
     meshes.note_weights_applied()  # the setup copy is, by definition, applied
 
     rollout_and_train_size = args.rollout_steps * args.num_envs
@@ -237,6 +241,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     pending_agent = None
     prev_metrics = None
     for update in range(start_update, num_updates + 1):
+        guard.tick(update)  # fires injected sig* faults for this step
         lr = ops.polynomial_decay(
             update, initial=args.lr, final=0.0, max_decay_steps=num_updates
         ) if args.anneal_lr else args.lr
@@ -318,6 +323,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             for k, v in data.items()
             if k not in ("rewards", "dones")
         }
+        flat = resilience.poison_batch(flat, update)  # nan.* sites
         flat = meshes.to_trainers(flat)  # the data path (ICI, typed pytree)
 
         # ---- trainers: async-dispatched single-jit update -------------------
@@ -327,9 +333,17 @@ def main(argv: Sequence[str] | None = None) -> None:
             state, flat, train_key,
             jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
         )
+        # NOTE: under --on_nonfinite skip/rollback this flag pull is the one
+        # host sync the policy costs; at the default 'warn' it is a no-op
+        # and the player/trainer overlap is untouched
+        resilience.update_skipped(metrics, args.on_nonfinite)
         # the weight path: updated params stream back to the player device
-        # behind the update; consumed by a later rollout when ready
-        pending_agent = meshes.to_player(state.agent)
+        # behind the update; consumed by a later rollout when ready. A
+        # deadline-dropped transfer (None) keeps the player on its stale
+        # weights — graceful degradation instead of deadlock (ISSUE 12)
+        shipped_agent = meshes.to_player(state.agent)
+        if shipped_agent is not None:
+            pending_agent = shipped_agent
 
         # log the PREVIOUS update's metrics — pulling this update's scalars
         # here would block the host on the trainer mesh and kill the overlap
@@ -347,14 +361,18 @@ def main(argv: Sequence[str] | None = None) -> None:
         logger.log("Info/learning_rate", lr, global_step)
         if (
             args.checkpoint_every > 0 and update % args.checkpoint_every == 0
-        ) or args.dry_run or update == num_updates:
+        ) or args.dry_run or update == num_updates or guard.preempted:
             save_checkpoint(
                 os.path.join(log_dir, "checkpoints", f"ckpt_{update}"),
                 {"agent": state.agent, "optimizer": state.opt_state, "update_step": update},
                 args=args,
-                block=args.dry_run or update == num_updates,
+                block=args.dry_run or update == num_updates or guard.preempted,
             )
 
+        if guard.preempted:
+            # the in-flight step finished and its grace checkpoint
+            # committed: exit with the distinct resumable rc
+            raise resilience.Preempted(update, guard.preempt_signal or "")
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()
@@ -365,7 +383,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             aggregator.update(name, val)
         logger.log_dict(aggregator.compute(), global_step)
         aggregator.reset()
-    player_agent = meshes.to_player(state.agent)
+    player_agent = meshes.to_player(state.agent, deadline_s=float("inf"))
     test_env = make_dict_env(
         args.env_id, args.seed, rank=0, args=args, run_name=log_dir, prefix="test"
     )()

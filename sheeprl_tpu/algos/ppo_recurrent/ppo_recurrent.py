@@ -42,6 +42,7 @@ from ...parallel import (
     shard_batch,
 )
 from ...telemetry import Telemetry
+from ... import resilience
 from ...analysis import Sanitizer
 from ...compile import CompilePlan, sds
 from ...utils.jit import donating_jit
@@ -147,6 +148,9 @@ def make_train_step(args: RecurrentPPOArgs, optimizer, seq_len: int, num_minibat
             "Loss/entropy_loss": ent,
         }
 
+    # --on_nonfinite skip/rollback: donation-safe nonfinite select around
+    # the unjitted body (default 'warn' is identity - zero jaxpr drift)
+    train_step = resilience.guard_nonfinite(train_step, args.on_nonfinite)
     return donating_jit(train_step, donate_argnums=(0,))
 
 
@@ -182,10 +186,12 @@ def test(agent: RecurrentPPOAgent, env: gym.Env, logger, args, obs_key: str) -> 
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(RecurrentPPOArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
+    resilience.prepare_run(args, "ppo_recurrent")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -206,6 +212,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo_recurrent")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -355,6 +362,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_update - 1  # empty training loop: fall through to test
     for update in range(start_update, num_updates + 1):
+        guard.tick(update)  # fires injected sig* faults for this step
         lr = ops.polynomial_decay(
             update, initial=args.lr, final=0.0, max_decay_steps=num_updates
         ) if args.anneal_lr else args.lr
@@ -444,6 +452,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         windows = _to_windows(
             {k: v for k, v in data.items() if k != "rewards"}, seq_len
         )
+        windows = resilience.poison_batch(windows, update)  # nan.* sites
         if n_dev > 1:
             windows = shard_batch(windows, mesh, axis=1)
         key, train_key = jax.random.split(key)
@@ -452,6 +461,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             state, windows, train_key,
             jnp.float32(lr), jnp.float32(clip_coef), jnp.float32(ent_coef),
         )
+        resilience.update_skipped(metrics, args.on_nonfinite)
         for name, val in metrics.items():
             aggregator.update(name, val)
         profiler.tick()
@@ -464,7 +474,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         logger.log("Info/learning_rate", lr, global_step)
         if (
             args.checkpoint_every > 0 and update % args.checkpoint_every == 0
-        ) or args.dry_run or update == num_updates:
+        ) or args.dry_run or update == num_updates or guard.preempted:
             save_checkpoint(
                 os.path.join(log_dir, "checkpoints", f"ckpt_{update}"),
                 {
@@ -473,9 +483,13 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "update_step": update,
                 },
                 args=args,
-                block=args.dry_run or update == num_updates,
+                block=args.dry_run or update == num_updates or guard.preempted,
             )
 
+        if guard.preempted:
+            # the in-flight step finished and its grace checkpoint
+            # committed: exit with the distinct resumable rc
+            raise resilience.Preempted(update, guard.preempt_signal or "")
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     plan.close()

@@ -42,6 +42,7 @@ from ...parallel import (
 from ...telemetry import Telemetry
 from ...analysis import Sanitizer
 from ...compile import CompilePlan, sds
+from ... import resilience
 from ...utils.jit import donating_jit
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.evaluation import (
@@ -78,7 +79,9 @@ def make_optimizers(args: SACArgs):
 
 def make_train_step(args: SACArgs, qf_optim, actor_optim, alpha_optim):
     """One jit for the whole update phase: scan over `gradient_steps`
-    batches, each doing the reference's train() sequence (sac.py:33-79)."""
+    batches, each doing the reference's train() sequence (sac.py:33-79);
+    under `--on_nonfinite skip/rollback` the body is wrapped with the
+    donation-safe nonfinite select before donation."""
 
     def gradient_step(carry, inp):
         state, do_ema = carry
@@ -152,6 +155,7 @@ def make_train_step(args: SACArgs, qf_optim, actor_optim, alpha_optim):
             "Loss/alpha_loss": jnp.mean(alpha_l),
         }
 
+    train_step = resilience.guard_nonfinite(train_step, args.on_nonfinite)
     return donating_jit(train_step, donate_argnums=(0,))
 
 
@@ -162,10 +166,12 @@ def policy_step(actor, obs, key):
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
     validate_eval_args(args)
+    resilience.prepare_run(args, "sac")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -186,6 +192,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="sac")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -309,6 +316,13 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
     plan.start()
 
+    if args.checkpoint_path:
+        # loop-PRNG restore for resume (after every init-time split): the
+        # resumed run continues the exact action/sample random stream
+        deep = resilience.load_resume_state(args.checkpoint_path, prng_key=key)
+        if deep:
+            key = deep["prng_key"]
+
     aggregator = MetricAggregator()
     num_updates = (
         int(args.total_steps // args.num_envs) if not args.dry_run else start_step
@@ -334,6 +348,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     if args.eval_only:
         num_updates = start_step - 1  # empty training loop: fall through to test
     for global_step in range(start_step, num_updates + 1):
+        guard.tick(global_step)  # fires injected sig* faults for this step
         # ---- interaction ----------------------------------------------------
         telem.mark("rollout")
         if global_step < learning_starts:
@@ -386,12 +401,37 @@ def main(argv: Sequence[str] | None = None) -> None:
                     )
                     for k, v in sample.items()
                 }
+                data = resilience.poison_batch(data, global_step)  # nan.* sites
                 if n_dev > 1:
                     data = shard_batch(data, mesh, axis=1)
                 key, train_key = jax.random.split(key)
                 do_ema = jnp.asarray(global_step % args.target_network_frequency == 0)
                 telem.mark("train/dispatch")
                 state, metrics = train_step(state, data, train_key, do_ema)
+                if resilience.update_skipped(metrics, args.on_nonfinite):
+                    # skip already held the pre-update state inside the jit;
+                    # rollback additionally restores the last-good checkpoint
+                    # and re-splits the PRNG away from the blowup
+                    if args.on_nonfinite == "rollback":
+                        restored = resilience.rollback(
+                            {
+                                "agent": state.agent, "qf_optimizer": state.qf_opt,
+                                "actor_optimizer": state.actor_opt,
+                                "alpha_optimizer": state.alpha_opt, "global_step": 0,
+                            },
+                            step=global_step,
+                        )
+                        if restored is not None:
+                            state = replicate(
+                                TrainState(
+                                    agent=restored["agent"],
+                                    qf_opt=restored["qf_optimizer"],
+                                    actor_opt=restored["actor_optimizer"],
+                                    alpha_opt=restored["alpha_optimizer"],
+                                ),
+                                mesh,
+                            )
+                            key, _ = jax.random.split(key)
             for name, val in metrics.items():
                 aggregator.update(name, val)
             profiler.tick()
@@ -406,6 +446,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
             or global_step == num_updates
+            or guard.preempted
         ):
             ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
             save_checkpoint(
@@ -416,10 +457,16 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "global_step": global_step,
                 },
                 args=args,
-                block=args.dry_run or global_step == num_updates,
+                # the preemption-grace checkpoint must commit before the exit
+                block=args.dry_run or global_step == num_updates or guard.preempted,
             )
             if args.checkpoint_buffer:
+                # ring contents + sampler PRNG state (ISSUE 12): a resumed
+                # run re-samples the exact stream the interrupted one would
                 rb.save(ckpt_path + ".buffer.npz")
+            resilience.save_resume_state(ckpt_path, prng_key=key)
+        if guard.preempted:
+            raise resilience.Preempted(global_step, guard.preempt_signal or "")
 
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)

@@ -29,6 +29,7 @@ from ...parallel import (
     process_index,
 )
 from ...telemetry import Telemetry
+from ... import resilience
 from ...analysis import Sanitizer
 from ...utils.checkpoint import load_checkpoint, load_checkpoint_args, save_checkpoint
 from ...utils.env import make_env
@@ -45,6 +46,7 @@ from .utils import test
 
 
 @register_algorithm()
+@resilience.crashsafe
 def main(argv: Sequence[str] | None = None) -> None:
     parser = DataclassArgumentParser(SACArgs)
     (args,) = parser.parse_args_into_dataclasses(argv)
@@ -54,6 +56,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         from .sac import main as coupled_main
 
         return coupled_main(argv)
+    resilience.prepare_run(args, "sac_decoupled")
     if args.checkpoint_path:
         saved = load_checkpoint_args(args.checkpoint_path)
         if saved:
@@ -72,6 +75,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     profiler = StepProfiler.from_args(args, log_dir, rank)
     logger.log_hyperparams(args.as_dict())
     telem = Telemetry.from_args(args, log_dir, rank, algo="sac_decoupled")
+    guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
     pipe = Pipeline.from_args(args, telem)
@@ -151,7 +155,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             rb.load(rb_state_path)
     # trainers hold the replicated train state; the player holds an actor copy
     state = meshes.replicated_on_trainers(state)
-    player_actor = meshes.to_player(state.agent.actor)
+    player_actor = meshes.to_player(state.agent.actor, deadline_s=float("inf"))
     meshes.note_weights_applied()  # the setup copy is, by definition, applied
 
     # ---- warm-start shape capture (ISSUE 5): zero example batches run
@@ -215,6 +219,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     pending_actor = None
     prev_metrics = None
     for global_step in range(start_step, num_updates + 1):
+        guard.tick(global_step)  # fires injected sig* faults for this step
         # ---- player: swap in new actor weights if the transfer landed -------
         telem.mark("rollout")
         if pending_actor is not None:
@@ -279,10 +284,15 @@ def main(argv: Sequence[str] | None = None) -> None:
                 key, train_key = jax.random.split(key)
                 do_ema = jnp.asarray(global_step % args.target_network_frequency == 0)
                 telem.mark("train/dispatch")
+                data = resilience.poison_batch(data, global_step)  # nan.* sites
                 state, metrics = train_step(state, data, train_key, do_ema)
+                resilience.update_skipped(metrics, args.on_nonfinite)
             # the weight path: refreshed actor streams back to the player
-            # device behind the update; consumed when ready
-            pending_actor = meshes.to_player(state.agent.actor)
+            # device behind the update; consumed when ready. A deadline-
+            # dropped transfer (None) keeps the player on stale weights
+            shipped_actor = meshes.to_player(state.agent.actor)
+            if shipped_actor is not None:
+                pending_actor = shipped_actor
             # log the previous update's metrics — pulling this update's
             # scalars here would block the host and kill the overlap
             if prev_metrics is not None:
@@ -300,6 +310,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             (args.checkpoint_every > 0 and global_step % args.checkpoint_every == 0)
             or args.dry_run
             or global_step == num_updates
+            or guard.preempted
         ):
             ckpt_path = os.path.join(log_dir, "checkpoints", f"ckpt_{global_step}")
             save_checkpoint(
@@ -310,11 +321,15 @@ def main(argv: Sequence[str] | None = None) -> None:
                     "global_step": global_step,
                 },
                 args=args,
-                block=args.dry_run or global_step == num_updates,
+                block=args.dry_run or global_step == num_updates or guard.preempted,
             )
             if args.checkpoint_buffer:
                 rb.save(ckpt_path + ".buffer.npz")
 
+        if guard.preempted:
+            # the in-flight step finished and its grace checkpoint
+            # committed: exit with the distinct resumable rc
+            raise resilience.Preempted(global_step, guard.preempt_signal or "")
     for drained, dstep in pipe.flush_metrics():
         logger.log_dict(telem.interval(drained, dstep, None), dstep)
     profiler.close()

@@ -977,6 +977,52 @@ def _check_sl011(a: _FileAnalysis) -> None:
             )
 
 
+_SL012_BROAD = {"Exception", "BaseException"}
+
+
+def _check_sl012(a: _FileAnalysis) -> None:
+    """Swallowed-and-unlogged broad exception handlers (ISSUE 12): a bare
+    `except:` / `except Exception:` / `except BaseException:` whose body is
+    nothing but pass/.../continue/break. Narrow handlers are presumed
+    deliberate; broad ones that also swallow silently leave no forensic
+    trail when an env, checkpoint or transfer dies — the exact class the
+    resilience subsystem's telemetry events exist to record."""
+
+    def is_broad(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        elems = t.elts if isinstance(t, ast.Tuple) else [t]
+        for e in elems:
+            leaf = e.attr if isinstance(e, ast.Attribute) else getattr(e, "id", None)
+            if leaf in _SL012_BROAD:
+                return True
+        return False
+
+    def swallows(stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            return True
+        return (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        )
+
+    for node in ast.walk(a.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not is_broad(node):
+            continue
+        if all(swallows(s) for s in node.body):
+            caught = "bare except" if node.type is None else ast.unparse(node.type)
+            a.report(
+                "SL012", node,
+                f"broad handler ({caught}) swallows the exception with no "
+                "log, event or re-raise — narrow the type or record the "
+                "failure (telemetry event / Fault counter / logger)",
+            )
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -998,6 +1044,7 @@ def lint_source(
     _check_sl009(analysis)
     _check_sl010(analysis)
     _check_sl011(analysis)
+    _check_sl012(analysis)
     for ctx in analysis._top_level_contexts():
         _check_sl002(analysis, ctx)
         _check_sl003(analysis, ctx)

@@ -243,6 +243,26 @@ _RULES = [
             "lookup tables"
         ),
     ),
+    Rule(
+        id="SL012",
+        name="swallowed-exception",
+        severity=WARNING,
+        summary=(
+            "broad exception handler (bare except / except Exception / "
+            "BaseException) whose body only passes/continues — the failure "
+            "is swallowed with NO log, NO telemetry event and NO re-raise. "
+            "In an algo main or hot-loop helper this is the silent-failure "
+            "class the resilience subsystem (ISSUE 12) exists to kill: a "
+            "crashed env, a failed checkpoint or a dead transfer degrades "
+            "the run with zero forensic trail"
+        ),
+        autofix=(
+            "narrow the exception type, or handle it visibly: re-raise, "
+            "telemetry.emit an event, bump a Fault/* counter, or log; "
+            "a genuinely-safe swallow (best-effort close of an already-"
+            "crashed resource) gets a justified suppression"
+        ),
+    ),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

@@ -53,6 +53,8 @@ class Sanitizer:
         if self.telemetry is not None:
             try:
                 self.telemetry.event(event, **data)
+            # sheeplint: disable=SL012 — the sanitizer reports THROUGH telemetry;
+            # a broken telemetry sink has nowhere better to report to
             except Exception:
                 pass
 

@@ -31,6 +31,7 @@ contracts are preserved:
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import uuid
@@ -155,6 +156,32 @@ def _unpack_values(direct, packed, layout):
             seg = seg != 0 if dt == np.bool_ else jax.lax.bitcast_convert_type(seg, dt)
         data[k] = seg.reshape(shape)
     return data
+
+
+def _encode_sample_state(state) -> np.ndarray:
+    """Sampler-PRNG snapshot as a JSON byte buffer for `.npz` embedding
+    (ISSUE 12): a resumed run continues the EXACT sample stream the
+    interrupted one would have drawn. Arrays (the device sample key) are
+    tagged; numpy bit-generator states are plain nested dicts of (big) ints,
+    which JSON carries losslessly."""
+
+    def enc(x):
+        if isinstance(x, (np.ndarray, jax.Array)):
+            a = np.asarray(x)
+            return {"__nd__": a.tolist(), "__dt__": str(a.dtype)}
+        raise TypeError(f"unserializable sampler-state leaf {type(x)!r}")
+
+    blob = json.dumps(state, default=enc).encode()
+    return np.frombuffer(blob, dtype=np.uint8)
+
+
+def _decode_sample_state(arr: np.ndarray):
+    def hook(d):
+        if "__nd__" in d and "__dt__" in d:
+            return jnp.asarray(np.asarray(d["__nd__"], dtype=d["__dt__"]))
+        return d
+
+    return json.loads(bytes(np.asarray(arr, dtype=np.uint8)).decode(), object_hook=hook)
 
 
 class ReplayBuffer:
@@ -475,11 +502,14 @@ class ReplayBuffer:
             full=st["full"],
             buffer_size=st["buffer_size"],
             n_envs=st["n_envs"],
+            sampler_state=_encode_sample_state(self.get_sample_state()),
             **{f"buf_{k}": v for k, v in (st["buf"] or {}).items()},
         )
 
     def load(self, path: str) -> None:
-        """Restore a ring saved with `save` into this (same-shape) buffer."""
+        """Restore a ring saved with `save` into this (same-shape) buffer,
+        including the sampler PRNG state when present (pre-ISSUE-12 files
+        restore contents only)."""
         data = np.load(path)
         bufs = {k[4:]: data[k] for k in data.files if k.startswith("buf_")}
         self.load_state_dict(
@@ -491,6 +521,8 @@ class ReplayBuffer:
                 "n_envs": int(data["n_envs"]),
             }
         )
+        if "sampler_state" in data.files:
+            self.set_sample_state(_decode_sample_state(data["sampler_state"]))
 
 
 class SequentialReplayBuffer(ReplayBuffer):
@@ -780,6 +812,7 @@ class EpisodeBuffer:
         for i, ep in enumerate(st["episodes"]):
             for k, v in ep.items():
                 flat[f"ep{i}_{k}"] = v
+        flat["sampler_state"] = _encode_sample_state(self.get_sample_state())
         np.savez(path, **flat)
 
     def load(self, path: str) -> None:
@@ -802,6 +835,10 @@ class EpisodeBuffer:
                 "sequence_length": self._sequence_length,
             }
         )
+        # restore AFTER the episode re-adds so any rng use during rebuild
+        # cannot advance the checkpointed sampler stream
+        if "sampler_state" in data.files:
+            self.set_sample_state(_decode_sample_state(data["sampler_state"]))
 
 class _AsyncEnvView:
     """Single-env handle into the unified device store of an
@@ -1431,6 +1468,7 @@ class AsyncReplayBuffer:
             flat[f"b{i}_full"] = np.bool_(s["full"])
             for k, v in (s["buf"] or {}).items():
                 flat[f"b{i}_buf_{k}"] = v
+        flat["sampler_state"] = _encode_sample_state(self.get_sample_state())
         np.savez(path, **flat)
 
     def load(self, path: str) -> None:
@@ -1453,3 +1491,5 @@ class AsyncReplayBuffer:
                 }
             )
         self.load_state_dict({"buffers": buffers})
+        if "sampler_state" in data.files:
+            self.set_sample_state(_decode_sample_state(data["sampler_state"]))

@@ -117,7 +117,17 @@ class RestartOnException(gym.Wrapper):
     """Recreate a crashed env (flaky Minecraft-style backends), capped at
     `maxfails` per `window` seconds; flags `info["restart_on_exception"]` so
     the training loop can patch its buffer
-    (/root/reference/sheeprl/envs/wrappers.py:73-122)."""
+    (/root/reference/sheeprl/envs/wrappers.py:73-122).
+
+    ISSUE 12: shares the generic `resilience.envwrap` machinery's
+    observability — restarts count into `Fault/env_restarts`, emit
+    `fault.env_error`/`fault.recovered` telemetry events, and the
+    deterministic `env.step@n` injection site fires inside the retry scope
+    here too (the dreamer mains wrap this OUTSIDE the per-thunk
+    `RestartingEnv`, so whichever wrapper sees the fault first recovers it).
+    Semantics differ from `RestartingEnv` on purpose: this wrapper returns a
+    NON-terminal transition plus the info flag, and the dreamer loops patch
+    the replay ring themselves (dreamer_v3.py buffer surgery)."""
 
     def __init__(
         self,
@@ -139,12 +149,24 @@ class RestartOnException(gym.Wrapper):
         super().__init__(env_fn())
 
     def _record_failure(self, err: Exception, where: str) -> None:
+        from ..resilience import inject
+
         now = time.time()
         if now > self._last + self._window:
             self._last = now
             self._fails = 1
         else:
             self._fails += 1
+        inject.count("Fault/env_errors")
+        from ..telemetry import emit
+
+        emit(
+            "fault.env_error",
+            error=f"{type(err).__name__}: {err}"[:300],
+            attempt=self._fails,
+            limit=self._maxfails,
+            where=where,
+        )
         if self._fails > self._maxfails:
             raise RuntimeError(f"env crashed too many times: {self._fails}") from err
         gym.logger.warn(
@@ -153,12 +175,23 @@ class RestartOnException(gym.Wrapper):
         time.sleep(self._wait)
 
     def step(self, action):
+        from ..resilience import inject
+
         try:
+            # inject only when no inner RestartingEnv already owns the site
+            # (double-wrapped dreamer envs would advance the counter twice)
+            if not getattr(self.env, "_sheeprl_resilient", False):
+                spec = inject.get_plan().fire_next("env.step")
+                if spec is not None:
+                    raise inject.InjectedFault(
+                        f"injected env.step fault: {spec.describe()}"
+                    )
             return self.env.step(action)
         except self._exceptions as e:
             self._record_failure(e, "STEP")
             self.env = self._env_fn()
             obs, info = self.env.reset()
+            inject.note_recovery("env.step", "env_restarts", attempt=self._fails)
             info["restart_on_exception"] = True
             return obs, 0.0, False, False, info
 

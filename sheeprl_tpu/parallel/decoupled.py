@@ -25,6 +25,7 @@ rest; the `device_put`s ride ICI/DCN.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any
 
@@ -36,6 +37,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import local_mesh_devices
 
 __all__ = ["DecoupledMeshes", "make_decoupled_meshes"]
+
+
+def _default_deadline() -> float | None:
+    """Weight-transfer deadline (seconds) from SHEEPRL_TPU_TRANSFER_TIMEOUT_S;
+    None (unset/non-positive) disables the graceful-degradation path."""
+    raw = os.environ.get("SHEEPRL_TPU_TRANSFER_TIMEOUT_S")
+    if not raw:
+        return None
+    val = float(raw)
+    return val if val > 0 else None
 
 
 class DecoupledMeshes:
@@ -95,9 +106,27 @@ class DecoupledMeshes:
         sharding = NamedSharding(self.trainer_mesh, P())
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
 
-    def to_player(self, tree: Any) -> Any:
+    def to_player(self, tree: Any, deadline_s: float | None = None) -> Any:
         """Ship (updated) params to the player device — the weight path
-        (replacing the flattened-vector broadcast, ppo_decoupled.py:304-307)."""
+        (replacing the flattened-vector broadcast, ppo_decoupled.py:304-307).
+
+        Graceful degradation (ISSUE 12): when the transfer exceeds
+        `deadline_s` (default: SHEEPRL_TPU_TRANSFER_TIMEOUT_S, off when
+        unset), the shipment is ABANDONED and None is returned — the caller
+        keeps acting on its current (stale) weights instead of deadlocking
+        the env loop behind a sick interconnect; the existing
+        `Decoupled/weight_staleness_s` gauge shows the growing lag and
+        `Fault/transfer_timeouts` counts the abandonments. The deterministic
+        `transfer.stall@n[:seconds]` injection site models the sick link:
+        the n-th weight transfer sleeps before shipping."""
+        from ..resilience import inject
+
+        if deadline_s is None:
+            deadline_s = _default_deadline()
+        start = time.monotonic()
+        spec = inject.get_plan().fire_next("transfer.stall")
+        if spec is not None:
+            time.sleep(spec.param if spec.param is not None else 1.0)
         self._to_player_transfers += 1
         self._weights_shipped += 1
 
@@ -105,7 +134,17 @@ class DecoupledMeshes:
             self._to_player_bytes += getattr(x, "nbytes", 0)
             return jax.device_put(x, self.player_device)
 
-        return jax.tree_util.tree_map(put, tree)
+        out = jax.tree_util.tree_map(put, tree)
+        if deadline_s is not None and (time.monotonic() - start) > deadline_s:
+            self._weights_applied = self._weights_shipped  # not pending: dropped
+            inject.note_recovery(
+                "transfer.stall",
+                "transfer_timeouts",
+                elapsed_s=round(time.monotonic() - start, 3),
+                deadline_s=deadline_s,
+            )
+            return None
+        return out
 
     def note_weights_applied(self) -> None:
         """Record that the player swapped in the most recent landed weight

@@ -70,6 +70,8 @@ def _start_copy(leaf: Any) -> Any:
     if copy_async is not None:
         try:
             copy_async()
+        # sheeplint: disable=SL012 — prefetch is a pure optimization; the
+        # blocking read below is the correctness path and surfaces real errors
         except Exception:
             pass  # the blocking read in Handle.get still works
     return leaf
@@ -235,6 +237,9 @@ class SamplePrefetcher:
                 if p_state is not None:
                     try:
                         rb.set_sample_state(p_state)
+                    # sheeplint: disable=SL012 — best-effort PRNG rewind after a
+                    # discarded prefetch; the miss is already counted in
+                    # sample_misses and the fresh resample is correct either way
                     except Exception:
                         pass
         if batch is None:

@@ -1,0 +1,84 @@
+"""Fault tolerance for production training (ISSUE 12): deterministic fault
+injection, preemption-grace checkpointing with auto-resume, and recovery
+actuators for NaN blowups, env crashes, checkpoint-write failures and
+decoupled-transfer stalls.
+
+Three coupled parts (see howto/fault_tolerance.md):
+
+  - `inject`  — seeded, site-keyed `FaultPlan` (`SHEEPRL_TPU_FAULTS` /
+                `--faults`): every failure mode this subsystem recovers can
+                be fired deterministically at a declared step, so each
+                recovery claim is a CI-replayable receipt;
+  - `guard`   — `RunGuard` (SIGTERM/SIGINT grace: finish the step, blocking
+                checkpoint, exit RC_PREEMPTED=75) + the `@crashsafe` scope
+                (crashed runs always leave a final telemetry record and a
+                drained checkpointer) + `resume.resolve_resume`
+                (`--resume {off,auto,<path>}`);
+  - `recover` — `--on_nonfinite {warn,skip,rollback}` (donation-safe in-jit
+                skip select, last-good checkpoint rollback), bounded
+                env-restart (`envwrap.RestartingEnv`) and checkpoint-write
+                retries, decoupled weight-transfer deadline
+                (`parallel/decoupled.py`).
+
+ROADMAP item 1 (elastic multi-actor scale-out) reuses this machinery
+verbatim: actor-process death is `env.step`-class recovery, learner
+preemption is the grace path, and membership changes ride the same
+telemetry events.
+"""
+
+from .guard import RC_PREEMPTED, Preempted, RunGuard, crashsafe
+from .inject import (
+    FAULT_SITES,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    arm_faults,
+    gauges,
+    get_plan,
+    note_recovery,
+    reset_plan,
+)
+from .recover import (
+    NONFINITE_POLICIES,
+    SKIP_FLAG,
+    guard_nonfinite,
+    note_checkpoint,
+    poison_batch,
+    rollback,
+    update_skipped,
+)
+from .resume import (
+    load_resume_state,
+    next_fallback,
+    prepare_run,
+    resolve_resume,
+    save_resume_state,
+)
+
+__all__ = [
+    "FAULT_SITES",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "NONFINITE_POLICIES",
+    "Preempted",
+    "RC_PREEMPTED",
+    "RunGuard",
+    "SKIP_FLAG",
+    "arm_faults",
+    "crashsafe",
+    "gauges",
+    "get_plan",
+    "guard_nonfinite",
+    "load_resume_state",
+    "next_fallback",
+    "note_checkpoint",
+    "note_recovery",
+    "poison_batch",
+    "prepare_run",
+    "reset_plan",
+    "resolve_resume",
+    "rollback",
+    "save_resume_state",
+    "update_skipped",
+]

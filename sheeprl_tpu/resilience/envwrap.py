@@ -1,0 +1,125 @@
+"""Bounded retry-with-backoff around host envs (ISSUE 12 tentpole part c).
+
+Host environments are the one component of a run the framework does not
+control: emulators segfault, sockets drop, physics engines NaN out. The
+reference framework's answer was a hand-rolled restart in one algo
+(dreamer_v3.py:565-573 patching the buffer after a MineRL hiccup); here it
+is a single wrapper every env thunk passes through (`utils/env.py`), so all
+13 mains inherit the same contract:
+
+  - `step()` exceptions are retried with exponential backoff: the crashed
+    env is closed (best-effort), rebuilt from its thunk, reset, and the
+    transition is surfaced as a TRUNCATED episode boundary carrying the
+    fresh reset observation (`info["env_restarted"] = True`) — the training
+    loop sees a normal episode end, never a stale terminal obs;
+  - restarts are BOUNDED: `SHEEPRL_TPU_ENV_RESTARTS` (default 3) consecutive
+    failures re-raise — an env that cannot come back is a real outage, not
+    something to retry forever;
+  - every restart increments the `Fault/env_restarts` gauge and emits
+    `fault.env_error` / `fault.recovered` telemetry events;
+  - the deterministic `env.step@n` injection site lives INSIDE the retry
+    scope: the n-th step() call on this wrapper raises `InjectedFault`, and
+    the same machinery that would recover a real crash recovers it — the
+    CI-replayable receipt that the recovery path works.
+
+Async vector workers run one wrapper per subprocess; each worker inherits
+the fault plan through the exported `SHEEPRL_TPU_FAULTS`, so an `env.step`
+fault fires once per worker at that worker's n-th step. Deterministic
+single-fire tests use the sync runner.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable
+
+import gymnasium as gym
+
+from . import inject
+
+__all__ = ["RestartingEnv", "resilient_thunk"]
+
+
+def _max_restarts() -> int:
+    return int(os.environ.get("SHEEPRL_TPU_ENV_RESTARTS", "3"))
+
+
+class RestartingEnv(gym.Wrapper):
+    """See module doc. Wraps the OUTERMOST env of a thunk so every inner
+    wrapper (episode stats, frame stacks, latency models) is rebuilt with
+    the env — a restart yields a genuinely fresh environment."""
+
+    # wrappers stacked above (e.g. the dreamer path's RestartOnException) see
+    # this through gym.Wrapper attribute forwarding and leave the injection
+    # site to the innermost resilient wrapper — one counted site per step
+    _sheeprl_resilient = True
+
+    def __init__(self, thunk: Callable[[], gym.Env], backoff_s: float = 0.05):
+        super().__init__(thunk())
+        self._thunk = thunk
+        self._backoff_s = backoff_s
+        self._consecutive_failures = 0
+
+    def step(self, action):
+        spec = inject.get_plan().fire_next("env.step")
+        try:
+            if spec is not None:
+                raise inject.InjectedFault(f"injected env.step fault: {spec.describe()}")
+            out = self.env.step(action)
+            self._consecutive_failures = 0
+            return out
+        except Exception as exc:
+            return self._restart(exc)
+
+    def _restart(self, exc: Exception):
+        self._consecutive_failures += 1
+        attempt = self._consecutive_failures
+        limit = _max_restarts()
+        inject.count("Fault/env_errors")
+        from ..telemetry import emit
+
+        emit(
+            "fault.env_error",
+            error=f"{type(exc).__name__}: {exc}"[:300],
+            attempt=attempt,
+            limit=limit,
+        )
+        if attempt > limit:
+            raise RuntimeError(
+                f"env failed {attempt} consecutive times (bound "
+                f"SHEEPRL_TPU_ENV_RESTARTS={limit}); last error: {exc!r}"
+            ) from exc
+        try:
+            self.env.close()
+        # sheeplint: disable=SL012 — best-effort close of an ALREADY-crashed env
+        # whose failure was just recorded by fault.env_error above
+        except Exception:
+            pass
+        time.sleep(self._backoff_s * (2 ** (attempt - 1)))
+        self.env = self._thunk()
+        obs, info = self.env.reset()
+        inject.note_recovery("env.step", "env_restarts", attempt=attempt)
+        info = dict(info)
+        info["env_restarted"] = True
+        # the interrupted episode ends here: a truncated boundary with the
+        # fresh reset obs (the same-step autoreset shape the vector runners
+        # already produce), reward 0 — the policy never trains across the
+        # discontinuity as if it were one trajectory
+        return obs, 0.0, False, True, info
+
+    def reset(self, *, seed: int | None = None, options: dict | None = None):
+        self._consecutive_failures = 0
+        return self.env.reset(seed=seed, options=options)
+
+
+def resilient_thunk(
+    thunk: Callable[[], gym.Env],
+) -> Callable[[], "RestartingEnv"]:
+    """Wrap an env thunk so the built env carries the restart machinery;
+    the thunk itself stays (cloud)picklable for spawn-based async workers."""
+
+    def build() -> RestartingEnv:
+        return RestartingEnv(thunk)
+
+    return build

@@ -1,0 +1,254 @@
+"""Deterministic fault injection (ISSUE 12 tentpole part a).
+
+A `FaultPlan` is a set of site-keyed, step-keyed fault declarations parsed
+from `SHEEPRL_TPU_FAULTS` (or `--faults`, which exports the same variable so
+env-worker subprocesses inherit the plan). Each spec fires EXACTLY ONCE at
+its declared step, so a CI job can reproduce any failure bit-for-bit: same
+plan + same seed -> same site, same step, same blast radius. Every firing is
+recorded as a `fault.injected` telemetry event and counted in the `Fault/*`
+gauges (`sheeprl_tpu.resilience.gauges`).
+
+Syntax: comma-separated `site@step[:param]` clauses, e.g.
+
+    SHEEPRL_TPU_FAULTS="env.step@12,nan.grad@3,sigterm@5"
+    SHEEPRL_TPU_FAULTS="transfer.stall@2:3.5"      # stall 3.5 s
+    SHEEPRL_TPU_FAULTS="env.step@10-20"            # seeded draw in [10, 20]
+
+A `lo-hi` step range is resolved at parse time with a deterministic
+site-keyed draw from the plan seed (`SHEEPRL_TPU_FAULT_SEED`, default 0) —
+the "seeded" half of the contract: fuzz-style CI jobs vary the seed, and any
+failing seed replays to the identical step.
+
+Step semantics per site (who counts, and what `step` means):
+
+    env.step        n-th `step()` call on one wrapped host env (per process;
+                    counted by `RestartingEnv`)
+    nan.loss        training batch of loop step k: reward-like leaves
+                    poisoned with NaN (loss goes non-finite)
+    nan.grad        training batch of loop step k: observation-like leaves
+                    poisoned with NaN (gradients go non-finite)
+    sigterm/sigint  deliver the signal to this process at loop step k
+                    (exercises the preemption-grace path)
+    sigkill         deliver SIGKILL at loop step k (no grace: exercises
+                    auto-resume from the last periodic checkpoint)
+    ckpt.write      n-th `save_checkpoint` write attempt raises before the
+                    orbax save (exercises the bounded retry)
+    transfer.stall  n-th decoupled weight transfer sleeps `param` seconds
+                    (default 1.0; exercises the transfer deadline)
+
+Loop-keyed sites (`nan.*`, `sig*`) fire through `fire_at(site, step)` with
+the main's own step counter; call-keyed sites (`env.step`, `ckpt.write`,
+`transfer.stall`) fire through `fire_next(site)`, which advances an internal
+per-site invocation counter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+from typing import Any, Optional
+
+__all__ = [
+    "FAULT_SITES",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "arm_faults",
+    "count",
+    "counters",
+    "gauges",
+    "get_plan",
+    "note_recovery",
+    "reset_plan",
+]
+
+ENV_VAR = "SHEEPRL_TPU_FAULTS"
+SEED_VAR = "SHEEPRL_TPU_FAULT_SEED"
+
+# site -> one-line contract (rendered in howto/fault_tolerance.md's table)
+FAULT_SITES: dict[str, str] = {
+    "env.step": "host env.step() raises (n-th call on one wrapped env)",
+    "nan.loss": "NaN poisoned into reward-like training-batch leaves at loop step k",
+    "nan.grad": "NaN poisoned into observation-like training-batch leaves at loop step k",
+    "sigterm": "SIGTERM delivered at loop step k (preemption grace)",
+    "sigint": "SIGINT delivered at loop step k (preemption grace)",
+    "sigkill": "SIGKILL delivered at loop step k (no grace; auto-resume)",
+    "ckpt.write": "checkpoint write attempt n raises before the orbax save",
+    "transfer.stall": "decoupled weight transfer n stalls `param` seconds",
+}
+
+
+class InjectedFault(RuntimeError):
+    """Raised at exception-type injection sites; recovery machinery treats it
+    like any runtime failure of the site (that is the point)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    step: int
+    param: Optional[float] = None
+
+    def describe(self) -> str:
+        p = "" if self.param is None else f":{self.param:g}"
+        return f"{self.site}@{self.step}{p}"
+
+
+class FaultPlan:
+    """Parsed, seeded fault plan; thread-safe exactly-once firing."""
+
+    def __init__(self, specs: list[FaultSpec], seed: int = 0, text: str = ""):
+        self.specs = list(specs)
+        self.seed = seed
+        self.text = text
+        self._pending: list[FaultSpec] = list(specs)
+        self._site_counters: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def parse(cls, text: str | None, seed: int = 0) -> "FaultPlan":
+        specs: list[FaultSpec] = []
+        for clause in (text or "").split(","):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if "@" not in clause:
+                raise ValueError(
+                    f"fault clause {clause!r} must be site@step[:param]"
+                )
+            site, _, rest = clause.partition("@")
+            site = site.strip()
+            if site not in FAULT_SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r}; known sites: "
+                    f"{sorted(FAULT_SITES)}"
+                )
+            step_s, _, param_s = rest.partition(":")
+            step_s = step_s.strip()
+            if "-" in step_s:
+                lo_s, _, hi_s = step_s.partition("-")
+                lo, hi = int(lo_s), int(hi_s)
+                if hi < lo:
+                    raise ValueError(f"empty step range in {clause!r}")
+                # site-keyed deterministic draw: the same (plan, seed) always
+                # resolves to the same step, and distinct sites decorrelate
+                rng = random.Random(f"{seed}|{site}|{lo}|{hi}")
+                step = rng.randint(lo, hi)
+            else:
+                step = int(step_s)
+            param = float(param_s) if param_s.strip() else None
+            specs.append(FaultSpec(site=site, step=step, param=param))
+        return cls(specs, seed=seed, text=text or "")
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls.parse(
+            os.environ.get(ENV_VAR), seed=int(os.environ.get(SEED_VAR, "0"))
+        )
+
+    # -- firing --------------------------------------------------------------
+    def fire_at(self, site: str, step: int) -> Optional[FaultSpec]:
+        """Fire the pending spec matching (site, step), if any — loop-keyed
+        sites. Exactly-once: a fired spec leaves the pending set."""
+        with self._lock:
+            for spec in self._pending:
+                if spec.site == site and spec.step == int(step):
+                    self._pending.remove(spec)
+                    self._record(spec)
+                    return spec
+        return None
+
+    def fire_next(self, site: str) -> Optional[FaultSpec]:
+        """Advance `site`'s invocation counter and fire the pending spec
+        declared for this invocation, if any — call-keyed sites."""
+        with self._lock:
+            n = self._site_counters.get(site, 0) + 1
+            self._site_counters[site] = n
+            for spec in self._pending:
+                if spec.site == site and spec.step == n:
+                    self._pending.remove(spec)
+                    self._record(spec)
+                    return spec
+        return None
+
+    def pending(self, site: str | None = None) -> list[FaultSpec]:
+        with self._lock:
+            return [
+                s for s in self._pending if site is None or s.site == site
+            ]
+
+    def _record(self, spec: FaultSpec) -> None:
+        count("Fault/injected")
+        # lazy import: inject must stay importable in env-worker subprocesses
+        # before (or without) jax/telemetry coming up
+        from ..telemetry import emit
+
+        emit("fault.injected", site=spec.site, step=spec.step, param=spec.param)
+
+
+# ---------------------------------------------------------------------------
+# Process-global plan + Fault/* counters
+# ---------------------------------------------------------------------------
+
+_PLAN: FaultPlan | None = None
+_COUNTERS: dict[str, float] = {}
+_LOCK = threading.Lock()
+
+
+def get_plan() -> FaultPlan:
+    """The process-global plan, parsed from the environment on first use."""
+    global _PLAN
+    if _PLAN is None:
+        _PLAN = FaultPlan.from_env()
+    return _PLAN
+
+
+def arm_faults(text: str | None) -> FaultPlan:
+    """Install a plan from `--faults` and export it to the environment so
+    spawned subprocesses (async env workers) inherit the same plan. Passing
+    None/"" re-arms from the current environment."""
+    global _PLAN
+    if text:
+        os.environ[ENV_VAR] = text
+    _PLAN = FaultPlan.from_env()
+    return _PLAN
+
+
+def reset_plan() -> None:
+    """Drop the global plan, counters and lagged recovery state (test
+    isolation)."""
+    global _PLAN
+    with _LOCK:
+        _PLAN = None
+        _COUNTERS.clear()
+    from . import recover
+
+    recover._pending_flag.clear()
+
+
+def count(name: str, delta: float = 1.0) -> None:
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0.0) + delta
+
+
+def counters() -> dict[str, float]:
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def gauges() -> dict[str, float]:
+    """Fault/* gauge source for `Telemetry.add_gauges` (registered by
+    `RunGuard.install`)."""
+    return counters()
+
+
+def note_recovery(site: str, action: str, **data: Any) -> None:
+    """Record a successful recovery: `fault.recovered` telemetry event plus
+    the per-action Fault/* counter every recovery path shares."""
+    count(f"Fault/{action}")
+    from ..telemetry import emit
+
+    emit("fault.recovered", site=site, action=action, **data)

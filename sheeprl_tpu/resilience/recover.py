@@ -1,0 +1,199 @@
+"""Recovery actuators (ISSUE 12 tentpole part c): the NaN watchdog escalated
+from "log it" to "survive it".
+
+`--on_nonfinite {warn,skip,rollback}` (StandardArgs):
+
+  - `warn`     — the PR-1 behavior: the telemetry watchdog prints, training
+                 marches on (and diverges). Default; the train jit is left
+                 byte-identical, so the committed sheepcheck/sheepmem ledger
+                 fingerprints only move when a non-default policy is armed.
+  - `skip`     — `guard_nonfinite` wraps the UNJITTED train-step body: after
+                 the update, every floating leaf of (new_state, metrics) is
+                 finiteness-reduced to one scalar `ok`, and the returned
+                 state is `jnp.where(ok, new, old)` per leaf. The select
+                 reads the old leaves INSIDE the same XLA program, so it
+                 composes with `donate_argnums` — the donated input buffer
+                 is read before XLA reuses it (the "donation-safe jnp.where
+                 guard"). A poisoned batch costs one wasted update instead
+                 of a poisoned parameter tree.
+  - `rollback` — skip, plus the host restores the last-good checkpoint and
+                 re-splits the loop PRNG so the retried trajectory diverges
+                 from the one that blew up. Supported where the main wires
+                 `resilience.rollback` (ppo, sac); others reject the flag at
+                 startup instead of degrading silently.
+
+Fault injection enters through `poison_batch` (sites `nan.loss` /
+`nan.grad`): the declared step's training batch gets one NaN written into a
+reward-like / observation-like leaf, which propagates into the losses and
+gradients — the deterministic stand-in for a numeric blowup.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from . import inject
+
+__all__ = [
+    "NONFINITE_POLICIES",
+    "SKIP_FLAG",
+    "guard_nonfinite",
+    "poison_batch",
+    "rollback",
+    "update_skipped",
+]
+
+NONFINITE_POLICIES = ("warn", "skip", "rollback")
+
+# metric key carrying the in-jit skip decision to the host (popped by
+# `update_skipped` before metrics reach the aggregator)
+SKIP_FLAG = "Fault/update_skipped"
+
+# leaf-name heuristics for the two poison sites
+_LOSS_LEAVES = ("rewards", "reward", "returns", "cont")
+_GRAD_LEAVES = ("observations", "obs", "rgb", "state", "vector")
+
+
+def _poison_leaf(value: Any) -> Any:
+    """One NaN in the first element; handles numpy and jax leaves."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if isinstance(value, np.ndarray):
+        out = value.copy()
+        out[(0,) * out.ndim] = np.nan
+        return out
+    idx = (0,) * value.ndim
+    return value.at[idx].set(jnp.nan)
+
+
+def poison_batch(data: dict, step: int) -> dict:
+    """Apply any `nan.loss` / `nan.grad` fault declared for loop step `step`
+    to the training batch `data` (a flat dict of [batch...] float leaves).
+    Returns `data` untouched when nothing fires."""
+    plan = inject.get_plan()
+    for site, preferred in (("nan.loss", _LOSS_LEAVES), ("nan.grad", _GRAD_LEAVES)):
+        spec = plan.fire_at(site, step)
+        if spec is None:
+            continue
+        import numpy as np
+
+        float_keys = [
+            k
+            for k, v in data.items()
+            if hasattr(v, "dtype") and np.issubdtype(v.dtype, np.floating)
+        ]
+        if not float_keys:
+            continue
+        target = next(
+            (k for k in float_keys if any(p in k.lower() for p in preferred)),
+            float_keys[0],
+        )
+        data = dict(data)
+        data[target] = _poison_leaf(data[target])
+    return data
+
+
+def guard_nonfinite(
+    body: Callable[..., tuple], policy: str
+) -> Callable[..., tuple]:
+    """Wrap an unjitted train-step body `(state, *args) -> (state, metrics)`
+    with the donation-safe skip select (see module doc). `warn` returns the
+    body untouched — zero jaxpr drift at the default."""
+    if policy not in NONFINITE_POLICIES:
+        raise ValueError(
+            f"on_nonfinite must be one of {NONFINITE_POLICIES}, got {policy!r}"
+        )
+    if policy == "warn":
+        return body
+
+    def guarded(state, *rest):
+        import jax
+        import jax.numpy as jnp
+
+        new_state, metrics = body(state, *rest)
+        checks = [
+            jnp.all(jnp.isfinite(leaf))
+            for leaf in jax.tree_util.tree_leaves((new_state, metrics))
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)
+        ]
+        ok = jnp.stack(checks).all() if checks else jnp.asarray(True)
+        guarded_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old), new_state, state
+        )
+        out_metrics = dict(metrics)
+        out_metrics[SKIP_FLAG] = (~ok).astype(jnp.float32)
+        return guarded_state, out_metrics
+
+    return guarded
+
+
+# one-slot queue of the in-flight skip flag: the check is LAGGED one update
+# so the host never blocks on the train step it just dispatched (a blocking
+# per-update pull measured 67% sps overhead on tiny CPU steps — the async
+# pipeline the mains run on must stay async)
+_pending_flag: list = []
+
+
+def update_skipped(metrics: dict, policy: str) -> bool:
+    """Host-side read of the in-jit skip flag, one update LAGGED. Pops
+    `SKIP_FLAG` from `metrics` (so the aggregator never sees it), starts an
+    async device->host copy of it, and reads the PREVIOUS update's flag —
+    which has had a whole update of wall time to land, so the read does not
+    stall dispatch. Consequences of the lag: the `fault.recovered` event
+    (and a rollback) trail the poisoned update by one step — the in-jit
+    select already held the state, so nothing is lost — and a skip in the
+    very last update goes unreported. Only exists when a non-default policy
+    armed the guard."""
+    flag = metrics.pop(SKIP_FLAG, None)
+    if flag is None:
+        return False
+    copy_async = getattr(flag, "copy_to_host_async", None)
+    if copy_async is not None:
+        copy_async()
+    prev = _pending_flag[0] if _pending_flag else None
+    _pending_flag[:] = [flag]
+    if prev is None:
+        return False
+    skipped = bool(float(prev))
+    if skipped:
+        inject.note_recovery("nan", "updates_skipped", policy=policy)
+    return skipped
+
+
+# ---------------------------------------------------------------------------
+# Rollback: last-good checkpoint registry + restore
+# ---------------------------------------------------------------------------
+
+_LAST_GOOD: list[str] = []  # committed checkpoint paths, oldest -> newest
+
+
+def note_checkpoint(path: str) -> None:
+    """Called by `save_checkpoint` on every committed write: the registry
+    `rollback` restores from (bounded; rollback only ever needs the tail)."""
+    _LAST_GOOD.append(path)
+    del _LAST_GOOD[:-8]
+
+
+def last_good_checkpoint() -> Optional[str]:
+    return _LAST_GOOD[-1] if _LAST_GOOD else None
+
+
+def rollback(template: dict, *, step: int) -> Optional[dict]:
+    """Restore the last-good checkpoint into `template` (the caller's
+    per-algo state dict shape). Returns the restored dict, or None when no
+    checkpoint has been committed yet — the caller then continues on the
+    skip path (already applied by `guard_nonfinite`)."""
+    from ..utils.checkpoint import load_checkpoint, wait_checkpoint
+
+    path = last_good_checkpoint()
+    if path is None:
+        inject.count("Fault/rollback_unavailable")
+        from ..telemetry import emit
+
+        emit("fault.rollback_unavailable", step=step)
+        return None
+    wait_checkpoint()
+    restored = load_checkpoint(path, template)
+    inject.note_recovery("nan", "rollbacks", step=step, checkpoint=path)
+    return restored

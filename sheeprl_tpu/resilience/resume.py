@@ -1,0 +1,168 @@
+"""Auto-resume resolution (`--resume {off,auto,<path>}`, ISSUE 12 part b).
+
+Resolution happens BEFORE the logger/telemetry exist (the resolved
+checkpoint decides the run directory): `--resume auto` walks the run-dir
+layout `{root_dir}/{run_name}/checkpoints/ckpt_<step>` newest-first and
+installs the newest VALID checkpoint (orbax commit markers + args.json
+sidecar — see `utils/checkpoint.valid_checkpoint`) into
+`args.checkpoint_path`, so the mains' existing restore paths — config
+reload from the sidecar, run-dir reuse in `create_logger`, per-algo state
+templates — do the rest untouched. Corrupt/partial candidates are skipped
+with a `checkpoint.corrupt` event and kept OUT of the fallback list.
+
+The ordered valid-candidate list of the chosen run survives in module state:
+when a restore crashes on a checkpoint that passed the marker check (bad
+array bytes), `utils/checkpoint.load_checkpoint` asks `next_fallback` for
+the previous valid candidate instead of dying — the corrupt-checkpoint
+satellite's second line of defense.
+
+A run with no resumable checkpoint starts FRESH and records `resume.none`;
+supervisors that blindly restart with `--resume auto` therefore work from
+the very first attempt.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from . import inject
+from .guard import note_event
+
+__all__ = [
+    "load_resume_state",
+    "next_fallback",
+    "prepare_run",
+    "resolve_resume",
+    "save_resume_state",
+]
+
+# valid checkpoints of the resumed run, newest first; [0] is what resolve
+# installed, the rest are restore-time fallbacks
+_CANDIDATES: list[str] = []
+
+
+def prepare_run(args: Any, algo_name: str) -> None:
+    """The one pre-logger resilience hook every main calls right after
+    argument parsing: arm the fault plan and resolve `--resume`."""
+    inject.arm_faults(getattr(args, "faults", None))
+    resolve_resume(args, algo_name)
+
+
+def resolve_resume(args: Any, algo_name: str) -> Optional[str]:
+    mode = getattr(args, "resume", "off") or "off"
+    if mode == "off":
+        return None
+    if getattr(args, "eval_only", False):
+        raise ValueError("--resume is a training flag; --eval_only takes --checkpoint_path")
+    if args.checkpoint_path:
+        # an explicit checkpoint wins; --resume auto is then redundant
+        note_event(
+            "resume", mode=mode, checkpoint=args.checkpoint_path, source="explicit"
+        )
+        return args.checkpoint_path
+    if mode != "auto":
+        if not os.path.isdir(mode):
+            raise ValueError(f"--resume path {mode!r} is not a checkpoint directory")
+        args.checkpoint_path = os.path.abspath(mode)
+        note_event("resume", mode="path", checkpoint=args.checkpoint_path)
+        return args.checkpoint_path
+
+    from ..utils.checkpoint import list_checkpoints
+
+    root = args.root_dir or os.path.join("logs", algo_name, args.env_id)
+    if args.run_name:
+        run_dirs = [os.path.join(root, args.run_name)]
+    else:
+        # no run identity given: resume the most recently touched run under
+        # the algo/env root (the "rerun the same command after eviction" path)
+        try:
+            entries = [
+                os.path.join(root, e)
+                for e in os.listdir(root)
+                if os.path.isdir(os.path.join(root, e))
+            ]
+        except OSError:
+            entries = []
+        run_dirs = sorted(entries, key=os.path.getmtime, reverse=True)
+    for run_dir in run_dirs:
+        valid = list_checkpoints(os.path.join(run_dir, "checkpoints"))
+        if valid:
+            _CANDIDATES[:] = valid  # newest first
+            args.checkpoint_path = valid[0]
+            note_event(
+                "resume",
+                mode="auto",
+                checkpoint=valid[0],
+                fallbacks=len(valid) - 1,
+            )
+            return valid[0]
+    note_event("resume.none", mode="auto", root=root)
+    return None
+
+
+def save_resume_state(ckpt_path: str, **trees: Any) -> None:
+    """Persist bit-exact-resume deep state NEXT TO an orbax checkpoint (one
+    `<ckpt>.resume.npz`): loop PRNG keys, Anakin collector carries — pytrees
+    whose structure the resumed process rebuilds itself, so only the leaves
+    are stored and the orbax key contract (and every old checkpoint) stays
+    untouched. None-valued entries are skipped."""
+    import jax
+    import numpy as np
+
+    payload: dict[str, Any] = {}
+    for name, tree in trees.items():
+        if tree is None:
+            continue
+        leaves = jax.tree_util.tree_leaves(tree)
+        payload[f"__count_{name}"] = np.asarray(len(leaves))
+        for i, leaf in enumerate(leaves):
+            payload[f"{name}__{i}"] = np.asarray(leaf)
+    if payload:
+        np.savez(ckpt_path + ".resume.npz", **payload)
+
+
+def load_resume_state(ckpt_path: str, **templates: Any) -> Optional[dict]:
+    """Restore `save_resume_state` leaves onto same-structure templates
+    (the freshly initialized key/carry of the resuming process). Returns
+    {name: tree} for the templates present in the sidecar, or None when the
+    checkpoint predates the sidecar (plain params-only resume)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    path = ckpt_path + ".resume.npz"
+    if not os.path.exists(path):
+        return None
+    data = np.load(path)
+    out: dict[str, Any] = {}
+    for name, template in templates.items():
+        if template is None or f"__count_{name}" not in data:
+            continue
+        treedef = jax.tree_util.tree_structure(template)
+        fresh = jax.tree_util.tree_leaves(template)
+        count = int(data[f"__count_{name}"])
+        if count != len(fresh):
+            raise ValueError(
+                f"resume sidecar {path} holds {count} leaves for {name!r}, "
+                f"the current config builds {len(fresh)} — config drift "
+                "between save and resume"
+            )
+        leaves = [
+            jnp.asarray(data[f"{name}__{i}"], dtype=fresh[i].dtype)
+            for i in range(count)
+        ]
+        out[name] = jax.tree_util.tree_unflatten(treedef, leaves)
+    return out or None
+
+
+def next_fallback(failed_path: str) -> Optional[str]:
+    """The next (older) valid candidate after a checkpoint that failed to
+    restore; None outside an auto-resume or past the end of the list."""
+    failed = os.path.abspath(failed_path)
+    paths = [os.path.abspath(p) for p in _CANDIDATES]
+    if failed in paths:
+        idx = paths.index(failed)
+        if idx + 1 < len(paths):
+            return _CANDIDATES[idx + 1]
+    return None

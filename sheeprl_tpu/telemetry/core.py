@@ -217,11 +217,17 @@ class Telemetry:
             out["XLA/total_compiles"] = comp["total_compiles"]
             out["XLA/total_compile_seconds"] = comp["total_compile_seconds"]
         out.update(device_memory_gauges())
+        gauge_errors = 0
         for source in self._gauge_sources:
             try:
                 out.update(source())
             except Exception:
-                pass  # a gauge source must never kill the loop
+                # a gauge source must never kill the loop — but a silently
+                # dead source is an observability hole (SL012), so the
+                # failure count rides the metrics it failed to produce
+                gauge_errors += 1
+        if gauge_errors:
+            out["Health/gauge_source_errors"] = float(gauge_errors)
         self._nan_watchdog(out, step)
         self._last_step = step
         now = time.monotonic()
@@ -293,6 +299,23 @@ class Telemetry:
             )
             self._teardown()
 
+    def abort(self, error: str | None = None) -> None:
+        """Crash-path teardown (the `@resilience.crashsafe` scope): emit a
+        `crash` record when given one, then close the JSONL WITHOUT the
+        clean-exit `end` event — a post-mortem can tell an aborted run from
+        a completed one by the missing `end`."""
+        if self._closed:
+            return
+        if error is not None:
+            self.event("crash", error=error, handled=True)
+        try:
+            atexit.unregister(self._atexit)
+        # sheeplint: disable=SL012 — unregister during interpreter teardown;
+        # the event log this would be reported to is being closed right here
+        except Exception:
+            pass
+        self._teardown()
+
     def close(self) -> None:
         """Normal end-of-run teardown: flush open phases, emit `end`."""
         if self._closed:
@@ -300,6 +323,8 @@ class Telemetry:
         self.event("end", phases=self.timers.flush())
         try:
             atexit.unregister(self._atexit)
+        # sheeplint: disable=SL012 — unregister during interpreter teardown;
+        # the event log this would be reported to is being closed right here
         except Exception:
             pass
         self._teardown()

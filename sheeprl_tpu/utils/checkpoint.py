@@ -16,6 +16,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import time
 from typing import Any
 
 import orbax.checkpoint as ocp
@@ -24,8 +25,14 @@ __all__ = [
     "save_checkpoint",
     "load_checkpoint",
     "latest_checkpoint",
+    "list_checkpoints",
+    "valid_checkpoint",
     "wait_checkpoint",
 ]
+
+# orbax finalizes a checkpoint by writing this marker into the (atomically
+# renamed) directory — its absence means an interrupted/partial write
+_COMMIT_MARKER = "_CHECKPOINT_METADATA"
 
 # one async checkpointer per process: saves overlap training (orbax commits
 # atomically via tmp-dir + rename, so a crash mid-save leaves the previous
@@ -85,15 +92,56 @@ def save_checkpoint(
         return x
 
     state = jax.tree_util.tree_map(_to_host, state)
-    ckptr = _checkpointer()
-    ckptr.wait_until_finished()  # at most one outstanding save
-    ckptr.save(path, state, force=True)
-    if block:
-        ckptr.wait_until_finished()
+    from ..resilience import inject
+
+    # deterministic injection site: the n-th save attempt raises before the
+    # orbax write — exercised by the bounded retry below (ISSUE 12)
+    injected = inject.get_plan().fire_next("ckpt.write")
+    retries = int(os.environ.get("SHEEPRL_TPU_CKPT_RETRIES", "2"))
+    last_exc: Exception | None = None
+    for attempt in range(1 + retries):
+        try:
+            if injected is not None and attempt == 0:
+                raise inject.InjectedFault(
+                    f"injected checkpoint-write fault: {injected.describe()}"
+                )
+            ckptr = _checkpointer()
+            ckptr.wait_until_finished()  # at most one outstanding save
+            ckptr.save(path, state, force=True)
+            if block:
+                ckptr.wait_until_finished()
+            break
+        except Exception as exc:
+            last_exc = exc
+            from ..telemetry import emit
+
+            emit(
+                "checkpoint.error",
+                path=path,
+                attempt=attempt + 1,
+                error=f"{type(exc).__name__}: {exc}"[:300],
+            )
+            if attempt >= retries:
+                if block:
+                    # a blocking save (final/preemption checkpoint) must not
+                    # be lost silently — surface the failure to the caller
+                    raise
+                # a periodic async save: losing one checkpoint is survivable,
+                # losing the run to it is not
+                inject.count("Fault/ckpt_lost")
+                return
+            inject.count("Fault/ckpt_retries")
+            time.sleep(0.05 * (2**attempt))
+    if last_exc is not None:
+        inject.note_recovery("ckpt.write", "ckpt_retried", path=path)
     if args is not None:
         cfg = args.as_dict() if hasattr(args, "as_dict") else dict(args)
         with open(path + ".args.json", "w") as fh:
             json.dump(cfg, fh)
+    # last-good registry for --on_nonfinite rollback
+    from ..resilience import note_checkpoint
+
+    note_checkpoint(path)
     # run-lifecycle record in <log_dir>/telemetry.jsonl (no-op without an
     # active Telemetry): a post-mortem can tell which checkpoints a crashed
     # run actually committed
@@ -120,8 +168,29 @@ def load_checkpoint(path: str, template: dict[str, Any] | None = None) -> dict[s
 
     wait_checkpoint()  # never read past an in-flight save
     path = os.path.abspath(path)
-    ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(path) if template is None else ckptr.restore(path, template)
+    try:
+        ckptr = ocp.StandardCheckpointer()
+        restored = (
+            ckptr.restore(path) if template is None else ckptr.restore(path, template)
+        )
+    except Exception as exc:
+        # a checkpoint that passed the marker check can still fail to restore
+        # (truncated array bytes). Under --resume auto, fall back to the
+        # previous VALID candidate of the same run instead of dying — the
+        # corrupt-checkpoint satellite's second line of defense.
+        from ..resilience import next_fallback
+        from ..telemetry import emit
+
+        emit(
+            "checkpoint.corrupt",
+            path=path,
+            reason=f"restore failed: {type(exc).__name__}: {exc}"[:300],
+        )
+        fallback = next_fallback(path)
+        if fallback is None:
+            raise
+        emit("checkpoint.fallback", failed=path, checkpoint=fallback)
+        return load_checkpoint(fallback, template)
     return jax.tree_util.tree_map(
         lambda x: jnp.array(x) if isinstance(x, jax.Array) else x, restored
     )
@@ -135,8 +204,52 @@ def load_checkpoint_args(path: str) -> dict[str, Any] | None:
         return json.load(fh)
 
 
-def latest_checkpoint(ckpt_dir: str) -> str | None:
-    """Newest `ckpt_*` entry in a run's checkpoint directory."""
+def valid_checkpoint(path: str) -> tuple[bool, str]:
+    """Structural validity of one checkpoint directory: the orbax commit
+    marker (written at finalize, AFTER the atomic rename) plus the
+    `args.json` sidecar a resumable checkpoint needs. Returns
+    (ok, reason-if-not)."""
+    if not os.path.isdir(path):
+        return False, "not a directory"
+    if not os.path.exists(os.path.join(path, _COMMIT_MARKER)):
+        return False, f"missing orbax commit marker {_COMMIT_MARKER}"
+    if not os.path.exists(path + ".args.json"):
+        return False, "missing args.json sidecar"
+    return True, ""
+
+
+def list_checkpoints(ckpt_dir: str) -> list[str]:
+    """All VALID `ckpt_<step>` entries of a run's checkpoint directory,
+    newest (highest step) first. Partial/corrupt candidates — interrupted
+    writes, missing sidecars — are skipped with a `checkpoint.corrupt`
+    telemetry event instead of crashing the resume."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    entries = [
+        e
+        for e in os.listdir(ckpt_dir)
+        if e.startswith("ckpt_") and e.split("_")[-1].isdigit()
+    ]
+    entries.sort(key=lambda e: int(e.split("_")[-1]), reverse=True)
+    out = []
+    for e in entries:
+        path = os.path.join(ckpt_dir, e)
+        ok, reason = valid_checkpoint(path)
+        if ok:
+            out.append(path)
+        else:
+            from ..resilience.guard import note_event
+
+            note_event("checkpoint.corrupt", path=path, reason=reason)
+    return out
+
+
+def latest_checkpoint(ckpt_dir: str, validate: bool = True) -> str | None:
+    """Newest `ckpt_*` entry in a run's checkpoint directory. With
+    `validate` (the default), newest VALID entry — see `list_checkpoints`."""
+    if validate:
+        found = list_checkpoints(ckpt_dir)
+        return found[0] if found else None
     if not os.path.isdir(ckpt_dir):
         return None
     # checkpoints are `ckpt_<step>` directories; skip `ckpt_<step>.args.json`

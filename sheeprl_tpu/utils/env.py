@@ -27,6 +27,7 @@ from ..envs.wrappers import (
     MaskVelocityWrapper,
     maybe_step_latency,
 )
+from ..resilience.envwrap import resilient_thunk
 
 __all__ = ["make_env", "make_dict_env", "get_dummy_env"]
 
@@ -62,7 +63,10 @@ def make_env(
         env.observation_space.seed(seed)
         return env
 
-    return thunk
+    # bounded retry-with-backoff around every host env (ISSUE 12): step()
+    # crashes rebuild the env from this thunk and surface as a truncated
+    # episode boundary; SHEEPRL_TPU_ENV_RESTARTS bounds consecutive failures
+    return resilient_thunk(thunk)
 
 
 class _ImageTransform(gym.ObservationWrapper):
@@ -317,4 +321,5 @@ def make_dict_env(
             )
         return env
 
-    return thunk
+    # bounded env-restart machinery, as in make_env (ISSUE 12)
+    return resilient_thunk(thunk)

@@ -27,6 +27,8 @@ def _prefetch(values) -> None:
         if copy_async is not None:
             try:
                 copy_async()
+            # sheeplint: disable=SL012 — prefetch-only path; compute()'s
+            # blocking pull is the correctness path and raises for real
             except Exception:
                 pass  # fall back to the blocking pull in compute
 

@@ -43,9 +43,11 @@ TINY_DV3 = [
 
 
 def _latest_ckpt(root):
+    # checkpoints are ckpt_<step> DIRECTORIES; skip the args.json and
+    # resume.npz (ISSUE 12 deep-state) sidecars that share the prefix
     ckpts = [
         p for p in glob.glob(os.path.join(root, "**", "ckpt_*"), recursive=True)
-        if not p.endswith(".args.json")
+        if os.path.isdir(p)
     ]
     assert ckpts, f"no checkpoint under {root}"
     return sorted(ckpts, key=lambda p: int(p.rsplit("_", 1)[-1]))[-1]

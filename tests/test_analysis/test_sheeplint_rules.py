@@ -605,10 +605,75 @@ def test_sl011_suppression_with_justification():
     assert ids(src) == []
 
 
+# ---------------------------------------------------------------------------
+# SL012 — swallowed-and-unlogged broad exception handlers (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def test_sl012_positive_bare_broad_and_ellipsis():
+    src = """
+    def f():
+        try:
+            step()
+        except Exception:
+            pass
+
+    def g():
+        try:
+            step()
+        except:
+            ...
+
+    def h():
+        for _ in range(3):
+            try:
+                step()
+            except (ValueError, BaseException):
+                continue
+    """
+    assert ids(src) == ["SL012", "SL012", "SL012"]
+
+
+def test_sl012_negative_narrow_logged_or_reraised():
+    src = """
+    def f():
+        try:
+            step()
+        except ValueError:
+            pass  # narrow: presumed deliberate
+
+    def g(log):
+        try:
+            step()
+        except Exception as exc:
+            log.warning("step failed: %s", exc)
+
+    def h():
+        try:
+            step()
+        except Exception:
+            cleanup()
+            raise
+    """
+    assert ids(src) == []
+
+
+def test_sl012_suppression_with_justification():
+    src = """
+    def f(env):
+        try:
+            env.close()
+        # sheeplint: disable=SL012 — best-effort close of a crashed env
+        except Exception:
+            pass
+    """
+    assert ids(src) == []
+
+
 def test_rule_catalog_complete():
     assert rule_ids() == [
         "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
-        "SL008", "SL009", "SL010", "SL011",
+        "SL008", "SL009", "SL010", "SL011", "SL012",
     ]
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")

@@ -1,0 +1,108 @@
+"""Env restart machinery (ISSUE 12): deterministic env.step injection inside
+the retry scope, bounded restarts with truncated-boundary semantics, and the
+vector-runner integration every main inherits through utils/env.py."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu import resilience
+from sheeprl_tpu.resilience.envwrap import RestartingEnv, resilient_thunk
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("SHEEPRL_TPU_FAULTS", raising=False)
+    monkeypatch.delenv("SHEEPRL_TPU_ENV_RESTARTS", raising=False)
+    resilience.reset_plan()
+    yield
+    resilience.reset_plan()
+
+
+class _CountingEnv(gym.Env):
+    """Tiny env recording construction and step counts; optionally crashes."""
+
+    observation_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+    action_space = gym.spaces.Discrete(2)
+    builds = 0
+
+    def __init__(self, crash_at: int | None = None):
+        type(self).builds += 1
+        self._crash_at = crash_at
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        return np.zeros(2, np.float32), {}
+
+    def step(self, action):
+        self._t += 1
+        if self._crash_at is not None and self._t == self._crash_at:
+            raise OSError("simulated emulator crash")
+        return np.full(2, self._t, np.float32), 1.0, False, False, {}
+
+
+def test_injected_env_step_fault_recovers_with_truncated_boundary():
+    _CountingEnv.builds = 0
+    resilience.arm_faults("env.step@3")
+    env = RestartingEnv(lambda: _CountingEnv(), backoff_s=0.0)
+    env.reset()
+    env.step(0)
+    env.step(0)
+    obs, reward, term, trunc, info = env.step(0)  # 3rd call: injected fault
+    assert _CountingEnv.builds == 2  # restarted once
+    assert trunc and not term and reward == 0.0
+    assert info.get("env_restarted") is True
+    np.testing.assert_array_equal(obs, np.zeros(2, np.float32))  # reset obs
+    # the plan fired exactly once: the next steps are clean
+    obs, _, _, trunc, info = env.step(0)
+    assert not trunc and "env_restarted" not in info
+    assert resilience.gauges().get("Fault/env_restarts") == 1.0
+
+
+def test_real_exception_recovers_and_consecutive_bound_reraises(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_ENV_RESTARTS", "2")
+    # every rebuilt env crashes on its FIRST step: failures stay consecutive
+    env = RestartingEnv(lambda: _CountingEnv(crash_at=1), backoff_s=0.0)
+    env.reset()
+    _, _, _, trunc, info = env.step(0)  # failure 1 -> restart
+    assert trunc and info["env_restarted"]
+    _, _, _, trunc, _ = env.step(0)  # failure 2 -> restart (at the bound)
+    assert trunc
+    with pytest.raises(RuntimeError, match="consecutive"):
+        env.step(0)  # failure 3 exceeds SHEEPRL_TPU_ENV_RESTARTS=2
+
+
+def test_success_resets_the_consecutive_failure_counter(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_ENV_RESTARTS", "1")
+    env = RestartingEnv(lambda: _CountingEnv(crash_at=2), backoff_s=0.0)
+    env.reset()
+    for _ in range(4):
+        # step 1 succeeds (resets the counter), step 2 crashes -> restart;
+        # with the bound at 1, only CONSECUTIVE failures would re-raise
+        env.step(0)
+
+
+def test_resilient_thunk_wraps_and_preserves_spaces():
+    build = resilient_thunk(lambda: _CountingEnv())
+    env = build()
+    assert isinstance(env, RestartingEnv)
+    assert env.observation_space.shape == (2,)
+    assert env.action_space.n == 2
+    env.close()
+
+
+def test_sync_vector_env_rides_through_env_fault():
+    """The integration receipt: a SyncVectorEnv over restarting envs keeps
+    stepping through an injected fault — the loop above it never notices."""
+    from sheeprl_tpu.envs.vector import SyncVectorEnv
+
+    resilience.arm_faults("env.step@2")
+    venv = SyncVectorEnv([resilient_thunk(lambda: _CountingEnv()) for _ in range(2)])
+    venv.reset(seed=0)
+    for _ in range(4):
+        obs, rewards, terms, truncs, infos = venv.step([0, 0])
+        assert obs.shape == (2, 2)
+    assert any("env_restarted" in i for i in infos) or resilience.gauges().get(
+        "Fault/env_restarts"
+    ) == 1.0

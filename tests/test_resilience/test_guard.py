@@ -1,0 +1,131 @@
+"""Preemption grace + crash scope (ISSUE 12): signal handling, the per-step
+fault tick, @crashsafe's distinct resumable rc, and the crashed-run
+telemetry guarantee."""
+
+import json
+import os
+import signal
+
+import pytest
+
+from sheeprl_tpu import resilience
+from sheeprl_tpu.resilience.guard import RC_PREEMPTED, Preempted, RunGuard
+from sheeprl_tpu.telemetry import Telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("SHEEPRL_TPU_FAULTS", raising=False)
+    resilience.reset_plan()
+    yield
+    RunGuard.uninstall()
+    resilience.reset_plan()
+
+
+def _events(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    if not path.exists():
+        return []
+    return [json.loads(l) for l in path.read_text().strip().splitlines()]
+
+
+def test_sigterm_sets_preempted_flag_and_restores_handlers():
+    prev = signal.getsignal(signal.SIGTERM)
+    guard = RunGuard.install()
+    try:
+        assert not guard.preempted
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.preempted
+        assert guard.preempt_signal == "SIGTERM"
+    finally:
+        RunGuard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_tick_fires_injected_sigterm_at_declared_step():
+    resilience.arm_faults("sigterm@3")
+    guard = RunGuard.install()
+    try:
+        assert guard.tick(1) is False
+        assert guard.tick(2) is False
+        assert guard.tick(3) is True  # injected signal, caught by the guard
+        assert guard.preempted
+    finally:
+        RunGuard.uninstall()
+
+
+def test_preempt_signal_emits_event_and_counts(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit")
+    guard = RunGuard.install(telem)
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        os.kill(os.getpid(), signal.SIGTERM)  # duplicate: counted once
+    finally:
+        RunGuard.uninstall()
+        telem.close()
+    events = [e for e in _events(tmp_path) if e.get("event") == "preempt.signal"]
+    assert len(events) == 1 and events[0]["signal"] == "SIGTERM"
+    assert resilience.gauges().get("Fault/preemptions") == 1.0
+
+
+def test_crashsafe_maps_preempted_to_resumable_rc(tmp_path):
+    telem_holder = {}
+
+    @resilience.crashsafe
+    def fake_main():
+        telem_holder["t"] = Telemetry(str(tmp_path), rank=0, algo="unit")
+        RunGuard.install(telem_holder["t"])
+        raise Preempted(7, "SIGTERM")
+
+    with pytest.raises(SystemExit) as exc_info:
+        fake_main()
+    assert exc_info.value.code == RC_PREEMPTED
+    events = _events(tmp_path)
+    preempt = [e for e in events if e.get("event") == "preempt"]
+    assert preempt and preempt[0]["step"] == 7
+    assert preempt[0]["rc"] == RC_PREEMPTED
+    # telemetry was closed (end event present), handlers restored
+    assert any(e.get("event") == "end" for e in events)
+    assert RunGuard._current is None
+
+
+def test_crashsafe_records_crash_event_and_reraises(tmp_path):
+    @resilience.crashsafe
+    def fake_main():
+        Telemetry(str(tmp_path), rank=0, algo="unit")
+        raise RuntimeError("boom at step 3")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        fake_main()
+    events = _events(tmp_path)
+    crash = [e for e in events if e.get("event") == "crash"]
+    assert crash and "boom at step 3" in crash[0]["error"]
+    # the scope tore telemetry down WITHOUT a clean `end` record
+    assert not any(e.get("event") == "end" for e in events)
+
+
+def test_crashsafe_passes_capture_complete_through(tmp_path):
+    from sheeprl_tpu.compile.plan import CaptureComplete
+
+    holder = {}
+
+    @resilience.crashsafe
+    def fake_main():
+        holder["t"] = Telemetry(str(tmp_path), rank=0, algo="unit")
+        raise CaptureComplete(None)
+
+    try:
+        with pytest.raises(CaptureComplete):
+            fake_main()
+    finally:
+        holder["t"].close()
+    # capture aborts are by-design: no crash record
+    assert not any(e.get("event") == "crash" for e in _events(tmp_path))
+
+
+def test_crashsafe_success_path_is_transparent():
+    @resilience.crashsafe
+    def fake_main(x):
+        return x * 2
+
+    assert fake_main(21) == 42

@@ -1,0 +1,169 @@
+"""Deterministic fault injection (ISSUE 12): plan parsing, seeded range
+resolution, exactly-once firing per site, telemetry receipts, and the
+process-global arming path the mains use."""
+
+import json
+
+import pytest
+
+from sheeprl_tpu import resilience
+from sheeprl_tpu.resilience.inject import ENV_VAR, FaultPlan
+from sheeprl_tpu.telemetry import Telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan(monkeypatch):
+    monkeypatch.delenv(ENV_VAR, raising=False)
+    resilience.reset_plan()
+    yield
+    resilience.reset_plan()
+
+
+def _events(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    if not path.exists():
+        return []
+    return [json.loads(l) for l in path.read_text().strip().splitlines()]
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_sites_steps_and_params():
+    plan = FaultPlan.parse("env.step@12, nan.grad@3, transfer.stall@2:3.5")
+    assert [(s.site, s.step, s.param) for s in plan.specs] == [
+        ("env.step", 12, None),
+        ("nan.grad", 3, None),
+        ("transfer.stall", 2, 3.5),
+    ]
+
+
+def test_parse_rejects_unknown_site_and_bad_clause():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan.parse("warp.core@3")
+    with pytest.raises(ValueError, match="site@step"):
+        FaultPlan.parse("sigterm")
+
+
+def test_parse_empty_and_none_are_empty_plans():
+    assert FaultPlan.parse(None).specs == []
+    assert FaultPlan.parse(" ").specs == []
+
+
+def test_seeded_range_is_deterministic_and_site_keyed():
+    a = FaultPlan.parse("env.step@10-20,sigterm@10-20", seed=7)
+    b = FaultPlan.parse("env.step@10-20,sigterm@10-20", seed=7)
+    c = FaultPlan.parse("env.step@10-20,sigterm@10-20", seed=8)
+    assert [s.step for s in a.specs] == [s.step for s in b.specs]
+    assert all(10 <= s.step <= 20 for s in a.specs)
+    # site-keyed: the two sites decorrelate under one seed (they could
+    # coincide by chance for SOME seed, not for this one — pinned receipt)
+    steps_a = {s.site: s.step for s in a.specs}
+    steps_c = {s.site: s.step for s in c.specs}
+    assert steps_a != steps_c
+
+
+# ---------------------------------------------------------------------------
+# firing semantics
+# ---------------------------------------------------------------------------
+
+
+def test_fire_at_is_exactly_once():
+    plan = FaultPlan.parse("sigterm@5")
+    assert plan.fire_at("sigterm", 4) is None
+    spec = plan.fire_at("sigterm", 5)
+    assert spec is not None and spec.step == 5
+    assert plan.fire_at("sigterm", 5) is None  # fired specs leave the plan
+    assert plan.pending() == []
+
+
+def test_fire_next_counts_per_site_invocations():
+    plan = FaultPlan.parse("ckpt.write@2,env.step@1")
+    assert plan.fire_next("ckpt.write") is None  # invocation 1
+    assert plan.fire_next("env.step") is not None  # env.step's own counter
+    assert plan.fire_next("ckpt.write") is not None  # invocation 2
+    assert plan.fire_next("ckpt.write") is None
+
+
+def test_every_site_has_deterministic_seeded_replay():
+    """The acceptance-criteria sweep: EVERY declared fault site resolves a
+    seeded range to the same (site, step) on every parse — the deterministic
+    half of each site's receipt (recovery halves live in test_envwrap /
+    test_recover / test_integration / test_resume)."""
+    from sheeprl_tpu.resilience.inject import FAULT_SITES
+
+    text = ",".join(f"{site}@5-50" for site in FAULT_SITES)
+    a = FaultPlan.parse(text, seed=13)
+    b = FaultPlan.parse(text, seed=13)
+    assert [(s.site, s.step) for s in a.specs] == [
+        (s.site, s.step) for s in b.specs
+    ]
+    assert {s.site for s in a.specs} == set(FAULT_SITES)
+    assert all(5 <= s.step <= 50 for s in a.specs)
+    # and each fires exactly once at its resolved step
+    for spec in list(a.specs):
+        assert a.fire_at(spec.site, spec.step) is not None
+        assert a.fire_at(spec.site, spec.step) is None
+
+
+def test_deterministic_replay_same_plan_same_firing_sequence():
+    """The CI-reproducibility receipt: two identical plans observe identical
+    (site, step) firing sequences over the same call trace."""
+
+    def trace(plan):
+        fired = []
+        for step in range(1, 8):
+            for site in ("sigterm", "nan.grad"):
+                if plan.fire_at(site, step):
+                    fired.append((site, step))
+            if plan.fire_next("env.step"):
+                fired.append(("env.step", step))
+        return fired
+
+    text = "sigterm@6,nan.grad@3,env.step@4"
+    assert trace(FaultPlan.parse(text)) == trace(FaultPlan.parse(text))
+    assert trace(FaultPlan.parse(text)) == [
+        ("nan.grad", 3),
+        ("env.step", 4),
+        ("sigterm", 6),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# telemetry + global plan
+# ---------------------------------------------------------------------------
+
+
+def test_firing_emits_fault_injected_event_and_counts(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit")
+    try:
+        plan = FaultPlan.parse("nan.loss@2")
+        plan.fire_at("nan.loss", 2)
+    finally:
+        telem.close()
+    events = [e for e in _events(tmp_path) if e.get("event") == "fault.injected"]
+    assert len(events) == 1
+    assert events[0]["site"] == "nan.loss" and events[0]["step"] == 2
+    assert resilience.gauges().get("Fault/injected") == 1.0
+
+
+def test_arm_faults_exports_env_and_installs_global_plan(monkeypatch):
+    plan = resilience.arm_faults("sigkill@9")
+    import os
+
+    assert os.environ[ENV_VAR] == "sigkill@9"
+    assert resilience.get_plan() is plan
+    assert [s.site for s in plan.specs] == ["sigkill"]
+
+
+def test_note_recovery_counts_and_emits(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit")
+    try:
+        resilience.note_recovery("env.step", "env_restarts", attempt=1)
+    finally:
+        telem.close()
+    events = [e for e in _events(tmp_path) if e.get("event") == "fault.recovered"]
+    assert len(events) == 1 and events[0]["action"] == "env_restarts"
+    assert resilience.gauges().get("Fault/env_restarts") == 1.0

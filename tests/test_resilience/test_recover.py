@@ -1,0 +1,187 @@
+"""Recovery actuators (ISSUE 12): batch poisoning, the donation-safe
+nonfinite skip select, host-side flag handling, and rollback restore."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu import resilience
+from sheeprl_tpu.resilience.recover import SKIP_FLAG
+from sheeprl_tpu.telemetry import Telemetry
+from sheeprl_tpu.utils.jit import donating_jit
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("SHEEPRL_TPU_FAULTS", raising=False)
+    resilience.reset_plan()
+    yield
+    resilience.reset_plan()
+
+
+# ---------------------------------------------------------------------------
+# poison_batch
+# ---------------------------------------------------------------------------
+
+
+def test_poison_batch_targets_reward_leaf_for_nan_loss():
+    resilience.arm_faults("nan.loss@4")
+    data = {
+        "observations": jnp.ones((8, 3)),
+        "rewards": jnp.ones((8, 1)),
+    }
+    out = resilience.poison_batch(dict(data), 3)
+    assert not np.isnan(np.asarray(out["rewards"])).any()  # not yet
+    out = resilience.poison_batch(dict(data), 4)
+    assert np.isnan(np.asarray(out["rewards"])).sum() == 1
+    assert not np.isnan(np.asarray(out["observations"])).any()
+    # exactly-once: the next step is clean again
+    out = resilience.poison_batch(dict(data), 4)
+    assert not np.isnan(np.asarray(out["rewards"])).any()
+
+
+def test_poison_batch_targets_obs_leaf_for_nan_grad_numpy():
+    resilience.arm_faults("nan.grad@1")
+    data = {"observations": np.ones((4, 2), np.float32), "rewards": np.ones((4, 1), np.float32)}
+    out = resilience.poison_batch(data, 1)
+    assert np.isnan(out["observations"]).sum() == 1
+    assert not np.isnan(out["rewards"]).any()
+    assert not np.isnan(data["observations"]).any()  # input not mutated
+
+
+# ---------------------------------------------------------------------------
+# guard_nonfinite: the donation-safe skip select
+# ---------------------------------------------------------------------------
+
+
+def _toy_step(state, batch, lr):
+    """A train-step-shaped body: sgd on a quadratic; metrics carry the loss."""
+    params, opt = state
+
+    def loss_fn(p):
+        return jnp.mean((p @ batch.T) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new_params = params - lr * grads
+    return (new_params, opt + 1), {"Loss/total": loss}
+
+
+def test_guard_warn_is_identity():
+    assert resilience.guard_nonfinite(_toy_step, "warn") is _toy_step
+
+
+def test_guard_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="on_nonfinite"):
+        resilience.guard_nonfinite(_toy_step, "explode")
+
+
+def test_skip_select_keeps_old_state_on_poisoned_batch_under_donation():
+    guarded = donating_jit(
+        resilience.guard_nonfinite(_toy_step, "skip"), donate_argnums=(0,)
+    )
+    params0 = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    state = (params0, jnp.int32(0))
+    clean = jnp.ones((5, 2))
+    poisoned = clean.at[0, 0].set(jnp.nan)
+
+    state1, m1 = guarded(state, clean, jnp.float32(0.1))
+    assert float(m1[SKIP_FLAG]) == 0.0
+    good_params = np.asarray(state1[0])
+
+    state2, m2 = guarded(state1, poisoned, jnp.float32(0.1))
+    assert float(m2[SKIP_FLAG]) == 1.0
+    # the poisoned update was dropped: params unchanged THROUGH the donation
+    np.testing.assert_array_equal(np.asarray(state2[0]), good_params)
+    assert int(state2[1]) == 1  # the in-jit counter select also held
+
+    state3, m3 = guarded(state2, clean, jnp.float32(0.1))
+    assert float(m3[SKIP_FLAG]) == 0.0
+    assert np.isfinite(np.asarray(state3[0])).all()
+
+
+def test_skip_is_bit_exact_vs_unguarded_on_clean_batches():
+    clean = jnp.arange(10.0).reshape(5, 2)
+    state = (jnp.asarray([[0.5, -0.25], [1.0, 2.0]]), jnp.int32(0))
+    plain_out, _ = jax.jit(_toy_step)(state, clean, jnp.float32(0.05))
+    guarded = jax.jit(resilience.guard_nonfinite(_toy_step, "skip"))
+    guard_out, metrics = guarded(state, clean, jnp.float32(0.05))
+    np.testing.assert_array_equal(np.asarray(plain_out[0]), np.asarray(guard_out[0]))
+    assert float(metrics[SKIP_FLAG]) == 0.0
+
+
+def test_update_skipped_pops_flag_and_records_one_update_lagged(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit")
+    try:
+        metrics = {"Loss/total": jnp.float32(jnp.nan), SKIP_FLAG: jnp.float32(1.0)}
+        # first call only queues the async pull (no previous flag to read)
+        assert resilience.update_skipped(metrics, "skip") is False
+        assert SKIP_FLAG not in metrics
+        # the next update's call reads the landed flag of the previous one
+        clean = {"Loss/total": jnp.float32(1.0), SKIP_FLAG: jnp.float32(0.0)}
+        assert resilience.update_skipped(clean, "skip") is True
+        # a flag-less metrics dict (policy 'warn') is always a no-op
+        assert resilience.update_skipped({"Loss/total": 1.0}, "skip") is False
+    finally:
+        telem.close()
+    events = [
+        json.loads(l)
+        for l in (tmp_path / "telemetry.jsonl").read_text().strip().splitlines()
+    ]
+    rec = [e for e in events if e.get("event") == "fault.recovered"]
+    assert rec and rec[0]["action"] == "updates_skipped"
+    assert resilience.gauges().get("Fault/updates_skipped") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# rollback
+# ---------------------------------------------------------------------------
+
+
+def test_rollback_restores_last_good_checkpoint(tmp_path):
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+    good = {"params": jnp.asarray([1.0, 2.0]), "step": 3}
+    path = str(tmp_path / "checkpoints" / "ckpt_3")
+    save_checkpoint(path, good, block=True)  # registers via note_checkpoint
+    assert resilience.rollback.__module__  # sanity: exported
+
+    restored = resilience.rollback(
+        {"params": jnp.zeros(2), "step": 0}, step=5
+    )
+    assert restored is not None
+    np.testing.assert_array_equal(np.asarray(restored["params"]), [1.0, 2.0])
+    assert int(restored["step"]) == 3
+    assert resilience.gauges().get("Fault/rollbacks") == 1.0
+
+
+def test_rollback_without_checkpoint_returns_none(tmp_path):
+    # fresh process state: clear the registry explicitly
+    from sheeprl_tpu.resilience import recover
+
+    recover._LAST_GOOD.clear()
+    assert resilience.rollback({"x": jnp.zeros(1)}, step=1) is None
+    assert resilience.gauges().get("Fault/rollback_unavailable") == 1.0
+
+
+def test_optax_state_survives_skip_select():
+    """The select must hold for realistic opt states (adam moments, counts)."""
+    opt = optax.adam(1e-2)
+    params = jnp.ones((3,))
+    state = (params, opt.init(params))
+
+    def body(st, batch):
+        p, o = st
+        grads = jax.grad(lambda q: jnp.sum((q * batch) ** 2))(p)
+        updates, o2 = opt.update(grads, o, p)
+        return (optax.apply_updates(p, updates), o2), {"Loss/total": jnp.sum(grads)}
+
+    guarded = jax.jit(resilience.guard_nonfinite(body, "skip"))
+    st1, m1 = guarded(state, jnp.ones((3,)))
+    st2, m2 = guarded(st1, jnp.full((3,), jnp.nan))
+    assert float(m2[SKIP_FLAG]) == 1.0
+    for a, b in zip(jax.tree_util.tree_leaves(st1), jax.tree_util.tree_leaves(st2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -101,6 +101,15 @@ def summarize(events: list[dict]) -> dict:
         "first_update": None,       # the `first_update` stamp event
         "compile_gauges": {},       # last Compile/* gauge values
         "anakin_gauges": {},        # last Anakin/* gauge values (jax envs)
+        # ISSUE 12 resilience subsystem (resilience/)
+        "fault_injected": [],       # fault.injected events (site/step)
+        "fault_recovered": [],      # fault.recovered events (site/action)
+        "preempt": None,            # the preempt lifecycle event (rc 75 exit)
+        "preempt_signal": None,     # when the grace window opened
+        "resume": None,             # the resume-resolution event
+        "checkpoint_corrupt": [],   # skipped/failed checkpoint candidates
+        "checkpoint_errors": [],    # retried checkpoint writes
+        "fault_gauges": {},         # last Fault/* gauge values
     }
     for ev in events:
         ts = ev.get("ts")
@@ -126,6 +135,20 @@ def summarize(events: list[dict]) -> dict:
             summary["partition_events"].append(ev)
         elif kind == "first_update":
             summary["first_update"] = ev
+        elif kind == "fault.injected":
+            summary["fault_injected"].append(ev)
+        elif kind == "fault.recovered":
+            summary["fault_recovered"].append(ev)
+        elif kind == "preempt":
+            summary["preempt"] = ev
+        elif kind == "preempt.signal":
+            summary["preempt_signal"] = ev
+        elif kind == "resume":
+            summary["resume"] = ev
+        elif kind in ("checkpoint.corrupt", "checkpoint.fallback"):
+            summary["checkpoint_corrupt"].append(ev)
+        elif kind == "checkpoint.error":
+            summary["checkpoint_errors"].append(ev)
         elif kind == "log":
             summary["log_events"] += 1
             if ev.get("step") is not None:
@@ -157,6 +180,8 @@ def summarize(events: list[dict]) -> dict:
                     summary["compile_gauges"][k] = v
                 elif k.startswith("Anakin/"):
                     summary["anakin_gauges"][k] = v
+                elif k.startswith("Fault/"):
+                    summary["fault_gauges"][k] = v
     # the "end" event carries phase time accumulated after the last interval
     if summary["end"]:
         for phase, secs in (summary["end"].get("phases") or {}).items():
@@ -416,6 +441,13 @@ def render(summary: dict) -> str:
         )
     if summary["crash"]:
         lines.append(f"OUTCOME: CRASHED — {summary['crash'].get('error')}")
+    elif summary["preempt"]:
+        p = summary["preempt"]
+        lines.append(
+            f"OUTCOME: PREEMPTED at step {p.get('step')} "
+            f"({p.get('signal', '?')}, resumable rc {p.get('rc')}) — "
+            "restart with --resume auto"
+        )
     elif summary["end"]:
         lines.append("OUTCOME: completed (clean end event)")
     else:
@@ -513,6 +545,71 @@ def render(summary: dict) -> str:
             f"rollouts={a.get('Anakin/rollouts', 0):.0f} "
             f"env_steps_total={a.get('Anakin/env_steps_total', 0):,.0f}"
         )
+
+    resil_any = (
+        summary["fault_injected"]
+        or summary["fault_recovered"]
+        or summary["preempt"]
+        or summary["resume"]
+        or summary["checkpoint_corrupt"]
+        or summary["checkpoint_errors"]
+        or summary["fault_gauges"]
+    )
+    if resil_any:
+        lines.append("")
+        lines.append("== resilience (faults / recovery) ==")
+        t0 = summary["first_ts"] or 0.0
+
+        def rel(ev):
+            ts = ev.get("ts")
+            return f"t+{ts - t0:7.2f}s" if isinstance(ts, (int, float)) else "t+      ?"
+
+        if summary["resume"]:
+            r = summary["resume"]
+            lines.append(
+                f"{rel(r)}  RESUME  {r.get('mode')} -> {r.get('checkpoint')}"
+                + (
+                    f" ({r.get('fallbacks')} fallback candidate(s))"
+                    if r.get("fallbacks") is not None
+                    else ""
+                )
+            )
+        for ev in summary["fault_injected"]:
+            param = "" if ev.get("param") is None else f":{ev['param']:g}"
+            lines.append(
+                f"{rel(ev)}  INJECT  {ev.get('site')}@{ev.get('step')}{param}"
+            )
+        for ev in summary["fault_recovered"]:
+            lines.append(
+                f"{rel(ev)}  RECOVER {ev.get('site')} -> {ev.get('action')}"
+            )
+        for ev in summary["checkpoint_errors"]:
+            lines.append(
+                f"{rel(ev)}  CKPT-RETRY attempt {ev.get('attempt')}: "
+                f"{ev.get('error', '')[:80]}"
+            )
+        for ev in summary["checkpoint_corrupt"]:
+            what = ev.get("reason") or f"fell back to {ev.get('checkpoint')}"
+            lines.append(
+                f"{rel(ev)}  CORRUPT {ev.get('path') or ev.get('failed')}: {what}"
+            )
+        if summary["preempt_signal"]:
+            lines.append(
+                f"{rel(summary['preempt_signal'])}  PREEMPT "
+                f"{summary['preempt_signal'].get('signal')} received "
+                "(grace window opened)"
+            )
+        if summary["preempt"]:
+            lines.append(
+                f"{rel(summary['preempt'])}  EXIT    grace checkpoint committed, "
+                f"rc {summary['preempt'].get('rc')}"
+            )
+        if summary["fault_gauges"]:
+            gauges = " ".join(
+                f"{k.split('/', 1)[1]}={v:.0f}"
+                for k, v in sorted(summary["fault_gauges"].items())
+            )
+            lines.append(f"Fault gauges: {gauges}")
 
     lines.append("")
     lines.append("== health ==")
@@ -693,6 +790,37 @@ def selftest() -> int:
     loaded = load_decision_cache(os.path.join(opt_dir, "decisions.json"))
     assert loaded == fake_cache
     assert load_decision_cache(os.path.join(opt_dir, "absent.json")) == {}
+
+    # resilience section (ISSUE 12): a preempted run with injected faults,
+    # recoveries, a corrupt-checkpoint skip and Fault/* gauges must render
+    # as the fault/recovery timeline, and the preempt outcome must win over
+    # "unknown" — written through the REAL Telemetry writer like the rest
+    d2 = tempfile.mkdtemp(prefix="telemetry_selftest_resil_")
+    telem2 = Telemetry(d2, rank=0, algo="resil")
+    telem2.event("start", algo="resil", env_id="dummy", seed=0)
+    telem2.event("resume", mode="auto", checkpoint="/run/checkpoints/ckpt_4", fallbacks=1)
+    telem2.event("fault.injected", site="nan.grad", step=6, param=None)
+    telem2.event("fault.recovered", site="nan", action="updates_skipped")
+    telem2.event("fault.injected", site="sigterm", step=9, param=None)
+    telem2.event("checkpoint.corrupt", path="/run/checkpoints/ckpt_2", reason="missing args.json sidecar")
+    telem2.event("checkpoint.error", path="/run/checkpoints/ckpt_8", attempt=1, error="InjectedFault: boom")
+    telem2.event("fault.recovered", site="ckpt.write", action="ckpt_retried")
+    telem2.event("preempt.signal", signal="SIGTERM")
+    telem2.interval({"Loss/x": 1.0, "Fault/injected": 2.0, "Fault/updates_skipped": 1.0}, step=9)
+    telem2.event("preempt", step=9, signal="SIGTERM", rc=75)
+    telem2.close()
+    summary2 = summarize(load_events(d2))
+    out2 = render(summary2)
+    assert "OUTCOME: PREEMPTED at step 9" in out2 and "rc 75" in out2, out2
+    assert "RESUME  auto -> /run/checkpoints/ckpt_4 (1 fallback candidate(s))" in out2
+    assert "INJECT  nan.grad@6" in out2 and "INJECT  sigterm@9" in out2
+    assert "RECOVER nan -> updates_skipped" in out2
+    assert "RECOVER ckpt.write -> ckpt_retried" in out2
+    assert "CKPT-RETRY attempt 1" in out2
+    assert "CORRUPT /run/checkpoints/ckpt_2: missing args.json sidecar" in out2
+    assert "PREEMPT SIGTERM received" in out2
+    assert "Fault gauges: injected=2 updates_skipped=1" in out2, out2
+
     print("\nselftest OK", file=sys.stderr)
     return 0
 

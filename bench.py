@@ -1846,6 +1846,7 @@ _METRIC_OF_ALGO = {
     "train_speed": ("rssm_scan_step_seconds", "seconds/step"),
     "sheepopt": ("sheepopt_remat_peak_reduction_pct", "percent"),
     "resilience": ("resilience_preemption_grace_seconds", "seconds"),
+    "flock": ("flock_actor_env_steps_per_sec", "env-steps/sec"),
 }
 
 
@@ -2761,6 +2762,189 @@ def bench_resilience() -> None:
     print(json.dumps(result))
 
 
+def bench_flock() -> None:
+    """ISSUE 14 headline: what the multi-process Sebulba runtime BUYS and
+    COSTS on one host — tiny PPO (CartPole) subprocesses through the real
+    `ppo.py` main:
+
+      1. actor scaling: `--flock 1` vs `--flock 2` compare aggregate
+         actor-side collection rate (env_steps from the actors' final
+         deregistration receipts over the fleet's connected window) and
+         the learner's steady steps/sec.
+      2. sample-path latency: in flock mode `Time/rollout_seconds` IS the
+         learner's chunk-drain wait (local shard memory, no socket) — the
+         per-update mean is the socket-free sample-path receipt.
+      3. weight staleness: the distribution of `Flock/actor*/staleness_s`
+         gauge samples across the whole run (how old the acting policy is).
+      4. dreamer_v3 `--flock 2` dry-run smoke: the buffer-mode shard path
+         end to end, pass/fail + wall time.
+
+    CPU receipts (mechanism, not raw speed: framing, drain scheduling and
+    snapshot distribution are backend-independent); knobs via
+    SHEEPRL_TPU_FLOCK_BENCH_{STEPS,ROLLOUT}."""
+    import json as _json
+    import os
+    import subprocess
+    import tempfile
+    import time
+
+    steps = int(os.environ.get("SHEEPRL_TPU_FLOCK_BENCH_STEPS", "6400"))
+    rollout = int(os.environ.get("SHEEPRL_TPU_FLOCK_BENCH_ROLLOUT", "8"))
+    root = tempfile.mkdtemp(prefix="bench_flock_")
+    env = _child_env(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        SHEEPRL_TPU_TELEMETRY="1",
+    )
+    env.pop("SHEEPRL_TPU_FAULTS", None)
+    env.pop("XLA_FLAGS", None)  # single-device children
+
+    def run_ppo(run_name, n_actors):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "sheeprl_tpu", "ppo",
+                "--env_id", "CartPole-v1", "--num_envs", "1",
+                "--rollout_steps", str(rollout), "--total_steps", str(steps),
+                "--per_rank_batch_size", "4", "--update_epochs", "1",
+                "--dense_units", "8", "--mlp_layers", "1",
+                "--cnn_features_dim", "16", "--mlp_features_dim", "8",
+                "--checkpoint_every", str(10 * steps), "--test_episodes", "0",
+                "--seed", "7", "--root_dir", root, "--run_name", run_name,
+                "--flock", str(n_actors),
+            ],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        wall = time.perf_counter() - t0
+        events = []
+        jsonl = os.path.join(root, run_name, "telemetry.jsonl")
+        if os.path.exists(jsonl):
+            with open(jsonl) as fh:
+                for line in fh:
+                    try:
+                        events.append(_json.loads(line))
+                    except _json.JSONDecodeError:
+                        break
+        return proc, wall, events
+
+    def actor_rate(events):
+        """Aggregate actor env-steps/s: final deregistration totals over the
+        joined->deregistered window (the fleet's connected lifetime)."""
+        joins = [e for e in events if e.get("event") == "flock.actor_joined"]
+        byes = {}
+        for e in events:
+            if e.get("event") == "flock.actor_disconnected":
+                byes[e.get("actor_id")] = e  # last disconnect per actor wins
+        if not joins or not byes:
+            return None, 0
+        total = sum(e.get("env_steps", 0) for e in byes.values())
+        t0 = min(e["ts"] for e in joins)
+        t1 = max(e["ts"] for e in byes.values())
+        return (total / (t1 - t0) if t1 > t0 else None), total
+
+    def learner_sps(events):
+        vals = [
+            ev["metrics"].get("Time/step_per_second")
+            for ev in events
+            if ev.get("event") == "log"
+            and isinstance(ev.get("metrics", {}).get("Time/step_per_second"), (int, float))
+        ]
+        return vals[-1] if vals else None
+
+    def drain_ms_per_update(events):
+        rollout_s = sum(
+            ev["metrics"]["Time/rollout_seconds"]
+            for ev in events
+            if ev.get("event") == "log"
+            and isinstance(ev.get("metrics", {}).get("Time/rollout_seconds"), (int, float))
+        )
+        updates = steps // rollout
+        return 1000.0 * rollout_s / updates if updates else None
+
+    def staleness(events):
+        samples = []
+        for ev in events:
+            if ev.get("event") != "log":
+                continue
+            for k, v in ev.get("metrics", {}).items():
+                if k.startswith("Flock/actor") and k.endswith("/staleness_s"):
+                    if isinstance(v, (int, float)):
+                        samples.append(v)
+        if not samples:
+            return None
+        s = sorted(samples)
+        return {
+            "n": len(s), "min_s": round(s[0], 3),
+            "p50_s": round(s[len(s) // 2], 3),
+            "p90_s": round(s[min(len(s) - 1, int(len(s) * 0.9))], 3),
+            "max_s": round(s[-1], 3),
+        }
+
+    arms = {}
+    for n in (1, 2):
+        proc, wall, ev = run_ppo(f"flock{n}", n)
+        rate, total = actor_rate(ev)
+        arms[n] = {
+            "rc": proc.returncode,
+            "wall_s": round(wall, 1),
+            "actor_env_steps_per_sec": round(rate, 1) if rate else None,
+            "actor_env_steps_total": total,
+            "learner_steps_per_sec": round(learner_sps(ev), 1) if learner_sps(ev) else None,
+            "drain_ms_per_update": round(drain_ms_per_update(ev), 3)
+            if drain_ms_per_update(ev) is not None else None,
+            "staleness": staleness(ev),
+        }
+        print(f"flock arm {n}: {arms[n]}", file=sys.stderr)
+
+    # dreamer_v3 buffer-mode smoke: tiny dry-run, pass/fail + wall
+    t0 = time.perf_counter()
+    dv3 = subprocess.run(
+        [
+            sys.executable, "-m", "sheeprl_tpu", "dreamer_v3",
+            "--dry_run", "--num_devices=1", "--num_envs=1", "--sync_env",
+            "--per_rank_batch_size=1", "--per_rank_sequence_length=1",
+            "--buffer_size=4", "--learning_starts=0", "--gradient_steps=1",
+            "--horizon=4", "--dense_units=8", "--cnn_channels_multiplier=2",
+            "--recurrent_state_size=8", "--hidden_size=8",
+            "--stochastic_size=4", "--discrete_size=4", "--mlp_layers=1",
+            "--train_every=1", "--checkpoint_every=1",
+            "--env_id=discrete_dummy", f"--root_dir={root}",
+            "--run_name=dv3flock", "--cnn_keys", "rgb", "--flock", "2",
+        ],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    dv3_wall = round(time.perf_counter() - t0, 1)
+
+    one, two = arms[1], arms[2]
+    scaling = (
+        round(two["actor_env_steps_per_sec"] / one["actor_env_steps_per_sec"], 2)
+        if one["actor_env_steps_per_sec"] and two["actor_env_steps_per_sec"]
+        else None
+    )
+    result = {
+        "metric": "flock_actor_env_steps_per_sec",
+        "value": two["actor_env_steps_per_sec"] or 0.0,
+        "unit": "env-steps/sec",
+        "algo": "ppo",
+        "backend": "cpu",
+        "flock_1": one,
+        "flock_2": two,
+        "actor_scaling_2_over_1": scaling,
+        "dv3_flock2_smoke_ok": dv3.returncode == 0,
+        "dv3_flock2_smoke_wall_s": dv3_wall,
+        "total_steps": steps, "rollout_steps": rollout,
+        "host_cpus": os.cpu_count(),
+        "note": BASELINE_NOTE,
+    }
+    if one["rc"] != 0 or two["rc"] != 0 or dv3.returncode != 0:
+        result["error"] = {
+            "flock1_rc": one["rc"], "flock2_rc": two["rc"],
+            "dv3_rc": dv3.returncode,
+            "dv3_stderr": dv3.stderr.strip().splitlines()[-3:],
+        }
+    print(json.dumps(result))
+
+
 def _arm_watchdog(metric: str, unit: str, budget_s: float) -> None:
     """Last-resort liveness bound: if the whole bench (backend init included)
     has not finished within `budget_s`, emit an artifact and hard-exit. Round
@@ -3286,6 +3470,8 @@ def main() -> None:
         bench_sheepopt()
     elif opts.algo == "resilience":
         bench_resilience()
+    elif opts.algo == "flock":
+        bench_flock()
     else:
         bench_dreamer_v3(tiny=opts.tiny, pipeline_mode=opts.pipeline)
 

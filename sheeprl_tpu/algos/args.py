@@ -154,6 +154,21 @@ class StandardArgs:
         "site-keyed draw (SHEEPRL_TPU_FAULT_SEED). Exported as "
         "SHEEPRL_TPU_FAULTS to env-worker subprocesses",
     )
+    flock: str = Arg(
+        default="off",
+        help="multi-process Sebulba actor-learner runtime (flock/, ISSUE "
+        "14): 'off' (default) keeps the in-process collection loop "
+        "(bit-exact pre-flock behavior); an integer N spawns N actor "
+        "processes that each run the task's collection loop against the "
+        "current policy and stream rollout chunks into a per-actor replay "
+        "shard hosted by the learner (length-prefixed socket transport; "
+        "the learner samples locally — no socket on the sample path). "
+        "Actors pull versioned weight snapshots off the hot path, "
+        "register/heartbeat with the service, and a killed actor is "
+        "respawned and rejoins at the current weight version without a "
+        "learner restart. Supported by ppo and dreamer_v3 (host env "
+        "backend)",
+    )
     sanitize: bool = Arg(
         default=False,
         help="runtime transfer/donation sanitizer (sheeplint's dynamic "
@@ -183,6 +198,15 @@ class StandardArgs:
             raise ValueError(
                 f"on_nonfinite must be 'warn', 'skip' or 'rollback', got {value!r}"
             )
+        if name == "flock" and value != "off":
+            try:
+                n = int(value)
+            except (TypeError, ValueError):
+                n = 0
+            if n <= 0:
+                raise ValueError(
+                    f"flock must be 'off' or a positive actor count, got {value!r}"
+                )
         super().__setattr__(name, value)
         if name == "log_dir" and value:
             os.makedirs(value, exist_ok=True)

@@ -548,7 +548,23 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem.add_gauges(plan.gauges)
 
     use_jax_env = args.env_backend == "jax"
-    if use_jax_env:
+    use_flock = args.flock != "off" and not args.eval_only
+    if use_flock and use_jax_env:
+        raise ValueError(
+            "--flock runs host envs in actor processes; drop --env_backend jax"
+        )
+    if use_flock:
+        # flock (ISSUE 14): the envs live in the actor processes — the
+        # learner builds ONE probe env to read the spaces, then closes it
+        probe = make_dict_env(
+            args.env_id, args.seed, rank=rank, args=args,
+            run_name=log_dir, vector_env_idx=0,
+        )()
+        observation_space = probe.observation_space
+        action_space = probe.action_space
+        probe.close()
+        envs = None
+    elif use_jax_env:
         # Anakin arrangement (ISSUE 6): env + player co-reside on chip; the
         # collection window is chunked jitted scans writing straight into
         # the device replay ring via reserve()/add_direct()
@@ -716,25 +732,85 @@ def main(argv: Sequence[str] | None = None) -> None:
     buffer_size = (
         args.buffer_size // (args.num_envs * world) if not args.dry_run else 2
     )
-    rb = AsyncReplayBuffer(
-        max(buffer_size, args.per_rank_sequence_length),
-        args.num_envs,
-        storage="host" if args.memmap_buffer else "device",
-        memmap_dir=(
-            os.path.join(log_dir, "memmap_buffer") if args.memmap_buffer else None
-        ),
-        sequential=True,
-        obs_keys=tuple(obs_keys),
-        seed=args.seed,
-    )
-    buffer_ckpt = (
-        os.path.abspath(args.checkpoint_path) + "_buffer.npz"
-        if args.checkpoint_path
-        else None
-    )
-    if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt) and not args.eval_only:
-        rb.load(buffer_ckpt)
-    sampler = pipe.sampler(rb)
+    rb = None
+    service = fleet = None
+    if use_flock:
+        from ... import flock as _flock
+        from ...data.wire import tree_nbytes
+
+        # sigkill clauses retarget onto actor 0: killing the learner tests
+        # nothing about elastic membership
+        _, actor_faults = _flock.retarget_sigkill(args)
+        _row = {
+            k: np.zeros(
+                (args.num_envs, *observation_space[k].shape),
+                np.uint8 if k in cnn_keys else np.float32,
+            )
+            for k in obs_keys
+        }
+        _row.update(
+            actions=np.zeros((args.num_envs, int(sum(actions_dim))), np.float32),
+            rewards=np.zeros((args.num_envs, 1), np.float32),
+            dones=np.zeros((args.num_envs, 1), np.float32),
+            is_first=np.zeros((args.num_envs, 1), np.float32),
+        )
+        capacity = _flock.shard_capacity(
+            "dreamer_v3", int(args.flock), tree_nbytes(_row),
+            floor_rows=max(64, 4 * args.per_rank_sequence_length),
+        )
+
+        def _make_shard(cap):
+            # one ordinary AsyncReplayBuffer per actor, host storage (the
+            # wire lands host arrays; sampling stages to device afterwards)
+            return AsyncReplayBuffer(
+                cap, args.num_envs, storage="host", sequential=True,
+                obs_keys=tuple(obs_keys), seed=args.seed,
+            )
+
+        service = _flock.ReplayService(
+            algo="dreamer_v3", n_actors=int(args.flock), mode="buffer",
+            capacity_rows=capacity, make_shard=_make_shard, telem=telem,
+        )
+        addr = service.start()
+        telem.add_gauges(service.gauges)
+        # actors block on the initial snapshot: version 1 is published
+        # BEFORE the first actor spawns
+        service.publish(jax.tree_util.tree_leaves(player))
+        service.set_random_phase(
+            args.checkpoint_path is None and not args.dry_run
+        )
+        fleet = _flock.ActorFleet(
+            algo="dreamer_v3", args=args, address=addr, log_dir=log_dir,
+            telem=telem, actor_faults=actor_faults,
+        )
+        fleet.start()
+        if not service.wait_for_actors(n=1, timeout=180.0):
+            fleet.close()
+            service.close()
+            raise RuntimeError("flock: no actor registered within 180 s")
+        # the learner samples the service directly: local shard reads, no
+        # socket on the sample path (the prefetcher pairs with a live rb)
+        sampler = service
+    else:
+        rb = AsyncReplayBuffer(
+            max(buffer_size, args.per_rank_sequence_length),
+            args.num_envs,
+            storage="host" if args.memmap_buffer else "device",
+            memmap_dir=(
+                os.path.join(log_dir, "memmap_buffer") if args.memmap_buffer else None
+            ),
+            sequential=True,
+            obs_keys=tuple(obs_keys),
+            seed=args.seed,
+        )
+        buffer_ckpt = (
+            os.path.abspath(args.checkpoint_path) + "_buffer.npz"
+            if args.checkpoint_path
+            else None
+        )
+        if buffer_ckpt and args.checkpoint_buffer and os.path.exists(buffer_ckpt) and not args.eval_only:
+            rb.load(buffer_ckpt)
+        sampler = pipe.sampler(rb)
 
     aggregator = MetricAggregator()
     single_global_step = args.num_envs
@@ -803,7 +879,7 @@ def main(argv: Sequence[str] | None = None) -> None:
             scan_span=anakin_chunk, env_batch=args.num_envs, devices=n_dev
         )
         telem.add_gauges(anakin.gauges)
-    else:
+    elif not use_flock:
         obs, _ = envs.reset(seed=args.seed)
         step_data = {k: np.asarray(obs[k]) for k in obs_keys}
         step_data["dones"] = np.zeros((args.num_envs, 1), np.float32)
@@ -870,7 +946,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                 sds((codec.blob_len,), jnp.int32), key, jnp.float32(0.0),
             ),
         )
-    else:
+    elif not use_flock:
+        # flock: the actors own the player jit; the learner has no
+        # interaction-critical executable to warm
         player_step = plan.register(
             "player_step", player_step,
             example=lambda: (
@@ -894,6 +972,18 @@ def main(argv: Sequence[str] | None = None) -> None:
         plan.declare_edge(
             "blob_step", "train_step", expect="reshard",
             note="replay buffer + sequence sampler",
+        )
+    elif use_flock:
+        # declared only when the flock is ON so default capture runs keep
+        # the committed shard ledgers byte-stable; both endpoints resolve
+        # as "unresolved" records (host-side, outside any compiled jit)
+        plan.declare_edge(
+            "flock_actors", "flock_replay", expect="reshard",
+            note="actor buffer ops over the socket transport (host-side)",
+        )
+        plan.declare_edge(
+            "flock_replay", "train_step", expect="reshard",
+            note="learner-local shard sample: no socket on the sample path",
         )
     else:
         plan.declare_edge(
@@ -919,7 +1009,27 @@ def main(argv: Sequence[str] | None = None) -> None:
         guard.tick(global_step)  # fires injected sig* faults for this step
         telem.mark("rollout")
         blob_added = False
-        if use_jax_env:
+        if use_flock:
+            # actors collect; one loop iteration corresponds to ONE replay
+            # row landing fleet-wide (num_envs env steps — the same
+            # global_step unit as the in-process path). The wait is the
+            # drain: how far training runs ahead of collection.
+            service.set_random_phase(
+                global_step <= learning_starts
+                and args.checkpoint_path is None
+                and "minedojo" not in args.env_id
+            )
+            target_rows = global_step - start_step + 1
+            while service.rows_total() < target_rows:
+                if guard.preempted:
+                    break
+                if service.actors_alive() == 0 and fleet.alive() == 0:
+                    raise RuntimeError(
+                        "flock: every actor is dead and the respawn budget "
+                        "is spent"
+                    )
+                time.sleep(0.01)
+        elif use_jax_env:
             # ---- Anakin collection: one jitted scan per chunk ---------------
             key, roll_key = jax.random.split(key)
             random_phase = (
@@ -1008,7 +1118,7 @@ def main(argv: Sequence[str] | None = None) -> None:
                 host=rb.prefers_host_adds,
             )
 
-        if not use_jax_env:
+        if not use_jax_env and not use_flock:
             if not blob_added:
                 step_data["actions"] = (
                     actions if isinstance(actions, jax.Array)
@@ -1113,6 +1223,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                     aggregator.update(name, val)
                 profiler.tick()
             player = make_player(state)
+            if use_flock:
+                telem.mark("flock/publish")
+                service.publish(jax.tree_util.tree_leaves(player))
             step_before_training = args.train_every // single_global_step
             if args.expl_decay:
                 expl_decay_steps += 1
@@ -1162,7 +1275,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                 args=args,
                 block=args.dry_run or global_step == num_updates or guard.preempted,
             )
-            if args.checkpoint_buffer:
+            if args.checkpoint_buffer and rb is not None:
+                # flock mode: shard contents live with the service and are
+                # rebuilt by the actors on resume, not checkpointed
                 rb.save(ckpt_path + "_buffer.npz")
 
         if guard.preempted:
@@ -1174,6 +1289,10 @@ def main(argv: Sequence[str] | None = None) -> None:
     profiler.close()
     if envs is not None:
         envs.close()
+    if fleet is not None:
+        fleet.close()
+    if service is not None:
+        service.close()
     run_test_episodes(
         lambda: test(player, logger, args, cnn_keys, mlp_keys, log_dir, sample_actions=True),
         args, logger,

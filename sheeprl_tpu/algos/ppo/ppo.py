@@ -265,7 +265,23 @@ def main(argv: Sequence[str] | None = None) -> None:
     telem.add_gauges(plan.gauges)
 
     use_jax_env = args.env_backend == "jax"
-    if use_jax_env:
+    use_flock = args.flock != "off" and not args.eval_only
+    if use_flock and use_jax_env:
+        raise ValueError(
+            "--flock runs host envs in actor processes; drop --env_backend jax"
+        )
+    if use_flock:
+        # flock (ISSUE 14): the envs live in the actor processes — the
+        # learner builds ONE probe env to read the spaces, then closes it
+        probe = make_dict_env(
+            args.env_id, args.seed, rank=rank, args=args,
+            run_name=log_dir, vector_env_idx=0, mask_velocities=args.mask_vel,
+        )()
+        observation_space = probe.observation_space
+        action_space = probe.action_space
+        probe.close()
+        envs = None
+    elif use_jax_env:
         # Anakin arrangement (ISSUE 6): env and agent co-reside on chip; the
         # whole rollout is ONE jitted lax.scan with zero host transfers per
         # step, env batch sharded over the mesh
@@ -333,7 +349,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     train_step = make_train_step(args, optimizer, num_minibatches, sanitizer)
 
     rb = None
-    if not use_jax_env:
+    if not (use_jax_env or use_flock):
         rb = ReplayBuffer(
             args.rollout_steps, args.num_envs,
             storage="host" if args.memmap_buffer else "device",
@@ -442,7 +458,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         collect_w = plan.register(
             "anakin_rollout", collect, example=lambda: (state.agent, carry, key)
         )
-    else:
+    elif not use_flock:
+        # flock: the learner never steps a policy against a live env — the
+        # actors own the player jit, so there is nothing to register here
         policy_step_w = plan.register(
             "policy_step", policy_step,
             example=lambda: (
@@ -459,6 +477,18 @@ def main(argv: Sequence[str] | None = None) -> None:
     # gae->train handoff reshuffles on purpose (host reshape + shard_batch).
     if use_jax_env:
         plan.declare_edge("anakin_rollout", "gae", expect="match")
+    if use_flock:
+        # declared only when the flock is ON so default capture runs keep
+        # the committed shard ledgers byte-stable; both endpoints resolve as
+        # "unresolved" records (host-side, outside any compiled jit)
+        plan.declare_edge(
+            "flock_actors", "flock_replay", expect="reshard",
+            note="actor rollout chunks over the socket transport (host-side)",
+        )
+        plan.declare_edge(
+            "flock_replay", "gae", expect="reshard",
+            note="learner-local chunk drain: no socket on the sample path",
+        )
     plan.declare_edge(
         "gae", "train_step", expect="reshard",
         note="host reshape [T,N]->[T*N] + shard_batch onto the mesh",
@@ -476,8 +506,53 @@ def main(argv: Sequence[str] | None = None) -> None:
         if deep:
             key = deep["prng_key"]
 
+    service = fleet = None
+    if use_flock:
+        from ... import flock as _flock
+        from ...data.wire import tree_nbytes
+
+        # sigkill clauses retarget onto actor 0: killing the learner tests
+        # nothing about elastic membership
+        _, actor_faults = _flock.retarget_sigkill(args)
+        _row = {
+            k: np.zeros(
+                (args.num_envs, *obs_space[k].shape),
+                np.uint8 if k in cnn_keys else np.float32,
+            )
+            for k in obs_keys
+        }
+        _row.update(
+            actions=np.zeros((args.num_envs, act_sum), np.float32),
+            logprobs=np.zeros((args.num_envs, 1), np.float32),
+            values=np.zeros((args.num_envs, 1), np.float32),
+            rewards=np.zeros((args.num_envs, 1), np.float32),
+            dones=np.zeros((args.num_envs, 1), np.float32),
+        )
+        service = _flock.ReplayService(
+            algo="ppo", n_actors=int(args.flock), mode="chunks",
+            capacity_rows=_flock.shard_capacity(
+                "ppo", int(args.flock), tree_nbytes(_row),
+                floor_rows=2 * (args.rollout_steps + 1),
+            ),
+            telem=telem,
+        )
+        addr = service.start()
+        telem.add_gauges(service.gauges)
+        # version 1 is published BEFORE the first actor spawns: actors block
+        # on the initial snapshot and never act on a private random init
+        service.publish(jax.tree_util.tree_leaves(state.agent))
+        fleet = _flock.ActorFleet(
+            algo="ppo", args=args, address=addr, log_dir=log_dir,
+            telem=telem, actor_faults=actor_faults,
+        )
+        fleet.start()
+        if not service.wait_for_actors(n=1, timeout=180.0):
+            fleet.close()
+            service.close()
+            raise RuntimeError("flock: no actor registered within 180 s")
+
     aggregator = MetricAggregator()
-    if use_jax_env:
+    if use_jax_env or use_flock:
         obs, next_done = None, None
     else:
         obs, _ = envs.reset(seed=args.seed)
@@ -502,6 +577,25 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         # ---- rollout hot loop ------------------------------------------------
         telem.mark("rollout")
+        chunk = None
+        if use_flock:
+            # drain ONE rollout chunk from the replay service (round-robin
+            # over actor shards, local memory — no socket on this path);
+            # Time/rollout_seconds becomes the drain wait: how far actor
+            # collection runs ahead of (or behind) training
+            while chunk is None:
+                chunk = service.next_chunk(timeout=5.0)
+                if chunk is None:
+                    if guard.preempted:
+                        raise resilience.Preempted(
+                            update, guard.preempt_signal or ""
+                        )
+                    if service.actors_alive() == 0 and fleet.alive() == 0:
+                        raise RuntimeError(
+                            "flock: every actor is dead and the respawn "
+                            "budget is spent"
+                        )
+            global_step += args.rollout_steps * args.num_envs
         if use_jax_env:
             # the whole rollout is one device-resident scan; the only host
             # work afterwards is the episode-stat pull (one device_get per
@@ -528,7 +622,9 @@ def main(argv: Sequence[str] | None = None) -> None:
                 )
         else:
             traj = None
-        for _ in range(0 if use_jax_env else args.rollout_steps):
+        for _ in range(
+            0 if (use_jax_env or use_flock) else args.rollout_steps
+        ):
             key, step_key = jax.random.split(key)
             device_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
             actions, logprob, value, env_idx = policy_step_w(
@@ -581,6 +677,17 @@ def main(argv: Sequence[str] | None = None) -> None:
             data = traj
             device_next_obs = carry.obs
             next_done_dev = carry.prev_done
+        elif use_flock:
+            # rows 0..T-1 are the rollout; the trailing row T carries the
+            # bootstrap obs and the done flag ENTERING the next step —
+            # exactly what the in-process path reads off the live env here
+            T = args.rollout_steps
+            data = {
+                k: jnp.asarray(chunk[k][:T])
+                for k in (*obs_keys, "actions", "logprobs", "values", "rewards", "dones")
+            }
+            device_next_obs = {k: jnp.asarray(chunk[k][T]) for k in obs_keys}
+            next_done_dev = jnp.asarray(chunk["dones"][T])
         else:
             # sheeplint: disable=SL010 — host-path GAE runs whole-rollout on
             # the default device by design; the update batch is resharded
@@ -626,6 +733,11 @@ def main(argv: Sequence[str] | None = None) -> None:
                         mesh,
                     )
                     key, _ = jax.random.split(key)
+        if use_flock:
+            # one device->host pull + one byte-pack per update; actors pull
+            # the cached frame off their own hot path
+            telem.mark("flock/publish")
+            service.publish(jax.tree_util.tree_leaves(state.agent))
         for name, val in metrics.items():
             aggregator.update(name, val)
         profiler.tick()
@@ -663,6 +775,10 @@ def main(argv: Sequence[str] | None = None) -> None:
     profiler.close()
     if envs is not None:
         envs.close()
+    if fleet is not None:
+        fleet.close()
+    if service is not None:
+        service.close()
     # fresh env per episode: test() closes the env it is handed
     run_test_episodes(
         lambda: test(state.agent, make_dict_env(

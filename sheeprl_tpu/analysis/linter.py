@@ -1023,6 +1023,149 @@ def _check_sl012(a: _FileAnalysis) -> None:
             )
 
 
+_SL013_SEND_SINKS = {"send", "sendall", "sendto", "send_bytes"}
+_SL013_HOST_PULLS = {"asarray", "ascontiguousarray", "array"}
+
+
+def _check_sl013(a: _FileAnalysis) -> None:
+    """Device arrays reaching serialization/socket sinks (ISSUE 14): a name
+    assigned from a jax.*/jnp.* call is device-tainted; passing it (or a
+    view/slice of it) to .tobytes(), socket send*/send_bytes or
+    pickle.dump/dumps hides a blocking d2h transfer inside the sink. An
+    explicit host pull (np.asarray/np.ascontiguousarray/np.array/
+    jax.device_get/bytes) clears the taint. Statements are processed in
+    source order per scope, so rebinding through a pull untaints."""
+
+    def _call_dotted(call: ast.Call) -> Optional[str]:
+        return a._dotted(call.func)
+
+    def is_host_pull(call: ast.Call) -> bool:
+        d = _call_dotted(call)
+        if not d:
+            return False
+        root, leaf = d.split(".", 1)[0], d.rsplit(".", 1)[-1]
+        if root in a.np_roots and leaf in _SL013_HOST_PULLS:
+            return True
+        if leaf == "device_get":
+            return True
+        return d in ("bytes", "memoryview", "bytearray")
+
+    def is_device_call(call: ast.Call) -> bool:
+        d = _call_dotted(call)
+        if not d:
+            return False
+        root = d.split(".", 1)[0]
+        if is_host_pull(call):
+            return False
+        return (
+            root == "jax"
+            or root in a.jnp_roots
+            or d.startswith("jax.numpy")
+        )
+
+    def tainted(node: ast.AST, taint: set) -> bool:
+        """Does this expression carry a device value? Follows views
+        (slices/attributes/arithmetic), stops at host pulls."""
+        if isinstance(node, ast.Name):
+            return node.id in taint
+        if isinstance(node, ast.Call):
+            return is_device_call(node)
+        if isinstance(node, ast.BinOp):
+            return tainted(node.left, taint) or tainted(node.right, taint)
+        if isinstance(node, (ast.Subscript, ast.Attribute, ast.Starred)):
+            return tainted(node.value, taint)
+        return False
+
+    def scan(node: ast.AST, taint: set) -> None:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "tobytes" and tainted(f.value, taint):
+                    a.report(
+                        "SL013", n,
+                        f"`{ast.unparse(f.value)}.tobytes()` serializes a "
+                        "device array — the byte view is a hidden blocking "
+                        "d2h transfer; pull with np.asarray first",
+                    )
+                    continue
+                if f.attr in _SL013_SEND_SINKS:
+                    for arg in n.args:
+                        if tainted(arg, taint):
+                            a.report(
+                                "SL013", n,
+                                f"device array `{ast.unparse(arg)}` passed "
+                                f"to socket .{f.attr}() without an explicit "
+                                "host pull",
+                            )
+                    continue
+            d = _call_dotted(n)
+            if d and d.rsplit(".", 1)[-1] in ("dump", "dumps") and (
+                "pickle" in d
+            ):
+                for arg in n.args:
+                    if tainted(arg, taint):
+                        a.report(
+                            "SL013", n,
+                            f"device array `{ast.unparse(arg)}` passed to "
+                            f"{d} without an explicit host pull",
+                        )
+
+    def bind(target: ast.expr, is_tainted: bool, taint: set) -> None:
+        names = (
+            [target]
+            if isinstance(target, ast.Name)
+            else list(getattr(target, "elts", []))
+        )
+        for nm in names:
+            if isinstance(nm, ast.Starred):
+                nm = nm.value
+            if isinstance(nm, ast.Name):
+                (taint.add if is_tainted else taint.discard)(nm.id)
+
+    def run(stmts, taint: set) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                run(s.body, set())
+                continue
+            if isinstance(s, ast.ClassDef):
+                run(s.body, set())
+                continue
+            if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = s.value
+                if value is None:
+                    continue
+                scan(value, taint)
+                t = tainted(value, taint)
+                targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+                for tgt in targets:
+                    bind(tgt, t, taint)
+                continue
+            bodies = []
+            for field in ("body", "orelse", "finalbody"):
+                bodies.extend(getattr(s, field, []) or [])
+            for h in getattr(s, "handlers", []) or []:
+                bodies.extend(h.body)
+            if bodies:
+                # scan the statement's own expressions (test/iter/items)
+                for field, val in ast.iter_fields(s):
+                    if field in ("body", "orelse", "finalbody", "handlers"):
+                        continue
+                    for v in val if isinstance(val, list) else [val]:
+                        if isinstance(v, ast.withitem):
+                            scan(v.context_expr, taint)
+                        elif isinstance(v, ast.expr):
+                            scan(v, taint)
+                if isinstance(s, ast.For):
+                    bind(s.target, tainted(s.iter, taint), taint)
+                run(bodies, taint)
+            else:
+                scan(s, taint)
+
+    run(a.tree.body, set())
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -1045,6 +1188,7 @@ def lint_source(
     _check_sl010(analysis)
     _check_sl011(analysis)
     _check_sl012(analysis)
+    _check_sl013(analysis)
     for ctx in analysis._top_level_contexts():
         _check_sl002(analysis, ctx)
         _check_sl003(analysis, ctx)

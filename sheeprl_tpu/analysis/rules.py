@@ -263,6 +263,28 @@ _RULES = [
             "crashed resource) gets a justified suppression"
         ),
     ),
+    Rule(
+        id="SL013",
+        name="device-array-to-wire",
+        severity=ERROR,
+        summary=(
+            "a device value (a name assigned from a jax.*/jnp.* call) "
+            "reaches a serialization/socket sink (.tobytes(), "
+            "send/sendall/sendto/send_bytes, pickle.dump/dumps) without an "
+            "explicit host pull — the byte view forces a hidden blocking "
+            "d2h transfer at the sink (and .tobytes() on a sharded array "
+            "gathers it whole), so the transfer cost is invisible to the "
+            "phase timers and the flock hot path (ISSUE 14: every byte "
+            "that crosses a socket must be pulled host-side exactly once, "
+            "where the telemetry can see it)"
+        ),
+        autofix=(
+            "pull explicitly first: np.asarray(x) / np.ascontiguousarray(x) "
+            "/ jax.device_get(x) — then serialize the host array (the "
+            "data/wire.py pack_* helpers already do this); an intentional "
+            "device-buffer send gets a justified suppression"
+        ),
+    ),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

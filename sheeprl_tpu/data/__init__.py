@@ -6,6 +6,7 @@ from .buffers import (
     SequentialReplayBuffer,
     stage_batch,
 )
+from .wire import pack_leaves, pack_tree, unpack_leaves, unpack_tree
 
 __all__ = [
     "ReplayBuffer",
@@ -14,4 +15,8 @@ __all__ = [
     "AsyncReplayBuffer",
     "StepBlobCodec",
     "stage_batch",
+    "pack_tree",
+    "unpack_tree",
+    "pack_leaves",
+    "unpack_leaves",
 ]

@@ -34,6 +34,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import struct
 import uuid
 from functools import partial
 from pathlib import Path
@@ -42,6 +43,8 @@ from typing import Mapping, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .wire import WireFormatError, pack_tree, unpack_tree
 
 __all__ = [
     "ReplayBuffer",
@@ -182,6 +185,64 @@ def _decode_sample_state(arr: np.ndarray):
         return d
 
     return json.loads(bytes(np.asarray(arr, dtype=np.uint8)).decode(), object_hook=hook)
+
+
+# ---------------------------------------------------------------------------
+# Wire round-trip (ISSUE 14): versioned pickle-free to_bytes()/from_bytes()
+# on every buffer class — the flock transport's payload format, and the only
+# serialization usable over a socket (save/load are .npz-file-only). Shared
+# frame: magic(4) | u32 meta_json_len | meta_json | u64 sampler_len |
+# sampler_json_bytes | class-specific payload (pack_tree blobs).
+# ---------------------------------------------------------------------------
+
+_WIRE_VERSION = 1
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def _wire_frame(magic: bytes, meta: dict, sampler_state, payload: bytes) -> bytes:
+    meta = dict(meta)
+    meta["version"] = _WIRE_VERSION
+    meta_b = json.dumps(meta).encode()
+    sampler_b = _encode_sample_state(sampler_state).tobytes()
+    return b"".join(
+        [
+            magic,
+            _U32.pack(len(meta_b)),
+            meta_b,
+            _U64.pack(len(sampler_b)),
+            sampler_b,
+            payload,
+        ]
+    )
+
+
+def _wire_unframe(magic: bytes, data: bytes, cls_name: str):
+    """-> (meta, decoded_sampler_state, payload_bytes); strict on magic,
+    version, and the concrete class name recorded at pack time."""
+    if len(data) < 8 or data[:4] != magic:
+        raise WireFormatError(f"bad buffer frame magic for {cls_name}")
+    (meta_len,) = _U32.unpack_from(data, 4)
+    off = 8 + meta_len
+    if off + 8 > len(data):
+        raise WireFormatError("truncated buffer frame meta")
+    meta = json.loads(data[8:off].decode())
+    if meta.get("version") != _WIRE_VERSION:
+        raise WireFormatError(
+            f"unsupported buffer wire version {meta.get('version')!r}"
+        )
+    if meta.get("class") != cls_name:
+        raise WireFormatError(
+            f"frame holds a {meta.get('class')!r}, not a {cls_name}"
+        )
+    (sampler_len,) = _U64.unpack_from(data, off)
+    off += 8
+    if off + sampler_len > len(data):
+        raise WireFormatError("truncated buffer frame sampler state")
+    sampler = _decode_sample_state(
+        np.frombuffer(data, dtype=np.uint8, count=sampler_len, offset=off)
+    )
+    return meta, sampler, data[off + sampler_len :]
 
 
 class ReplayBuffer:
@@ -524,6 +585,59 @@ class ReplayBuffer:
         if "sampler_state" in data.files:
             self.set_sample_state(_decode_sample_state(data["sampler_state"]))
 
+    # -- wire round-trip ------------------------------------------------------
+    _WIRE_MAGIC = b"SRB1"
+
+    def to_bytes(self) -> bytes:
+        """Versioned pickle-free frame of the whole buffer — ring contents
+        (bit-exact, via the width-class wire packing), head state, AND the
+        sampler PRNG: `from_bytes` continues the exact sample stream."""
+        st = self.to_state_dict()
+        meta = {
+            "class": type(self).__name__,
+            "buffer_size": self._buffer_size,
+            "n_envs": self._n_envs,
+            "pos": st["pos"],
+            "full": st["full"],
+            "obs_keys": list(self.obs_keys),
+            "has_buf": st["buf"] is not None,
+        }
+        payload = pack_tree(st["buf"]) if st["buf"] is not None else b""
+        return _wire_frame(
+            self._WIRE_MAGIC, meta, self.get_sample_state(), payload
+        )
+
+    @classmethod
+    def from_bytes(
+        cls,
+        data: bytes,
+        storage: str = "host",
+        memmap_dir: str | os.PathLike | None = None,
+    ) -> "ReplayBuffer":
+        """Rebuild from a `to_bytes` frame. `storage` is receiver policy,
+        not wire state (the flock replay service holds shards on host)."""
+        meta, sampler, payload = _wire_unframe(
+            cls._WIRE_MAGIC, data, cls.__name__
+        )
+        buf = cls(
+            meta["buffer_size"],
+            n_envs=meta["n_envs"],
+            storage=storage,
+            memmap_dir=memmap_dir,
+            obs_keys=tuple(meta["obs_keys"]),
+        )
+        buf.load_state_dict(
+            {
+                "buf": unpack_tree(payload) if meta["has_buf"] else None,
+                "pos": meta["pos"],
+                "full": meta["full"],
+                "buffer_size": meta["buffer_size"],
+                "n_envs": meta["n_envs"],
+            }
+        )
+        buf.set_sample_state(sampler)
+        return buf
+
 
 class SequentialReplayBuffer(ReplayBuffer):
     """Samples contiguous `[n_samples, seq_len, batch]` windows, each from a
@@ -839,6 +953,58 @@ class EpisodeBuffer:
         # cannot advance the checkpointed sampler stream
         if "sampler_state" in data.files:
             self.set_sample_state(_decode_sample_state(data["sampler_state"]))
+
+    # -- wire round-trip ------------------------------------------------------
+    _WIRE_MAGIC = b"SEB1"
+
+    def to_bytes(self) -> bytes:
+        """Versioned pickle-free frame: episodes as length-prefixed
+        `pack_tree` blobs, plus the sampler PRNG state."""
+        st = self.to_state_dict()
+        meta = {
+            "class": type(self).__name__,
+            "buffer_size": self._buffer_size,
+            "sequence_length": self._sequence_length,
+            "n_episodes": len(st["episodes"]),
+        }
+        parts = []
+        for ep in st["episodes"]:
+            blob = pack_tree(ep)
+            parts.append(_U64.pack(len(blob)) + blob)
+        return _wire_frame(
+            self._WIRE_MAGIC, meta, self.get_sample_state(), b"".join(parts)
+        )
+
+    @classmethod
+    def from_bytes(
+        cls, data: bytes, memmap_dir: str | os.PathLike | None = None
+    ) -> "EpisodeBuffer":
+        meta, sampler, payload = _wire_unframe(
+            cls._WIRE_MAGIC, data, cls.__name__
+        )
+        buf = cls(
+            meta["buffer_size"], meta["sequence_length"], memmap_dir=memmap_dir
+        )
+        episodes = []
+        off = 0
+        for _ in range(meta["n_episodes"]):
+            if off + 8 > len(payload):
+                raise WireFormatError("truncated episode payload")
+            (blob_len,) = _U64.unpack_from(payload, off)
+            off += 8
+            episodes.append(unpack_tree(payload[off : off + blob_len]))
+            off += blob_len
+        buf.load_state_dict(
+            {
+                "episodes": episodes,
+                "buffer_size": meta["buffer_size"],
+                "sequence_length": meta["sequence_length"],
+            }
+        )
+        # AFTER the re-adds, same ordering contract as load()
+        buf.set_sample_state(sampler)
+        return buf
+
 
 class _AsyncEnvView:
     """Single-env handle into the unified device store of an
@@ -1493,3 +1659,85 @@ class AsyncReplayBuffer:
         self.load_state_dict({"buffers": buffers})
         if "sampler_state" in data.files:
             self.set_sample_state(_decode_sample_state(data["sampler_state"]))
+
+    # -- wire round-trip ------------------------------------------------------
+    _WIRE_MAGIC = b"SAB1"
+
+    def to_bytes(self) -> bytes:
+        """Versioned pickle-free frame: one sub-frame per env column (meta +
+        `pack_tree` ring blob), plus the full sampler state including the
+        host path's per-env sub-sampler states."""
+        st = self.to_state_dict()
+        meta = {
+            "class": type(self).__name__,
+            "buffer_size": self._buffer_size,
+            "n_envs": self._n_envs,
+            "sequential": self._sequential,
+            "split": self._split,
+            "obs_keys": list(self._obs_keys),
+            "seed": self._seed,
+        }
+        parts = []
+        for s in st["buffers"]:
+            sub = json.dumps(
+                {
+                    "pos": int(s["pos"]),
+                    "full": bool(s["full"]),
+                    "has_buf": s["buf"] is not None,
+                }
+            ).encode()
+            blob = pack_tree(s["buf"]) if s["buf"] is not None else b""
+            parts.append(_U32.pack(len(sub)) + sub + _U64.pack(len(blob)) + blob)
+        return _wire_frame(
+            self._WIRE_MAGIC, meta, self.get_sample_state(), b"".join(parts)
+        )
+
+    @classmethod
+    def from_bytes(
+        cls,
+        data: bytes,
+        storage: str = "host",
+        memmap_dir: str | os.PathLike | None = None,
+    ) -> "AsyncReplayBuffer":
+        meta, sampler, payload = _wire_unframe(
+            cls._WIRE_MAGIC, data, cls.__name__
+        )
+        buf = cls(
+            meta["buffer_size"],
+            n_envs=meta["n_envs"],
+            storage=storage,
+            memmap_dir=memmap_dir,
+            sequential=meta["sequential"],
+            obs_keys=tuple(meta["obs_keys"]),
+            seed=meta["seed"],
+            split=meta["split"],
+        )
+        buffers = []
+        off = 0
+        for _ in range(meta["n_envs"]):
+            if off + 4 > len(payload):
+                raise WireFormatError("truncated per-env payload")
+            (sub_len,) = _U32.unpack_from(payload, off)
+            off += 4
+            sub = json.loads(payload[off : off + sub_len].decode())
+            off += sub_len
+            (blob_len,) = _U64.unpack_from(payload, off)
+            off += 8
+            ring = (
+                unpack_tree(payload[off : off + blob_len])
+                if sub["has_buf"]
+                else None
+            )
+            off += blob_len
+            buffers.append(
+                {
+                    "buf": ring,
+                    "pos": sub["pos"],
+                    "full": sub["full"],
+                    "buffer_size": meta["buffer_size"],
+                    "n_envs": 1,
+                }
+            )
+        buf.load_state_dict({"buffers": buffers})
+        buf.set_sample_state(sampler)
+        return buf

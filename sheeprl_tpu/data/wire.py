@@ -1,0 +1,150 @@
+"""Pickle-free byte framing for buffer contents and weight snapshots
+(ISSUE 14 satellite: the flock transport's framing layer, and a standalone
+fix — the orbax save path cannot ride a socket).
+
+The on-wire scheme reuses the width-class packing of `buffers.py`: every
+array is byte-viewed through its itemsize-class integer carrier
+(`_GROUP_VIEW` — int carriers are bit-exact by construction, so arbitrary
+NaN payloads survive where a float-typed carrier could be canonicalized),
+concatenated into ONE blob per width class, and described by a static
+layout of `(key, dtype_str, shape, group, offset, size)` rows. The host
+inverse slices each value back out of its class blob and bit-views it to
+the true dtype — an exact bit-level roundtrip.
+
+Unlike the device add path (which downcasts 64-bit values to match the
+x64-disabled device store), the wire is host<->host, so a fourth `w8`
+class carries 64-bit dtypes losslessly.
+
+Frame grammar (all integers little-endian, `struct` — no pickle anywhere):
+
+    tree  := MAGIC_TREE u32(header_len) header_json group_bytes*
+    header_json := {"layout": [[key, dtype_str, [dims...], group, off, size]...],
+                    "groups": [[group_name, nbytes]...]}
+
+`pack_leaves`/`unpack_leaves` frame an ordered list of arrays (a weight
+snapshot's flattened leaves — the treedef never crosses the wire: both
+ends rebuild it from their identically-constructed model).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Mapping, Sequence
+
+import numpy as np
+
+__all__ = [
+    "MAGIC_LEAVES",
+    "MAGIC_TREE",
+    "WireFormatError",
+    "pack_leaves",
+    "pack_tree",
+    "tree_nbytes",
+    "unpack_leaves",
+    "unpack_tree",
+]
+
+MAGIC_TREE = b"SFT1"
+MAGIC_LEAVES = b"SFW1"
+
+# width-class carriers, extending buffers._GROUP_VIEW with a host-only w8
+_WIRE_GROUP = {1: "w1", 2: "w2", 4: "w4", 8: "w8"}
+_WIRE_VIEW = {
+    "w1": np.uint8,
+    "w2": np.uint16,
+    "w4": np.uint32,
+    "w8": np.uint64,
+}
+
+_U32 = struct.Struct("<I")
+
+
+class WireFormatError(ValueError):
+    """Malformed or version-mismatched wire frame."""
+
+
+def _as_wire_array(v) -> np.ndarray:
+    # np.asarray pulls device values host-side exactly once, here, so the
+    # byte-view below never touches a jax.Array (SL013's contract)
+    a = np.asarray(v)
+    if a.dtype.hasobject:
+        raise WireFormatError(f"object dtype {a.dtype} cannot ride the wire")
+    return a
+
+
+def pack_tree(tree: Mapping[str, "np.ndarray"]) -> bytes:
+    """One framed blob for a str-keyed mapping of arrays (a buffer's ring,
+    a rollout chunk). Bit-exact: raw carrier bytes, no float transit."""
+    layout: list[list] = []
+    groups: dict[str, list[np.ndarray]] = {}
+    offsets: dict[str, int] = {}
+    for k, v in tree.items():
+        a = _as_wire_array(v)
+        g = _WIRE_GROUP.get(a.dtype.itemsize)
+        if g is None:
+            raise WireFormatError(f"unsupported itemsize {a.dtype.itemsize} for {k!r}")
+        # ascontiguousarray AFTER capturing a.shape: it promotes 0-d to 1-d
+        view = np.ascontiguousarray(a).reshape(-1).view(_WIRE_VIEW[g])
+        off = offsets.get(g, 0)
+        groups.setdefault(g, []).append(view)
+        layout.append([str(k), a.dtype.str, list(a.shape), g, off, int(a.size)])
+        offsets[g] = off + a.size
+    order = sorted(groups)
+    blobs = {g: np.concatenate(groups[g]) for g in order}
+    header = json.dumps(
+        {
+            "layout": layout,
+            "groups": [[g, int(blobs[g].nbytes)] for g in order],
+        }
+    ).encode()
+    parts = [MAGIC_TREE, _U32.pack(len(header)), header]
+    parts.extend(blobs[g].tobytes() for g in order)
+    return b"".join(parts)
+
+
+def unpack_tree(data: bytes) -> dict[str, np.ndarray]:
+    """Inverse of `pack_tree`; returns writable host arrays."""
+    if len(data) < 8 or data[:4] != MAGIC_TREE:
+        raise WireFormatError("bad tree frame magic")
+    (header_len,) = _U32.unpack_from(data, 4)
+    end = 8 + header_len
+    if end > len(data):
+        raise WireFormatError("truncated tree frame header")
+    header = json.loads(data[8:end].decode())
+    blobs: dict[str, np.ndarray] = {}
+    off = end
+    for g, nbytes in header["groups"]:
+        if g not in _WIRE_VIEW or off + nbytes > len(data):
+            raise WireFormatError("truncated tree frame payload")
+        blobs[g] = np.frombuffer(data, dtype=_WIRE_VIEW[g], count=nbytes // np.dtype(_WIRE_VIEW[g]).itemsize, offset=off)
+        off += nbytes
+    out: dict[str, np.ndarray] = {}
+    for k, ds, shape, g, start, size in header["layout"]:
+        dt = np.dtype(ds)
+        seg = blobs[g][start : start + size]
+        if seg.shape[0] != size:
+            raise WireFormatError(f"layout overruns group {g!r} for key {k!r}")
+        # copy() both detaches from the shared frombuffer view and makes
+        # the result writable (frombuffer arrays are read-only)
+        out[k] = seg.view(dt).reshape(shape).copy()
+    return out
+
+
+def pack_leaves(leaves: Sequence["np.ndarray"]) -> bytes:
+    """Frame an ordered leaf list (weight snapshot): the treedef stays off
+    the wire — both ends flatten an identically-built model."""
+    tree = {str(i): leaf for i, leaf in enumerate(leaves)}
+    return MAGIC_LEAVES + pack_tree(tree)
+
+
+def unpack_leaves(data: bytes) -> list[np.ndarray]:
+    if len(data) < 4 or data[:4] != MAGIC_LEAVES:
+        raise WireFormatError("bad leaves frame magic")
+    tree = unpack_tree(data[4:])
+    return [tree[str(i)] for i in range(len(tree))]
+
+
+def tree_nbytes(tree: Mapping[str, "np.ndarray"]) -> int:
+    """Payload bytes one packed row-tree occupies (shard sizing input)."""
+    return int(sum(np.asarray(v).nbytes for v in tree.values()))

@@ -1,0 +1,29 @@
+"""sheepflock — multi-process Sebulba actor-learner runtime (ISSUE 14).
+
+Podracer's Sebulba arrangement (arXiv:2104.06272) on this repo's pieces:
+N actor processes run the task's existing collection loop and stream
+rollout chunks over a length-prefixed socket into a **replay service**
+hosted inside the learner process — one shard (an ordinary
+`data/buffers.py` buffer) per actor, so the learner samples locally with
+NO socket on the sample path. Weights flow the other way as versioned
+snapshots pulled off the actors' hot path. Membership is elastic: actors
+register/heartbeat/deregister, the learner keeps training through an
+actor death (the sheepfault `sigkill` site), and a respawned actor
+rejoins at the current weight version without a learner restart.
+
+Module map:
+    wire.py      socket frame protocol (pickle-free, `data/wire.py` payloads)
+    sizing.py    per-actor shard capacities from the sheepmem ledger
+    service.py   learner-side replay service + membership + gauges
+    actor.py     actor process entry (`python -m sheeprl_tpu.flock.actor`)
+    launcher.py  actor subprocess lifecycle: spawn, monitor, respawn
+
+Wired behind `--flock {off,N}` in `ppo` and `dreamer_v3`; `--flock off`
+is bit-exact vs the in-process path (checkpoint-parity test-gated).
+"""
+
+from .launcher import ActorFleet, retarget_sigkill
+from .service import ReplayService
+from .sizing import shard_capacity
+
+__all__ = ["ActorFleet", "ReplayService", "retarget_sigkill", "shard_capacity"]

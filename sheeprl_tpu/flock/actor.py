@@ -1,0 +1,580 @@
+"""Flock actor process entry: `python -m sheeprl_tpu.flock.actor`.
+
+One actor runs the task's EXISTING host-env collection loop — the same
+`policy_step` / player-step jits the in-process mains use — against a
+local copy of the policy, and streams rollout data to the learner's
+replay service over the `flock/wire.py` socket protocol. Configuration
+arrives via environment variables (set by `launcher.ActorFleet`):
+
+    SHEEPRL_TPU_FLOCK_ADDR       service address (tcp:HOST:PORT | unix:PATH)
+    SHEEPRL_TPU_FLOCK_ACTOR_ID   this actor's integer id
+    SHEEPRL_TPU_FLOCK_ALGO       'ppo' | 'dreamer_v3'
+    SHEEPRL_TPU_FLOCK_ARGS       JSON of the learner's `args.as_dict()`
+    SHEEPRL_TPU_FLOCK_LOG_DIR    run directory (env video/media side files)
+
+Weight pulls ride a SECOND connection serviced by a background thread
+(`WeightFetcher`), so a snapshot transfer never sits inside the env-step
+loop; the loop swaps a landed version in between steps. The actor builds
+its model with the same constructors the learner uses — only the
+flattened leaves cross the wire, never a treedef, never a pickle.
+
+Faults: the actor arms `SHEEPRL_TPU_FAULTS` from its (launcher-scrubbed)
+environment and fires the `sigkill` site from its step loop — the
+elastic-membership receipt the CI fault-smoke scenario kills.
+
+Actors are observability-quiet by design: no Telemetry instance (the
+learner's rank-0 JSONL is the single event stream; actor stats arrive
+there through PUSH/HEARTBEAT metadata → `Flock/actor*` gauges).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import struct
+import sys
+import tempfile
+import threading
+import time
+
+# actors are host-collection processes: pin the cpu backend before jax
+# initializes (the learner owns whatever accelerator the run targets)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..resilience import inject
+from . import wire
+from .service import PROTO_VERSION, pack_push
+
+_U32 = struct.Struct("<I")
+
+PUSH_EVERY_ROWS = 8  # dv3: rows buffered per PUSH frame
+HEARTBEAT_S = 1.0
+WEIGHT_POLL_S = 0.25
+
+
+class WeightFetcher(threading.Thread):
+    """Polls GET_WEIGHTS on a dedicated connection; holds the newest
+    landed (version, leaves) for the step loop to swap in. A timed-out or
+    failed poll keeps the old weights — the PR-12 `to_player` deadline
+    semantics: degrade to staleness, never stall the actor."""
+
+    def __init__(self, addr: str, actor_id: int, timeout: float | None):
+        super().__init__(name=f"flock-weights-{actor_id}", daemon=True)
+        self._addr = addr
+        self._actor_id = actor_id
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.version = -1
+        self._leaves: list[np.ndarray] | None = None
+
+    def take(self):
+        """-> (version, leaves) of the newest unconsumed snapshot, or
+        (None, None). Consuming clears the slot."""
+        with self._lock:
+            leaves, self._leaves = self._leaves, None
+            return (self.version, leaves) if leaves is not None else (None, None)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        sock = None
+        while not self._stop.is_set():
+            try:
+                if sock is None:
+                    sock = wire.connect(self._addr, timeout=self._timeout)
+                    wire.send_json(
+                        sock,
+                        wire.HELLO,
+                        {
+                            "actor_id": self._actor_id,
+                            "pid": os.getpid(),
+                            "role": "weights",
+                            "proto": PROTO_VERSION,
+                        },
+                    )
+                wire.send_json(
+                    sock, wire.GET_WEIGHTS, {"have_version": self.version}
+                )
+                frame = wire.recv_frame(sock)
+                if frame is None:
+                    return  # service gone: the main loop will notice too
+                kind, payload = frame
+                if kind == wire.WEIGHTS:
+                    (meta_len,) = _U32.unpack_from(payload, 0)
+                    meta = json.loads(payload[4 : 4 + meta_len].decode())
+                    from ..data.wire import unpack_leaves
+
+                    leaves = unpack_leaves(payload[4 + meta_len :])
+                    with self._lock:
+                        self.version = int(meta["version"])
+                        self._leaves = leaves
+            except (OSError, wire.FrameError):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+            self._stop.wait(WEIGHT_POLL_S)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class _ServiceLink:
+    """The actor's data connection: HELLO/WELCOME handshake, then strictly
+    sequential PUSH and HEARTBEAT request/replies from the step loop."""
+
+    def __init__(self, addr: str, actor_id: int, timeout: float | None):
+        self.sock = wire.connect(addr, timeout=timeout)
+        wire.send_json(
+            self.sock,
+            wire.HELLO,
+            {
+                "actor_id": actor_id,
+                "pid": os.getpid(),
+                "role": "data",
+                "proto": PROTO_VERSION,
+            },
+        )
+        self.welcome = wire.recv_json(self.sock, wire.WELCOME)
+        self.random_phase = bool(self.welcome.get("random_phase"))
+        self._last_hb = time.monotonic()
+        self._hb_steps0 = 0
+        self._hb_t0 = time.monotonic()
+
+    def push(self, ops, *, rows: int, env_steps: int, weight_version: int):
+        wire.send_frame(
+            self.sock,
+            wire.PUSH,
+            pack_push(
+                ops, rows=rows, env_steps=env_steps, weight_version=weight_version
+            ),
+        )
+        reply = wire.recv_json(self.sock, wire.PUSH_OK)
+        self.random_phase = bool(reply.get("random_phase"))
+        return reply
+
+    def maybe_heartbeat(self, env_steps: int, weight_version: int) -> None:
+        now = time.monotonic()
+        if now - self._last_hb < HEARTBEAT_S:
+            return
+        dt = max(now - self._hb_t0, 1e-9)
+        sps = (env_steps - self._hb_steps0) / dt
+        self._hb_t0, self._hb_steps0 = now, env_steps
+        self._last_hb = now
+        wire.send_json(
+            self.sock,
+            wire.HEARTBEAT,
+            {
+                "actor_id": self.welcome["actor_id"],
+                "env_steps": env_steps,
+                "weight_version": weight_version,
+                "sps": sps,
+            },
+        )
+        reply = wire.recv_json(self.sock, wire.HEARTBEAT_OK)
+        self.random_phase = bool(reply.get("random_phase"))
+
+    def close(self) -> None:
+        try:
+            wire.send_json(
+                self.sock, wire.BYE, {"actor_id": self.welcome["actor_id"]}
+            )
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _transfer_timeout() -> float | None:
+    raw = os.environ.get("SHEEPRL_TPU_TRANSFER_TIMEOUT_S")
+    if not raw:
+        return 30.0
+    val = float(raw)
+    return val if val > 0 else None
+
+
+def _fire_faults(step: int) -> None:
+    """The flock `sigkill` site: an armed plan kills THIS actor process
+    dead (no cleanup, no goodbye) — exactly the failure mode the elastic
+    membership path must absorb."""
+    spec = inject.get_plan().fire_at("sigkill", step)
+    if spec is not None:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _wait_initial_weights(fetcher: WeightFetcher, timeout: float = 120.0):
+    """Block until the learner's first published snapshot lands: actors
+    must never collect on their private random init (PPO is on-policy)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        version, leaves = fetcher.take()
+        if leaves is not None:
+            return version, leaves
+        time.sleep(0.05)
+    raise TimeoutError("no initial weight snapshot from the flock service")
+
+
+def _make_envs(args, actor_id: int, log_dir: str, *, mask_vel: bool = False):
+    from ..envs import make_vector_env
+    from ..utils.env import make_dict_env
+
+    # decorrelated env seeds per actor; same offset scheme every rejoin, so
+    # a respawned actor replays its own env stream rather than a fresh draw
+    seed0 = args.seed + 1009 * (actor_id + 1)
+    kw = {"mask_velocities": args.mask_vel} if mask_vel else {}
+    return make_vector_env(
+        [
+            make_dict_env(
+                args.env_id, seed0 + i, rank=actor_id, args=args,
+                run_name=log_dir, vector_env_idx=i, **kw,
+            )
+            for i in range(args.num_envs)
+        ],
+        sync=args.sync_env or args.num_envs == 1,
+    ), seed0
+
+
+# ---------------------------------------------------------------------------
+# ppo
+# ---------------------------------------------------------------------------
+
+
+def run_ppo(args, actor_id: int, addr: str, log_dir: str) -> None:
+    from ..algos.ppo.agent import (
+        PPOAgent,
+        buffer_actions,
+        indices_to_env_actions,
+    )
+    from ..algos.ppo.ppo import actions_dim_of, policy_step, validate_obs_keys
+
+    envs, seed0 = _make_envs(args, actor_id, log_dir, mask_vel=True)
+    observation_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    cnn_keys, mlp_keys = validate_obs_keys(observation_space, args)
+    obs_keys = [*cnn_keys, *mlp_keys]
+    actions_dim, is_continuous = actions_dim_of(action_space)
+
+    # same constructor call as the learner -> same pytree structure; the
+    # random init below never acts (first snapshot is awaited), it only
+    # donates the treedef the wire leaves unflatten into
+    key = jax.random.PRNGKey(seed0)
+    key, agent_key = jax.random.split(key)
+    agent = PPOAgent.init(
+        agent_key, actions_dim, observation_space.spaces,
+        cnn_keys, mlp_keys,
+        cnn_features_dim=args.cnn_features_dim, mlp_features_dim=args.mlp_features_dim,
+        screen_size=args.screen_size, mlp_layers=args.mlp_layers,
+        dense_units=args.dense_units, dense_act=args.dense_act,
+        layer_norm=args.layer_norm, is_continuous=is_continuous,
+        actor_hidden_size=args.actor_hidden_size,
+        critic_hidden_size=args.critic_hidden_size,
+        cnn_channels_multiplier=args.cnn_channels_multiplier,
+        precision=args.precision,
+    )
+    treedef = jax.tree_util.tree_structure(agent)
+
+    timeout = _transfer_timeout()
+    fetcher = WeightFetcher(addr, actor_id, timeout)
+    fetcher.start()
+    link = _ServiceLink(addr, actor_id, timeout)
+    version, leaves = _wait_initial_weights(fetcher)
+    agent = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(x) for x in leaves])
+
+    T = args.rollout_steps
+    obs, _ = envs.reset(seed=seed0)
+    next_done = np.zeros(args.num_envs, dtype=np.float32)
+    env_steps = 0
+    step_counter = 0
+    try:
+        while True:
+            chunk: dict[str, list] = {k: [] for k in obs_keys}
+            for extra in ("actions", "logprobs", "values", "rewards", "dones"):
+                chunk[extra] = []
+            for _ in range(T):
+                step_counter += 1
+                _fire_faults(step_counter)
+                # swap in a landed snapshot between steps: a chunk may mix
+                # adjacent versions — fine for PPO, whose recorded
+                # logprobs/values stay consistent with the acting policy
+                new_version, new_leaves = fetcher.take()
+                if new_leaves is not None:
+                    version = new_version
+                    agent = jax.tree_util.tree_unflatten(
+                        treedef, [jnp.asarray(x) for x in new_leaves]
+                    )
+                key, step_key = jax.random.split(key)
+                device_obs = {k: jnp.asarray(obs[k]) for k in obs_keys}
+                actions, logprob, value, env_idx = policy_step(
+                    agent, device_obs, step_key
+                )
+                env_idx_np = np.asarray(env_idx)
+                env_actions = indices_to_env_actions(
+                    env_idx_np, actions_dim, is_continuous
+                )
+                next_obs, rewards, terms, truncs, _infos = envs.step(
+                    list(env_actions)
+                )
+                dones = (terms | truncs).astype(np.float32)
+                for k in obs_keys:
+                    chunk[k].append(np.asarray(obs[k]))
+                chunk["actions"].append(
+                    np.asarray(
+                        buffer_actions(
+                            env_idx_np, actions, actions_dim, is_continuous,
+                            host=True,
+                        ),
+                        np.float32,
+                    )
+                )
+                lv = np.asarray(jnp.concatenate([logprob, value], axis=-1))
+                chunk["logprobs"].append(lv[:, :1])
+                chunk["values"].append(lv[:, 1:])
+                chunk["rewards"].append(
+                    np.asarray(rewards, np.float32)[:, None]
+                )
+                chunk["dones"].append(next_done[:, None].copy())
+                next_done = dones
+                obs = next_obs
+                env_steps += args.num_envs
+                link.maybe_heartbeat(env_steps, version)
+            # bootstrap row T: the obs/done entering the NEXT step — the
+            # learner's GAE tail; other slots are zero-filled padding
+            for k in obs_keys:
+                chunk[k].append(np.asarray(obs[k]))
+            chunk["dones"].append(next_done[:, None].copy())
+            for extra in ("actions", "logprobs", "values", "rewards"):
+                chunk[extra].append(np.zeros_like(chunk[extra][0]))
+            tree = {k: np.stack(v) for k, v in chunk.items()}
+            link.push(
+                [(tree, None)],
+                rows=T,
+                env_steps=env_steps,
+                weight_version=version,
+            )
+    finally:
+        fetcher.stop()
+        link.close()
+        envs.close()
+
+
+# ---------------------------------------------------------------------------
+# dreamer_v3
+# ---------------------------------------------------------------------------
+
+
+def run_dreamer_v3(args, actor_id: int, addr: str, log_dir: str) -> None:
+    from ..algos.dreamer_v3.agent import PlayerDV3, build_models
+    from ..algos.dreamer_v3.dreamer_v3 import _random_actions
+    from ..algos.dreamer_v3.utils import make_device_preprocess
+    from ..algos.ppo.agent import (
+        buffer_actions,
+        env_action_indices,
+        indices_to_env_actions,
+    )
+    from ..algos.ppo.ppo import actions_dim_of, validate_obs_keys
+
+    envs, seed0 = _make_envs(args, actor_id, log_dir)
+    observation_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    cnn_keys, mlp_keys = validate_obs_keys(observation_space, args)
+    obs_keys = [*cnn_keys, *mlp_keys]
+    actions_dim, is_continuous = actions_dim_of(action_space)
+    act_sum = int(sum(actions_dim))
+
+    key = jax.random.PRNGKey(seed0)
+    key, model_key = jax.random.split(key)
+    world_model, dv3_actor, _critic, _target = build_models(
+        model_key, actions_dim, is_continuous, args,
+        observation_space.spaces, cnn_keys, mlp_keys,
+    )
+    # the published snapshot is the PLAYER's leaves (encoder+rssm+actor):
+    # the critic/optimizer halves of the train state never leave the learner
+    player = PlayerDV3(
+        encoder=world_model.encoder,
+        rssm=world_model.rssm,
+        actor=dv3_actor,
+        actions_dim=tuple(actions_dim),
+        stochastic_size=args.stochastic_size,
+        discrete_size=args.discrete_size,
+        recurrent_state_size=args.recurrent_state_size,
+        is_continuous=is_continuous,
+        compute_dtype=args.precision,
+    )
+    treedef = jax.tree_util.tree_structure(player)
+    _dev_preprocess = make_device_preprocess(cnn_keys)
+
+    def _player_step(p, s, o, k, expl, mask):
+        new_s, acts = p.step(
+            s, _dev_preprocess(o), k, expl, is_training=True, mask=mask
+        )
+        return new_s, acts, env_action_indices(acts, actions_dim, is_continuous)
+
+    player_step = jax.jit(_player_step)
+
+    timeout = _transfer_timeout()
+    fetcher = WeightFetcher(addr, actor_id, timeout)
+    fetcher.start()
+    link = _ServiceLink(addr, actor_id, timeout)
+    version, leaves = _wait_initial_weights(fetcher)
+    player = jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(x) for x in leaves]
+    )
+    player_state = player.init_states(args.num_envs)
+    expl_dev = jnp.float32(args.expl_amount)
+
+    obs, _ = envs.reset(seed=seed0)
+    step_data = {k: np.asarray(obs[k]) for k in obs_keys}
+    step_data["dones"] = np.zeros((args.num_envs, 1), np.float32)
+    step_data["rewards"] = np.zeros((args.num_envs, 1), np.float32)
+    step_data["is_first"] = np.ones((args.num_envs, 1), np.float32)
+
+    ops: list[tuple[dict, list | None]] = []
+    rows_pending = 0
+    env_steps = 0
+    step_counter = 0
+    try:
+        while True:
+            step_counter += 1
+            _fire_faults(step_counter)
+            new_version, new_leaves = fetcher.take()
+            if new_leaves is not None:
+                version = new_version
+                player = jax.tree_util.tree_unflatten(
+                    treedef, [jnp.asarray(x) for x in new_leaves]
+                )
+            if link.random_phase:
+                pairs = [
+                    _random_actions(action_space, actions_dim, is_continuous)
+                    for _ in range(args.num_envs)
+                ]
+                actions = np.stack([p[0] for p in pairs])
+                env_actions = [p[1] for p in pairs]
+            else:
+                device_obs = {
+                    k: jnp.asarray(np.asarray(obs[k])) for k in obs_keys
+                }
+                mask = {
+                    k: v for k, v in device_obs.items() if k.startswith("mask")
+                } or None
+                key, step_key = jax.random.split(key)
+                player_state, actions_dev, env_idx_dev = player_step(
+                    player, player_state, device_obs, step_key, expl_dev, mask
+                )
+                env_idx = np.asarray(env_idx_dev)
+                env_actions = list(
+                    indices_to_env_actions(env_idx, actions_dim, is_continuous)
+                )
+                actions = buffer_actions(
+                    env_idx, actions_dev, actions_dim, is_continuous, host=True
+                )
+            step_data["actions"] = np.asarray(actions, np.float32)
+            ops.append(({k: v[None].copy() for k, v in step_data.items()}, None))
+            rows_pending += 1
+
+            next_obs, rewards, terms, truncs, infos = envs.step(env_actions)
+            dones = np.logical_or(terms, truncs).astype(np.float32)
+
+            step_data["is_first"] = np.zeros((args.num_envs, 1), np.float32)
+            real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+            for i, info in enumerate(infos):
+                if "final_observation" in info:
+                    for k in obs_keys:
+                        real_next_obs[k][i] = info["final_observation"][k]
+
+            for k in obs_keys:
+                step_data[k] = np.asarray(next_obs[k])
+            obs = next_obs
+            step_data["dones"] = dones[:, None]
+            step_data["rewards"] = (
+                np.tanh(rewards)[:, None] if args.clip_rewards else rewards[:, None]
+            ).astype(np.float32)
+
+            dones_idxes = np.nonzero(dones)[0].tolist()
+            if dones_idxes:
+                n_reset = len(dones_idxes)
+                reset_data = {
+                    k: real_next_obs[k][dones_idxes][None] for k in obs_keys
+                }
+                reset_data["dones"] = np.ones((1, n_reset, 1), np.float32)
+                reset_data["actions"] = np.zeros((1, n_reset, act_sum), np.float32)
+                reset_data["rewards"] = step_data["rewards"][dones_idxes][None]
+                reset_data["is_first"] = np.zeros((1, n_reset, 1), np.float32)
+                ops.append((reset_data, dones_idxes))
+                step_data["rewards"][dones_idxes] = 0.0
+                step_data["dones"][dones_idxes] = 0.0
+                step_data["is_first"][dones_idxes] = 1.0
+                if not link.random_phase:
+                    reset_mask = np.zeros((args.num_envs,), np.float32)
+                    reset_mask[dones_idxes] = 1.0
+                    player_state = player.reset_states(
+                        player_state, jnp.asarray(reset_mask)
+                    )
+            env_steps += args.num_envs
+
+            if rows_pending >= PUSH_EVERY_ROWS:
+                link.push(
+                    ops,
+                    rows=rows_pending,
+                    env_steps=env_steps,
+                    weight_version=version,
+                )
+                ops, rows_pending = [], 0
+            link.maybe_heartbeat(env_steps, version)
+    finally:
+        fetcher.stop()
+        link.close()
+        envs.close()
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def main() -> int:
+    addr = os.environ["SHEEPRL_TPU_FLOCK_ADDR"]
+    actor_id = int(os.environ["SHEEPRL_TPU_FLOCK_ACTOR_ID"])
+    algo = os.environ["SHEEPRL_TPU_FLOCK_ALGO"]
+    cfg = json.loads(os.environ["SHEEPRL_TPU_FLOCK_ARGS"])
+    log_dir = os.environ.get("SHEEPRL_TPU_FLOCK_LOG_DIR") or tempfile.mkdtemp(
+        prefix="flock-actor-"
+    )
+    if algo == "ppo":
+        from ..algos.ppo.args import PPOArgs
+        from ..utils.parser import DataclassArgumentParser
+
+        (args,) = DataclassArgumentParser(PPOArgs).parse_dict(cfg)
+        runner = run_ppo
+    elif algo == "dreamer_v3":
+        from ..algos.dreamer_v3.args import DreamerV3Args
+        from ..utils.parser import DataclassArgumentParser
+
+        (args,) = DataclassArgumentParser(DreamerV3Args).parse_dict(cfg)
+        runner = run_dreamer_v3
+    else:
+        print(f"flock actor: unsupported algo {algo!r}", file=sys.stderr)
+        return 2
+    try:
+        runner(args, actor_id, addr, log_dir)
+    except (ConnectionError, wire.FrameError, TimeoutError):
+        # the learner finished (service closed) or went away: a clean exit,
+        # not a failure — the launcher treats rc 0 as "no respawn needed"
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

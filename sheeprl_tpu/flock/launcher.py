@@ -1,0 +1,211 @@
+"""Actor subprocess lifecycle: spawn, monitor, respawn.
+
+`ActorFleet` owns N `python -m sheeprl_tpu.flock.actor` children,
+configured entirely through environment variables (no argv surface to
+drift from the learner's parsed config — the learner's `args.as_dict()`
+JSON rides across verbatim). A monitor thread polls the children; a
+child that dies with a non-zero/negative return code is respawned (up to
+a bounded budget) with a fault-scrubbed environment, reconnects to the
+service under its same actor id, and resumes filling its shard — the
+learner never restarts, never even blocks.
+
+`retarget_sigkill` implements the sheepfault contract for the flock
+topology: a `sigkill@N` clause in `--faults` is retargeted from the
+learner onto actor 0 (killing the learner tests nothing about elastic
+membership), while every other clause stays learner-side. Respawned
+actors ALWAYS get the scrubbed plan so an exactly-once kill cannot
+re-fire on the replacement process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+from ..resilience import inject
+from ..telemetry import core as telemetry
+
+__all__ = ["ActorFleet", "retarget_sigkill"]
+
+_REPO = Path(__file__).resolve().parents[2]
+_POLL_S = 0.5
+
+
+def retarget_sigkill(args) -> tuple[str, str]:
+    """Split the armed fault plan for the flock topology.
+
+    Returns `(learner_text, actor_text)`: the learner re-arms with every
+    clause EXCEPT sigkill ones; the sigkill clauses are handed to actor
+    0's environment (first spawn only). No plan -> two empty strings."""
+    text = os.environ.get(inject.ENV_VAR, "") or ""
+    clauses = [c.strip() for c in text.split(",") if c.strip()]
+    actor_clauses = [
+        c for c in clauses if c.split("@", 1)[0].strip() == "sigkill"
+    ]
+    learner_clauses = [c for c in clauses if c not in actor_clauses]
+    learner_text = ",".join(learner_clauses)
+    if actor_clauses:
+        # rewrite the exported env BEFORE re-arming (arm_faults re-parses
+        # from the environment) so learner-side env workers inherit the
+        # scrubbed plan too
+        if learner_text:
+            os.environ[inject.ENV_VAR] = learner_text
+        else:
+            os.environ.pop(inject.ENV_VAR, None)
+        inject.reset_plan()
+        inject.get_plan()
+    return learner_text, ",".join(actor_clauses)
+
+
+class ActorFleet:
+    """Spawns and supervises the actor processes of one flock run."""
+
+    def __init__(
+        self,
+        *,
+        algo: str,
+        args,
+        address: str,
+        log_dir: str,
+        telem=None,
+        actor_faults: str = "",
+        max_respawns: int = 3,
+    ):
+        self.algo = algo
+        self.n_actors = int(args.flock)
+        self.address = address
+        self.log_dir = log_dir
+        self._args_json = json.dumps(args.as_dict())
+        self._telem = telem
+        self._actor_faults = actor_faults
+        self._max_respawns = max_respawns
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._respawns: dict[int, int] = {i: 0 for i in range(self.n_actors)}
+        self._logs: dict[int, object] = {}
+        self._stop = threading.Event()
+        self._monitor: threading.Thread | None = None
+        os.makedirs(os.path.join(log_dir, "flock"), exist_ok=True)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        for actor_id in range(self.n_actors):
+            self._spawn(actor_id, first=True)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="flock-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 5.0
+        for proc in self._procs.values():
+            left = max(deadline - time.monotonic(), 0.1)
+            try:
+                proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+        for fh in self._logs.values():
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- internals ------------------------------------------------------------
+
+    def _spawn(self, actor_id: int, *, first: bool) -> None:
+        env = dict(os.environ)
+        env.update(
+            SHEEPRL_TPU_FLOCK_ADDR=self.address,
+            SHEEPRL_TPU_FLOCK_ACTOR_ID=str(actor_id),
+            SHEEPRL_TPU_FLOCK_ALGO=self.algo,
+            SHEEPRL_TPU_FLOCK_ARGS=self._args_json,
+            SHEEPRL_TPU_FLOCK_LOG_DIR=self.log_dir,
+            JAX_PLATFORMS="cpu",
+            # actors are telemetry-quiet: the learner's JSONL is the single
+            # event stream of the run
+            SHEEPRL_TPU_TELEMETRY="0",
+        )
+        # one actor process needs no forced multi-device cpu topology
+        env.pop("XLA_FLAGS", None)
+        # the sigkill clause rides ONLY on actor 0's FIRST incarnation: a
+        # respawn re-firing the same exactly-once kill would loop forever
+        if first and actor_id == 0 and self._actor_faults:
+            env[inject.ENV_VAR] = self._actor_faults
+        else:
+            env.pop(inject.ENV_VAR, None)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (str(_REPO), os.environ.get("PYTHONPATH")) if p
+        )
+        log_path = os.path.join(
+            self.log_dir, "flock", f"actor{actor_id}.log"
+        )
+        fh = open(log_path, "ab")
+        old = self._logs.get(actor_id)
+        self._logs[actor_id] = fh
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        self._procs[actor_id] = subprocess.Popen(
+            [sys.executable, "-m", "sheeprl_tpu.flock.actor"],
+            env=env,
+            stdout=fh,
+            stderr=subprocess.STDOUT,
+            cwd=str(_REPO),
+        )
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            for actor_id, proc in list(self._procs.items()):
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                self._event("flock.actor_died", actor_id=actor_id, rc=rc)
+                if rc == 0:
+                    # clean exit (service closed under it): nothing to heal
+                    del self._procs[actor_id]
+                    continue
+                if self._respawns[actor_id] >= self._max_respawns:
+                    self._event(
+                        "flock.actor_abandoned",
+                        actor_id=actor_id,
+                        respawns=self._respawns[actor_id],
+                    )
+                    del self._procs[actor_id]
+                    continue
+                self._respawns[actor_id] += 1
+                self._spawn(actor_id, first=False)
+                self._event(
+                    "flock.actor_respawned",
+                    actor_id=actor_id,
+                    attempt=self._respawns[actor_id],
+                )
+            self._stop.wait(_POLL_S)
+
+    def alive(self) -> int:
+        return sum(1 for p in self._procs.values() if p.poll() is None)
+
+    def _event(self, name: str, **data) -> None:
+        if self._telem is not None:
+            self._telem.event(name, **data)
+        else:
+            telemetry.emit(name, **data)

@@ -1,0 +1,570 @@
+"""Learner-side replay service: the socket half of the Sebulba split.
+
+Runs INSIDE the learner process (an accept thread plus one handler thread
+per actor connection) and hosts one replay shard per actor — an ordinary
+`data/buffers.py` buffer, so the learner samples with plain function
+calls: there is NO socket on the sample path. Actors connect over the
+`flock/wire.py` frame protocol, register (HELLO/WELCOME), stream rollout
+ops (PUSH), heartbeat, and pull versioned weight snapshots on a second
+connection so the fetch never blocks their env-step loop.
+
+Two shard modes cover the two algorithm families:
+
+    mode="chunks"  on-policy (ppo): each PUSH carries one whole rollout
+                   chunk; the service keeps a bounded per-actor queue and
+                   the learner drains round-robin with `next_chunk()`.
+                   A full queue drops the OLDEST chunk (on-policy data
+                   ages out; `Flock/chunks_dropped` counts the loss).
+    mode="buffer"  off-policy (dreamer_v3): each PUSH carries ordered
+                   buffer ops `(row_tree, indices|None)` applied to the
+                   actor's shard via its normal `.add()`; the learner
+                   calls `sample()` which partitions the batch across
+                   filled shards and concatenates.
+
+Membership is elastic: a dead connection only marks the actor
+disconnected (its shard stays sampleable), and a reconnecting actor with
+the same id bumps its generation and resumes filling the same shard —
+the `flock.actor_rejoined` event is the receipt the CI fault-smoke
+scenario asserts on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+from ..telemetry import core as telemetry
+from . import wire
+
+__all__ = ["ReplayService"]
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+PROTO_VERSION = 1
+
+
+def pack_push(ops, *, rows: int, env_steps: int, weight_version: int) -> bytes:
+    """PUSH payload: u32 n_ops, then per op u32 meta_len | meta_json |
+    u64 blob_len | pack_tree blob. Frame-level stats ride in op 0's meta.
+    `ops` is a list of (host_tree, indices|None)."""
+    from ..data.wire import pack_tree
+
+    parts = [_U32.pack(len(ops))]
+    for i, (tree, indices) in enumerate(ops):
+        meta: dict[str, Any] = {
+            "indices": None if indices is None else [int(j) for j in indices]
+        }
+        if i == 0:
+            meta.update(
+                rows=int(rows),
+                env_steps=int(env_steps),
+                weight_version=int(weight_version),
+            )
+        blob = pack_tree(tree)
+        mb = json.dumps(meta).encode()
+        parts += [_U32.pack(len(mb)), mb, _U64.pack(len(blob)), blob]
+    return b"".join(parts)
+
+
+def unpack_push(payload: bytes):
+    """-> (ops, frame_meta) where ops = [(tree, indices|None), ...]."""
+    from ..data.wire import unpack_tree
+
+    (n_ops,) = _U32.unpack_from(payload, 0)
+    off = 4
+    ops = []
+    frame_meta: dict[str, Any] = {}
+    for i in range(n_ops):
+        (meta_len,) = _U32.unpack_from(payload, off)
+        off += 4
+        meta = json.loads(payload[off : off + meta_len].decode())
+        off += meta_len
+        (blob_len,) = _U64.unpack_from(payload, off)
+        off += 8
+        tree = unpack_tree(payload[off : off + blob_len])
+        off += blob_len
+        if i == 0:
+            frame_meta = {
+                k: meta.get(k) for k in ("rows", "env_steps", "weight_version")
+            }
+        ops.append((tree, meta.get("indices")))
+    return ops, frame_meta
+
+
+class _ActorState:
+    __slots__ = (
+        "actor_id",
+        "generation",
+        "connected",
+        "ever_connected",
+        "pid",
+        "last_heartbeat",
+        "env_steps",
+        "weight_version",
+        "sps",
+        "rows",
+    )
+
+    def __init__(self, actor_id: int):
+        self.actor_id = actor_id
+        self.generation = 0
+        self.connected = False
+        self.ever_connected = False
+        self.pid = -1
+        self.last_heartbeat = 0.0
+        self.env_steps = 0
+        self.weight_version = -1
+        self.sps = 0.0
+        self.rows = 0
+
+
+class ReplayService:
+    """Sharded replay + membership + weight distribution for one learner."""
+
+    def __init__(
+        self,
+        *,
+        algo: str,
+        n_actors: int,
+        mode: str,
+        capacity_rows: int,
+        make_shard: Callable[[int], Any] | None = None,
+        transport: str | None = None,
+        telem: "telemetry.Telemetry | None" = None,
+    ):
+        if mode not in ("chunks", "buffer"):
+            raise ValueError(f"mode must be 'chunks' or 'buffer', got {mode!r}")
+        if mode == "buffer" and make_shard is None:
+            raise ValueError("buffer mode needs a make_shard factory")
+        self.algo = algo
+        self.n_actors = n_actors
+        self.mode = mode
+        self.capacity_rows = capacity_rows
+        self._telem = telem
+        self._lock = threading.RLock()
+        self._chunk_ready = threading.Condition(self._lock)
+        self._membership = threading.Condition(self._lock)
+        self._actors = {i: _ActorState(i) for i in range(n_actors)}
+        # shards outlive connections: a rejoining actor resumes filling its own
+        self._shards = (
+            {i: make_shard(capacity_rows) for i in range(n_actors)}
+            if mode == "buffer"
+            else {}
+        )
+        self._shard_locks = {i: threading.Lock() for i in range(n_actors)}
+        self._chunks: dict[int, deque] = {i: deque() for i in range(n_actors)}
+        self._chunk_cap: dict[int, int] = {}
+        self._drain_order = 0
+        self._weight_version = 0
+        self._weight_payload: bytes | None = None
+        self._publish_ts: dict[int, float] = {}
+        self._random_phase = False
+        self._rows_total = 0
+        self._chunks_dropped = 0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self._listener: socket.socket | None = None
+        self._unix_path: str | None = None
+        self.address = ""
+        self._transport = transport or os.environ.get(
+            "SHEEPRL_TPU_FLOCK_TRANSPORT", "unix"
+        )
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> str:
+        if self._transport == "tcp":
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("127.0.0.1", 0))
+            self.address = wire.format_address(
+                "tcp", "127.0.0.1", srv.getsockname()[1]
+            )
+        else:
+            # a short tempdir path: AF_UNIX paths cap at ~107 bytes
+            sock_dir = tempfile.mkdtemp(prefix="flock-")
+            self._unix_path = os.path.join(sock_dir, "svc.sock")
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(self._unix_path)
+            self.address = wire.format_address("unix", self._unix_path)
+        srv.listen(self.n_actors * 2 + 2)
+        self._listener = srv
+        t = threading.Thread(
+            target=self._accept_loop, name="flock-accept", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        self._event("flock.started", address=self.address, mode=self.mode)
+        return self.address
+
+    def close(self) -> None:
+        self._stop.set()
+        for sock in [self._listener, *self._conns]:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        if self._unix_path:
+            try:
+                os.unlink(self._unix_path)
+                os.rmdir(os.path.dirname(self._unix_path))
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- socket side ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve, args=(conn,), name="flock-conn", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        actor_id = None
+        role = "data"
+        try:
+            frame = wire.recv_frame(conn)
+            if frame is None or frame[0] != wire.HELLO:
+                return
+            hello = json.loads(frame[1].decode())
+            actor_id = int(hello["actor_id"])
+            role = hello.get("role", "data")
+            if actor_id not in self._actors or hello.get("proto") != PROTO_VERSION:
+                wire.send_json(
+                    conn, wire.ERROR, {"error": f"bad hello {hello!r}"}
+                )
+                return
+            if role == "weights":
+                self._serve_weights(conn)
+                return
+            self._register(actor_id, hello)
+            wire.send_json(
+                conn,
+                wire.WELCOME,
+                {
+                    "actor_id": actor_id,
+                    "shard_capacity": self.capacity_rows,
+                    "weight_version": self._weight_version,
+                    "random_phase": self._random_phase,
+                    "generation": self._actors[actor_id].generation,
+                },
+            )
+            while not self._stop.is_set():
+                frame = wire.recv_frame(conn)
+                if frame is None:
+                    break
+                kind, payload = frame
+                if kind == wire.PUSH:
+                    self._handle_push(conn, actor_id, payload)
+                elif kind == wire.HEARTBEAT:
+                    self._handle_heartbeat(conn, actor_id, payload)
+                elif kind == wire.BYE:
+                    break
+                else:
+                    wire.send_json(
+                        conn,
+                        wire.ERROR,
+                        {"error": f"unexpected {wire.KIND_NAMES.get(kind, kind)}"},
+                    )
+        except (wire.FrameError, OSError, ValueError, KeyError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if actor_id in self._actors and role == "data":
+                self._deregister(actor_id)
+
+    def _serve_weights(self, conn: socket.socket) -> None:
+        """Dedicated weight-pull connection: GET_WEIGHTS request/reply only,
+        so a slow snapshot transfer never sits between two PUSHes."""
+        while not self._stop.is_set():
+            frame = wire.recv_frame(conn)
+            if frame is None:
+                return
+            kind, payload = frame
+            if kind != wire.GET_WEIGHTS:
+                wire.send_json(conn, wire.ERROR, {"error": "weights conn"})
+                return
+            have = json.loads(payload.decode()).get("have_version", -1)
+            with self._lock:
+                version, blob = self._weight_version, self._weight_payload
+            if blob is None or have == version:
+                wire.send_json(conn, wire.WEIGHTS_UNCHANGED, {"version": version})
+            else:
+                wire.send_frame(conn, wire.WEIGHTS, blob)
+
+    def _register(self, actor_id: int, hello: dict) -> None:
+        with self._lock:
+            st = self._actors[actor_id]
+            rejoin = st.ever_connected
+            st.generation += 1 if rejoin else 0
+            st.connected = True
+            st.ever_connected = True
+            st.pid = int(hello.get("pid", -1))
+            st.last_heartbeat = time.monotonic()
+            self._membership.notify_all()
+        if rejoin:
+            self._event(
+                "flock.actor_rejoined",
+                actor_id=actor_id,
+                generation=st.generation,
+                weight_version=self._weight_version,
+            )
+        else:
+            self._event("flock.actor_joined", actor_id=actor_id, pid=st.pid)
+
+    def _deregister(self, actor_id: int) -> None:
+        with self._lock:
+            st = self._actors[actor_id]
+            was = st.connected
+            st.connected = False
+        if was:
+            self._event(
+                "flock.actor_disconnected",
+                actor_id=actor_id,
+                rows=st.rows,
+                env_steps=st.env_steps,
+            )
+
+    def _handle_push(self, conn, actor_id: int, payload: bytes) -> None:
+        ops, meta = unpack_push(payload)
+        rows = int(meta.get("rows") or 0)
+        if self.mode == "buffer":
+            shard = self._shards[actor_id]
+            with self._shard_locks[actor_id]:
+                for tree, indices in ops:
+                    shard.add(tree, indices=indices)
+        else:
+            with self._lock:
+                q = self._chunks[actor_id]
+                cap = self._chunk_cap.get(actor_id)
+                if cap is None and rows:
+                    cap = max(2, self.capacity_rows // rows)
+                    self._chunk_cap[actor_id] = cap
+                if cap and len(q) >= cap:
+                    q.popleft()
+                    self._chunks_dropped += 1
+                for tree, _ in ops:
+                    q.append(tree)
+                self._chunk_ready.notify_all()
+        with self._lock:
+            st = self._actors[actor_id]
+            st.rows += rows
+            st.env_steps = int(meta.get("env_steps") or st.env_steps)
+            st.weight_version = int(
+                meta.get("weight_version", st.weight_version)
+            )
+            st.last_heartbeat = time.monotonic()
+            self._rows_total += rows
+            reply = {
+                "rows_total": self._rows_total,
+                "random_phase": self._random_phase,
+                "weight_version": self._weight_version,
+            }
+        wire.send_json(conn, wire.PUSH_OK, reply)
+
+    def _handle_heartbeat(self, conn, actor_id: int, payload: bytes) -> None:
+        hb = json.loads(payload.decode())
+        with self._lock:
+            st = self._actors[actor_id]
+            st.last_heartbeat = time.monotonic()
+            st.env_steps = int(hb.get("env_steps", st.env_steps))
+            st.weight_version = int(hb.get("weight_version", st.weight_version))
+            st.sps = float(hb.get("sps", st.sps))
+            reply = {
+                "random_phase": self._random_phase,
+                "weight_version": self._weight_version,
+            }
+        wire.send_json(conn, wire.HEARTBEAT_OK, reply)
+
+    # -- learner side ---------------------------------------------------------
+
+    def publish(self, leaves) -> int:
+        """Snapshot a new weight version from flattened model leaves. The
+        device->host pull and the byte packing happen ONCE here; every
+        actor pull then reuses the cached frame."""
+        from ..data.wire import pack_leaves
+
+        host_leaves = [np.asarray(leaf) for leaf in leaves]
+        blob = pack_leaves(host_leaves)
+        with self._lock:
+            self._weight_version += 1
+            version = self._weight_version
+            meta = json.dumps({"version": version}).encode()
+            self._weight_payload = _U32.pack(len(meta)) + meta + blob
+            self._publish_ts[version] = time.monotonic()
+            # keep the timestamp map bounded
+            for old in [v for v in self._publish_ts if v < version - 64]:
+                del self._publish_ts[old]
+        return version
+
+    @property
+    def weight_version(self) -> int:
+        return self._weight_version
+
+    def set_random_phase(self, flag: bool) -> None:
+        with self._lock:
+            self._random_phase = bool(flag)
+
+    def wait_for_actors(self, n: int | None = None, timeout: float = 60.0) -> bool:
+        """Block until n actors (default: all) have registered."""
+        want = self.n_actors if n is None else n
+        deadline = time.monotonic() + timeout
+        with self._membership:
+            while self.actors_alive() < want:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._stop.is_set():
+                    return False
+                self._membership.wait(timeout=min(left, 0.5))
+        return True
+
+    def actors_alive(self) -> int:
+        return sum(1 for st in self._actors.values() if st.connected)
+
+    def next_chunk(self, timeout: float | None = None):
+        """Chunks mode: pop the next rollout chunk, round-robin across
+        actors so one fast actor cannot starve the rest. None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._chunk_ready:
+            while True:
+                ids = sorted(self._chunks)
+                for k in range(len(ids)):
+                    aid = ids[(self._drain_order + k) % len(ids)]
+                    if self._chunks[aid]:
+                        self._drain_order = (ids.index(aid) + 1) % len(ids)
+                        return self._chunks[aid].popleft()
+                if self._stop.is_set():
+                    return None
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return None
+                self._chunk_ready.wait(timeout=0.5 if left is None else min(left, 0.5))
+
+    def sample(self, batch_size: int, **kw):
+        """Buffer mode: partition the batch across shards that can serve it
+        and concatenate — local calls only, no socket. Shards still warming
+        up (or disconnected mid-fill) are skipped; the batch re-partitions
+        over the rest."""
+        ready = sorted(self._shards)
+        counts = [batch_size // len(ready)] * len(ready)
+        for i in range(batch_size % len(ready)):
+            counts[i] += 1
+        parts, served, missing = [], [], 0
+        for aid, n in zip(ready, counts):
+            if n == 0:
+                continue
+            with self._shard_locks[aid]:
+                try:
+                    parts.append(self._shards[aid].sample(n, **kw))
+                    served.append(aid)
+                except (ValueError, RuntimeError):
+                    missing += n
+        if not parts:
+            # the partition may have skipped (n == 0) the only shard with
+            # data — e.g. batch_size < n_actors early in the run. Any single
+            # shard that can serve the WHOLE batch keeps training moving.
+            for aid in ready:
+                with self._shard_locks[aid]:
+                    try:
+                        return self._shards[aid].sample(batch_size, **kw)
+                    except (ValueError, RuntimeError):
+                        continue
+            raise RuntimeError("no flock shard could serve the sample request")
+        if missing:
+            # a shard still warming up drops out; its slice tops up from a
+            # shard that CAN serve, so the batch shape never shrinks (the
+            # train jit's aval is part of the warm-compile contract)
+            aid = served[0]
+            with self._shard_locks[aid]:
+                parts.append(self._shards[aid].sample(missing, **kw))
+        axis = 2 if "sequence_length" in kw else 0
+        return {
+            k: np.concatenate([p[k] for p in parts], axis=axis)
+            for k in parts[0]
+        }
+
+    def rows_total(self) -> int:
+        return self._rows_total
+
+    def shard(self, actor_id: int):
+        return self._shards.get(actor_id)
+
+    # -- observability --------------------------------------------------------
+
+    def gauges(self) -> dict[str, float]:
+        now = time.monotonic()
+        with self._lock:
+            out: dict[str, float] = {
+                "Flock/actors_alive": float(self.actors_alive()),
+                "Flock/weight_version": float(self._weight_version),
+                "Flock/rows_total": float(self._rows_total),
+                "Flock/chunks_dropped": float(self._chunks_dropped),
+            }
+            for aid, st in self._actors.items():
+                if not st.ever_connected:
+                    continue
+                prefix = f"Flock/actor{aid}"
+                lag = max(0, self._weight_version - max(st.weight_version, 0))
+                # staleness: how long ago the version this actor acts with
+                # stopped being current (0 while it holds the latest)
+                if lag == 0:
+                    staleness = 0.0
+                else:
+                    superseded = self._publish_ts.get(
+                        max(st.weight_version, 0) + 1
+                    )
+                    staleness = 0.0 if superseded is None else now - superseded
+                if self.mode == "buffer":
+                    fill = min(st.rows, self.capacity_rows) / max(
+                        self.capacity_rows, 1
+                    )
+                else:
+                    cap = self._chunk_cap.get(aid, 0)
+                    fill = len(self._chunks[aid]) / cap if cap else 0.0
+                out[f"{prefix}/env_steps_s"] = float(st.sps)
+                out[f"{prefix}/env_steps"] = float(st.env_steps)
+                out[f"{prefix}/weight_version"] = float(st.weight_version)
+                out[f"{prefix}/version_lag"] = float(lag)
+                out[f"{prefix}/staleness_s"] = float(staleness)
+                out[f"{prefix}/shard_fill"] = float(fill)
+                out[f"{prefix}/heartbeat_age_s"] = (
+                    float(now - st.last_heartbeat) if st.last_heartbeat else -1.0
+                )
+                out[f"{prefix}/connected"] = float(st.connected)
+                out[f"{prefix}/generation"] = float(st.generation)
+        return out
+
+    def _event(self, name: str, **data) -> None:
+        if self._telem is not None:
+            self._telem.event(name, **data)
+        else:
+            telemetry.emit(name, **data)

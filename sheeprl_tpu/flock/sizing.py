@@ -1,0 +1,96 @@
+"""Per-actor replay shard capacities derived from the committed sheepmem
+ledger (`analysis/budget/<spec>.json`, the PR-10 static memory analysis).
+
+Policy: the host-side replay tier for a flock run gets a byte budget that
+scales with the task's measured device working set — the ledger's largest
+`peak_bytes` entry (in practice the train step) times a host multiplier —
+so a task whose update footprint grew (bigger models, longer sequences)
+automatically gets a proportionally deeper replay tier, and the number is
+a MEASURED artifact of the committed ledger rather than a magic constant.
+The budget is split evenly across actors and converted to rows through
+the actual packed row width (`data.wire.tree_nbytes` of one row-tree).
+
+Environment overrides:
+
+    SHEEPRL_TPU_FLOCK_SHARD_BYTES    total byte budget across all shards
+                                     (wins over the ledger)
+    SHEEPRL_TPU_FLOCK_HOST_FACTOR    ledger peak -> host budget multiplier
+                                     (default 64: host RAM is plentiful
+                                     next to HBM)
+
+Everything here is deterministic: same ledger + same env -> same
+capacities, so two runs of the same spec shard identically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+__all__ = ["ledger_peak_bytes", "shard_capacity"]
+
+# repo root: sheeprl_tpu/flock/sizing.py -> sheeprl_tpu/flock -> sheeprl_tpu -> repo
+_REPO = Path(__file__).resolve().parents[2]
+_BUDGET_DIR = _REPO / "analysis" / "budget"
+
+_DEFAULT_HOST_FACTOR = 64
+# never size a shard below something trainable, never above a cap that
+# would dwarf the in-process defaults
+_FLOOR_ROWS = 64
+_CEIL_ROWS = 1_000_000
+
+
+def ledger_peak_bytes(spec: str) -> int | None:
+    """Largest `peak_bytes` in `analysis/budget/<spec>.json`'s memory
+    section, or None when the spec has no committed ledger (new task,
+    stripped checkout) — callers fall back to a fixed budget."""
+    path = _BUDGET_DIR / f"{spec}.json"
+    try:
+        with open(path) as fh:
+            ledger = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    peaks = [
+        int(rec["peak_bytes"])
+        for rec in ledger.get("memory", {}).values()
+        if isinstance(rec, dict) and "peak_bytes" in rec
+    ]
+    return max(peaks) if peaks else None
+
+
+def shard_capacity(
+    spec: str,
+    n_actors: int,
+    row_nbytes: int,
+    *,
+    floor_rows: int = _FLOOR_ROWS,
+    ceil_rows: int = _CEIL_ROWS,
+    fallback_budget_bytes: int = 256 * 1024 * 1024,
+) -> int:
+    """Rows per actor shard for `spec` split over `n_actors` actors.
+
+    `row_nbytes` is the packed width of ONE buffer row (one env-step across
+    the actor's envs) — compute it with `data.wire.tree_nbytes` on a real
+    row-tree so dtype/shape changes reprice the shard automatically.
+    """
+    if n_actors <= 0:
+        raise ValueError(f"n_actors must be positive, got {n_actors}")
+    if row_nbytes <= 0:
+        raise ValueError(f"row_nbytes must be positive, got {row_nbytes}")
+    override = os.environ.get("SHEEPRL_TPU_FLOCK_SHARD_BYTES")
+    if override:
+        total = int(override)
+    else:
+        peak = ledger_peak_bytes(spec)
+        if peak is None:
+            total = fallback_budget_bytes
+        else:
+            factor = int(
+                os.environ.get(
+                    "SHEEPRL_TPU_FLOCK_HOST_FACTOR", _DEFAULT_HOST_FACTOR
+                )
+            )
+            total = peak * factor
+    rows = total // (n_actors * row_nbytes)
+    return int(min(max(rows, floor_rows), ceil_rows))

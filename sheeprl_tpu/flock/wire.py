@@ -1,0 +1,179 @@
+"""Flock socket protocol: length-prefixed frames over localhost TCP or a
+Unix-domain socket. Pickle-free end to end — control payloads are JSON,
+bulk payloads are `data/wire.py` trees (width-class packed, bit-exact).
+
+Frame layout (little-endian):
+
+    magic(4) = b"FLK1" | kind(1) | flags(1) | reserved(2) | length(8)
+    payload[length]
+
+Kinds (actor -> service unless noted):
+
+    HELLO       JSON {actor_id, pid, role, proto}
+    WELCOME     (service) JSON {actor_id, shard_capacity, weight_version,
+                                random_phase, generation}
+    PUSH        u32 n_ops, then per op: u32 meta_len | meta_json
+                | u64 blob_len | pack_tree blob.
+                op meta: {indices: [..]|null}; frame-level trailing JSON
+                rides in the first op's meta: {rows, env_steps,
+                weight_version}
+    PUSH_OK     (service) JSON {rows_total, random_phase, weight_version}
+    HEARTBEAT   JSON {actor_id, env_steps, weight_version, sps}
+    HEARTBEAT_OK(service) JSON {random_phase, weight_version}
+    GET_WEIGHTS JSON {have_version}
+    WEIGHTS     (service) u32 meta_len | {version} | pack_leaves blob
+    WEIGHTS_UNCHANGED (service) JSON {version}
+    BYE         JSON {actor_id}
+    ERROR       (either) JSON {error}
+
+Transport addresses serialize as `tcp:HOST:PORT` or `unix:PATH` — one
+string, environment-variable friendly for actor subprocesses.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+__all__ = [
+    "MAGIC",
+    "MAX_FRAME_BYTES",
+    "FrameError",
+    "connect",
+    "format_address",
+    "parse_address",
+    "recv_frame",
+    "recv_json",
+    "send_frame",
+    "send_json",
+]
+
+MAGIC = b"FLK1"
+_HEADER = struct.Struct("<4sBBHQ")
+# a pushed chunk is rollout-sized, weights are model-sized; 1 GiB is far
+# above both and guards against a corrupt length field allocating the moon
+MAX_FRAME_BYTES = 1 << 30
+
+# frame kinds
+HELLO = 1
+WELCOME = 2
+PUSH = 3
+PUSH_OK = 4
+HEARTBEAT = 5
+HEARTBEAT_OK = 6
+GET_WEIGHTS = 7
+WEIGHTS = 8
+WEIGHTS_UNCHANGED = 9
+BYE = 10
+ERROR = 11
+
+KIND_NAMES = {
+    HELLO: "hello",
+    WELCOME: "welcome",
+    PUSH: "push",
+    PUSH_OK: "push_ok",
+    HEARTBEAT: "heartbeat",
+    HEARTBEAT_OK: "heartbeat_ok",
+    GET_WEIGHTS: "get_weights",
+    WEIGHTS: "weights",
+    WEIGHTS_UNCHANGED: "weights_unchanged",
+    BYE: "bye",
+    ERROR: "error",
+}
+
+
+class FrameError(ConnectionError):
+    """Malformed frame or protocol violation on a flock socket."""
+
+
+def send_frame(sock: socket.socket, kind: int, payload: bytes = b"") -> None:
+    sock.sendall(_HEADER.pack(MAGIC, kind, 0, 0, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    """Exactly n bytes, or None on clean EOF at a frame boundary."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise FrameError("connection closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> tuple[int, bytes] | None:
+    """-> (kind, payload), or None on clean EOF (peer went away)."""
+    header = _recv_exact(sock, _HEADER.size)
+    if header is None:
+        return None
+    magic, kind, _flags, _rsvd, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} exceeds cap")
+    payload = _recv_exact(sock, length) if length else b""
+    if length and payload is None:
+        raise FrameError("connection closed before frame payload")
+    return kind, payload or b""
+
+
+def send_json(sock: socket.socket, kind: int, obj: dict) -> None:
+    send_frame(sock, kind, json.dumps(obj).encode())
+
+
+def recv_json(sock: socket.socket, expected_kind: int) -> dict:
+    frame = recv_frame(sock)
+    if frame is None:
+        raise FrameError("connection closed awaiting reply")
+    kind, payload = frame
+    if kind == ERROR:
+        raise FrameError(
+            f"peer error: {json.loads(payload.decode()).get('error')}"
+        )
+    if kind != expected_kind:
+        raise FrameError(
+            f"expected {KIND_NAMES.get(expected_kind)}, got {KIND_NAMES.get(kind, kind)}"
+        )
+    return json.loads(payload.decode())
+
+
+# ---------------------------------------------------------------------------
+# addresses
+# ---------------------------------------------------------------------------
+
+
+def format_address(kind: str, *parts) -> str:
+    if kind == "tcp":
+        host, port = parts
+        return f"tcp:{host}:{port}"
+    if kind == "unix":
+        (path,) = parts
+        return f"unix:{path}"
+    raise ValueError(f"unknown transport {kind!r}")
+
+
+def parse_address(addr: str):
+    """-> ('tcp', host, port) | ('unix', path)."""
+    if addr.startswith("tcp:"):
+        host, _, port = addr[4:].rpartition(":")
+        return ("tcp", host, int(port))
+    if addr.startswith("unix:"):
+        return ("unix", addr[5:])
+    raise ValueError(f"unparseable flock address {addr!r}")
+
+
+def connect(addr: str, timeout: float | None = None) -> socket.socket:
+    parsed = parse_address(addr)
+    if parsed[0] == "tcp":
+        sock = socket.create_connection(parsed[1:], timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    else:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(parsed[1])
+    return sock

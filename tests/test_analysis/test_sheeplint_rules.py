@@ -670,10 +670,93 @@ def test_sl012_suppression_with_justification():
     assert ids(src) == []
 
 
+# ---------------------------------------------------------------------------
+# SL013 — device arrays reaching serialization/socket sinks (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def test_sl013_positive_tobytes_send_and_pickle():
+    src = """
+    import pickle
+    import jax
+    import jax.numpy as jnp
+
+    def push(sock, state):
+        x = jnp.zeros((4,))
+        blob = x.tobytes()
+        sock.sendall(x)
+        leaves = jax.tree_util.tree_leaves(state)
+        pickle.dumps(leaves)
+        sock.send(jnp.ones(3))
+    """
+    assert ids(src) == ["SL013"] * 4
+
+
+def test_sl013_positive_through_views_and_rebinds():
+    src = """
+    import jax.numpy as jnp
+
+    def f(sock):
+        x = jnp.zeros((4, 2))
+        y = x
+        sock.sendall(y[0])
+        row = x[1]
+        sock.send_bytes(row)
+    """
+    assert ids(src) == ["SL013", "SL013"]
+
+
+def test_sl013_negative_host_pull_clears_taint():
+    src = """
+    import pickle
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    def push(sock, state):
+        x = jnp.zeros((4,))
+        host = np.asarray(x)
+        sock.sendall(host.tobytes())
+        x = np.ascontiguousarray(x)
+        sock.send(x.tobytes())
+        leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(state)]
+        pickle.dumps(leaves)
+        pulled = jax.device_get(jnp.ones(3))
+        sock.sendto(pulled, ("h", 1))
+        sock.sendall(np.zeros(3).tobytes())
+    """
+    assert ids(src) == []
+
+
+def test_sl013_taint_is_per_scope_and_ordered():
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    x = jnp.zeros(3)
+
+    def clean(sock):
+        x = np.zeros(3)  # shadows: this scope's x is host-side
+        sock.sendall(x.tobytes())
+    """
+    assert ids(src) == []
+
+
+def test_sl013_suppression_with_justification():
+    src = """
+    import jax.numpy as jnp
+
+    def f(sock):
+        x = jnp.zeros(3)
+        sock.sendall(x)  # sheeplint: disable=SL013 — intentional device send
+    """
+    assert ids(src) == []
+
+
 def test_rule_catalog_complete():
     assert rule_ids() == [
         "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
-        "SL008", "SL009", "SL010", "SL011", "SL012",
+        "SL008", "SL009", "SL010", "SL011", "SL012", "SL013",
     ]
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")

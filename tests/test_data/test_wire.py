@@ -1,0 +1,200 @@
+"""Bit-exact receipts for the wire layer (ISSUE 14 satellite): the
+`pack_tree`/`unpack_tree` framing primitives and every buffer class's
+versioned pickle-free `to_bytes()/from_bytes()` round-trip, including the
+sampler PRNG state — a restored buffer continues the EXACT sample stream
+the source would have drawn."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import (
+    AsyncReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+from sheeprl_tpu.data.wire import (
+    WireFormatError,
+    pack_leaves,
+    pack_tree,
+    unpack_leaves,
+    unpack_tree,
+)
+
+
+def bits_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return (
+        a.dtype == b.dtype
+        and a.shape == b.shape
+        and a.tobytes() == b.tobytes()
+    )
+
+
+# ---------------------------------------------------------------------------
+# framing primitives
+# ---------------------------------------------------------------------------
+
+
+def test_pack_tree_roundtrip_mixed_dtypes():
+    rng = np.random.default_rng(0)
+    tree = {
+        "f32": rng.normal(size=(3, 2, 4)).astype(np.float32),
+        "u8": rng.integers(0, 255, size=(5, 2), dtype=np.uint8),
+        "i32": rng.integers(-(2**30), 2**30, size=(7,), dtype=np.int32),
+        "bool": rng.integers(0, 2, size=(4, 3)).astype(np.bool_),
+        "f16": rng.normal(size=(2, 2)).astype(np.float16),
+        "i64": rng.integers(-(2**60), 2**60, size=(3,), dtype=np.int64),
+        "f64": rng.normal(size=(2, 5)),
+        "scalarish": np.float32(3.25).reshape(()),
+    }
+    out = unpack_tree(pack_tree(tree))
+    assert set(out) == set(tree)
+    for k in tree:
+        assert bits_equal(tree[k], out[k]), k
+    # restored arrays must be writable (frombuffer views are not)
+    out["f32"][0, 0, 0] = 1.0
+
+
+def test_pack_tree_preserves_nan_payloads():
+    # arbitrary NaN bit patterns must survive: the int carrier guarantees
+    # no canonicalization anywhere on the wire
+    weird = np.array([0x7FC00001, 0xFFC12345, 0x7F800000], np.uint32).view(
+        np.float32
+    )
+    out = unpack_tree(pack_tree({"x": weird}))
+    assert out["x"].view(np.uint32).tolist() == weird.view(np.uint32).tolist()
+
+
+def test_pack_leaves_roundtrip_preserves_order():
+    leaves = [
+        np.arange(6, dtype=np.float32).reshape(2, 3),
+        np.array([True, False]),
+        np.arange(4, dtype=np.int64),
+    ]
+    out = unpack_leaves(pack_leaves(leaves))
+    assert len(out) == 3
+    for a, b in zip(leaves, out):
+        assert bits_equal(a, b)
+
+
+def test_wire_rejects_garbage():
+    with pytest.raises(WireFormatError):
+        unpack_tree(b"NOPE" + b"\x00" * 16)
+    with pytest.raises(WireFormatError):
+        unpack_leaves(b"XXXX")
+    with pytest.raises(WireFormatError):
+        # valid magic, truncated header
+        unpack_tree(pack_tree({"a": np.zeros(4, np.float32)})[:10])
+
+
+# ---------------------------------------------------------------------------
+# buffer round-trips
+# ---------------------------------------------------------------------------
+
+
+def fill_rows(t, n_envs, rng):
+    return {
+        "observations": rng.normal(size=(t, n_envs, 3)).astype(np.float32),
+        "actions": rng.integers(0, 4, size=(t, n_envs, 1)).astype(np.float32),
+        "rewards": rng.normal(size=(t, n_envs, 1)).astype(np.float32),
+        "dones": (rng.random((t, n_envs, 1)) < 0.1).astype(np.float32),
+    }
+
+
+def assert_same_sample_stream(src, dst, **kw):
+    a, b = src.sample(4, **kw), dst.sample(4, **kw)
+    assert set(a) == set(b)
+    for k in a:
+        assert bits_equal(a[k], b[k]), k
+
+
+@pytest.mark.parametrize("storage", ["device", "host"])
+def test_replay_buffer_roundtrip(storage):
+    rng = np.random.default_rng(1)
+    rb = ReplayBuffer(8, n_envs=2, storage=storage, seed=3)
+    rb.add(fill_rows(5, 2, rng))
+    rb.sample(2)  # advance the sampler stream past its seed state
+    blob = rb.to_bytes()
+    out = ReplayBuffer.from_bytes(blob, storage="host")
+    assert out.pos == rb.pos and out.full == rb.full
+    for k in rb.buffer:
+        assert bits_equal(rb[k], out[k]), k
+    # stream equality requires the same sampling path (device storage draws
+    # from the jax key, host from the numpy rng — both restore, but compare
+    # like with like)
+    same = ReplayBuffer.from_bytes(blob, storage=storage)
+    assert_same_sample_stream(rb, same)
+
+
+def test_sequential_replay_buffer_roundtrip():
+    rng = np.random.default_rng(2)
+    rb = SequentialReplayBuffer(16, n_envs=2, storage="host", seed=5)
+    rb.add(fill_rows(12, 2, rng))
+    blob = rb.to_bytes()
+    out = SequentialReplayBuffer.from_bytes(blob, storage="host")
+    assert_same_sample_stream(rb, out, sequence_length=4, n_samples=2)
+
+
+def test_class_name_is_checked():
+    rb = ReplayBuffer(4, storage="host")
+    rb.add(fill_rows(2, 1, np.random.default_rng(0)))
+    with pytest.raises(WireFormatError):
+        SequentialReplayBuffer.from_bytes(rb.to_bytes())
+
+
+def test_empty_buffer_roundtrip():
+    rb = ReplayBuffer(4, n_envs=2, storage="host")
+    out = ReplayBuffer.from_bytes(rb.to_bytes())
+    assert out.buffer is None and out.pos == 0 and not out.full
+
+
+def test_episode_buffer_roundtrip():
+    rng = np.random.default_rng(3)
+    eb = EpisodeBuffer(64, sequence_length=4, seed=7)
+    for ep_len in (6, 9, 5):
+        dones = np.zeros((ep_len, 1), np.float32)
+        dones[-1] = 1.0
+        eb.add(
+            {
+                "observations": rng.normal(size=(ep_len, 3)).astype(np.float32),
+                "dones": dones,
+            }
+        )
+    eb.sample(2)
+    out = EpisodeBuffer.from_bytes(eb.to_bytes())
+    assert len(out.buffer) == len(eb.buffer)
+    for src_ep, dst_ep in zip(eb.buffer, out.buffer):
+        for k in src_ep:
+            assert bits_equal(src_ep[k], dst_ep[k]), k
+    assert_same_sample_stream(eb, out, n_samples=2)
+
+
+@pytest.mark.parametrize("storage", ["device", "host"])
+def test_async_replay_buffer_roundtrip(storage):
+    rng = np.random.default_rng(4)
+    rb = AsyncReplayBuffer(
+        16, n_envs=3, storage=storage, sequential=True, seed=9
+    )
+    rb.add(fill_rows(10, 3, rng))
+    rb.add(fill_rows(2, 2, rng), indices=[0, 2])
+    blob = rb.to_bytes()
+    out = AsyncReplayBuffer.from_bytes(blob, storage="host")
+    assert out.n_envs == rb.n_envs
+    src_st, dst_st = rb.to_state_dict(), out.to_state_dict()
+    for s, d in zip(src_st["buffers"], dst_st["buffers"]):
+        assert s["pos"] == d["pos"] and s["full"] == d["full"]
+        for k in s["buf"] or {}:
+            assert bits_equal(s["buf"][k], d["buf"][k]), k
+    if storage == "host":
+        # full sampler state (incl. per-env sub-states) restores: the next
+        # draws from source and restored copies are identical
+        assert_same_sample_stream(rb, out, sequence_length=3, n_samples=2)
+
+
+def test_replay_buffer_roundtrip_preserves_nan_payload_rows():
+    rb = ReplayBuffer(4, n_envs=1, storage="host")
+    rows = np.array([0x7FC00001, 0x7FC00002], np.uint32).view(np.float32)
+    rb.add({"observations": rows.reshape(2, 1, 1)})
+    out = ReplayBuffer.from_bytes(rb.to_bytes())
+    assert bits_equal(rb["observations"], out["observations"])

@@ -1,0 +1,97 @@
+"""End-to-end flock runs: `--flock off` bit-exactness and short `--flock 2`
+CPU runs for both supported algorithms (ISSUE 14 acceptance receipts)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+from sheeprl_tpu.utils.registry import tasks
+import sheeprl_tpu.algos  # noqa: F401 - fire registrations
+
+
+def _ppo_argv(tmp_path, run_name, extra=()):
+    return [
+        "--env_id", "CartPole-v1",
+        "--dry_run",
+        "--num_envs", "1",
+        "--rollout_steps", "8",
+        "--per_rank_batch_size", "4",
+        "--update_epochs", "1",
+        "--dense_units", "8",
+        "--mlp_layers", "1",
+        "--cnn_features_dim", "16",
+        "--mlp_features_dim", "8",
+        "--root_dir", str(tmp_path),
+        "--run_name", run_name,
+        *extra,
+    ]
+
+
+def test_flock_flag_validation():
+    from sheeprl_tpu.algos.ppo.args import PPOArgs
+
+    with pytest.raises(ValueError, match="flock"):
+        PPOArgs(flock="many")
+    with pytest.raises(ValueError, match="flock"):
+        PPOArgs(flock="0")
+    assert PPOArgs(flock="2").flock == "2"
+    # actors run host envs: the Anakin backend has no actor processes
+    with pytest.raises(ValueError, match="flock"):
+        tasks["ppo"](["--flock", "2", "--env_backend", "jax", "--dry_run"])
+
+
+@pytest.mark.timeout(300)
+def test_ppo_flock_off_is_bit_exact_vs_default(tmp_path):
+    """The acceptance parity receipt: an explicit --flock off run is
+    bitwise-identical to a run with no flag at all — the flock wiring must
+    not perturb the in-process path."""
+    import jax
+
+    tasks["ppo"](_ppo_argv(tmp_path, "default"))
+    tasks["ppo"](_ppo_argv(tmp_path, "flock_off", extra=("--flock", "off")))
+    a = load_checkpoint(str(tmp_path / "default" / "checkpoints" / "ckpt_1"))
+    b = load_checkpoint(str(tmp_path / "flock_off" / "checkpoints" / "ckpt_1"))
+    leaves_a = jax.tree_util.tree_leaves(a["agent"])
+    leaves_b = jax.tree_util.tree_leaves(b["agent"])
+    assert len(leaves_a) == len(leaves_b)
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+@pytest.mark.timeout(600)
+def test_ppo_flock_two_actors_dry_run(tmp_path):
+    tasks["ppo"](_ppo_argv(tmp_path, "flock2", extra=("--flock", "2")))
+    ckpt_dir = tmp_path / "flock2" / "checkpoints"
+    state = load_checkpoint(str(ckpt_dir / "ckpt_1"))
+    assert set(state.keys()) == {"agent", "optimizer", "update_step"}
+    telemetry = (tmp_path / "flock2" / "telemetry.jsonl").read_text()
+    assert '"flock.started"' in telemetry
+    assert telemetry.count('"flock.actor_joined"') == 2
+    assert '"Flock/actors_alive"' in telemetry
+    # both actor log files exist (spawned subprocess receipts)
+    logs = sorted(os.listdir(tmp_path / "flock2" / "flock"))
+    assert logs == ["actor0.log", "actor1.log"]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_dreamer_v3_flock_two_actors_dry_run(tmp_path):
+    tasks["dreamer_v3"](
+        [
+            "--dry_run", "--num_devices=1", "--num_envs=1", "--sync_env",
+            "--per_rank_batch_size=1", "--per_rank_sequence_length=1",
+            "--buffer_size=4", "--learning_starts=0", "--gradient_steps=1",
+            "--horizon=4", "--dense_units=8", "--cnn_channels_multiplier=2",
+            "--recurrent_state_size=8", "--hidden_size=8",
+            "--stochastic_size=4", "--discrete_size=4", "--mlp_layers=1",
+            "--train_every=1", "--checkpoint_every=1",
+            "--env_id=discrete_dummy", f"--root_dir={tmp_path}",
+            "--run_name=flock2", "--cnn_keys", "rgb", "--flock", "2",
+        ]
+    )
+    ckpt_dir = tmp_path / "flock2" / "checkpoints"
+    assert any(e.startswith("ckpt_") for e in sorted(os.listdir(ckpt_dir)))
+    telemetry = (tmp_path / "flock2" / "telemetry.jsonl").read_text()
+    assert telemetry.count('"flock.actor_joined"') == 2

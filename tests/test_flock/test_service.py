@@ -1,0 +1,277 @@
+"""ReplayService behavior over real sockets: membership, elastic rejoin,
+chunk draining, buffer sampling, weight distribution, gauges (ISSUE 14)."""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.wire import unpack_leaves
+from sheeprl_tpu.flock import wire
+from sheeprl_tpu.flock.service import PROTO_VERSION, ReplayService, pack_push
+
+
+class _Recorder:
+    """Stands in for the learner Telemetry: records service events."""
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, name, **data):
+        self.events.append((name, data))
+
+    def names(self):
+        return [n for n, _ in self.events]
+
+
+class _FakeActor:
+    """Speaks the data-connection protocol from the test thread."""
+
+    def __init__(self, address, actor_id):
+        self.sock = wire.connect(address, timeout=5.0)
+        wire.send_json(
+            self.sock,
+            wire.HELLO,
+            {"actor_id": actor_id, "pid": 123, "role": "data", "proto": PROTO_VERSION},
+        )
+        self.welcome = wire.recv_json(self.sock, wire.WELCOME)
+
+    def push(self, tree, *, rows, env_steps=0, weight_version=0, indices=None):
+        payload = pack_push(
+            [(tree, indices)],
+            rows=rows,
+            env_steps=env_steps,
+            weight_version=weight_version,
+        )
+        wire.send_frame(self.sock, wire.PUSH, payload)
+        return wire.recv_json(self.sock, wire.PUSH_OK)
+
+    def heartbeat(self, **hb):
+        wire.send_json(self.sock, wire.HEARTBEAT, hb)
+        return wire.recv_json(self.sock, wire.HEARTBEAT_OK)
+
+    def bye(self):
+        wire.send_json(self.sock, wire.BYE, {})
+        self.sock.close()
+
+
+def _chunk(v=0.0, rows=4):
+    return {
+        "obs": np.full((rows + 1, 1, 3), v, np.float32),
+        "dones": np.zeros((rows + 1, 1, 1), np.float32),
+    }
+
+
+def _wait_events(rec, name, n=1, timeout=5.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while rec.names().count(name) < n:
+        if time.monotonic() > deadline:
+            raise AssertionError(f"never saw {n}x {name}: {rec.names()}")
+        time.sleep(0.01)
+
+
+@pytest.mark.timeout(60)
+@pytest.mark.parametrize("transport", ["unix", "tcp"])
+def test_membership_join_heartbeat_bye(transport):
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=64,
+        transport=transport, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        assert addr.startswith(transport + ":")
+        a0 = _FakeActor(addr, 0)
+        assert a0.welcome["generation"] == 0
+        assert a0.welcome["shard_capacity"] == 64
+        assert svc.wait_for_actors(n=1, timeout=5.0)
+        assert not svc.wait_for_actors(n=2, timeout=0.2)  # a1 not here yet
+        a1 = _FakeActor(addr, 1)
+        assert svc.wait_for_actors(timeout=5.0)
+        assert svc.actors_alive() == 2
+        hb = a1.heartbeat(env_steps=40, weight_version=0, sps=10.0)
+        assert hb["weight_version"] == 0
+        a0.bye()
+        a1.bye()
+        _wait_events(rec, "flock.actor_disconnected", n=2)
+        assert svc.actors_alive() == 0
+    assert rec.names().count("flock.actor_joined") == 2
+
+
+@pytest.mark.timeout(60)
+def test_rejoin_bumps_generation_and_emits_receipt():
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        a = _FakeActor(addr, 0)
+        a.push(_chunk(1.0), rows=4)
+        a.sock.close()  # simulate SIGKILL: no BYE, just a dead connection
+        _wait_events(rec, "flock.actor_disconnected")
+        assert svc.actors_alive() == 0
+        # respawned process reconnects under the same id
+        b = _FakeActor(addr, 0)
+        assert b.welcome["generation"] == 1
+        assert svc.actors_alive() == 1
+        b.bye()
+    joined = [n for n in rec.names() if n.startswith("flock.actor_")]
+    assert "flock.actor_rejoined" in joined
+    assert joined.index("flock.actor_joined") < joined.index("flock.actor_rejoined")
+
+
+@pytest.mark.timeout(60)
+def test_bad_hello_rejected():
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=8, telem=_Recorder(),
+    ) as svc:
+        addr = svc.start()
+        sock = wire.connect(addr, timeout=5.0)
+        wire.send_json(
+            sock, wire.HELLO, {"actor_id": 7, "role": "data", "proto": PROTO_VERSION}
+        )
+        with pytest.raises(wire.FrameError, match="bad hello"):
+            wire.recv_json(sock, wire.WELCOME)
+        sock.close()
+
+
+@pytest.mark.timeout(60)
+def test_chunks_round_robin_and_oldest_dropped():
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=8, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        a0, a1 = _FakeActor(addr, 0), _FakeActor(addr, 1)
+        a0.push(_chunk(0.0), rows=4)
+        a0.push(_chunk(1.0), rows=4)
+        a1.push(_chunk(10.0), rows=4)
+        assert svc.rows_total() == 12
+        # round-robin drain alternates actors while both have chunks
+        vals = [float(svc.next_chunk(timeout=5.0)["obs"][0, 0, 0]) for _ in range(3)]
+        assert set(vals) == {0.0, 1.0, 10.0}
+        assert vals[:2] in ([0.0, 10.0], [10.0, 0.0])  # one from each first
+        assert svc.next_chunk(timeout=0.1) is None
+        # queue cap = capacity_rows // rows = 2: a third undrained chunk
+        # evicts the OLDEST (on-policy data ages out)
+        a0.push(_chunk(2.0), rows=4)
+        a0.push(_chunk(3.0), rows=4)
+        a0.push(_chunk(4.0), rows=4)
+        assert svc.gauges()["Flock/chunks_dropped"] == 1.0
+        assert float(svc.next_chunk(timeout=5.0)["obs"][0, 0, 0]) == 3.0
+        a0.bye()
+        a1.bye()
+
+
+class _ListShard:
+    """Minimal stand-in for a replay buffer shard."""
+
+    def __init__(self, cap):
+        self.cap = cap
+        self.rows = []
+
+    def add(self, tree, indices=None):
+        self.rows.append((tree, indices))
+
+    def sample(self, n, **kw):
+        if not self.rows:
+            raise ValueError("empty shard")
+        return {"x": np.full((n, 1), float(len(self.rows)), np.float32)}
+
+
+@pytest.mark.timeout(60)
+def test_buffer_mode_applies_ops_and_partitions_sample():
+    rec = _Recorder()
+    with ReplayService(
+        algo="dreamer_v3", n_actors=2, mode="buffer", capacity_rows=16,
+        make_shard=_ListShard, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        a0, a1 = _FakeActor(addr, 0), _FakeActor(addr, 1)
+        row = {"x": np.zeros((1, 1, 1), np.float32)}
+        a0.push(row, rows=1)
+        a0.push(row, rows=1, indices=[0])
+        a1.push(row, rows=1)
+        # ordered ops landed on the right shards, indices preserved
+        assert [idx for _, idx in svc.shard(0).rows] == [None, [0]]
+        assert len(svc.shard(1).rows) == 1
+        out = svc.sample(4)
+        assert out["x"].shape == (4, 1)
+        a0.bye()
+        a1.bye()
+
+
+@pytest.mark.timeout(60)
+def test_buffer_sample_tops_up_from_serving_shard():
+    """A warming-up (empty) shard must not shrink the batch — its slice is
+    re-served from a shard that has data; only all-empty raises."""
+    with ReplayService(
+        algo="dreamer_v3", n_actors=2, mode="buffer", capacity_rows=16,
+        make_shard=_ListShard, telem=_Recorder(),
+    ) as svc:
+        svc.start()
+        with pytest.raises(RuntimeError, match="no flock shard"):
+            svc.sample(4)
+        svc.shard(1).add({"x": np.zeros((1,), np.float32)})
+        # shard 0 empty: batch_size=1 would partition [1, 0] — the fallback
+        # must find shard 1; batch_size=4 tops shard 0's slice up from 1
+        assert svc.sample(1)["x"].shape == (1, 1)
+        assert svc.sample(4)["x"].shape == (4, 1)
+
+
+@pytest.mark.timeout(60)
+def test_weights_channel_versioned_pull():
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=8, telem=_Recorder(),
+    ) as svc:
+        addr = svc.start()
+        leaves = [np.arange(6, dtype=np.float32).reshape(2, 3), np.zeros(2, np.int32)]
+        assert svc.publish(leaves) == 1
+        sock = wire.connect(addr, timeout=5.0)
+        wire.send_json(
+            sock, wire.HELLO,
+            {"actor_id": 0, "role": "weights", "proto": PROTO_VERSION},
+        )
+        wire.send_json(sock, wire.GET_WEIGHTS, {"have_version": -1})
+        kind, payload = wire.recv_frame(sock)
+        assert kind == wire.WEIGHTS
+        (meta_len,) = struct.unpack_from("<I", payload)
+        meta = json.loads(payload[4 : 4 + meta_len].decode())
+        assert meta == {"version": 1}
+        out = unpack_leaves(payload[4 + meta_len :])
+        np.testing.assert_array_equal(out[0], leaves[0])
+        np.testing.assert_array_equal(out[1], leaves[1])
+        # holding the current version -> no bulk transfer
+        wire.send_json(sock, wire.GET_WEIGHTS, {"have_version": 1})
+        assert wire.recv_json(sock, wire.WEIGHTS_UNCHANGED) == {"version": 1}
+        svc.publish(leaves)
+        wire.send_json(sock, wire.GET_WEIGHTS, {"have_version": 1})
+        kind, _ = wire.recv_frame(sock)
+        assert kind == wire.WEIGHTS
+        sock.close()
+
+
+@pytest.mark.timeout(60)
+def test_gauges_track_staleness_and_fill():
+    with ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=8, telem=_Recorder(),
+    ) as svc:
+        addr = svc.start()
+        svc.publish([np.zeros(1, np.float32)])  # v1
+        a0 = _FakeActor(addr, 0)
+        a0.push(_chunk(0.0), rows=4, env_steps=4, weight_version=1)
+        g = svc.gauges()
+        assert g["Flock/actors_alive"] == 1.0
+        assert g["Flock/weight_version"] == 1.0
+        assert g["Flock/rows_total"] == 4.0
+        assert g["Flock/actor0/version_lag"] == 0.0
+        assert g["Flock/actor0/staleness_s"] == 0.0
+        assert g["Flock/actor0/shard_fill"] == 0.5  # 1 chunk of cap 2
+        assert "Flock/actor1/connected" not in g  # never joined: no row
+        svc.publish([np.zeros(1, np.float32)])  # v2: actor 0 now stale
+        g = svc.gauges()
+        assert g["Flock/actor0/version_lag"] == 1.0
+        assert g["Flock/actor0/staleness_s"] >= 0.0
+        a0.bye()

@@ -1,0 +1,38 @@
+"""Shard capacities come from the committed sheepmem ledger (ISSUE 14)."""
+
+import pytest
+
+from sheeprl_tpu.flock.sizing import ledger_peak_bytes, shard_capacity
+
+
+def test_ledger_peak_bytes_reads_committed_budget():
+    # the repo commits analysis/budget/ppo.json (PR 10); peak must be real
+    peak = ledger_peak_bytes("ppo")
+    assert peak is not None and peak > 0
+
+
+def test_shard_capacity_scales_ledger_and_splits_actors(monkeypatch):
+    monkeypatch.delenv("SHEEPRL_TPU_FLOCK_SHARD_BYTES", raising=False)
+    monkeypatch.setenv("SHEEPRL_TPU_FLOCK_HOST_FACTOR", "64")
+    one = shard_capacity("ppo", 1, 1000)
+    two = shard_capacity("ppo", 2, 1000)
+    assert one == 64 * ledger_peak_bytes("ppo") // 1000
+    assert two == one // 2  # fixed host budget split across the fleet
+
+
+def test_shard_capacity_env_override_and_clamps(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_FLOCK_SHARD_BYTES", "1000000")
+    assert shard_capacity("ppo", 2, 1000) == 500
+    # floor wins over a tiny budget; ceiling over a huge one
+    assert shard_capacity("ppo", 2, 1000, floor_rows=600) == 600
+    monkeypatch.setenv("SHEEPRL_TPU_FLOCK_SHARD_BYTES", str(10**15))
+    assert shard_capacity("ppo", 2, 1000, ceil_rows=2048) == 2048
+
+
+def test_unknown_spec_uses_fallback_budget(monkeypatch):
+    monkeypatch.delenv("SHEEPRL_TPU_FLOCK_SHARD_BYTES", raising=False)
+    assert ledger_peak_bytes("no_such_algo") is None
+    cap = shard_capacity(
+        "no_such_algo", 4, 1000, fallback_budget_bytes=4_000_000
+    )
+    assert cap == 1000

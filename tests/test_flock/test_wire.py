@@ -1,0 +1,171 @@
+"""Flock frame protocol: pickle-free length-prefixed frames (ISSUE 14)."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.flock import service as service_mod
+from sheeprl_tpu.flock import wire
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_frame_roundtrip():
+    a, b = _pair()
+    try:
+        wire.send_frame(a, wire.PUSH, b"payload-bytes")
+        kind, payload = wire.recv_frame(b)
+        assert kind == wire.PUSH
+        assert payload == b"payload-bytes"
+        # empty payload is legal (length 0)
+        wire.send_frame(a, wire.BYE)
+        assert wire.recv_frame(b) == (wire.BYE, b"")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_json_roundtrip_and_expected_kind():
+    a, b = _pair()
+    try:
+        wire.send_json(a, wire.HELLO, {"actor_id": 3, "proto": 1})
+        msg = wire.recv_json(b, wire.HELLO)
+        assert msg == {"actor_id": 3, "proto": 1}
+        wire.send_json(a, wire.HEARTBEAT, {})
+        with pytest.raises(wire.FrameError, match="expected push"):
+            wire.recv_json(b, wire.PUSH)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_error_frame_raises():
+    a, b = _pair()
+    try:
+        wire.send_json(a, wire.ERROR, {"error": "boom"})
+        with pytest.raises(wire.FrameError, match="boom"):
+            wire.recv_json(b, wire.WELCOME)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_bad_magic_and_oversize_length():
+    a, b = _pair()
+    try:
+        a.sendall(b"NOPE" + bytes(12))
+        with pytest.raises(wire.FrameError, match="magic"):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    a, b = _pair()
+    try:
+        a.sendall(
+            wire._HEADER.pack(wire.MAGIC, wire.PUSH, 0, 0, wire.MAX_FRAME_BYTES + 1)
+        )
+        with pytest.raises(wire.FrameError, match="exceeds cap"):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_clean_eof_is_none_mid_frame_raises():
+    a, b = _pair()
+    a.close()
+    try:
+        assert wire.recv_frame(b) is None  # EOF at a frame boundary
+    finally:
+        b.close()
+    a, b = _pair()
+    try:
+        a.sendall(wire._HEADER.pack(wire.MAGIC, wire.PUSH, 0, 0, 100) + b"short")
+        a.close()
+        with pytest.raises(wire.FrameError, match="closed"):
+            wire.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_address_roundtrip():
+    assert wire.parse_address(wire.format_address("tcp", "127.0.0.1", 4242)) == (
+        "tcp",
+        "127.0.0.1",
+        4242,
+    )
+    assert wire.parse_address(wire.format_address("unix", "/tmp/x.sock")) == (
+        "unix",
+        "/tmp/x.sock",
+    )
+    with pytest.raises(ValueError):
+        wire.parse_address("carrier-pigeon:coop7")
+
+
+def test_connect_tcp_and_unix(tmp_path):
+    # tcp
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    addr = wire.format_address("tcp", "127.0.0.1", srv.getsockname()[1])
+    got = {}
+
+    def _accept():
+        conn, _ = srv.accept()
+        got["frame"] = wire.recv_frame(conn)
+        conn.close()
+
+    t = threading.Thread(target=_accept)
+    t.start()
+    c = wire.connect(addr, timeout=5.0)
+    wire.send_frame(c, wire.HELLO, b"hi")
+    c.close()
+    t.join(timeout=5.0)
+    srv.close()
+    assert got["frame"] == (wire.HELLO, b"hi")
+
+    # unix
+    path = str(tmp_path / "svc.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(1)
+    t = threading.Thread(target=_accept)
+    t.start()
+    c = wire.connect(wire.format_address("unix", path), timeout=5.0)
+    wire.send_frame(c, wire.HELLO, b"hi")
+    c.close()
+    t.join(timeout=5.0)
+    srv.close()
+    assert got["frame"] == (wire.HELLO, b"hi")
+
+
+def test_push_payload_roundtrip_bit_exact():
+    """pack_push/unpack_push carry trees through data/wire.py packing:
+    bit-exact floats (NaN payloads included) and exact indices metadata."""
+    rng = np.random.default_rng(7)
+    tree_a = {
+        "rgb": rng.integers(0, 255, (4, 2, 3), dtype=np.uint8),
+        "rewards": np.array([[np.nan], [1.5]], np.float32),
+    }
+    tree_b = {"dones": np.ones((1, 2, 1), np.float32)}
+    payload = service_mod.pack_push(
+        [(tree_a, None), (tree_b, [0, 1])],
+        rows=4,
+        env_steps=123,
+        weight_version=9,
+    )
+    ops, meta = service_mod.unpack_push(payload)
+    assert meta == {"rows": 4, "env_steps": 123, "weight_version": 9}
+    assert len(ops) == 2
+    out_a, idx_a = ops[0]
+    assert idx_a is None
+    np.testing.assert_array_equal(out_a["rgb"], tree_a["rgb"])
+    assert out_a["rewards"].tobytes() == tree_a["rewards"].tobytes()  # NaN-safe
+    out_b, idx_b = ops[1]
+    assert idx_b == [0, 1]
+    np.testing.assert_array_equal(out_b["dones"], tree_b["dones"])

@@ -110,6 +110,11 @@ def summarize(events: list[dict]) -> dict:
         "checkpoint_corrupt": [],   # skipped/failed checkpoint candidates
         "checkpoint_errors": [],    # retried checkpoint writes
         "fault_gauges": {},         # last Fault/* gauge values
+        # ISSUE 14 flock subsystem (flock/)
+        "flock_started": None,      # flock.started (address/mode)
+        "flock_events": [],         # flock.* membership lifecycle events
+        "flock_gauges": {},         # last Flock/* gauge values
+        "flock_staleness": {},      # actor_id -> list of staleness samples
     }
     for ev in events:
         ts = ev.get("ts")
@@ -149,6 +154,10 @@ def summarize(events: list[dict]) -> dict:
             summary["checkpoint_corrupt"].append(ev)
         elif kind == "checkpoint.error":
             summary["checkpoint_errors"].append(ev)
+        elif kind == "flock.started":
+            summary["flock_started"] = ev
+        elif isinstance(kind, str) and kind.startswith("flock."):
+            summary["flock_events"].append(ev)
         elif kind == "log":
             summary["log_events"] += 1
             if ev.get("step") is not None:
@@ -182,6 +191,13 @@ def summarize(events: list[dict]) -> dict:
                     summary["anakin_gauges"][k] = v
                 elif k.startswith("Fault/"):
                     summary["fault_gauges"][k] = v
+                elif k.startswith("Flock/"):
+                    summary["flock_gauges"][k] = v
+                    parts = k.split("/")
+                    if len(parts) == 3 and parts[2] == "staleness_s":
+                        summary["flock_staleness"].setdefault(
+                            parts[1], []
+                        ).append(v)
     # the "end" event carries phase time accumulated after the last interval
     if summary["end"]:
         for phase, secs in (summary["end"].get("phases") or {}).items():
@@ -546,6 +562,91 @@ def render(summary: dict) -> str:
             f"env_steps_total={a.get('Anakin/env_steps_total', 0):,.0f}"
         )
 
+    fg = summary["flock_gauges"]
+    if fg or summary["flock_started"] or summary["flock_events"]:
+        lines.append("")
+        lines.append("== flock (actor-learner runtime) ==")
+        started = summary["flock_started"] or {}
+        lines.append(
+            f"service: address={started.get('address', '?')} "
+            f"mode={started.get('mode', '?')}"
+        )
+        lines.append(
+            f"fleet: actors_alive={fg.get('Flock/actors_alive', 0):.0f} "
+            f"weight_version={fg.get('Flock/weight_version', 0):.0f} "
+            f"rows_total={fg.get('Flock/rows_total', 0):,.0f} "
+            f"chunks_dropped={fg.get('Flock/chunks_dropped', 0):.0f}"
+        )
+        # Per-actor table from the Flock/actor{N}/<field> gauge namespace.
+        actors = sorted(
+            {
+                k.split("/")[1]
+                for k in fg
+                if k.count("/") == 2 and k.split("/")[1].startswith("actor")
+            },
+            key=lambda a: (len(a), a),
+        )
+        if actors:
+            headers = (
+                "actor", "steps/s", "env_steps", "wv", "lag",
+                "stale_s", "hb_s", "fill", "gen", "up",
+            )
+            widths = (8, 10, 12, 5, 5, 9, 7, 7, 5, 4)
+            lines.append(_fmt_row(headers, widths))
+            for a in actors:
+                def g(field, _a=a):
+                    return fg.get(f"Flock/{_a}/{field}")
+
+                def num(field, fmt, _g=g):
+                    v = _g(field)
+                    return format(v, fmt) if isinstance(v, (int, float)) else "-"
+
+                lines.append(_fmt_row(
+                    (
+                        a,
+                        num("env_steps_s", ",.0f"),
+                        num("env_steps", ",.0f"),
+                        num("weight_version", ".0f"),
+                        num("version_lag", ".0f"),
+                        num("staleness_s", ".2f"),
+                        num("heartbeat_age_s", ".2f"),
+                        num("shard_fill", ".2f"),
+                        num("generation", ".0f"),
+                        "yes" if g("connected") else "no",
+                    ),
+                    widths,
+                ))
+        # Staleness distribution across every logged interval, not just the
+        # last gauge value — the number the bench round cares about.
+        all_stale = [v for vs in summary["flock_staleness"].values() for v in vs]
+        if all_stale:
+            s = sorted(all_stale)
+            lines.append(
+                f"weight staleness (all actors, {len(s)} samples): "
+                f"min={s[0]:.2f}s p50={s[len(s) // 2]:.2f}s "
+                f"p90={s[min(len(s) - 1, int(len(s) * 0.9))]:.2f}s "
+                f"max={s[-1]:.2f}s"
+            )
+        if summary["flock_events"]:
+            counts: dict = {}
+            for ev in summary["flock_events"]:
+                counts[ev["event"]] = counts.get(ev["event"], 0) + 1
+            lines.append(
+                "membership: "
+                + " ".join(f"{k.split('.', 1)[1]}={v}" for k, v in sorted(counts.items()))
+            )
+            t0 = summary["first_ts"] or 0.0
+            for ev in summary["flock_events"]:
+                ts = ev.get("ts")
+                rel = f"t+{ts - t0:7.2f}s" if isinstance(ts, (int, float)) else "t+      ?"
+                what = ev["event"].split(".", 1)[1].upper()
+                detail = " ".join(
+                    f"{k}={v}"
+                    for k, v in ev.items()
+                    if k not in ("event", "ts", "step")
+                )
+                lines.append(f"{rel}  {what:<12} {detail}")
+
     resil_any = (
         summary["fault_injected"]
         or summary["fault_recovered"]
@@ -820,6 +921,64 @@ def selftest() -> int:
     assert "CORRUPT /run/checkpoints/ckpt_2: missing args.json sidecar" in out2
     assert "PREEMPT SIGTERM received" in out2
     assert "Fault gauges: injected=2 updates_skipped=1" in out2, out2
+
+    # flock section (ISSUE 14): a 2-actor run with a death + rejoin must
+    # render the service line, the per-actor table, the staleness
+    # distribution and the membership timeline — written through the REAL
+    # Telemetry writer like the rest
+    d3 = tempfile.mkdtemp(prefix="telemetry_selftest_flock_")
+    telem3 = Telemetry(d3, rank=0, algo="flock")
+    telem3.event("start", algo="flock", env_id="dummy", seed=0)
+    telem3.event("flock.started", address="unix:/tmp/svc.sock", mode="buffer")
+    telem3.event("flock.actor_joined", actor_id=0, pid=111)
+    telem3.event("flock.actor_joined", actor_id=1, pid=222)
+    telem3.interval(
+        {
+            "Flock/actors_alive": 2.0, "Flock/weight_version": 3.0,
+            "Flock/rows_total": 1024.0, "Flock/chunks_dropped": 0.0,
+            "Flock/actor0/env_steps_s": 512.0, "Flock/actor0/env_steps": 600.0,
+            "Flock/actor0/weight_version": 3.0, "Flock/actor0/version_lag": 0.0,
+            "Flock/actor0/staleness_s": 0.25, "Flock/actor0/heartbeat_age_s": 0.1,
+            "Flock/actor0/shard_fill": 0.5, "Flock/actor0/generation": 0.0,
+            "Flock/actor0/connected": 1.0,
+            "Flock/actor1/env_steps_s": 480.0, "Flock/actor1/env_steps": 424.0,
+            "Flock/actor1/weight_version": 2.0, "Flock/actor1/version_lag": 1.0,
+            "Flock/actor1/staleness_s": 0.75, "Flock/actor1/heartbeat_age_s": 0.2,
+            "Flock/actor1/shard_fill": 0.4, "Flock/actor1/generation": 0.0,
+            "Flock/actor1/connected": 1.0,
+        },
+        step=10,
+    )
+    telem3.event("flock.actor_disconnected", actor_id=1, rows=424, env_steps=424)
+    telem3.event("flock.actor_died", actor_id=1, rc=-9)
+    telem3.event("flock.actor_respawned", actor_id=1, attempt=1)
+    telem3.event("flock.actor_rejoined", actor_id=1, generation=1, weight_version=4)
+    telem3.interval(
+        {
+            "Flock/actors_alive": 2.0, "Flock/weight_version": 4.0,
+            "Flock/rows_total": 2048.0, "Flock/chunks_dropped": 0.0,
+            "Flock/actor0/staleness_s": 0.30, "Flock/actor0/connected": 1.0,
+            "Flock/actor1/staleness_s": 0.05, "Flock/actor1/connected": 1.0,
+            "Flock/actor1/generation": 1.0,
+        },
+        step=20,
+    )
+    telem3.close()
+    summary3 = summarize(load_events(d3))
+    out3 = render(summary3)
+    assert "== flock (actor-learner runtime) ==" in out3, out3
+    assert "address=unix:/tmp/svc.sock mode=buffer" in out3
+    assert "actors_alive=2 weight_version=4 rows_total=2,048" in out3, out3
+    assert "actor0" in out3 and "actor1" in out3
+    assert "weight staleness (all actors, 4 samples)" in out3, out3
+    assert "min=0.05s" in out3 and "max=0.75s" in out3, out3
+    assert (
+        "membership: actor_died=1 actor_disconnected=1 actor_joined=2 "
+        "actor_rejoined=1 actor_respawned=1" in out3
+    ), out3
+    assert "DIED" in out3 and "rc=-9" in out3
+    assert "REJOINED" in out3 and "generation=1" in out3
+    assert summary3["flock_staleness"]["actor1"] == [0.75, 0.05]
 
     print("\nselftest OK", file=sys.stderr)
     return 0

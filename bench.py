@@ -1847,6 +1847,7 @@ _METRIC_OF_ALGO = {
     "sheepopt": ("sheepopt_remat_peak_reduction_pct", "percent"),
     "resilience": ("resilience_preemption_grace_seconds", "seconds"),
     "flock": ("flock_actor_env_steps_per_sec", "env-steps/sec"),
+    "serve": ("serve_sac_qps", "requests/sec"),
 }
 
 
@@ -2945,6 +2946,259 @@ def bench_flock() -> None:
     print(json.dumps(result))
 
 
+def bench_serve() -> None:
+    """ISSUE 15 headline: what the batched serving tier delivers on CPU —
+    sustained QPS + client-observed latency p50/p99 at two closed-loop
+    operating points (concurrency 1 -> the rung-1 program, concurrency 8
+    -> co-batching up the ladder) for BOTH served families (SAC greedy
+    actor, DV3 recurrent player sessions), batch occupancy at the loaded
+    point, a hot params swap under concurrent load with zero dropped
+    requests, the pad-slice parity receipt (served rung-1 result bit-exact
+    vs a direct jit call; a padded 3-row request bit-exact vs the padded
+    direct call), and DV3 same-obs session determinism. Everything runs
+    the REAL wire path (ServeServer + ServeClient over a unix socket);
+    mechanism receipts are backend-independent, chip QPS lands
+    opportunistically like every other rung."""
+    import os
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from sheeprl_tpu.serve import (
+        MicroBatcher, ParamsStore, ServeArgs, ServeClient, ServeServer,
+    )
+    from sheeprl_tpu.serve.policies import build_policy
+
+    RUNGS = [1, 2, 4, 8]
+
+    def build(algo, model_argv):
+        args = ServeArgs(algo=algo, model_argv=model_argv)
+        log_dir = tempfile.mkdtemp(prefix=f"bench_serve_{algo}_")
+        policy, params, _loader = build_policy(args, log_dir)
+        # the swap mechanism is what's measured, not orbax: the loader
+        # re-serves the same tree, flipping the version under live traffic
+        store = ParamsStore(lambda path: params, params)
+        return policy, params, store
+
+    def warm_ladder(policy, params):
+        """Trace/compile every rung before measurement — the server does
+        this at startup (CompilePlan AOT, --warm_compile on), so steady-
+        state latency is what the tier actually serves."""
+        import jax
+
+        t0 = time.perf_counter()
+        for rung in RUNGS:
+            ex = policy.example(params, rung)
+            concrete = [params] + [
+                jax.tree_util.tree_map(
+                    lambda s: np.zeros(s.shape, s.dtype), a
+                )
+                for a in ex[1:]
+            ]
+            policy.step(*concrete)
+        return round(time.perf_counter() - t0, 2)
+
+    def serving(policy, store, window_ms=1.0):
+        def dispatch(stacked, pendings, rung):
+            version, live = store.current()
+            return (
+                policy.run(policy.step, live, version, stacked, pendings, rung),
+                version,
+            )
+
+        batcher = MicroBatcher(
+            dispatch, RUNGS, window_ms=window_ms, default_deadline_ms=0.0
+        )
+        server = ServeServer(policy, store, batcher)
+        server.start()
+        return server
+
+    def drive(server, concurrency, per_client, obs_of, *, sessions=False,
+              reload_at=None):
+        """Closed-loop client threads; returns the phase receipt. With
+        `reload_at`, a hot swap fires once that many requests completed."""
+        lats, versions, errors = [], [], []
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def worker(tid):
+            try:
+                with ServeClient(server.address, timeout=120.0) as client:
+                    for i in range(per_client):
+                        t0 = time.perf_counter()
+                        _res, meta = client.request(
+                            obs_of(tid, i),
+                            session=f"s{tid}" if sessions else None,
+                            reset=(i == 0) if sessions else False,
+                        )
+                        ms = 1000.0 * (time.perf_counter() - t0)
+                        with lock:
+                            lats.append(ms)
+                            versions.append(meta["version"])
+                            if reload_at and len(lats) >= reload_at:
+                                done.set()
+            except Exception as err:
+                with lock:
+                    errors.append(f"{type(err).__name__}: {err}")
+                done.set()
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(concurrency)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        reload_s = None
+        if reload_at:
+            done.wait(timeout=300.0)
+            r0 = time.perf_counter()
+            with ServeClient(server.address, timeout=120.0) as admin:
+                reply = admin.reload("swap")
+            reload_s = time.perf_counter() - r0
+            assert reply["ok"], reply
+        for t in threads:
+            t.join(timeout=600.0)
+        wall = time.perf_counter() - t0
+        s = sorted(lats)
+        total = concurrency * per_client
+        g = server.gauges()
+        receipt = {
+            "concurrency": concurrency,
+            "requests": len(lats),
+            "dropped": total - len(lats),
+            "errors": errors[:3],
+            "qps": round(len(lats) / wall, 1) if wall > 0 else None,
+            "latency_p50_ms": round(s[len(s) // 2], 3) if s else None,
+            "latency_p99_ms": round(
+                s[min(len(s) - 1, int(len(s) * 0.99))], 3
+            ) if s else None,
+            "batch_occupancy": round(g["Serve/batch_occupancy"], 3),
+            "dispatches": int(g["Serve/dispatches"]),
+        }
+        if reload_at:
+            receipt["reload"] = {
+                "swap_seconds": round(reload_s, 4),
+                "versions_seen": sorted(set(versions)),
+                "zero_dropped": receipt["dropped"] == 0 and not errors,
+            }
+        return receipt
+
+    results = {}
+
+    # --- SAC: stateless greedy actor ---------------------------------------
+    policy, params, store = build(
+        "sac", "--env_id Pendulum-v1 --actor_hidden_size 16 --critic_hidden_size 16"
+    )
+    results["sac_ladder_warm_seconds"] = warm_ladder(policy, params)
+    rng = np.random.default_rng(0)
+    sac_pool = rng.standard_normal((64, 1, policy.obs_dim)).astype(np.float32)
+
+    def sac_obs(tid, i):
+        return {"obs": sac_pool[(tid * 31 + i) % len(sac_pool)]}
+
+    server = serving(policy, store)
+    try:
+        # parity receipt before load: rung-1 bit-exact, pad-slice bit-exact
+        with ServeClient(server.address) as client:
+            one = {"obs": sac_pool[0]}
+            res, meta = client.request(one)
+            direct = np.asarray(policy.step(params, one["obs"]))
+            parity_b1 = meta["rung"] == 1 and bool(
+                np.array_equal(res["actions"], direct)
+            )
+            three = {"obs": rng.standard_normal((3, policy.obs_dim)).astype(np.float32)}
+            res3, meta3 = client.request(three)
+            padded = np.concatenate(
+                [three["obs"], np.zeros((1, policy.obs_dim), np.float32)]
+            )
+            parity_pad = meta3["rung"] == 4 and bool(np.array_equal(
+                res3["actions"], np.asarray(policy.step(params, padded))[:3]
+            ))
+        results["sac_parity"] = {
+            "rung1_bit_exact": parity_b1, "pad_slice_bit_exact": parity_pad,
+        }
+    finally:
+        server.close()
+    for conc, per in ((1, 200), (8, 100)):
+        server = serving(policy, store)
+        try:
+            results[f"sac_b{conc}"] = drive(server, conc, per, sac_obs)
+        finally:
+            server.close()
+        print(f"serve sac conc={conc}: {results[f'sac_b{conc}']}", file=sys.stderr)
+    # hot swap under concurrent load: zero drops, both versions served
+    server = serving(policy, store)
+    try:
+        results["sac_reload"] = drive(
+            server, 8, 50, sac_obs, reload_at=8 * 50 // 3
+        )
+    finally:
+        server.close()
+    print(f"serve sac reload: {results['sac_reload']}", file=sys.stderr)
+
+    # --- DV3: recurrent player, server-side sessions ------------------------
+    policy, params, store = build(
+        "dreamer_v3",
+        "--env_id discrete_dummy --cnn_keys rgb --dense_units 8 "
+        "--cnn_channels_multiplier 2 --recurrent_state_size 8 "
+        "--hidden_size 8 --stochastic_size 4 --discrete_size 4 --mlp_layers 1",
+    )
+    results["dv3_ladder_warm_seconds"] = warm_ladder(policy, params)
+    obs_shapes = {
+        k: (policy.obs_space[k].shape, policy.obs_space[k].dtype)
+        for k in policy.obs_keys
+    }
+
+    def dv3_obs(tid, i):
+        return {
+            k: np.full((1,) + tuple(shape), (tid + i) % 7, dtype=dtype)
+            for k, (shape, dtype) in obs_shapes.items()
+        }
+
+    server = serving(policy, store)
+    try:
+        # same obs + reset through two fresh sessions at concurrency 1 (both
+        # rung 1, same program) must produce identical actions
+        with ServeClient(server.address) as client:
+            a1, _ = client.request(dv3_obs(0, 0), session="det_a", reset=True)
+            a2, _ = client.request(dv3_obs(0, 0), session="det_b", reset=True)
+        results["dv3_session_deterministic"] = bool(
+            np.array_equal(a1["actions"], a2["actions"])
+        )
+    finally:
+        server.close()
+    for conc, per in ((1, 50), (8, 25)):
+        server = serving(policy, store)
+        try:
+            results[f"dv3_b{conc}"] = drive(
+                server, conc, per, dv3_obs, sessions=True
+            )
+        finally:
+            server.close()
+        print(f"serve dv3 conc={conc}: {results[f'dv3_b{conc}']}", file=sys.stderr)
+
+    loaded = results["sac_b8"]
+    result = {
+        "metric": "serve_sac_qps",
+        "value": loaded["qps"] or 0.0,
+        "unit": "requests/sec",
+        "algo": "serve",
+        "backend": "cpu",
+        "rungs": RUNGS,
+        **results,
+        "zero_dropped_everywhere": all(
+            r.get("dropped") == 0 and not r.get("errors")
+            for r in results.values()
+            if isinstance(r, dict) and "dropped" in r
+        ),
+        "host_cpus": os.cpu_count(),
+        "note": BASELINE_NOTE,
+    }
+    print(json.dumps(result))
+
+
 def _arm_watchdog(metric: str, unit: str, budget_s: float) -> None:
     """Last-resort liveness bound: if the whole bench (backend init included)
     has not finished within `budget_s`, emit an artifact and hard-exit. Round
@@ -3472,6 +3726,8 @@ def main() -> None:
         bench_resilience()
     elif opts.algo == "flock":
         bench_flock()
+    elif opts.algo == "serve":
+        bench_serve()
     else:
         bench_dreamer_v3(tiny=opts.tiny, pipeline_mode=opts.pipeline)
 

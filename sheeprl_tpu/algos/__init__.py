@@ -17,6 +17,7 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.dreamer_v3.dreamer_v3_decoupled",
     "sheeprl_tpu.algos.p2e_dv1.p2e_dv1",
     "sheeprl_tpu.algos.p2e_dv2.p2e_dv2",
+    "sheeprl_tpu.serve.serve",
 ]
 
 import importlib

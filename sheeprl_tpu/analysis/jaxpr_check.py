@@ -815,6 +815,17 @@ CAPTURE_ARGV: dict[str, list[str]] = {
     ],
     "p2e_dv1": ["--num_devices", "1", *_DREAMER_TINY],
     "p2e_dv2": ["--num_devices", "1", *_DREAMER_TINY, "--discrete_size", "4"],
+    # serving tier (ISSUE 15): one fixed-shape policy jit per batch-ladder
+    # rung (`serve/policy_b{1,2,4}`); the checkpoint-free --model_argv init
+    # builds the same tiny SAC the `sac` spec captures. The ledger's
+    # argument/peak bytes per rung are what `serve/ladder.py` scales to
+    # size production ladders without trial compiles.
+    "serve": [
+        "--algo", "sac",
+        "--max_batch", "4",
+        "--model_argv",
+        "--env_id Pendulum-v1 --actor_hidden_size 16 --critic_hidden_size 16",
+    ],
 }
 
 # Named capture VARIANTS: flag combinations of the same mains that register
@@ -834,6 +845,30 @@ CAPTURE_VARIANTS: dict[str, tuple[str, list[str]]] = {
     "dreamer_v3@anakin": (
         "dreamer_v3",
         ["--env_backend", "jax", "--env_id", "pixeltoy"],
+    ),
+    # the DV3 player ladder: recurrent PlayerState in, mode actions out —
+    # same serve main, dreamer_v3 policy family at _DREAMER_TINY widths
+    "dreamer_v3@serve": (
+        "serve",
+        [
+            "--algo", "dreamer_v3",
+            "--model_argv",
+            "--env_id discrete_dummy --cnn_keys rgb --dense_units 8 "
+            "--cnn_channels_multiplier 2 --recurrent_state_size 8 "
+            "--hidden_size 8 --stochastic_size 4 --discrete_size 4 "
+            "--mlp_layers 1",
+        ],
+    ),
+    # serve takes precision through the nested --model_argv (ServeArgs has
+    # no --precision of its own): the whole string re-specifies last-wins,
+    # and policies.py threads targs.precision into both policy builds
+    "serve@bf16": (
+        "serve",
+        [
+            "--model_argv",
+            "--env_id Pendulum-v1 --actor_hidden_size 16 "
+            "--critic_hidden_size 16 --precision bfloat16",
+        ],
     ),
     **{f"{algo}@bf16": (algo, list(_BF16)) for algo in (
         "ppo",

@@ -26,6 +26,22 @@ Kinds (actor -> service unless noted):
     BYE         JSON {actor_id}
     ERROR       (either) JSON {error}
 
+Serving kinds (client -> server unless noted; sheeprl_tpu/serve/):
+
+    REQUEST     u32 meta_len | meta_json | pack_tree obs blob.
+                meta: {id, deadline_ms, session, reset}
+    RESPONSE    (server) u32 meta_len | meta_json | pack_tree action blob.
+                meta: {id, version, rung, rows, queue_ms}
+    SHED        (server) JSON {id, retry_after_ms, reason} — deadline-aware
+                load shedding, NOT an error: retry after the hint
+    RELOAD      JSON {path}; server replies RELOAD JSON
+                {ok, version, error}
+
+Frame kinds form an EXTENSIBLE registry: subsystems claim values through
+`register_kind` (u8, append-only — committed values are pinned by
+tests/test_flock/test_wire.py and must never be renumbered; 1-11 belong
+to flock, 12-15 to serve, 16+ are free).
+
 Transport addresses serialize as `tcp:HOST:PORT` or `unix:PATH` — one
 string, environment-variable friendly for actor subprocesses.
 """
@@ -40,11 +56,13 @@ __all__ = [
     "MAGIC",
     "MAX_FRAME_BYTES",
     "FrameError",
+    "KIND_NAMES",
     "connect",
     "format_address",
     "parse_address",
     "recv_frame",
     "recv_json",
+    "register_kind",
     "send_frame",
     "send_json",
 ]
@@ -55,32 +73,51 @@ _HEADER = struct.Struct("<4sBBHQ")
 # above both and guards against a corrupt length field allocating the moon
 MAX_FRAME_BYTES = 1 << 30
 
-# frame kinds
-HELLO = 1
-WELCOME = 2
-PUSH = 3
-PUSH_OK = 4
-HEARTBEAT = 5
-HEARTBEAT_OK = 6
-GET_WEIGHTS = 7
-WEIGHTS = 8
-WEIGHTS_UNCHANGED = 9
-BYE = 10
-ERROR = 11
+# value -> wire name for every registered frame kind (diagnostics only —
+# the VALUE is the protocol)
+KIND_NAMES: dict[int, str] = {}
 
-KIND_NAMES = {
-    HELLO: "hello",
-    WELCOME: "welcome",
-    PUSH: "push",
-    PUSH_OK: "push_ok",
-    HEARTBEAT: "heartbeat",
-    HEARTBEAT_OK: "heartbeat_ok",
-    GET_WEIGHTS: "get_weights",
-    WEIGHTS: "weights",
-    WEIGHTS_UNCHANGED: "weights_unchanged",
-    BYE: "bye",
-    ERROR: "error",
-}
+
+def register_kind(value: int, name: str) -> int:
+    """Claim a frame-kind value in the shared FLK1 registry. Kinds are a
+    single u8 on the wire, so the registry enforces the two corruptions a
+    closed constant set silently allowed: a value collision between two
+    subsystems, and an out-of-range value truncated by the header pack.
+    Returns `value` so kinds read as constants at the definition site."""
+    if not 1 <= value <= 255:
+        raise ValueError(f"frame kind {value} out of u8 range [1, 255]")
+    if value in KIND_NAMES and KIND_NAMES[value] != name:
+        raise ValueError(
+            f"frame kind {value} already registered as {KIND_NAMES[value]!r} "
+            f"(attempted {name!r})"
+        )
+    other = {v for v, n in KIND_NAMES.items() if n == name and v != value}
+    if other:
+        raise ValueError(
+            f"frame-kind name {name!r} already registered as value {other}"
+        )
+    KIND_NAMES[value] = name
+    return value
+
+
+# flock kinds (PR 14, committed values — never renumber)
+HELLO = register_kind(1, "hello")
+WELCOME = register_kind(2, "welcome")
+PUSH = register_kind(3, "push")
+PUSH_OK = register_kind(4, "push_ok")
+HEARTBEAT = register_kind(5, "heartbeat")
+HEARTBEAT_OK = register_kind(6, "heartbeat_ok")
+GET_WEIGHTS = register_kind(7, "get_weights")
+WEIGHTS = register_kind(8, "weights")
+WEIGHTS_UNCHANGED = register_kind(9, "weights_unchanged")
+BYE = register_kind(10, "bye")
+ERROR = register_kind(11, "error")
+
+# serving kinds (PR 15, sheeprl_tpu/serve/ — appended, nothing renumbered)
+REQUEST = register_kind(12, "request")
+RESPONSE = register_kind(13, "response")
+SHED = register_kind(14, "shed")
+RELOAD = register_kind(15, "reload")
 
 
 class FrameError(ConnectionError):

@@ -1,0 +1,42 @@
+"""sheepserve — batched policy-inference serving tier (ISSUE 15).
+
+Micro-batches concurrent client requests into fixed-shape AOT
+executables sized from the committed sheepmem ledger, hot-reloads
+checkpoints without dropping requests (double-buffered params swap), and
+sheds load past per-request deadlines instead of collapsing the queue.
+Speaks the FLK1 framed transport (REQUEST/RESPONSE/SHED/RELOAD) over
+unix or TCP sockets. See howto/serving.md.
+
+Exports resolve lazily (PEP 562): the algos registry imports
+`sheeprl_tpu.serve.serve` while `sheeprl_tpu.algos` is itself mid-import
+(serve args subclass StandardArgs), so an eager import list here would
+be a cycle.
+"""
+
+_EXPORTS = {
+    "MicroBatcher": "batcher",
+    "OversizedRequest": "errors",
+    "ParamsStore": "params",
+    "PendingRequest": "batcher",
+    "RequestShed": "errors",
+    "RungDecision": "ladder",
+    "SERVE_ALGOS": "args",
+    "ServeArgs": "args",
+    "ServeClient": "client",
+    "ServeError": "errors",
+    "ServeServer": "server",
+    "ledger_spec": "ladder",
+    "parse_rungs": "ladder",
+    "size_ladder": "ladder",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

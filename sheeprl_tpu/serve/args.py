@@ -1,0 +1,99 @@
+"""Serving-tier config. Subclasses StandardArgs so the shared plumbing
+(platform pin, run directories, telemetry, warm compile) keeps its flags;
+the training-only fields are simply unused by the `serve` task."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..utils.parser import Arg
+from ..algos.args import StandardArgs
+
+SERVE_ALGOS = ("sac", "dreamer_v3")
+
+
+@dataclasses.dataclass
+class ServeArgs(StandardArgs):
+    algo: str = Arg(
+        default="sac",
+        help="policy family to serve: 'sac' (greedy actor over vector obs) "
+        "or 'dreamer_v3' (player step with server-held per-session "
+        "recurrent state; requests must be single-row and carry a "
+        "'session' id)",
+    )
+    ckpt: Optional[str] = Arg(
+        default=None,
+        help="orbax checkpoint directory to serve (the training task's "
+        "ckpt_<step> dir; its args.json sidecar rebuilds the exact model). "
+        "Omitted: a fresh tiny model is initialized from --model_argv — "
+        "useful for smoke tests and the analysis capture sweep only",
+    )
+    bind: str = Arg(
+        default="unix:auto",
+        help="listen address: 'unix:auto' (fresh socket in a tempdir; the "
+        "resolved address is printed and written to <log_dir>/serve_address), "
+        "'unix:PATH', or 'tcp:HOST:PORT' (port 0 picks an ephemeral port)",
+    )
+    batch_window_ms: float = Arg(
+        default=2.0,
+        help="micro-batching window: after the first queued request, wait up "
+        "to this long for more requests before dispatching (a full ladder "
+        "rung dispatches immediately). Trades per-request latency for "
+        "batch occupancy",
+    )
+    deadline_ms: float = Arg(
+        default=100.0,
+        help="default per-request deadline; a request still queued past it "
+        "is shed with a SHED frame (retry_after hint) instead of collapsing "
+        "the queue. Requests may override per-call; <=0 disables shedding",
+    )
+    max_batch: int = Arg(
+        default=8,
+        help="largest batch rung of the serving ladder (requests with more "
+        "rows than this are rejected with a typed error)",
+    )
+    ladder: str = Arg(
+        default="auto",
+        help="batch-ladder rungs: 'auto' sizes powers of two up to "
+        "--max_batch from the committed sheepmem ledger (argument/peak "
+        "bytes per rung, trial-compile fallback cached in the decision "
+        "framework), or an explicit comma list like '1,2,8'",
+    )
+    reload_poll_s: float = Arg(
+        default=0.0,
+        help=">0: watch the checkpoint directory of --ckpt every this many "
+        "seconds and hot-reload newer valid checkpoints automatically "
+        "(clients can always trigger an explicit reload with a RELOAD "
+        "frame). Reloads are double-buffered: version N keeps serving "
+        "until N+1 is fully loaded, and keeps serving on a failed reload",
+    )
+    serve_requests: int = Arg(
+        default=-1,
+        help="exit cleanly after this many completed requests (responses + "
+        "sheds); -1 serves until SIGTERM/SIGINT",
+    )
+    model_argv: Optional[str] = Arg(
+        default=None,
+        help="space-separated training-args tokens (e.g. "
+        "'--actor_hidden_size 16') used to init a fresh model when --ckpt "
+        "is omitted; ignored when a checkpoint (with its args.json) is "
+        "given",
+    )
+    # serving wants the AOT executables by default: the whole point of the
+    # ladder is fixed-shape compiled dispatch
+    warm_compile: str = Arg(
+        default="on",
+        help="AOT-compile the per-rung policy executables in the background "
+        "at startup ('on', the default for serving) or lazily on first "
+        "dispatch ('off')",
+    )
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name == "algo" and value not in SERVE_ALGOS:
+            raise ValueError(
+                f"algo must be one of {SERVE_ALGOS}, got {value!r}"
+            )
+        if name == "max_batch" and int(value) < 1:
+            raise ValueError(f"max_batch must be >= 1, got {value!r}")
+        super().__setattr__(name, value)

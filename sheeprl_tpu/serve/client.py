@@ -1,0 +1,97 @@
+"""Thin blocking client for the serving tier.
+
+One socket, one in-flight request at a time (concurrency = many clients,
+exactly how the batcher wants its load). Typed failures: a SHED frame
+raises `RequestShed` (read `.retry_after_ms` and come back), an ERROR
+frame raises `OversizedRequest` or `ServeError`.
+
+    client = ServeClient("unix:/tmp/.../serve.sock")
+    result, meta = client.request({"obs": obs_batch})
+    actions = result["actions"]          # rows match the request
+    client.reload()                      # hot-swap to the newest ckpt
+    client.close()
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Any
+
+import numpy as np
+
+from ..flock import wire
+from .errors import OversizedRequest, RequestShed, ServeError
+from .server import PROTO_VERSION, pack_request, unpack_request
+
+__all__ = ["ServeClient"]
+
+
+class ServeClient:
+    def __init__(self, address: str, timeout: float | None = 60.0):
+        self._sock = wire.connect(address, timeout=timeout)
+        self._ids = itertools.count(1)
+        wire.send_json(self._sock, wire.HELLO, {"proto": PROTO_VERSION})
+        self.info = wire.recv_json(self._sock, wire.WELCOME)
+
+    def request(
+        self,
+        obs: dict[str, np.ndarray],
+        deadline_ms: float | None = None,
+        session: str | None = None,
+        reset: bool = False,
+    ) -> tuple[dict[str, np.ndarray], dict]:
+        """-> (result tree, response meta). Raises RequestShed past the
+        deadline, OversizedRequest for rows beyond the ladder, ServeError
+        for dispatch failures."""
+        meta: dict[str, Any] = {"id": next(self._ids)}
+        if deadline_ms is not None:
+            meta["deadline_ms"] = deadline_ms
+        if session is not None:
+            meta["session"] = session
+        if reset:
+            meta["reset"] = True
+        wire.send_frame(self._sock, wire.REQUEST, pack_request(meta, obs))
+        frame = wire.recv_frame(self._sock)
+        if frame is None:
+            raise ServeError("server closed the connection")
+        kind, payload = frame
+        if kind == wire.RESPONSE:
+            resp_meta, result = unpack_request(payload)
+            return result, resp_meta
+        if kind == wire.SHED:
+            shed = json.loads(payload.decode())
+            raise RequestShed(
+                float(shed.get("retry_after_ms", 0.0)),
+                shed.get("reason", "deadline"),
+            )
+        if kind == wire.ERROR:
+            err = json.loads(payload.decode())
+            if err.get("kind") == "oversized":
+                raise OversizedRequest(-1, -1, message=err.get("error"))
+            raise ServeError(err.get("error", "request failed"))
+        raise wire.FrameError(
+            f"unexpected reply kind {wire.KIND_NAMES.get(kind, kind)}"
+        )
+
+    def reload(self, path: str | None = None) -> dict:
+        """Ask the server to hot-reload (default: its current source).
+        Returns the server's {ok, version, seconds, error} reply."""
+        wire.send_json(self._sock, wire.RELOAD, {"path": path})
+        return wire.recv_json(self._sock, wire.RELOAD)
+
+    def close(self) -> None:
+        try:
+            wire.send_frame(self._sock, wire.BYE)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

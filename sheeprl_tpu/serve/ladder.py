@@ -1,0 +1,188 @@
+"""Batch-ladder sizing for the serving tier.
+
+The server dispatches micro-batches through fixed-shape AOT executables,
+one per ladder rung (1, 2, 4, ... up to --max_batch). Each rung costs one
+XLA compile at startup and holds its peak working set for the lifetime of
+the server, so the ladder is SIZED, not assumed: a rung is accepted when
+its predicted peak bytes fit the serving memory budget.
+
+The decision ladder mirrors `compile/partition.decide_batch_chunk`:
+
+  0. ledger-first — the committed sheepmem ledger carries measured
+     argument/peak bytes for every `<spec>/policy_b<rung>` serving jit
+     (the `@serve` capture variants, ISSUE 15 satellite); the live
+     footprint is predicted by scaling with the argument-byte ratio, zero
+     lowering, zero trial compile;
+  1. no ledger entry — trial-AOT-compile the rung once and read XLA's own
+     `memory_analysis()`; the measurement is memoized in the unified
+     decision cache (compile/decisions.py, family `serve_ladder`), so a
+     restarted server never re-probes.
+
+Rung 1 is always kept (a server that can serve nothing is not a server —
+if even batch 1 exceeds the budget the operator must shrink the model,
+not the ladder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable
+
+from ..compile.partition import (
+    _example_arg_bytes,
+    ledger_entry,
+    partition_mem_budget_bytes,
+)
+
+__all__ = [
+    "RungDecision",
+    "ledger_spec",
+    "parse_rungs",
+    "serve_mem_budget_bytes",
+    "size_ladder",
+]
+
+
+def parse_rungs(ladder: str, max_batch: int) -> list[int]:
+    """'auto' -> powers of two up to max_batch (always including
+    max_batch); '1,2,8' -> that list, validated and sorted."""
+    if ladder == "auto":
+        rungs = []
+        r = 1
+        while r < max_batch:
+            rungs.append(r)
+            r *= 2
+        rungs.append(max_batch)
+        return rungs
+    try:
+        rungs = sorted({int(tok) for tok in ladder.split(",") if tok.strip()})
+    except ValueError:
+        raise ValueError(f"unparseable ladder {ladder!r} (want e.g. '1,2,8')")
+    if not rungs or rungs[0] < 1:
+        raise ValueError(f"ladder rungs must be >= 1, got {ladder!r}")
+    if rungs[-1] > max_batch:
+        raise ValueError(
+            f"ladder rung {rungs[-1]} exceeds --max_batch {max_batch}"
+        )
+    return rungs
+
+
+def ledger_spec(algo: str) -> str:
+    """The capture-spec name whose committed budget file carries the
+    serving jits: the base `serve` spec is the SAC ladder (the capture
+    default), other algos are `<algo>@serve` variants."""
+    return "serve" if algo == "sac" else f"{algo}@serve"
+
+
+def serve_mem_budget_bytes() -> int:
+    """Peak-bytes budget per serving executable. Defaults to the partition
+    heuristic's CPU budget; SHEEPRL_TPU_SERVE_MEM_MB overrides."""
+    mb = os.environ.get("SHEEPRL_TPU_SERVE_MEM_MB")
+    if mb:
+        return int(float(mb) * 2**20)
+    return partition_mem_budget_bytes()
+
+
+@dataclasses.dataclass
+class RungDecision:
+    rung: int
+    accepted: bool
+    source: str  # 'ledger' | 'probe' | 'floor' | 'error'
+    peak_bytes: int
+    reason: str
+
+    def as_event(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def size_ladder(
+    fn: Callable,
+    example_of: Callable[[int], tuple],
+    rungs: list[int],
+    spec: str,
+    mem_budget_bytes: int | None = None,
+    store_path: str | None = None,
+) -> list[RungDecision]:
+    """Decide, per requested rung, whether its executable fits the serving
+    memory budget. `fn` is the jitted per-rung policy step, `example_of`
+    maps a rung to its exact call arguments (live pytrees /
+    ShapeDtypeStructs). Returns one RungDecision per rung, in order."""
+    budget = serve_mem_budget_bytes() if mem_budget_bytes is None else mem_budget_bytes
+    decisions: list[RungDecision] = []
+    for rung in rungs:
+        example = example_of(rung)
+        peak, source, note = _predict_peak(fn, example, spec, rung, store_path)
+        if peak is None:
+            # unmeasurable (lowering failed, no ledger): keep the rung —
+            # refusing to serve on a broken probe is worse than serving
+            decisions.append(
+                RungDecision(rung, True, "error", 0, f"unmeasured ({note}); kept")
+            )
+            continue
+        if peak <= budget:
+            decisions.append(
+                RungDecision(
+                    rung, True, source, peak,
+                    f"peak {peak / 2**20:.1f}MiB within budget "
+                    f"{budget / 2**20:.0f}MiB ({note})",
+                )
+            )
+        elif rung == min(rungs):
+            decisions.append(
+                RungDecision(
+                    rung, True, "floor", peak,
+                    f"peak {peak / 2**20:.1f}MiB EXCEEDS budget "
+                    f"{budget / 2**20:.0f}MiB but the smallest rung is "
+                    f"always kept ({note})",
+                )
+            )
+        else:
+            decisions.append(
+                RungDecision(
+                    rung, False, source, peak,
+                    f"peak {peak / 2**20:.1f}MiB > budget "
+                    f"{budget / 2**20:.0f}MiB ({note})",
+                )
+            )
+    return decisions
+
+
+def _predict_peak(
+    fn: Callable, example: tuple, spec: str, rung: int, store_path: str | None
+) -> tuple[int | None, str, str]:
+    """-> (predicted peak bytes | None, source, note)."""
+    key = f"{spec}/policy_b{rung}"
+    mem = ledger_entry(key, "memory")
+    if mem and mem.get("peak_bytes") and mem.get("argument_bytes"):
+        try:
+            live_args = _example_arg_bytes(example)
+        except Exception:
+            live_args = 0
+        if live_args:
+            # activations scale with the data; parameters cancel out of the
+            # ratio (same scaling argument as decide_batch_chunk's step 0)
+            ratio = max(live_args / max(int(mem["argument_bytes"]), 1), 1.0)
+            peak = int(int(mem["peak_bytes"]) * ratio)
+            return peak, "ledger", f"ledger {key} x{ratio:.2f}"
+    # no committed entry (an uncaptured algo/width): one trial compile,
+    # memoized in the shared decision cache
+    from ..compile import decisions as dec
+    from ..compile.partition import compiled_memory_stats
+    from ..compile.plan import avals_of
+
+    def _measure() -> dict:
+        try:
+            exe = fn.lower(*avals_of(example)).compile()
+        except Exception as err:
+            return {"error": f"trial compile failed: {type(err).__name__}"}
+        stats = compiled_memory_stats(exe) or {}
+        return {"peak_bytes": int(stats.get("peak_bytes", 0))}
+
+    record, src = dec.measured_probe(
+        "serve_ladder", key, example, _measure, store_path=store_path
+    )
+    if record.get("error"):
+        return None, "error", record["error"]
+    tag = "probe cache" if src == "cache" else "probe"
+    return int(record.get("peak_bytes", 0)), "probe", tag

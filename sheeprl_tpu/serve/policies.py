@@ -1,0 +1,332 @@
+"""Per-algo serving adapters: build the served params from an orbax
+checkpoint (or a fresh tiny init), expose ONE jitted fixed-shape policy
+step per ladder rung, and map batched rows to per-request results.
+
+Two families (the tentpole's CLI surface):
+
+  - `sac` — stateless greedy actor: obs [B, obs_dim] -> actions
+    [B, act_dim] via `SACActor.get_greedy_actions` (tanh(mean), no
+    sampling — deterministic, so the served action is bit-exact vs a
+    direct policy call on the same params version);
+  - `dreamer_v3` — the PlayerDV3 recurrent step in greedy mode
+    (`is_training=False`, zero exploration). The recurrent PlayerState
+    lives SERVER-SIDE in a per-session table: a request carries a
+    `session` id (plus an optional `reset` flag), the adapter gathers the
+    session's state row into the batch, steps, and scatters the updated
+    row back. Requests are single-row — one session, one env, one row.
+
+The served params pytree is exactly what the ParamsStore hot-swaps: the
+SAC actor module, or the whole PlayerDV3 (same treedef across a reload,
+so the AOT executables stay valid).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from .errors import ServeError
+
+__all__ = ["DV3ServePolicy", "SACServePolicy", "build_policy"]
+
+
+def build_policy(args, log_dir: str):
+    """-> (policy, params, loader). `loader(path)` re-extracts the served
+    params from a checkpoint — the ParamsStore reload callback."""
+    if args.algo == "sac":
+        return _build_sac(args, log_dir)
+    if args.algo == "dreamer_v3":
+        return _build_dv3(args, log_dir)
+    raise ServeError(f"unservable algo {args.algo!r}")
+
+
+def _training_args(args, args_cls, parser_cls):
+    """The training-task config the model is rebuilt from: the
+    checkpoint's args.json when serving a checkpoint (authoritative —
+    widths/keys must match the saved weights), else --model_argv."""
+    from ..utils.checkpoint import load_checkpoint_args
+
+    parser = parser_cls(args_cls)
+    if args.ckpt:
+        saved = load_checkpoint_args(args.ckpt)
+        if not saved:
+            raise ServeError(
+                f"checkpoint {args.ckpt} has no args.json sidecar — cannot "
+                "rebuild the model it holds"
+            )
+        saved = dict(saved)
+        # never recurse into training-resume paths, never write run dirs
+        saved.update(checkpoint_path=None, log_dir=None, root_dir=None)
+        (targs,) = parser.parse_dict(saved)
+    else:
+        tokens = (args.model_argv or "").split()
+        (targs,) = parser.parse_args_into_dataclasses(tokens)
+    return targs
+
+
+# ---------------------------------------------------------------------------
+# SAC
+# ---------------------------------------------------------------------------
+
+
+class SACServePolicy:
+    algo = "sac"
+    max_rows_per_request = None  # any row count up to the largest rung
+
+    def __init__(self, obs_dim: int, act_dim: int):
+        import jax
+
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.step: Callable = jax.jit(
+            lambda actor, obs: actor.get_greedy_actions(obs)
+        )
+
+    def example(self, params, rung: int) -> tuple:
+        import jax.numpy as jnp
+
+        from ..compile import sds
+
+        return (params, sds((rung, self.obs_dim), jnp.float32))
+
+    def run(self, runner, params, version, batch, pendings, rung) -> dict:
+        del version, pendings, rung
+        acts = runner(params, np.asarray(batch["obs"], dtype=np.float32))
+        return {"actions": np.asarray(acts)}
+
+
+def _build_sac(args, log_dir: str):
+    import jax
+
+    from ..algos.sac.agent import SACAgent
+    from ..algos.sac.args import SACArgs
+    from ..algos.sac.sac import make_optimizers
+    from ..utils.checkpoint import load_checkpoint
+    from ..utils.env import make_env
+    from ..utils.parser import DataclassArgumentParser
+
+    targs = _training_args(args, SACArgs, DataclassArgumentParser)
+    env = make_env(
+        targs.env_id, targs.seed, 0, False, run_name=log_dir, prefix="serve",
+        action_repeat=targs.action_repeat,
+    )()
+    try:
+        import gymnasium as gym
+
+        if not isinstance(env.action_space, gym.spaces.Box):
+            raise ServeError("sac serving needs a continuous action space")
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(np.prod(env.action_space.shape))
+        action_low, action_high = env.action_space.low, env.action_space.high
+    finally:
+        env.close()
+
+    agent = SACAgent.init(
+        jax.random.PRNGKey(targs.seed), obs_dim, act_dim,
+        num_critics=targs.num_critics,
+        actor_hidden_size=targs.actor_hidden_size,
+        critic_hidden_size=targs.critic_hidden_size,
+        action_low=action_low, action_high=action_high,
+        alpha=targs.alpha, tau=targs.tau, precision=targs.precision,
+    )
+    qf_optim, actor_optim, alpha_optim = make_optimizers(targs)
+    template = {
+        "agent": agent,
+        "qf_optimizer": qf_optim.init(agent.critics),
+        "actor_optimizer": actor_optim.init(agent.actor),
+        "alpha_optimizer": alpha_optim.init(agent.log_alpha),
+        "global_step": 0,
+    }
+
+    def loader(path: str):
+        return load_checkpoint(path, template)["agent"].actor
+
+    params = loader(args.ckpt) if args.ckpt else agent.actor
+    return SACServePolicy(obs_dim, act_dim), params, loader
+
+
+# ---------------------------------------------------------------------------
+# DreamerV3
+# ---------------------------------------------------------------------------
+
+
+class DV3ServePolicy:
+    algo = "dreamer_v3"
+    max_rows_per_request = 1  # one session, one env, one row
+
+    def __init__(
+        self,
+        obs_space: dict,
+        cnn_keys,
+        mlp_keys,
+        session_cap: int = 1024,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from ..algos.dreamer_v3.utils import make_device_preprocess
+
+        self.obs_space = obs_space
+        self.obs_keys = [*cnn_keys, *mlp_keys]
+        self.session_cap = session_cap
+        self._sessions: dict[str, dict[str, np.ndarray]] = {}
+        self._init_cache: tuple[int, dict[str, np.ndarray]] | None = None
+        prep = make_device_preprocess(cnn_keys)
+
+        def _step(player, state, obs):
+            # greedy serving: mode actions, zero exploration; the PRNG key
+            # is a constant — with is_training=False and expl 0 the random
+            # draws are inert, so the step is deterministic per (params,
+            # state, obs)
+            from ..algos.dreamer_v3.agent import PlayerState
+
+            st = PlayerState(
+                actions=state["actions"],
+                recurrent_state=state["recurrent"],
+                stochastic_state=state["stochastic"],
+            )
+            new_st, acts = player.step(
+                st, prep(obs), jax.random.PRNGKey(0), jnp.float32(0.0),
+                is_training=False,
+            )
+            return {
+                "actions": new_st.actions,
+                "recurrent": new_st.recurrent_state,
+                "stochastic": new_st.stochastic_state,
+            }, acts
+
+        self.step: Callable = jax.jit(_step)
+
+    # ---- state rows --------------------------------------------------------
+    def _init_row(self, version: int, params) -> dict[str, np.ndarray]:
+        """A fresh single-row PlayerState as numpy, cached per params
+        version (the transition prior depends on the weights)."""
+        if self._init_cache is not None and self._init_cache[0] == version:
+            return self._init_cache[1]
+        st = params.init_states(1)
+        row = {
+            "actions": np.asarray(st.actions)[0],
+            "recurrent": np.asarray(st.recurrent_state)[0],
+            "stochastic": np.asarray(st.stochastic_state)[0],
+        }
+        self._init_cache = (version, row)
+        return row
+
+    def state_dims(self, params) -> dict[str, int]:
+        row = self._init_row(0, params)
+        return {k: int(v.shape[0]) for k, v in row.items()}
+
+    def example(self, params, rung: int) -> tuple:
+        import jax.numpy as jnp
+
+        from ..compile import sds
+
+        dims = self.state_dims(params)
+        dt = jnp.dtype(params.compute_dtype)
+        state = {k: sds((rung, d), dt) for k, d in dims.items()}
+        obs = {
+            k: sds((rung,) + tuple(self.obs_space[k].shape), self.obs_space[k].dtype)
+            for k in self.obs_keys
+        }
+        return (params, state, obs)
+
+    def run(self, runner, params, version, batch, pendings, rung) -> dict:
+        init = self._init_row(version, params)
+        rows = []
+        sids: list[str | None] = []
+        for p in pendings:
+            sid = p.meta.get("session")
+            reset = bool(p.meta.get("reset"))
+            if sid is not None and not reset and sid in self._sessions:
+                rows.append(self._sessions[sid])
+            else:
+                rows.append(init)
+            sids.append(sid)
+        while len(rows) < rung:  # pad rows carry the inert init state
+            rows.append(init)
+        state = {
+            k: np.stack([r[k] for r in rows], axis=0)
+            for k in ("actions", "recurrent", "stochastic")
+        }
+        obs = {k: np.asarray(batch[k]) for k in self.obs_keys}
+        new_state, acts = runner(params, state, obs)
+        new_state = {k: np.asarray(v) for k, v in new_state.items()}
+        # scatter updated rows back; only the dispatch thread touches the
+        # table, so plain dict ops are race-free
+        for i, sid in enumerate(sids):
+            if sid is None:
+                continue
+            self._sessions[sid] = {k: new_state[k][i] for k in new_state}
+        while len(self._sessions) > self.session_cap:  # FIFO eviction
+            self._sessions.pop(next(iter(self._sessions)))
+        return {"actions": np.asarray(acts)}
+
+
+def _build_dv3(args, log_dir: str):
+    import jax
+
+    from .. import ops
+    from ..algos.dreamer_v3.agent import PlayerDV3, build_models
+    from ..algos.dreamer_v3.args import DreamerV3Args
+    from ..algos.dreamer_v3.dreamer_v3 import make_optimizers
+    from ..algos.ppo.ppo import actions_dim_of, validate_obs_keys
+    from ..utils.checkpoint import load_checkpoint
+    from ..utils.env import make_dict_env
+    from ..utils.parser import DataclassArgumentParser
+
+    targs = _training_args(args, DreamerV3Args, DataclassArgumentParser)
+    # one probe env to read the spaces, then close — the flock learner's
+    # pattern (dreamer_v3.py:556-565); serving never steps an env
+    probe = make_dict_env(
+        targs.env_id, targs.seed, rank=0, args=targs,
+        run_name=log_dir, vector_env_idx=0,
+    )()
+    observation_space = probe.observation_space
+    action_space = probe.action_space
+    probe.close()
+    cnn_keys, mlp_keys = validate_obs_keys(observation_space, targs)
+    actions_dim, is_continuous = actions_dim_of(action_space)
+
+    world_model, actor, critic, target_critic = build_models(
+        jax.random.PRNGKey(targs.seed), actions_dim, is_continuous, targs,
+        observation_space.spaces, cnn_keys, mlp_keys,
+    )
+
+    def make_player(wm, act) -> PlayerDV3:
+        return PlayerDV3(
+            encoder=wm.encoder, rssm=wm.rssm, actor=act,
+            actions_dim=tuple(actions_dim),
+            stochastic_size=targs.stochastic_size,
+            discrete_size=targs.discrete_size,
+            recurrent_state_size=targs.recurrent_state_size,
+            is_continuous=is_continuous,
+            compute_dtype=targs.precision,
+        )
+
+    world_optimizer, actor_optimizer, critic_optimizer = make_optimizers(targs)
+    moments = ops.Moments.init(
+        targs.moments_decay, targs.moment_max,
+        targs.moments_percentile_low, targs.moments_percentile_high,
+    )
+    template = {
+        "world_model": world_model,
+        "actor": actor,
+        "critic": critic,
+        "target_critic": target_critic,
+        "world_optimizer": world_optimizer.init(world_model),
+        "actor_optimizer": actor_optimizer.init(actor),
+        "critic_optimizer": critic_optimizer.init(critic),
+        "moments": moments,
+        "expl_decay_steps": 0,
+        "global_step": 0,
+        "batch_size": 0,
+    }
+
+    def loader(path: str) -> PlayerDV3:
+        ckpt = load_checkpoint(path, template)
+        return make_player(ckpt["world_model"], ckpt["actor"])
+
+    params = loader(args.ckpt) if args.ckpt else make_player(world_model, actor)
+    policy = DV3ServePolicy(observation_space.spaces, cnn_keys, mlp_keys)
+    return policy, params, loader

@@ -504,7 +504,12 @@ def test_bf16_capture_variants_cover_all_mains():
     assert {s.split("@")[0] for s in bf16_specs} == set(tasks)
     for spec in bf16_specs:
         algo, extra = jc.resolve_capture(spec)
-        assert extra[-2:] == ["--precision", "bfloat16"]
+        # serve has no top-level --precision; its variant re-specifies the
+        # nested --model_argv with the flag appended (last-wins)
+        if extra[-2:] == ["--precision", "bfloat16"]:
+            continue
+        assert extra[-2] == "--model_argv"
+        assert extra[-1].split()[-2:] == ["--precision", "bfloat16"]
 
 
 def test_fingerprint_counts_bf16_upcasts():

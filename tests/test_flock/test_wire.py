@@ -169,3 +169,65 @@ def test_push_payload_roundtrip_bit_exact():
     out_b, idx_b = ops[1]
     assert idx_b == [0, 1]
     np.testing.assert_array_equal(out_b["dones"], tree_b["dones"])
+
+
+# ---------------------------------------------------------------------------
+# frame-kind registry (ISSUE 15 satellite): the kind byte is a wire-format
+# contract — committed values may NEVER be renumbered, and new kinds must
+# register without touching old ones
+# ---------------------------------------------------------------------------
+
+PINNED_KINDS = {
+    # flock (PR 14)
+    "hello": 1,
+    "welcome": 2,
+    "push": 3,
+    "push_ok": 4,
+    "heartbeat": 5,
+    "heartbeat_ok": 6,
+    "get_weights": 7,
+    "weights": 8,
+    "weights_unchanged": 9,
+    "bye": 10,
+    "error": 11,
+    # serving tier (PR 15)
+    "request": 12,
+    "response": 13,
+    "shed": 14,
+    "reload": 15,
+}
+
+
+def test_frame_kind_values_are_pinned():
+    """Regression pin: adding a frame kind must not renumber existing
+    ones. If this fails, a wire-format break shipped — fix the numbers,
+    not this test."""
+    for name, value in PINNED_KINDS.items():
+        assert getattr(wire, name.upper()) == value, name
+        assert wire.KIND_NAMES[value] == name
+
+
+def test_register_kind_rejects_collisions():
+    with pytest.raises(ValueError):
+        wire.register_kind(wire.HELLO, "not-hello")  # value taken
+    with pytest.raises(ValueError):
+        wire.register_kind(200, "hello")  # name taken by another value
+    with pytest.raises(ValueError):
+        wire.register_kind(0, "zero")  # out of u8 range
+    with pytest.raises(ValueError):
+        wire.register_kind(256, "too-big")
+    # re-registering the same (value, name) pair is idempotent
+    assert wire.register_kind(wire.HELLO, "hello") == wire.HELLO
+
+
+def test_serve_frames_travel_like_flock_frames():
+    a, b = _pair()
+    try:
+        wire.send_json(a, wire.SHED, {"id": 4, "retry_after_ms": 12.5})
+        kind, payload = wire.recv_frame(b)
+        assert kind == wire.SHED
+        wire.send_frame(a, wire.REQUEST, b"\x01\x02")
+        assert wire.recv_frame(b) == (wire.REQUEST, b"\x01\x02")
+    finally:
+        a.close()
+        b.close()

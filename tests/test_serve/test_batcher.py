@@ -1,0 +1,214 @@
+"""MicroBatcher edge cases (ISSUE 15 satellite): empty window flush,
+oversized rejection, deadline shed before dispatch, pad-slice
+bit-exactness. All driven through `flush_once` with an injected clock —
+no threads, no sockets, no model."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.batcher import MicroBatcher, _stack_pad
+from sheeprl_tpu.serve.errors import OversizedRequest, RequestShed, ServeError
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class RecordingDispatch:
+    """Echo dispatch: result rows mirror the stacked obs; records calls."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, stacked, pendings, rung):
+        self.calls.append((stacked, [p.rows for p in pendings], rung))
+        return {"actions": stacked["obs"] * 2.0}, 7
+
+
+def _batcher(rungs=(1, 2, 4), window_ms=5.0, deadline_ms=100.0, clock=None):
+    dispatch = RecordingDispatch()
+    b = MicroBatcher(
+        dispatch, list(rungs), window_ms=window_ms,
+        default_deadline_ms=deadline_ms, clock=clock or FakeClock(),
+    )
+    return b, dispatch
+
+
+def _obs(rows, dim=3, fill=1.0):
+    return {"obs": np.full((rows, dim), fill, dtype=np.float32)}
+
+
+def test_empty_window_flush_dispatches_nothing():
+    b, dispatch = _batcher()
+    assert b.flush_once() == 0
+    assert dispatch.calls == []
+    assert b.gauges()["Serve/dispatches"] == 0.0
+
+
+def test_oversized_request_rejected_at_submit():
+    b, dispatch = _batcher(rungs=(1, 2, 4))
+    with pytest.raises(OversizedRequest) as exc:
+        b.submit(_obs(5))
+    assert exc.value.rows == 5 and exc.value.max_rung == 4
+    # rejected before it ever reached the queue: nothing to dispatch
+    assert b.flush_once() == 0 and dispatch.calls == []
+    assert b.gauges()["Serve/oversized_total"] == 1.0
+
+
+def test_mismatched_row_axes_rejected():
+    b, _ = _batcher()
+    with pytest.raises(ServeError, match="rows axis"):
+        b.submit({"a": np.zeros((2, 3)), "b": np.zeros((3, 3))})
+
+
+def test_deadline_expired_request_shed_before_dispatch():
+    clock = FakeClock(0.0)
+    b, dispatch = _batcher(deadline_ms=50.0, clock=clock)
+    pending = b.submit(_obs(1))
+    clock.t = 0.2  # 200ms later: way past the 50ms deadline
+    assert b.flush_once() == 1
+    assert dispatch.calls == []  # shed BEFORE dispatch — no compute spent
+    with pytest.raises(RequestShed) as exc:
+        pending.wait(timeout=1.0)
+    assert exc.value.retry_after_ms >= 0.0
+    assert b.gauges()["Serve/shed_total"] == 1.0
+
+
+def test_expired_and_live_requests_split_in_one_flush():
+    clock = FakeClock(0.0)
+    b, dispatch = _batcher(deadline_ms=50.0, clock=clock)
+    stale = b.submit(_obs(1))
+    clock.t = 0.2
+    fresh = b.submit(_obs(1, fill=3.0))  # enqueued at t=0.2, not expired
+    assert b.flush_once() == 2
+    with pytest.raises(RequestShed):
+        stale.wait(timeout=1.0)
+    out = fresh.wait(timeout=1.0)
+    assert np.array_equal(out["actions"], _obs(1, fill=6.0)["obs"])
+    assert len(dispatch.calls) == 1
+
+
+def test_pad_slice_roundtrip_across_requests():
+    """3 requests (1+2+1 rows) -> one rung-4 dispatch, slices return in
+    submit order and carry exactly each request's rows."""
+    b, dispatch = _batcher()
+    p1 = b.submit(_obs(1, fill=1.0))
+    p2 = b.submit(_obs(2, fill=2.0))
+    p3 = b.submit(_obs(1, fill=3.0))
+    assert b.flush_once() == 3
+    (stacked, rows, rung), = dispatch.calls
+    assert rows == [1, 2, 1] and rung == 4
+    assert np.array_equal(p1.wait()["actions"], np.full((1, 3), 2.0, np.float32))
+    assert np.array_equal(p2.wait()["actions"], np.full((2, 3), 4.0, np.float32))
+    assert np.array_equal(p3.wait()["actions"], np.full((1, 3), 6.0, np.float32))
+    assert p2.rung == 4 and p2.version == 7
+    assert b.gauges()["Serve/batch_occupancy"] == 1.0  # 4 rows / rung 4
+
+
+def test_padding_goes_to_next_rung_and_is_sliced_off():
+    b, dispatch = _batcher(rungs=(1, 2, 4))
+    p = b.submit(_obs(3, fill=1.0))
+    assert b.flush_once() == 1
+    (stacked, _, rung), = dispatch.calls
+    assert rung == 4 and stacked["obs"].shape == (4, 3)
+    assert np.array_equal(stacked["obs"][3], np.zeros(3, np.float32))  # pad row
+    assert p.wait()["actions"].shape == (3, 3)  # pad sliced off
+
+
+def test_batched_of_one_bit_exact_vs_direct_jit_call():
+    """The parity receipt: a single-row request served through rung 1 IS
+    the program a direct batch-1 jit call runs — results are bit-exact.
+    And a padded dispatch (rung 4) matches the same jit applied to the
+    padded batch, row for row."""
+    import jax
+    import jax.numpy as jnp
+
+    w = np.random.default_rng(0).standard_normal((3, 2)).astype(np.float32)
+    step = jax.jit(lambda x: jnp.tanh(x @ w))
+
+    def dispatch(stacked, pendings, rung):
+        return {"actions": np.asarray(step(stacked["obs"]))}, 1
+
+    b = MicroBatcher(dispatch, [1, 4], window_ms=0.0, default_deadline_ms=0.0)
+    one = np.random.default_rng(1).standard_normal((1, 3)).astype(np.float32)
+    p = b.submit({"obs": one})
+    b.flush_once()
+    assert p.rung == 1
+    assert np.array_equal(p.wait()["actions"], np.asarray(step(one)))
+
+    three = np.random.default_rng(2).standard_normal((3, 3)).astype(np.float32)
+    p2 = b.submit({"obs": three})
+    b.flush_once()
+    assert p2.rung == 4
+    padded = np.concatenate([three, np.zeros((1, 3), np.float32)])
+    assert np.array_equal(p2.wait()["actions"], np.asarray(step(padded))[:3])
+
+
+def test_dispatch_failure_completes_requests_with_typed_error():
+    def bad(stacked, pendings, rung):
+        raise RuntimeError("device fell over")
+
+    b = MicroBatcher(bad, [2], window_ms=0.0, default_deadline_ms=0.0)
+    p = b.submit(_obs(1))
+    b.flush_once()
+    with pytest.raises(ServeError, match="device fell over"):
+        p.wait(timeout=1.0)
+    assert b.gauges()["Serve/failed_total"] == 1.0
+
+
+def test_greedy_fill_keeps_overflow_for_next_flush():
+    b, dispatch = _batcher(rungs=(1, 2, 4))
+    for fill in (1.0, 2.0):
+        b.submit(_obs(3, fill=fill))  # 3+3 rows > max rung 4
+    assert b.flush_once() == 1  # first request only
+    assert b.queue_depth() == 3
+    assert b.flush_once() == 1
+    assert b.queue_depth() == 0
+    assert [c[2] for c in dispatch.calls] == [4, 4]
+
+
+@pytest.mark.timeout(30)
+def test_close_drains_queue_zero_drop():
+    """Shutdown answers every queued request — the zero-drop guarantee."""
+    b, _ = _batcher(deadline_ms=0.0)  # no deadline: nothing may be shed
+    pendings = [b.submit(_obs(1, fill=float(i))) for i in range(9)]
+    b.start()
+    b.close()
+    for p in pendings:
+        p.wait(timeout=5.0)  # raises if dropped
+    assert b.gauges()["Serve/served_total"] == 9.0
+
+
+@pytest.mark.timeout(30)
+def test_threaded_loop_serves_concurrent_submitters():
+    b, _ = _batcher(window_ms=1.0, deadline_ms=0.0)
+    b.start()
+    results = []
+    def work(i):
+        p = b.submit(_obs(1, fill=float(i)))
+        results.append((i, p.wait(timeout=10.0)["actions"][0, 0]))
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    b.close()
+    assert sorted(results) == [(i, 2.0 * i) for i in range(16)]
+
+
+def test_stack_pad_preserves_dtype_and_values():
+    trees = [
+        {"x": np.arange(6, dtype=np.int32).reshape(2, 3)},
+        {"x": np.arange(3, dtype=np.int32).reshape(1, 3) + 100},
+    ]
+    out = _stack_pad(trees, rows=3, rung=4)
+    assert out["x"].dtype == np.int32 and out["x"].shape == (4, 3)
+    assert np.array_equal(out["x"][:2], trees[0]["x"])
+    assert np.array_equal(out["x"][2:3], trees[1]["x"])
+    assert np.array_equal(out["x"][3], np.zeros(3, np.int32))

@@ -1,0 +1,109 @@
+"""Ladder parsing + ledger-first rung sizing (ISSUE 15)."""
+
+import numpy as np
+import pytest
+
+import sheeprl_tpu.serve.ladder as lm
+
+
+def test_parse_rungs_auto_powers_of_two():
+    assert lm.parse_rungs("auto", 8) == [1, 2, 4, 8]
+    assert lm.parse_rungs("auto", 6) == [1, 2, 4, 6]  # max_batch always kept
+    assert lm.parse_rungs("auto", 1) == [1]
+
+
+def test_parse_rungs_explicit_list():
+    assert lm.parse_rungs("4,1,2", 8) == [1, 2, 4]
+    with pytest.raises(ValueError, match="exceeds"):
+        lm.parse_rungs("16", 8)
+    with pytest.raises(ValueError, match=">= 1"):
+        lm.parse_rungs("0,2", 8)
+    with pytest.raises(ValueError, match="unparseable"):
+        lm.parse_rungs("a,b", 8)
+
+
+def test_ledger_spec_naming():
+    assert lm.ledger_spec("sac") == "serve"
+    assert lm.ledger_spec("dreamer_v3") == "dreamer_v3@serve"
+
+
+def test_serve_mem_budget_env_override(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_SERVE_MEM_MB", "64")
+    assert lm.serve_mem_budget_bytes() == 64 * 2**20
+
+
+def _fake_ledger(peaks):
+    """ledger_entry stand-in: peak scales with the rung suffix."""
+
+    def entry(key, section="memory"):
+        assert section == "memory"
+        rung = int(key.rsplit("_b", 1)[1])
+        if rung not in peaks:
+            return None
+        return {"peak_bytes": peaks[rung], "argument_bytes": 100 * rung}
+
+    return entry
+
+
+def _example_of(rung):
+    # argument bytes == 100 * rung -> ledger ratio 1.0 exactly
+    return (np.zeros((rung, 25), dtype=np.float32),)
+
+
+def test_size_ladder_ledger_first_accepts_within_budget(monkeypatch):
+    monkeypatch.setattr(lm, "ledger_entry", _fake_ledger({1: 50, 2: 90, 4: 200}))
+    dec = lm.size_ladder(None, _example_of, [1, 2, 4], "serve", mem_budget_bytes=100)
+    assert [(d.rung, d.accepted, d.source) for d in dec] == [
+        (1, True, "ledger"), (2, True, "ledger"), (4, False, "ledger"),
+    ]
+    assert dec[2].peak_bytes == 200
+
+
+def test_size_ladder_smallest_rung_kept_even_over_budget(monkeypatch):
+    monkeypatch.setattr(lm, "ledger_entry", _fake_ledger({2: 500, 4: 900}))
+    dec = lm.size_ladder(None, _example_of, [2, 4], "serve", mem_budget_bytes=100)
+    assert dec[0].accepted and dec[0].source == "floor"
+    assert not dec[1].accepted
+
+
+def test_size_ladder_scales_ledger_by_argument_ratio(monkeypatch):
+    monkeypatch.setattr(lm, "ledger_entry", _fake_ledger({1: 100}))
+    # live args are 4x the ledger's argument bytes -> predicted peak 4x
+    dec = lm.size_ladder(
+        None, lambda r: (np.zeros((r, 100), np.float32),), [1], "serve",
+        mem_budget_bytes=10**9,
+    )
+    assert dec[0].peak_bytes == 400
+    assert "x4.00" in dec[0].reason
+
+
+def test_size_ladder_probe_fallback_uses_real_compile(monkeypatch, tmp_path):
+    """No ledger entry -> one trial AOT compile, memoized in the decision
+    cache; a second sizing run must hit the cache."""
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(lm, "ledger_entry", lambda *a, **k: None)
+    fn = jax.jit(lambda x: jnp.tanh(x * 2.0))
+    store = str(tmp_path / "decisions.json")
+    dec = lm.size_ladder(
+        fn, lambda r: (np.zeros((r, 8), np.float32),), [2], "nosuchspec",
+        mem_budget_bytes=10**9, store_path=store,
+    )
+    assert dec[0].accepted and dec[0].source == "probe"
+    assert "probe cache" not in dec[0].reason
+    dec2 = lm.size_ladder(
+        fn, lambda r: (np.zeros((r, 8), np.float32),), [2], "nosuchspec",
+        mem_budget_bytes=10**9, store_path=store,
+    )
+    assert "probe cache" in dec2[0].reason
+
+
+def test_size_ladder_committed_ledger_covers_serve_spec():
+    """The committed analysis/budget entries for the capture-spec ladder
+    must satisfy the ledger-first path: no probes, no compiles."""
+    entry = lm.ledger_entry("serve/policy_b1", "memory")
+    assert entry is not None, "analysis/budget/serve.json missing the serving ladder"
+    assert entry.get("peak_bytes") and entry.get("argument_bytes")
+    entry4 = lm.ledger_entry("dreamer_v3@serve/policy_b4", "memory")
+    assert entry4 is not None

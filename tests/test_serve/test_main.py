@@ -1,0 +1,87 @@
+"""The registered `serve` main: CLI surface, dry-run lifecycle, capture
+mode, telemetry record."""
+
+import glob
+import json
+import os
+
+import pytest
+
+SAC_TINY_MODEL = (
+    "--env_id Pendulum-v1 --actor_hidden_size 16 --critic_hidden_size 16"
+)
+
+
+def test_serve_task_registered():
+    import sheeprl_tpu.algos  # noqa: F401 — fire registrations
+    from sheeprl_tpu.utils.registry import tasks
+
+    assert "serve" in tasks
+
+
+def test_serve_args_validation():
+    from sheeprl_tpu.serve import ServeArgs
+
+    with pytest.raises(ValueError, match="algo"):
+        ServeArgs(algo="ppo")
+    with pytest.raises(ValueError, match="max_batch"):
+        ServeArgs(max_batch=0)
+    args = ServeArgs(algo="dreamer_v3", max_batch=4)
+    assert args.warm_compile == "on"  # serving default: AOT the ladder
+
+
+def test_serve_help_mentions_serving_surface(capsys):
+    from sheeprl_tpu.utils.parser import DataclassArgumentParser
+    from sheeprl_tpu.serve import ServeArgs
+
+    parser = DataclassArgumentParser(ServeArgs)
+    with pytest.raises(SystemExit):
+        parser.parse_args_into_dataclasses(["--help"])
+    help_text = capsys.readouterr().out
+    for flag in ("--ckpt", "--batch_window_ms", "--deadline_ms", "--max_batch",
+                 "--ladder", "--bind", "--reload_poll_s"):
+        assert flag in help_text, flag
+
+
+@pytest.mark.timeout(60)
+def test_capture_mode_records_ladder_jits(tmp_path):
+    """The analysis sweep contract: capture unwinds at plan.start() with
+    one policy jit per requested rung and nothing executed."""
+    from sheeprl_tpu.analysis import jaxpr_check as jc
+
+    algo, extra = jc.resolve_capture("serve")
+    plan = jc.capture_plan(algo, str(tmp_path), extra)
+    assert [e.name for e in plan._entries] == ["policy_b1", "policy_b2", "policy_b4"]
+
+
+@pytest.mark.timeout(180)
+def test_dry_run_serves_and_writes_telemetry(tmp_path):
+    """--dry_run brings the full stack up (policy, ladder, AOT plan,
+    socket), writes the address file, emits a parseable Serve/* telemetry
+    record, and exits cleanly."""
+    import sheeprl_tpu.algos  # noqa: F401
+    from sheeprl_tpu.utils.registry import tasks
+
+    tasks["serve"]([
+        "--algo", "sac",
+        "--model_argv", SAC_TINY_MODEL,
+        "--root_dir", str(tmp_path),
+        "--run_name", "dry",
+        "--platform", "cpu",
+        "--max_batch", "2",
+        "--dry_run",
+    ])
+    run_dir = os.path.join(str(tmp_path), "dry")
+    addr = open(os.path.join(run_dir, "serve_address")).read().strip()
+    assert addr.startswith(("unix:", "tcp:"))
+    records = []
+    for path in glob.glob(os.path.join(run_dir, "**", "*.jsonl"), recursive=True):
+        with open(path) as fh:
+            records += [json.loads(line) for line in fh if line.strip()]
+    serve_metrics = [
+        r for r in records
+        if any(str(k).startswith("Serve/") for k in r.get("metrics", {}))
+    ]
+    assert serve_metrics, f"no Serve/* telemetry record in {run_dir}"
+    events = {r.get("event") for r in records}
+    assert "serve.start" in events and "serve.stop" in events

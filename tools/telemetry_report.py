@@ -115,6 +115,12 @@ def summarize(events: list[dict]) -> dict:
         "flock_events": [],         # flock.* membership lifecycle events
         "flock_gauges": {},         # last Flock/* gauge values
         "flock_staleness": {},      # actor_id -> list of staleness samples
+        # ISSUE 15 serving subsystem (serve/)
+        "serve_start": None,        # serve.start (address/algo/rungs)
+        "serve_stop": None,         # serve.stop (completed/final version)
+        "serve_reloads": [],        # serve.reload timeline (ok/version/seconds)
+        "serve_ladder": [],         # serve.ladder rung-sizing decisions
+        "serve_gauges": {},         # last Serve/* gauge values
     }
     for ev in events:
         ts = ev.get("ts")
@@ -158,6 +164,14 @@ def summarize(events: list[dict]) -> dict:
             summary["flock_started"] = ev
         elif isinstance(kind, str) and kind.startswith("flock."):
             summary["flock_events"].append(ev)
+        elif kind == "serve.start":
+            summary["serve_start"] = ev
+        elif kind == "serve.stop":
+            summary["serve_stop"] = ev
+        elif kind == "serve.reload":
+            summary["serve_reloads"].append(ev)
+        elif kind == "serve.ladder":
+            summary["serve_ladder"].append(ev)
         elif kind == "log":
             summary["log_events"] += 1
             if ev.get("step") is not None:
@@ -191,6 +205,8 @@ def summarize(events: list[dict]) -> dict:
                     summary["anakin_gauges"][k] = v
                 elif k.startswith("Fault/"):
                     summary["fault_gauges"][k] = v
+                elif k.startswith("Serve/"):
+                    summary["serve_gauges"][k] = v
                 elif k.startswith("Flock/"):
                     summary["flock_gauges"][k] = v
                     parts = k.split("/")
@@ -647,6 +663,70 @@ def render(summary: dict) -> str:
                 )
                 lines.append(f"{rel}  {what:<12} {detail}")
 
+    sg = summary["serve_gauges"]
+    if sg or summary["serve_start"] or summary["serve_ladder"]:
+        lines.append("")
+        lines.append("== serving (batched inference tier) ==")
+        started = summary["serve_start"] or {}
+        lines.append(
+            f"server: algo={started.get('algo', '?')} "
+            f"address={started.get('address', '?')} "
+            f"rungs={started.get('rungs', '?')} "
+            f"ckpt={started.get('ckpt') or '-'}"
+        )
+        if summary["serve_ladder"]:
+            lines.append("batch ladder (ledger-first sizing):")
+            for d in summary["serve_ladder"]:
+                status = "accepted" if d.get("accepted") else "REJECTED"
+                peak = d.get("peak_bytes")
+                peak_s = _fmt_wire(peak) if isinstance(peak, (int, float)) else "-"
+                lines.append(
+                    f"  rung {d.get('rung', '?'):>4}  {status:<9} "
+                    f"{str(d.get('source', '?')):<7} peak={peak_s:<10} "
+                    f"{d.get('reason', '')}"
+                )
+        if sg:
+            lines.append(
+                f"load: qps={sg.get('Serve/qps', 0):.1f} "
+                f"latency p50={sg.get('Serve/latency_p50_ms', 0):.2f}ms "
+                f"p99={sg.get('Serve/latency_p99_ms', 0):.2f}ms "
+                f"batch_occupancy={sg.get('Serve/batch_occupancy', 0):.2f}"
+            )
+            lines.append(
+                f"requests: served={sg.get('Serve/served_total', 0):,.0f} "
+                f"shed={sg.get('Serve/shed_total', 0):.0f} "
+                f"oversized={sg.get('Serve/oversized_total', 0):.0f} "
+                f"failed={sg.get('Serve/failed_total', 0):.0f} "
+                f"dispatches={sg.get('Serve/dispatches', 0):,.0f}"
+            )
+            lines.append(
+                f"params: version={sg.get('Serve/params_version', 0):.0f} "
+                f"reloads={sg.get('Serve/reloads', 0):.0f} "
+                f"reload_failures={sg.get('Serve/reload_failures', 0):.0f}"
+            )
+        # Hot-reload timeline: every swap (and every refused swap) with the
+        # version the server kept serving.
+        t0 = summary["first_ts"] or 0.0
+        for ev in summary["serve_reloads"]:
+            ts = ev.get("ts")
+            rel = f"t+{ts - t0:7.2f}s" if isinstance(ts, (int, float)) else "t+      ?"
+            if ev.get("ok"):
+                lines.append(
+                    f"{rel}  RELOAD  -> v{ev.get('version')} "
+                    f"({ev.get('seconds', 0):.2f}s) {ev.get('path', '')}"
+                )
+            else:
+                lines.append(
+                    f"{rel}  RELOAD-FAILED kept v{ev.get('version')}: "
+                    f"{(ev.get('error') or '')[:80]}"
+                )
+        if summary["serve_stop"]:
+            st = summary["serve_stop"]
+            lines.append(
+                f"stopped: completed={st.get('completed')} "
+                f"final_version={st.get('version')}"
+            )
+
     resil_any = (
         summary["fault_injected"]
         or summary["fault_recovered"]
@@ -979,6 +1059,61 @@ def selftest() -> int:
     assert "DIED" in out3 and "rc=-9" in out3
     assert "REJOINED" in out3 and "generation=1" in out3
     assert summary3["flock_staleness"]["actor1"] == [0.75, 0.05]
+
+    # serving section (ISSUE 15): ladder sizing decisions, traffic gauges,
+    # and a hot-reload timeline with one success and one refused swap must
+    # render — written through the REAL Telemetry writer like the rest
+    d4 = tempfile.mkdtemp(prefix="telemetry_selftest_serve_")
+    telem4 = Telemetry(d4, rank=0, algo="serve")
+    telem4.event("start", algo="serve", env_id="dummy", seed=0)
+    telem4.event(
+        "serve.ladder", rung=1, accepted=True, source="ledger",
+        peak_bytes=2048, reason="ledger serve/policy_b1 x1.05",
+    )
+    telem4.event(
+        "serve.ladder", rung=8, accepted=False, source="ledger",
+        peak_bytes=1 << 30, reason="predicted peak exceeds budget",
+    )
+    telem4.event(
+        "serve.start", address="unix:/tmp/serve.sock", algo="sac",
+        rungs=[1], version=1, ckpt="/run/checkpoints/ckpt_1",
+    )
+    telem4.event(
+        "serve.reload", ok=True, version=2, path="/run/checkpoints/ckpt_2",
+        seconds=0.12, error=None,
+    )
+    telem4.event(
+        "serve.reload", ok=False, version=2, path="/run/checkpoints/ckpt_bad",
+        seconds=0.01, error="FileNotFoundError: no such checkpoint",
+    )
+    telem4.interval(
+        {
+            "Serve/qps": 180.5, "Serve/latency_p50_ms": 2.4,
+            "Serve/latency_p99_ms": 9.8, "Serve/batch_occupancy": 0.81,
+            "Serve/served_total": 1200.0, "Serve/shed_total": 3.0,
+            "Serve/oversized_total": 1.0, "Serve/failed_total": 0.0,
+            "Serve/dispatches": 400.0, "Serve/params_version": 2.0,
+            "Serve/reloads": 1.0, "Serve/reload_failures": 1.0,
+        },
+        step=1200,
+    )
+    telem4.event("serve.stop", completed=1200, version=2)
+    telem4.close()
+    summary4 = summarize(load_events(d4))
+    out4 = render(summary4)
+    assert "== serving (batched inference tier) ==" in out4, out4
+    assert "algo=sac address=unix:/tmp/serve.sock rungs=[1]" in out4, out4
+    assert "rung    1  accepted  ledger" in out4, out4
+    assert "rung    8  REJECTED" in out4, out4
+    assert "qps=180.5" in out4 and "p50=2.40ms" in out4 and "p99=9.80ms" in out4
+    assert "batch_occupancy=0.81" in out4, out4
+    assert "served=1,200 shed=3 oversized=1 failed=0" in out4, out4
+    assert "version=2 reloads=1 reload_failures=1" in out4, out4
+    assert "RELOAD  -> v2 (0.12s) /run/checkpoints/ckpt_2" in out4, out4
+    assert "RELOAD-FAILED kept v2: FileNotFoundError" in out4, out4
+    assert "stopped: completed=1200 final_version=2" in out4, out4
+    assert len(summary4["serve_ladder"]) == 2
+    assert [r["ok"] for r in summary4["serve_reloads"]] == [True, False]
 
     print("\nselftest OK", file=sys.stderr)
     return 0

@@ -1848,6 +1848,7 @@ _METRIC_OF_ALGO = {
     "resilience": ("resilience_preemption_grace_seconds", "seconds"),
     "flock": ("flock_actor_env_steps_per_sec", "env-steps/sec"),
     "serve": ("serve_sac_qps", "requests/sec"),
+    "chaos": ("chaos_recovery_receipts", "count"),
 }
 
 
@@ -3273,6 +3274,220 @@ def _probe_backend_once(timeout_s: float) -> tuple[bool, str]:
     return False, (tail[-1] if tail else f"probe rc={proc.returncode}")
 
 
+def bench_chaos() -> None:
+    """ISSUE 16 headline: the chaos harness — seeded distributed faults
+    against the REAL multi-process stack, recovery proven from telemetry
+    receipts, deterministic at the same seed.
+
+    Scenario A (flock crash-resume): tiny PPO `--flock 2` with
+    `net.partition@30:1` (retargeted onto actor 0's frame sends — deep
+    enough into the run that the clause lands on the DATA connection, so
+    the actor must reconnect with backoff and re-HELLO, visible as
+    `flock.actor_rejoined` in learner telemetry) and `peer.crash@12`
+    (guard SIGKILLs the LEARNER mid-run, no grace — after the update-4
+    and update-8 checkpoints exist). The same run dir is relaunched with
+    `--resume auto`: the replay-service sidecar riding the checkpoint
+    must rehost at the pre-crash address with zero committed rows lost
+    (`flock.resumed`), and surviving/respawned actors must rejoin
+    (`flock.actor_rejoined` / `flock.actor_adopted`).
+
+    Scenario B (serve client retry): a serve subprocess armed with
+    `net.corrupt@40` garbles one response frame mid-stream; the client's
+    typed `ConnectionLost` path must reconnect and resend the SAME
+    request id, and the server's dedupe must answer from cache — receipt:
+    every request served AND `completed == n_requests` (no double
+    execution). SIGTERM then drains (`serve.draining`/`serve.drained`,
+    rc 75, zero drops). Run twice: the `fault.injected` (site, step)
+    receipts must be IDENTICAL across runs — the determinism half of the
+    chaos contract.
+
+    CPU receipts (mechanism, not raw speed); knobs via
+    SHEEPRL_TPU_CHAOS_{STEPS,REQUESTS}."""
+    import json as _json
+    import os
+    import signal as _signal
+    import subprocess
+    import tempfile
+    import time
+
+    import numpy as np
+
+    steps = int(os.environ.get("SHEEPRL_TPU_CHAOS_STEPS", "256"))
+    n_requests = int(os.environ.get("SHEEPRL_TPU_CHAOS_REQUESTS", "60"))
+    root = tempfile.mkdtemp(prefix="bench_chaos_")
+    env = _child_env(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        SHEEPRL_TPU_TELEMETRY="1",
+    )
+    env.pop("SHEEPRL_TPU_FAULTS", None)
+    env.pop("XLA_FLAGS", None)  # single-device children
+
+    def read_events(run_name):
+        events = []
+        jsonl = os.path.join(root, run_name, "telemetry.jsonl")
+        if os.path.exists(jsonl):
+            with open(jsonl) as fh:
+                for line in fh:
+                    try:
+                        events.append(_json.loads(line))
+                    except _json.JSONDecodeError:
+                        break
+        return events
+
+    def names(events):
+        return [e.get("event") for e in events]
+
+    # -- scenario A: flock partition + learner crash + auto-resume ----------
+    def run_ppo(extra):
+        return subprocess.run(
+            [
+                sys.executable, "-m", "sheeprl_tpu", "ppo",
+                "--env_id", "CartPole-v1", "--num_envs", "1",
+                "--rollout_steps", "8", "--total_steps", str(steps),
+                "--per_rank_batch_size", "4", "--update_epochs", "1",
+                "--dense_units", "8", "--mlp_layers", "1",
+                "--cnn_features_dim", "16", "--mlp_features_dim", "8",
+                "--checkpoint_every", "4", "--test_episodes", "0",
+                "--seed", "7", "--root_dir", root, "--run_name", "chaosA",
+                "--flock", "2", *extra,
+            ],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+
+    t0 = time.perf_counter()
+    crash = run_ppo(["--faults", "net.partition@30:1,peer.crash@12"])
+    ev1 = read_events("chaosA")
+    crashed_ok = crash.returncode == -int(_signal.SIGKILL)
+    # the partition's recovery receipt: actor 0 reconnected and re-HELLOed
+    rejoined_pre = "flock.actor_rejoined" in names(ev1)
+    print(
+        f"chaos A crash: rc={crash.returncode} rejoined={rejoined_pre} "
+        f"({time.perf_counter() - t0:.1f}s)",
+        file=sys.stderr,
+    )
+
+    resume = run_ppo(["--resume", "auto"])
+    ev2 = read_events("chaosA")[len(ev1):]  # the resumed segment only
+    resumed = [e for e in ev2 if e.get("event") == "flock.resumed"]
+    rows_kept = resumed[0].get("rows_total", 0) if resumed else 0
+    resumed_version = resumed[0].get("weight_version", -1) if resumed else -1
+    rejoined_post = any(
+        n in ("flock.actor_rejoined", "flock.actor_adopted")
+        for n in names(ev2)
+    )
+    scenario_a = {
+        "crash_rc_sigkill_ok": crashed_ok,
+        "partition_rejoin_ok": rejoined_pre,
+        "resume_rc": resume.returncode,
+        "flock_resumed_ok": bool(resumed),
+        "rows_kept": rows_kept,
+        "restored_weight_version": resumed_version,
+        "actors_rejoined_after_resume": rejoined_post,
+    }
+    print(f"chaos A resume: {scenario_a}", file=sys.stderr)
+
+    # -- scenario B: serve corrupt-frame retry + drain, twice ---------------
+    def run_serve_round(run_name):
+        serve_env = dict(env)
+        serve_env["SHEEPRL_TPU_FAULTS"] = "net.corrupt@40"
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "sheeprl_tpu", "serve",
+                "--algo", "sac",
+                "--model_argv",
+                "--env_id Pendulum-v1 --actor_hidden_size 16 "
+                "--critic_hidden_size 16",
+                "--platform", "cpu", "--max_batch", "2",
+                "--deadline_ms", "5000",
+                "--root_dir", root, "--run_name", run_name,
+            ],
+            env=serve_env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        addr_file = os.path.join(root, run_name, "serve_address")
+        deadline = time.monotonic() + 180.0
+        while not os.path.exists(addr_file):
+            if time.monotonic() > deadline or proc.poll() is not None:
+                proc.kill()
+                return {"error": f"server never came up (rc={proc.poll()})"}
+            time.sleep(0.2)
+        address = open(addr_file).read().strip()
+
+        from sheeprl_tpu.serve import ServeClient
+
+        served, retried = 0, 0
+        with ServeClient(address, timeout=60.0, backoff_s=0.05) as client:
+            for i in range(n_requests):
+                obs = {
+                    "obs": np.full((1, 3), float(i % 7), np.float32)
+                }
+                _res, meta = client.request(obs, retries=5)
+                served += 1
+        proc.send_signal(_signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        events = read_events(run_name)
+        stop = [e for e in events if e.get("event") == "serve.stop"]
+        faults = [
+            (e.get("site"), e.get("step"))
+            for e in events
+            if e.get("event") == "fault.injected"
+        ]
+        return {
+            "served": served,
+            "rc": rc,
+            "completed": stop[0].get("completed", -1) if stop else -1,
+            "stop_signal": stop[0].get("signal") if stop else None,
+            "drained": "serve.drained" in names(events),
+            "faults": faults,
+        }
+
+    round1 = run_serve_round("chaosB1")
+    print(f"chaos B round 1: {round1}", file=sys.stderr)
+    round2 = run_serve_round("chaosB2")
+    print(f"chaos B round 2: {round2}", file=sys.stderr)
+    deterministic = (
+        "error" not in round1 and "error" not in round2
+        and round1["faults"] == round2["faults"]
+        and len(round1["faults"]) > 0
+    )
+
+    receipts = {
+        "a_crash_rc": scenario_a["crash_rc_sigkill_ok"],
+        "a_partition_rejoin": scenario_a["partition_rejoin_ok"],
+        "a_resume_clean": scenario_a["resume_rc"] == 0,
+        "a_flock_resumed": scenario_a["flock_resumed_ok"],
+        "a_rows_kept": rows_kept > 0,
+        "a_actors_rejoined": scenario_a["actors_rejoined_after_resume"],
+        "b_all_served": round1.get("served") == n_requests,
+        "b_no_double_execution": round1.get("completed") == n_requests,
+        "b_rc_preempted": round1.get("rc") == 75,
+        "b_drained": bool(round1.get("drained")),
+        "b_deterministic_injection": deterministic,
+    }
+    result = {
+        "metric": "chaos_recovery_receipts",
+        "value": float(sum(receipts.values())),
+        "unit": "count",
+        "receipts_total": len(receipts),
+        "algo": "chaos",
+        "backend": "cpu",
+        "receipts": receipts,
+        "scenario_a": scenario_a,
+        "scenario_b": {"round1": round1, "round2": round2},
+        "total_steps": steps, "n_requests": n_requests,
+        "host_cpus": os.cpu_count(),
+        "note": BASELINE_NOTE,
+    }
+    if not all(receipts.values()):
+        result["error"] = {
+            "failed": sorted(k for k, v in receipts.items() if not v),
+            "crash_stderr": crash.stderr.strip().splitlines()[-3:],
+            "resume_stderr": resume.stderr.strip().splitlines()[-3:],
+        }
+    print(json.dumps(result))
+
+
 def bench_ppo_decoupled_pixel() -> None:
     """BASELINE config 3 (Atari-shaped pixel obs, decoupled player/trainer):
     same coupled-vs-decoupled comparison as `--algo ppo_decoupled`, but the
@@ -3728,6 +3943,8 @@ def main() -> None:
         bench_flock()
     elif opts.algo == "serve":
         bench_serve()
+    elif opts.algo == "chaos":
+        bench_chaos()
     else:
         bench_dreamer_v3(tiny=opts.tiny, pipeline_mode=opts.pipeline)
 

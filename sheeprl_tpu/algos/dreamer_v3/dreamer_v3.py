@@ -738,8 +738,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         from ... import flock as _flock
         from ...data.wire import tree_nbytes
 
-        # sigkill clauses retarget onto actor 0: killing the learner tests
-        # nothing about elastic membership
+        # sigkill/net.* clauses retarget onto actor 0: killing the learner
+        # tests nothing about elastic membership, and under flock the
+        # interesting frame sends are the actor's (peer.crash stays here)
         _, actor_faults = _flock.retarget_sigkill(args)
         _row = {
             k: np.zeros(
@@ -771,10 +772,18 @@ def main(argv: Sequence[str] | None = None) -> None:
             algo="dreamer_v3", n_actors=int(args.flock), mode="buffer",
             capacity_rows=capacity, make_shard=_make_shard, telem=telem,
         )
+        # crash-resume: the sidecar riding the checkpoint carries the shard
+        # contents and membership table, and pins the pre-crash address so
+        # surviving actors reconnect instead of re-collecting from scratch
+        flock_restored = bool(
+            args.checkpoint_path
+            and service.restore_sidecar(args.checkpoint_path)
+        )
         addr = service.start()
         telem.add_gauges(service.gauges)
         # actors block on the initial snapshot: version 1 is published
-        # BEFORE the first actor spawns
+        # BEFORE the first actor spawns (on resume this bumps PAST the
+        # restored version: weight versions stay monotonic across the crash)
         service.publish(jax.tree_util.tree_leaves(player))
         service.set_random_phase(
             args.checkpoint_path is None and not args.dry_run
@@ -783,7 +792,16 @@ def main(argv: Sequence[str] | None = None) -> None:
             algo="dreamer_v3", args=args, address=addr, log_dir=log_dir,
             telem=telem, actor_faults=actor_faults,
         )
-        fleet.start()
+        service.on_evict = fleet.handle_eviction
+        flock_skip: set[int] = set()
+        if flock_restored:
+            # adoption window: actors that outlived the crash are already
+            # re-dialing this address; don't double-spawn their ids
+            service.wait_for_actors(n=int(args.flock), timeout=10.0)
+            flock_skip = service.connected_ids()
+            for aid in flock_skip:
+                fleet.adopt(aid, service.actor_pid(aid))
+        fleet.start(skip=flock_skip)
         if not service.wait_for_actors(n=1, timeout=180.0):
             fleet.close()
             service.close()
@@ -1276,9 +1294,12 @@ def main(argv: Sequence[str] | None = None) -> None:
                 block=args.dry_run or global_step == num_updates or guard.preempted,
             )
             if args.checkpoint_buffer and rb is not None:
-                # flock mode: shard contents live with the service and are
-                # rebuilt by the actors on resume, not checkpointed
                 rb.save(ckpt_path + "_buffer.npz")
+            if use_flock:
+                # flock mode: the shard contents ride a service sidecar
+                # (bit-exact buffer wire codecs, sampler PRNG included) so a
+                # restarted learner resumes with zero committed rows lost
+                service.save_sidecar(ckpt_path)
 
         if guard.preempted:
             # the in-flight step finished and its grace checkpoint

@@ -511,8 +511,9 @@ def main(argv: Sequence[str] | None = None) -> None:
         from ... import flock as _flock
         from ...data.wire import tree_nbytes
 
-        # sigkill clauses retarget onto actor 0: killing the learner tests
-        # nothing about elastic membership
+        # sigkill/net.* clauses retarget onto actor 0: killing the learner
+        # tests nothing about elastic membership, and under flock the
+        # interesting frame sends are the actor's (peer.crash stays here)
         _, actor_faults = _flock.retarget_sigkill(args)
         _row = {
             k: np.zeros(
@@ -536,16 +537,33 @@ def main(argv: Sequence[str] | None = None) -> None:
             ),
             telem=telem,
         )
+        # crash-resume: a sidecar riding the checkpoint rehosts the service
+        # at the pre-crash address with every committed row intact, so
+        # surviving actors' reconnect backoff finds it and re-HELLOs
+        flock_restored = bool(
+            args.checkpoint_path
+            and service.restore_sidecar(args.checkpoint_path)
+        )
         addr = service.start()
         telem.add_gauges(service.gauges)
         # version 1 is published BEFORE the first actor spawns: actors block
-        # on the initial snapshot and never act on a private random init
+        # on the initial snapshot and never act on a private random init (on
+        # resume this bumps PAST the restored version: monotonic receipts)
         service.publish(jax.tree_util.tree_leaves(state.agent))
         fleet = _flock.ActorFleet(
             algo="ppo", args=args, address=addr, log_dir=log_dir,
             telem=telem, actor_faults=actor_faults,
         )
-        fleet.start()
+        service.on_evict = fleet.handle_eviction
+        flock_skip: set[int] = set()
+        if flock_restored:
+            # adoption window: actors that outlived the crash are already
+            # re-dialing this address; don't double-spawn their ids
+            service.wait_for_actors(n=int(args.flock), timeout=10.0)
+            flock_skip = service.connected_ids()
+            for aid in flock_skip:
+                fleet.adopt(aid, service.actor_pid(aid))
+        fleet.start(skip=flock_skip)
         if not service.wait_for_actors(n=1, timeout=180.0):
             fleet.close()
             service.close()
@@ -764,6 +782,10 @@ def main(argv: Sequence[str] | None = None) -> None:
             resilience.save_resume_state(
                 ckpt_path, prng_key=key, collector=carry if use_jax_env else None
             )
+            if use_flock:
+                # replay-service sidecar: committed rows + membership table
+                # ride the same checkpoint the restart resumes from
+                service.save_sidecar(ckpt_path)
         if guard.preempted:
             # the in-flight update finished and its checkpoint committed:
             # exit with the distinct resumable rc (crashsafe maps this)

@@ -20,7 +20,16 @@ flattened leaves cross the wire, never a treedef, never a pickle.
 
 Faults: the actor arms `SHEEPRL_TPU_FAULTS` from its (launcher-scrubbed)
 environment and fires the `sigkill` site from its step loop — the
-elastic-membership receipt the CI fault-smoke scenario kills.
+elastic-membership receipt the CI fault-smoke scenario kills. The `net.*`
+sites fire inside `flock/wire.py` on this process's own frame sends.
+
+Reconnection (ISSUE 16): a dead data socket (learner crash, injected
+partition) is NOT fatal — `ResilientLink` reconnects with capped
+exponential backoff bounded by `SHEEPRL_TPU_FLOCK_RECONNECT_S` (default
+120 s, sized to ride out a learner restart including jax bring-up),
+re-HELLOs (the service bumps the generation), and re-pushes the in-flight
+chunk, so no collected row is lost to a transient. Only an exhausted
+budget exits the process (rc 0: the learner is really gone).
 
 Actors are observability-quiet by design: no Telemetry instance (the
 learner's rank-0 JSONL is the single event stream; actor stats arrive
@@ -55,6 +64,11 @@ _U32 = struct.Struct("<I")
 PUSH_EVERY_ROWS = 8  # dv3: rows buffered per PUSH frame
 HEARTBEAT_S = 1.0
 WEIGHT_POLL_S = 0.25
+
+RECONNECT_VAR = "SHEEPRL_TPU_FLOCK_RECONNECT_S"
+DEFAULT_RECONNECT_S = 120.0
+BACKOFF_BASE_S = 0.25
+BACKOFF_CAP_S = 5.0
 
 
 class WeightFetcher(threading.Thread):
@@ -104,7 +118,10 @@ class WeightFetcher(threading.Thread):
                 )
                 frame = wire.recv_frame(sock)
                 if frame is None:
-                    return  # service gone: the main loop will notice too
+                    # service gone — maybe restarting at the same address:
+                    # drop the socket and keep polling (the data link's
+                    # reconnect budget bounds how long the actor waits)
+                    raise ConnectionResetError("weights connection closed")
                 kind, payload = frame
                 if kind == wire.WEIGHTS:
                     (meta_len,) = _U32.unpack_from(payload, 0)
@@ -198,6 +215,93 @@ class _ServiceLink:
             pass
 
 
+def _reconnect_budget() -> float:
+    return float(os.environ.get(RECONNECT_VAR, DEFAULT_RECONNECT_S))
+
+
+def _connect_with_backoff(
+    addr: str, actor_id: int, timeout: float | None
+) -> _ServiceLink:
+    """Dial the service until it answers: capped exponential backoff
+    (0.25 s doubling to 5 s) bounded by the total reconnect budget. An
+    injected `net.partition` window refuses `wire.connect` outright, so
+    the backoff genuinely waits the partition out."""
+    budget = _reconnect_budget()
+    deadline = time.monotonic() + budget
+    delay = BACKOFF_BASE_S
+    last: Exception | None = None
+    while True:
+        try:
+            return _ServiceLink(addr, actor_id, timeout)
+        except (OSError, TimeoutError) as err:
+            last = err
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise ConnectionError(
+                    f"flock service {addr!r} unreachable after "
+                    f"{budget:.0f}s (last: {type(last).__name__}: {last})"
+                ) from err
+            time.sleep(min(delay, left))
+            delay = min(delay * 2.0, BACKOFF_CAP_S)
+
+
+class ResilientLink:
+    """`_ServiceLink` that survives the service going away: every failed
+    push/heartbeat closes the socket, reconnects with backoff (re-HELLO ->
+    the service bumps this actor's generation), and re-pushes the chunk
+    that was in flight — PUSH frames are self-contained, so a replayed
+    chunk after a learner restore is new data, never a duplicate commit."""
+
+    _RETRIES = 3  # fresh backoff-bounded connection per attempt
+
+    def __init__(self, addr: str, actor_id: int, timeout: float | None):
+        self._addr = addr
+        self._actor_id = actor_id
+        self._timeout = timeout
+        self._link = _connect_with_backoff(addr, actor_id, timeout)
+
+    @property
+    def welcome(self) -> dict:
+        return self._link.welcome
+
+    @property
+    def random_phase(self) -> bool:
+        return self._link.random_phase
+
+    def _reconnect(self) -> None:
+        try:
+            self._link.sock.close()
+        except OSError:
+            pass
+        self._link = _connect_with_backoff(
+            self._addr, self._actor_id, self._timeout
+        )
+
+    def push(self, ops, *, rows: int, env_steps: int, weight_version: int):
+        for attempt in range(self._RETRIES):
+            try:
+                return self._link.push(
+                    ops,
+                    rows=rows,
+                    env_steps=env_steps,
+                    weight_version=weight_version,
+                )
+            except (OSError, TimeoutError):
+                if attempt == self._RETRIES - 1:
+                    raise
+                self._reconnect()
+
+    def maybe_heartbeat(self, env_steps: int, weight_version: int) -> None:
+        try:
+            self._link.maybe_heartbeat(env_steps, weight_version)
+        except (OSError, TimeoutError):
+            # heartbeats are disposable — reconnect, don't replay
+            self._reconnect()
+
+    def close(self) -> None:
+        self._link.close()
+
+
 def _transfer_timeout() -> float | None:
     raw = os.environ.get("SHEEPRL_TPU_TRANSFER_TIMEOUT_S")
     if not raw:
@@ -289,7 +393,7 @@ def run_ppo(args, actor_id: int, addr: str, log_dir: str) -> None:
     timeout = _transfer_timeout()
     fetcher = WeightFetcher(addr, actor_id, timeout)
     fetcher.start()
-    link = _ServiceLink(addr, actor_id, timeout)
+    link = ResilientLink(addr, actor_id, timeout)
     version, leaves = _wait_initial_weights(fetcher)
     agent = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(x) for x in leaves])
 
@@ -427,7 +531,7 @@ def run_dreamer_v3(args, actor_id: int, addr: str, log_dir: str) -> None:
     timeout = _transfer_timeout()
     fetcher = WeightFetcher(addr, actor_id, timeout)
     fetcher.start()
-    link = _ServiceLink(addr, actor_id, timeout)
+    link = ResilientLink(addr, actor_id, timeout)
     version, leaves = _wait_initial_weights(fetcher)
     player = jax.tree_util.tree_unflatten(
         treedef, [jnp.asarray(x) for x in leaves]

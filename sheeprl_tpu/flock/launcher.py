@@ -10,11 +10,13 @@ service under its same actor id, and resumes filling its shard — the
 learner never restarts, never even blocks.
 
 `retarget_sigkill` implements the sheepfault contract for the flock
-topology: a `sigkill@N` clause in `--faults` is retargeted from the
-learner onto actor 0 (killing the learner tests nothing about elastic
-membership), while every other clause stays learner-side. Respawned
-actors ALWAYS get the scrubbed plan so an exactly-once kill cannot
-re-fire on the replacement process.
+topology: `sigkill@N` and `net.*` clauses in `--faults` are retargeted
+from the learner onto actor 0 (killing the learner tests nothing about
+elastic membership, and under flock the interesting frame sends are the
+actor's), while every other clause — including `peer.crash`, which
+exists precisely to kill the service host — stays learner-side.
+Respawned actors ALWAYS get the scrubbed plan so an exactly-once kill
+cannot re-fire on the replacement process.
 """
 
 from __future__ import annotations
@@ -40,12 +42,17 @@ def retarget_sigkill(args) -> tuple[str, str]:
     """Split the armed fault plan for the flock topology.
 
     Returns `(learner_text, actor_text)`: the learner re-arms with every
-    clause EXCEPT sigkill ones; the sigkill clauses are handed to actor
-    0's environment (first spawn only). No plan -> two empty strings."""
+    clause EXCEPT sigkill/net.* ones; those are handed to actor 0's
+    environment (first spawn only). `peer.crash` deliberately stays
+    learner-side — it exists to kill the service HOST. No plan -> two
+    empty strings."""
     text = os.environ.get(inject.ENV_VAR, "") or ""
     clauses = [c.strip() for c in text.split(",") if c.strip()]
     actor_clauses = [
-        c for c in clauses if c.split("@", 1)[0].strip() == "sigkill"
+        c
+        for c in clauses
+        if c.split("@", 1)[0].strip() == "sigkill"
+        or c.split("@", 1)[0].strip().startswith("net.")
     ]
     learner_clauses = [c for c in clauses if c not in actor_clauses]
     learner_text = ",".join(learner_clauses)
@@ -85,6 +92,7 @@ class ActorFleet:
         self._actor_faults = actor_faults
         self._max_respawns = max_respawns
         self._procs: dict[int, subprocess.Popen] = {}
+        self._adopted: dict[int, int] = {}  # actor_id -> orphan pid
         self._respawns: dict[int, int] = {i: 0 for i in range(self.n_actors)}
         self._logs: dict[int, object] = {}
         self._stop = threading.Event()
@@ -93,13 +101,65 @@ class ActorFleet:
 
     # -- lifecycle ------------------------------------------------------------
 
-    def start(self) -> None:
+    def start(self, skip: set[int] = frozenset()) -> None:
+        """Spawn every actor not in `skip`. On crash-resume the learner
+        skips ids whose pre-crash processes survived the restart and are
+        already reconnected — those are `adopt`ed instead of respawned."""
         for actor_id in range(self.n_actors):
-            self._spawn(actor_id, first=True)
+            if actor_id not in skip:
+                self._spawn(actor_id, first=True)
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="flock-monitor", daemon=True
         )
         self._monitor.start()
+
+    def adopt(self, actor_id: int, pid: int) -> None:
+        """Track a surviving pre-crash actor process this fleet did not
+        spawn, so `close()` still tears it down with the rest."""
+        if pid > 0:
+            self._adopted[actor_id] = pid
+            self._event("flock.actor_adopted", actor_id=actor_id, pid=pid)
+
+    def handle_eviction(self, actor_id: int) -> None:
+        """`ReplayService.on_evict` hook: a heartbeat-stale actor is
+        treated like a death — kill the wedged process (the monitor loop
+        then applies the normal respawn budget)."""
+        proc = self._procs.get(actor_id)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            return
+        pid = self._adopted.pop(actor_id, None)
+        if pid is not None:
+            self._kill_pid(pid)
+            # an adopted orphan has no Popen handle for the monitor loop:
+            # respawn it here under the same budget
+            if self._respawns[actor_id] < self._max_respawns:
+                self._respawns[actor_id] += 1
+                self._spawn(actor_id, first=False)
+                self._event(
+                    "flock.actor_respawned",
+                    actor_id=actor_id,
+                    attempt=self._respawns[actor_id],
+                )
+            else:
+                self._event(
+                    "flock.actor_abandoned",
+                    actor_id=actor_id,
+                    respawns=self._respawns[actor_id],
+                )
+
+    @staticmethod
+    def _kill_pid(pid: int) -> None:
+        import signal as _signal
+
+        for sig in (_signal.SIGTERM, _signal.SIGKILL):
+            try:
+                os.kill(pid, sig)
+            except ProcessLookupError:
+                return
+            except OSError:
+                return
+            time.sleep(0.2)
 
     def close(self) -> None:
         self._stop.set()
@@ -116,6 +176,8 @@ class ActorFleet:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5.0)
+        for pid in self._adopted.values():
+            self._kill_pid(pid)
         for fh in self._logs.values():
             try:
                 fh.close()
@@ -202,7 +264,14 @@ class ActorFleet:
             self._stop.wait(_POLL_S)
 
     def alive(self) -> int:
-        return sum(1 for p in self._procs.values() if p.poll() is None)
+        n = sum(1 for p in self._procs.values() if p.poll() is None)
+        for pid in self._adopted.values():
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                continue
+            n += 1
+        return n
 
     def _event(self, name: str, **data) -> None:
         if self._telem is not None:

@@ -26,6 +26,18 @@ disconnected (its shard stays sampleable), and a reconnecting actor with
 the same id bumps its generation and resumes filling the same shard —
 the `flock.actor_rejoined` event is the receipt the CI fault-smoke
 scenario asserts on.
+
+Crash-resume (ISSUE 16): `save_sidecar` snapshots the service next to a
+learner checkpoint — shard contents via the buffers' own `to_bytes()`
+wire codecs, the per-actor generation/weight-version table, and the bound
+address — and `restore_sidecar` + `start()` rehosts the service at the
+SAME address, so surviving actors reconnect (capped backoff on their
+side), re-HELLO with a bumped generation, and no committed row is lost.
+Actors whose heartbeat goes stale past
+`SHEEPRL_TPU_FLOCK_HEARTBEAT_TIMEOUT_S` are evicted: the connection is
+freed (the shard is kept for rejoin), `flock.actor_stale` is emitted, and
+the optional `on_evict` callback lets ActorFleet apply its respawn
+budget.
 """
 
 from __future__ import annotations
@@ -51,6 +63,11 @@ _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
 PROTO_VERSION = 1
+
+SIDECAR_MAGIC = b"SFLK"
+SIDECAR_SUFFIX = ".flock"
+HEARTBEAT_TIMEOUT_VAR = "SHEEPRL_TPU_FLOCK_HEARTBEAT_TIMEOUT_S"
+DEFAULT_HEARTBEAT_TIMEOUT_S = 30.0
 
 
 def pack_push(ops, *, rows: int, env_steps: int, weight_version: int) -> bytes:
@@ -174,17 +191,51 @@ class ReplayService:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._conns: list[socket.socket] = []
+        self._data_conns: dict[int, socket.socket] = {}
         self._listener: socket.socket | None = None
         self._unix_path: str | None = None
         self.address = ""
         self._transport = transport or os.environ.get(
             "SHEEPRL_TPU_FLOCK_TRANSPORT", "unix"
         )
+        # crash-resume: restore_sidecar pins the pre-crash address so
+        # surviving actors' reconnect backoff finds the rehosted service
+        self._requested_address: str | None = None
+        self._restored = False
+        # eviction: ActorFleet hooks this to apply its respawn budget to
+        # actors whose heartbeat went stale (<= 0 disables the monitor)
+        self.on_evict: Callable[[int], None] | None = None
+        self.heartbeat_timeout_s = float(
+            os.environ.get(HEARTBEAT_TIMEOUT_VAR, DEFAULT_HEARTBEAT_TIMEOUT_S)
+        )
 
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> str:
-        if self._transport == "tcp":
+        requested = (
+            wire.parse_address(self._requested_address)
+            if self._requested_address
+            else None
+        )
+        if requested is not None and requested[0] == "tcp":
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((requested[1], requested[2]))
+            self.address = self._requested_address
+        elif requested is not None:
+            # rehost at the pre-crash unix path: the SIGKILLed process never
+            # unlinked it, and a stale socket file refuses new connects
+            path = requested[1]
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._unix_path = path
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(path)
+            self.address = self._requested_address
+        elif self._transport == "tcp":
             srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             srv.bind(("127.0.0.1", 0))
@@ -205,7 +256,20 @@ class ReplayService:
         )
         t.start()
         self._threads.append(t)
+        if self.heartbeat_timeout_s > 0:
+            mon = threading.Thread(
+                target=self._monitor_loop, name="flock-monitor", daemon=True
+            )
+            mon.start()
+            self._threads.append(mon)
         self._event("flock.started", address=self.address, mode=self.mode)
+        if self._restored:
+            self._event(
+                "flock.resumed",
+                address=self.address,
+                rows_total=self._rows_total,
+                weight_version=self._weight_version,
+            )
         return self.address
 
     def close(self) -> None:
@@ -265,6 +329,8 @@ class ReplayService:
                 self._serve_weights(conn)
                 return
             self._register(actor_id, hello)
+            with self._lock:
+                self._data_conns[actor_id] = conn
             wire.send_json(
                 conn,
                 wire.WELCOME,
@@ -293,14 +359,26 @@ class ReplayService:
                         wire.ERROR,
                         {"error": f"unexpected {wire.KIND_NAMES.get(kind, kind)}"},
                     )
-        except (wire.FrameError, OSError, ValueError, KeyError):
-            pass
+        except (wire.FrameError, OSError, ValueError, KeyError) as err:
+            # the failure already killed this connection; the service keeps
+            # serving every other actor, but the error must leave a receipt
+            # (SL012: swallowed handlers hide exactly the chaos-CI signals)
+            if not self._stop.is_set():
+                self._event(
+                    "flock.conn_error",
+                    actor_id=actor_id,
+                    role=role,
+                    error=f"{type(err).__name__}: {err}",
+                )
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
             if actor_id in self._actors and role == "data":
+                with self._lock:
+                    if self._data_conns.get(actor_id) is conn:
+                        del self._data_conns[actor_id]
                 self._deregister(actor_id)
 
     def _serve_weights(self, conn: socket.socket) -> None:
@@ -354,6 +432,48 @@ class ReplayService:
                 rows=st.rows,
                 env_steps=st.env_steps,
             )
+
+    def _monitor_loop(self) -> None:
+        """Heartbeat staleness eviction: the `heartbeat_age_s` gauge was
+        recorded but never acted on — a wedged actor (e.g. partitioned
+        mid-push) held its connection slot forever. Past the timeout the
+        connection is freed (the shard is KEPT for rejoin) and ActorFleet's
+        `on_evict` hook applies the normal respawn budget."""
+        poll = max(0.1, min(self.heartbeat_timeout_s / 4.0, 1.0))
+        while not self._stop.wait(poll):
+            now = time.monotonic()
+            stale = []
+            with self._lock:
+                for aid, st in self._actors.items():
+                    if not st.connected or not st.last_heartbeat:
+                        continue
+                    age = now - st.last_heartbeat
+                    if age > self.heartbeat_timeout_s:
+                        stale.append((aid, age))
+            for aid, age in stale:
+                self.evict(aid, age=age)
+
+    def evict(self, actor_id: int, age: float | None = None) -> None:
+        """Free a stale actor's connection; keep its shard for rejoin."""
+        with self._lock:
+            conn = self._data_conns.pop(actor_id, None)
+        self._event(
+            "flock.actor_stale",
+            actor_id=actor_id,
+            age_s=None if age is None else round(age, 3),
+            timeout_s=self.heartbeat_timeout_s,
+        )
+        if conn is not None:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self.on_evict is not None:
+            self.on_evict(actor_id)
 
     def _handle_push(self, conn, actor_id: int, payload: bytes) -> None:
         ops, meta = unpack_push(payload)
@@ -517,6 +637,140 @@ class ReplayService:
 
     def shard(self, actor_id: int):
         return self._shards.get(actor_id)
+
+    def connected_ids(self) -> set[int]:
+        with self._lock:
+            return {
+                aid for aid, st in self._actors.items() if st.connected
+            }
+
+    def actor_pid(self, actor_id: int) -> int:
+        with self._lock:
+            return self._actors[actor_id].pid
+
+    # -- crash-resume sidecar -------------------------------------------------
+
+    def sidecar_path(self, ckpt_path: str) -> str:
+        return str(ckpt_path) + SIDECAR_SUFFIX
+
+    def save_sidecar(self, ckpt_path: str) -> str:
+        """Snapshot the service next to a learner checkpoint: per-actor
+        shard contents (the buffers' own `to_bytes` wire codecs keep this
+        bit-exact, sampler PRNG included), the membership table, and the
+        bound address. Written atomically (tmp + rename) so a crash mid-save
+        leaves the previous sidecar intact."""
+        from ..data.wire import pack_tree
+
+        blobs: list[bytes] = []
+        actors: dict[str, dict[str, Any]] = {}
+        with self._lock:
+            for aid in range(self.n_actors):
+                st = self._actors[aid]
+                actors[str(aid)] = {
+                    "generation": st.generation,
+                    "ever_connected": st.ever_connected,
+                    "env_steps": st.env_steps,
+                    "weight_version": st.weight_version,
+                    "rows": st.rows,
+                }
+                if self.mode == "buffer":
+                    with self._shard_locks[aid]:
+                        blobs.append(self._shards[aid].to_bytes())
+                else:
+                    chunks = list(self._chunks[aid])
+                    parts = [_U32.pack(len(chunks))]
+                    for tree in chunks:
+                        blob = pack_tree(tree)
+                        parts += [_U64.pack(len(blob)), blob]
+                    blobs.append(b"".join(parts))
+            meta = {
+                "algo": self.algo,
+                "mode": self.mode,
+                "n_actors": self.n_actors,
+                "capacity_rows": self.capacity_rows,
+                "address": self.address,
+                "weight_version": self._weight_version,
+                "rows_total": self._rows_total,
+                "chunks_dropped": self._chunks_dropped,
+                "random_phase": self._random_phase,
+                "chunk_cap": {str(k): v for k, v in self._chunk_cap.items()},
+                "actors": actors,
+                "blob_lens": [len(b) for b in blobs],
+            }
+        mb = json.dumps(meta).encode()
+        path = self.sidecar_path(ckpt_path)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(SIDECAR_MAGIC + _U32.pack(len(mb)) + mb)
+            for blob in blobs:
+                fh.write(blob)
+        os.replace(tmp, path)
+        return path
+
+    def restore_sidecar(self, ckpt_path: str) -> bool:
+        """Load a sidecar written by `save_sidecar`; call BEFORE `start()`
+        so the service rehosts at the pre-crash address. Returns False when
+        no sidecar rides this checkpoint."""
+        path = self.sidecar_path(ckpt_path)
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if data[:4] != SIDECAR_MAGIC:
+            raise ValueError(f"bad flock sidecar magic in {path!r}")
+        (meta_len,) = _U32.unpack_from(data, 4)
+        meta = json.loads(data[8 : 8 + meta_len].decode())
+        if (meta["algo"], meta["mode"], meta["n_actors"]) != (
+            self.algo,
+            self.mode,
+            self.n_actors,
+        ):
+            raise ValueError(
+                f"flock sidecar {path!r} was written for "
+                f"algo={meta['algo']} mode={meta['mode']} "
+                f"n_actors={meta['n_actors']}; this service is "
+                f"algo={self.algo} mode={self.mode} n_actors={self.n_actors}"
+            )
+        off = 8 + meta_len
+        with self._lock:
+            self._requested_address = meta["address"]
+            self._weight_version = int(meta["weight_version"])
+            self._rows_total = int(meta["rows_total"])
+            self._chunks_dropped = int(meta["chunks_dropped"])
+            self._random_phase = bool(meta["random_phase"])
+            self._chunk_cap = {
+                int(k): int(v) for k, v in meta.get("chunk_cap", {}).items()
+            }
+            for aid in range(self.n_actors):
+                st = self._actors[aid]
+                saved = meta["actors"][str(aid)]
+                st.generation = int(saved["generation"])
+                st.ever_connected = bool(saved["ever_connected"])
+                st.env_steps = int(saved["env_steps"])
+                st.weight_version = int(saved["weight_version"])
+                st.rows = int(saved["rows"])
+                st.connected = False  # actors re-HELLO after the restart
+            for aid, blob_len in enumerate(meta["blob_lens"]):
+                blob = data[off : off + blob_len]
+                off += blob_len
+                if self.mode == "buffer":
+                    self._shards[aid] = type(self._shards[aid]).from_bytes(
+                        blob, storage="host"
+                    )
+                else:
+                    from ..data.wire import unpack_tree
+
+                    (n_chunks,) = _U32.unpack_from(blob, 0)
+                    pos = 4
+                    q = deque()
+                    for _ in range(n_chunks):
+                        (blen,) = _U64.unpack_from(blob, pos)
+                        pos += 8
+                        q.append(unpack_tree(blob[pos : pos + blen]))
+                        pos += blen
+                    self._chunks[aid] = q
+            self._restored = True
+        return True
 
     # -- observability --------------------------------------------------------
 

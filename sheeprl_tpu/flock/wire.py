@@ -44,6 +44,12 @@ to flock, 12-15 to serve, 16+ are free).
 
 Transport addresses serialize as `tcp:HOST:PORT` or `unix:PATH` — one
 string, environment-variable friendly for actor subprocesses.
+
+Network fault injection (ISSUE 16): the sheepfault `net.*` sites live HERE,
+in the one framing layer every distributed tier shares, so one injection
+point covers flock actors, the replay service, serve clients and the serve
+server alike. With no fault clauses armed the hook is a single attribute
+read on the process-global plan — the frame path stays byte-identical.
 """
 
 from __future__ import annotations
@@ -51,6 +57,8 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import threading
+import time
 
 __all__ = [
     "MAGIC",
@@ -124,8 +132,75 @@ class FrameError(ConnectionError):
     """Malformed frame or protocol violation on a flock socket."""
 
 
+# ---------------------------------------------------------------------------
+# injected network faults (resilience/inject.py `net.*` sites)
+# ---------------------------------------------------------------------------
+
+NET_SITES = ("net.drop", "net.delay", "net.corrupt", "net.partition")
+DEFAULT_DELAY_MS = 100.0
+DEFAULT_PARTITION_S = 2.0
+
+# monotonic deadline of the open partition window: while it is in the
+# future, `connect` from THIS process is refused — reconnect backoff has to
+# wait the partition out instead of healing on its first retry
+_partition_until = 0.0
+_partition_gate = threading.Lock()
+
+
+def partition_remaining() -> float:
+    """Seconds left in the injected partition window (0.0 when closed)."""
+    with _partition_gate:
+        return max(0.0, _partition_until - time.monotonic())
+
+
+def _inject_send(sock: socket.socket, data: bytes) -> bytes | None:
+    """Advance every net site's per-process frame counter and apply the
+    fired fault, if any. Returns the (possibly corrupted) bytes to send, or
+    None when the frame must be silently dropped. Inert without an armed
+    plan: one attribute read, no counters, no locks."""
+    global _partition_until
+    from ..resilience import inject
+
+    plan = inject.get_plan()
+    if not plan.specs or not any(s.site in NET_SITES for s in plan.pending()):
+        return data
+    fired = []
+    for site in NET_SITES:
+        spec = plan.fire_next(site)
+        if spec is not None:
+            fired.append(spec)
+            inject.count(f"Fault/{site}")
+    for spec in fired:
+        if spec.site == "net.delay":
+            time.sleep((spec.param or DEFAULT_DELAY_MS) / 1000.0)
+        elif spec.site == "net.drop":
+            return None
+        elif spec.site == "net.corrupt":
+            # garbled magic: the RECEIVER raises FrameError and kills that
+            # one connection; the sender's socket stays healthy
+            return b"XXXX" + data[4:]
+        elif spec.site == "net.partition":
+            with _partition_gate:
+                _partition_until = time.monotonic() + (
+                    spec.param or DEFAULT_PARTITION_S
+                )
+            try:
+                sock.shutdown(socket.SHUT_RDWR)  # both directions dead
+            except OSError:
+                pass
+            raise ConnectionResetError(
+                "injected net.partition: connection shut down both ways"
+            )
+    return data
+
+
 def send_frame(sock: socket.socket, kind: int, payload: bytes = b"") -> None:
-    sock.sendall(_HEADER.pack(MAGIC, kind, 0, 0, len(payload)) + payload)
+    data = _inject_send(
+        sock, _HEADER.pack(MAGIC, kind, 0, 0, len(payload)) + payload
+    )
+    if data is None:
+        return
+    sock.sendall(data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -205,6 +280,11 @@ def parse_address(addr: str):
 
 
 def connect(addr: str, timeout: float | None = None) -> socket.socket:
+    left = partition_remaining()
+    if left > 0.0:
+        raise ConnectionRefusedError(
+            f"injected net.partition: {left:.2f}s left in the window"
+        )
     parsed = parse_address(addr)
     if parsed[0] == "tcp":
         sock = socket.create_connection(parsed[1:], timeout=timeout)

@@ -164,6 +164,10 @@ class RunGuard:
             ("sigterm", signal.SIGTERM),
             ("sigint", signal.SIGINT),
             ("sigkill", signal.SIGKILL),
+            # peer.crash: same SIGKILL delivery, but launcher.retarget_sigkill
+            # never moves it onto an actor — it always kills THIS host (the
+            # replay-service-owning learner, or the serve server)
+            ("peer.crash", signal.SIGKILL),
         ):
             if plan.fire_at(site, step) is not None:
                 os.kill(os.getpid(), signum)

@@ -23,6 +23,20 @@ Step semantics per site (who counts, and what `step` means):
 
     env.step        n-th `step()` call on one wrapped host env (per process;
                     counted by `RestartingEnv`)
+    net.drop        k-th FLK1 frame send in this process is silently not
+                    sent (the peer sees nothing; request/reply loops hang
+                    until their timeout)
+    net.delay       k-th FLK1 frame send sleeps `param` ms (default 100)
+                    before hitting the socket
+    net.corrupt     k-th FLK1 frame send garbles the magic: the RECEIVER
+                    raises FrameError and kills that one connection
+    net.partition   k-th FLK1 frame send shuts the connection down both
+                    ways AND blocks `wire.connect` in this process for
+                    `param` seconds (default 2.0) — reconnect backoff has
+                    to wait the window out
+    peer.crash      SIGKILL this process (the replay-service / serve host)
+                    at loop step k — unlike `sigkill` it is NEVER
+                    retargeted onto an actor under --flock
     nan.loss        training batch of loop step k: reward-like leaves
                     poisoned with NaN (loss goes non-finite)
     nan.grad        training batch of loop step k: observation-like leaves
@@ -36,10 +50,12 @@ Step semantics per site (who counts, and what `step` means):
     transfer.stall  n-th decoupled weight transfer sleeps `param` seconds
                     (default 1.0; exercises the transfer deadline)
 
-Loop-keyed sites (`nan.*`, `sig*`) fire through `fire_at(site, step)` with
-the main's own step counter; call-keyed sites (`env.step`, `ckpt.write`,
-`transfer.stall`) fire through `fire_next(site)`, which advances an internal
-per-site invocation counter.
+Loop-keyed sites (`nan.*`, `sig*`, `peer.crash`) fire through
+`fire_at(site, step)` with the main's own step counter; call-keyed sites
+(`env.step`, `ckpt.write`, `transfer.stall`, `net.*`) fire through
+`fire_next(site)`, which advances an internal per-site invocation counter —
+for the `net.*` sites each `flock/wire.py` frame send advances every armed
+net site's counter, so `net.drop@3` means "this process's 3rd sent frame".
 """
 
 from __future__ import annotations
@@ -77,6 +93,16 @@ FAULT_SITES: dict[str, str] = {
     "sigkill": "SIGKILL delivered at loop step k (no grace; auto-resume)",
     "ckpt.write": "checkpoint write attempt n raises before the orbax save",
     "transfer.stall": "decoupled weight transfer n stalls `param` seconds",
+    # distributed sites (ISSUE 16): injected inside the FLK1 framing layer
+    # (flock/wire.py), shared by the flock and serve tiers
+    "net.drop": "k-th FLK1 frame send silently dropped (peer sees nothing)",
+    "net.delay": "k-th FLK1 frame send delayed `param` ms (default 100)",
+    "net.corrupt": "k-th FLK1 frame sent with garbled magic (receiver FrameError)",
+    "net.partition": (
+        "k-th FLK1 frame send kills the connection both ways and blocks "
+        "reconnects for `param` seconds (default 2.0)"
+    ),
+    "peer.crash": "SIGKILL the replay-service/serve host at loop step k",
 }
 
 

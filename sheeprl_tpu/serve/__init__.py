@@ -14,6 +14,7 @@ be a cycle.
 """
 
 _EXPORTS = {
+    "ConnectionLost": "errors",
     "MicroBatcher": "batcher",
     "OversizedRequest": "errors",
     "ParamsStore": "params",

@@ -3,36 +3,81 @@
 One socket, one in-flight request at a time (concurrency = many clients,
 exactly how the batcher wants its load). Typed failures: a SHED frame
 raises `RequestShed` (read `.retry_after_ms` and come back), an ERROR
-frame raises `OversizedRequest` or `ServeError`.
+frame raises `OversizedRequest` or `ServeError`, a dead socket raises
+`ConnectionLost`.
 
     client = ServeClient("unix:/tmp/.../serve.sock")
     result, meta = client.request({"obs": obs_batch})
     actions = result["actions"]          # rows match the request
     client.reload()                      # hot-swap to the newest ckpt
     client.close()
+
+Retry (ISSUE 16, opt-in — the default `retries=0` keeps every typed
+error surfacing immediately): `request(..., retries=N)` absorbs up to N
+failures. A SHED reply sleeps the server's `retry_after_ms` hint before
+resending; a dead socket reconnects and resends the SAME request id —
+ids are idempotent (a per-client random nonce + counter), so a server
+that already executed the request replays its cached answer instead of
+running it twice.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import secrets
+import time
 from typing import Any
 
 import numpy as np
 
 from ..flock import wire
-from .errors import OversizedRequest, RequestShed, ServeError
-from .server import PROTO_VERSION, pack_request, unpack_request
+from ..telemetry import core as telemetry
+from .errors import ConnectionLost, OversizedRequest, RequestShed, ServeError
+from .server import HEALTH, PROTO_VERSION, pack_request, unpack_request
 
 __all__ = ["ServeClient"]
 
 
 class ServeClient:
-    def __init__(self, address: str, timeout: float | None = 60.0):
-        self._sock = wire.connect(address, timeout=timeout)
+    def __init__(
+        self,
+        address: str,
+        timeout: float | None = 60.0,
+        retries: int = 0,
+        backoff_s: float = 0.1,
+    ):
+        self._address = address
+        self._timeout = timeout
+        self._retries = int(retries)
+        self._backoff_s = float(backoff_s)
+        # idempotent request ids: random per-client nonce + counter — never
+        # collides across clients (the old bare-int ids did), so the server
+        # can dedupe replayed ids after a reconnect
+        self._nonce = secrets.token_hex(4)
         self._ids = itertools.count(1)
+        self._sock: Any = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = wire.connect(self._address, timeout=self._timeout)
         wire.send_json(self._sock, wire.HELLO, {"proto": PROTO_VERSION})
         self.info = wire.recv_json(self._sock, wire.WELCOME)
+
+    def _reconnect(self) -> None:
+        self._drop_socket()
+        self._connect()
+
+    def _drop_socket(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError as err:
+                telemetry.emit(
+                    "serve.client_close_error",
+                    error=f"{type(err).__name__}: {err}",
+                )
+            self._sock = None
 
     def request(
         self,
@@ -40,39 +85,87 @@ class ServeClient:
         deadline_ms: float | None = None,
         session: str | None = None,
         reset: bool = False,
+        retries: int | None = None,
     ) -> tuple[dict[str, np.ndarray], dict]:
         """-> (result tree, response meta). Raises RequestShed past the
         deadline, OversizedRequest for rows beyond the ladder, ServeError
-        for dispatch failures."""
-        meta: dict[str, Any] = {"id": next(self._ids)}
+        for dispatch failures, ConnectionLost for a dead socket. With
+        `retries` > 0 (or a client-level default) sheds are retried after
+        the server's hint and dead sockets are reconnected — the SAME
+        request id is resent, so a retry can never double-execute."""
+        budget = self._retries if retries is None else int(retries)
+        meta: dict[str, Any] = {"id": f"{self._nonce}-{next(self._ids)}"}
         if deadline_ms is not None:
             meta["deadline_ms"] = deadline_ms
         if session is not None:
             meta["session"] = session
         if reset:
             meta["reset"] = True
-        wire.send_frame(self._sock, wire.REQUEST, pack_request(meta, obs))
-        frame = wire.recv_frame(self._sock)
+        payload = pack_request(meta, obs)
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(payload)
+            except RequestShed as shed:
+                if attempt >= budget:
+                    raise
+                time.sleep(max(shed.retry_after_ms, 0.0) / 1000.0)
+            except ConnectionLost:
+                if attempt >= budget:
+                    raise
+                time.sleep(self._backoff_s * (2.0**attempt))
+                try:
+                    self._reconnect()
+                except (OSError, TimeoutError) as err:
+                    if attempt + 1 >= budget:
+                        raise ConnectionLost(
+                            f"reconnect to {self._address!r} failed: {err}"
+                        ) from err
+            attempt += 1
+
+    def _request_once(
+        self, payload: bytes
+    ) -> tuple[dict[str, np.ndarray], dict]:
+        try:
+            wire.send_frame(self._sock, wire.REQUEST, payload)
+            frame = wire.recv_frame(self._sock)
+        except (OSError, TimeoutError) as err:
+            self._drop_socket()
+            raise ConnectionLost(
+                f"server connection died mid-request: {err}"
+            ) from err
         if frame is None:
-            raise ServeError("server closed the connection")
-        kind, payload = frame
+            self._drop_socket()
+            raise ConnectionLost("server closed the connection")
+        kind, reply = frame
         if kind == wire.RESPONSE:
-            resp_meta, result = unpack_request(payload)
+            resp_meta, result = unpack_request(reply)
             return result, resp_meta
         if kind == wire.SHED:
-            shed = json.loads(payload.decode())
+            shed = json.loads(reply.decode())
             raise RequestShed(
                 float(shed.get("retry_after_ms", 0.0)),
                 shed.get("reason", "deadline"),
             )
         if kind == wire.ERROR:
-            err = json.loads(payload.decode())
+            err = json.loads(reply.decode())
             if err.get("kind") == "oversized":
                 raise OversizedRequest(-1, -1, message=err.get("error"))
             raise ServeError(err.get("error", "request failed"))
         raise wire.FrameError(
             f"unexpected reply kind {wire.KIND_NAMES.get(kind, kind)}"
         )
+
+    def health(self) -> dict:
+        """HEALTH round-trip: {ready, draining, version, queue_depth,
+        completed} — the liveness probe load balancers and the chaos
+        harness poll."""
+        try:
+            wire.send_json(self._sock, HEALTH, {})
+            return wire.recv_json(self._sock, HEALTH)
+        except (OSError, TimeoutError) as err:
+            self._drop_socket()
+            raise ConnectionLost(f"health probe failed: {err}") from err
 
     def reload(self, path: str | None = None) -> dict:
         """Ask the server to hot-reload (default: its current source).
@@ -81,14 +174,18 @@ class ServeClient:
         return wire.recv_json(self._sock, wire.RELOAD)
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             wire.send_frame(self._sock, wire.BYE)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        except OSError as err:
+            # a dead socket at close is expected after a server crash, but
+            # never silent (SL012): the event is the receipt chaos CI greps
+            telemetry.emit(
+                "serve.client_close_error",
+                error=f"{type(err).__name__}: {err}",
+            )
+        self._drop_socket()
 
     def __enter__(self):
         return self

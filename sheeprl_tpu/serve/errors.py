@@ -2,15 +2,23 @@
 `RequestShed` becomes a SHED frame (retryable, carries the retry hint), an
 `OversizedRequest` becomes an ERROR frame (the client must split the
 request — retrying the same payload can never succeed), anything else
-becomes a generic ERROR frame."""
+becomes a generic ERROR frame. `ConnectionLost` is client-side only: the
+socket died mid-request — safe to reconnect and resend the SAME request
+id (the server dedupes)."""
 
 from __future__ import annotations
 
-__all__ = ["OversizedRequest", "RequestShed", "ServeError"]
+__all__ = ["ConnectionLost", "OversizedRequest", "RequestShed", "ServeError"]
 
 
 class ServeError(RuntimeError):
     """Base class for serving-tier failures."""
+
+
+class ConnectionLost(ServeError):
+    """The server connection died mid-request (crash, restart, injected
+    partition). Retryable: request ids are idempotent, so reconnecting and
+    resending the same id can never double-execute."""
 
 
 class OversizedRequest(ServeError):

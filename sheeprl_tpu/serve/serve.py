@@ -16,8 +16,13 @@ Wiring, in dependency order:
   4. hot-reloadable params (params.py), micro-batcher (batcher.py),
      FLK1 socket front (server.py);
   5. the serve loop: heartbeat `Serve/*` telemetry intervals, optional
-     checkpoint-directory polling for automatic hot reload, clean drain
-     on SIGTERM/SIGINT (or after --serve_requests completions).
+     checkpoint-directory polling for automatic hot reload, graceful
+     drain on SIGTERM/SIGINT — in-flight batches finish, queued requests
+     are served (the batcher's zero-drop close), NEW requests are shed
+     with reason="draining", and the process exits rc 75 (the shared
+     resumable/preempted code). `--serve_requests` completion stays a
+     plain rc 0. An armed `peer.crash@k` fault SIGKILLs the server at
+     loop step k — the chaos harness's server-crash injection.
 
 The resolved listen address is printed AND written to
 `<log_dir>/serve_address` so scripted clients never parse stdout.
@@ -112,9 +117,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     )
     server = ServeServer(policy, store, batcher, bind=args.bind, telem=telem)
     stop = threading.Event()
+    got_signal: list[str] = []
+
+    def _on_signal(signum, _frame):
+        got_signal.append(signal.Signals(signum).name)
+        stop.set()
+
     if threading.current_thread() is threading.main_thread():
         for sig in (signal.SIGTERM, signal.SIGINT):
-            signal.signal(sig, lambda *_: stop.set())
+            signal.signal(sig, _on_signal)
 
     poller = None
     start_t = time.monotonic()
@@ -135,10 +146,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             )
             poller.start()
 
+        from ..resilience import inject
+
+        telem.add_gauges(inject.gauges)
         step = 0
         while not stop.is_set():
             stop.wait(0.5)
             step += 1
+            # the chaos harness's server-crash site: SIGKILL, no drain — the
+            # recovery under test is the CLIENT's (typed ConnectionLost +
+            # reconnect/resend under idempotent ids)
+            if inject.get_plan().fire_at("peer.crash", step) is not None:
+                os.kill(os.getpid(), signal.SIGKILL)
             if step % 4 == 0 or stop.is_set() or args.dry_run:
                 elapsed = max(time.monotonic() - start_t, 1e-6)
                 # a non-empty metrics dict guarantees a parseable JSONL
@@ -155,7 +174,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                 break
     finally:
         stop.set()
-        telem.event("serve.stop", completed=server.completed, version=store.version)
+        if got_signal:
+            # graceful drain: queued requests finish (zero dropped
+            # in-flight), new ones are shed with reason="draining"
+            server.drain()
+        telem.event(
+            "serve.stop",
+            completed=server.completed,
+            version=store.version,
+            signal=got_signal[0] if got_signal else None,
+        )
         server.close()
         if poller is not None:
             poller.join(timeout=2.0)
@@ -168,6 +196,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         plan.close()
         telem.close()
         logger.close()
+    if got_signal:
+        from ..resilience import RC_PREEMPTED
+
+        # the DISTINCT resumable rc (75, EX_TEMPFAIL): supervisors treat a
+        # drained serve exit exactly like a preempted training exit
+        raise SystemExit(RC_PREEMPTED)
 
 
 def _poll_reloads(args: ServeArgs, store, stop: threading.Event) -> None:

@@ -15,7 +15,17 @@ with exactly one frame per request:
 RELOAD frames trigger `ParamsStore.reload` in the handler thread (the
 dispatch path never blocks on a reload) and are answered with a RELOAD
 reply {ok, version, seconds, error}. HELLO/WELCOME carries the serving
-contract: algo, obs keys, ladder rungs, params version.
+contract: algo, obs keys, ladder rungs, params version. HEALTH frames
+(kind 16) answer {ready, draining, version, queue_depth, completed} —
+the liveness probe for load balancers and the chaos harness.
+
+Hardening (ISSUE 16): string request ids are idempotent — a terminal
+answer (RESPONSE/ERROR, never SHED) is cached in a bounded dedupe map,
+so a client that reconnects and replays an already-executed id gets the
+cached answer instead of a double execution. `drain()` flips the server
+into graceful-shutdown: queued work finishes (the batcher's zero-drop
+close), while NEW requests are shed with reason="draining" and a
+`retry_after_ms` hint — the SIGTERM path in serve.py then exits rc 75.
 
 The server owns the client-visible latency clock: per-response wall time
 from frame-in to frame-out feeds the `Serve/qps`, `Serve/latency_p50_ms`
@@ -31,7 +41,7 @@ import struct
 import tempfile
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any
 
 import numpy as np
@@ -45,6 +55,12 @@ __all__ = ["ServeServer", "pack_request", "unpack_request"]
 _U32 = struct.Struct("<I")
 
 PROTO_VERSION = 1
+
+# serving liveness probe (appended in the shared FLK1 registry; 1-15 are
+# pinned by flock/serve above)
+HEALTH = wire.register_kind(16, "health")
+
+DEDUPE_CAP = 256  # replayed-id answers kept per server
 
 
 def pack_request(meta: dict, obs: dict[str, np.ndarray]) -> bytes:
@@ -79,10 +95,14 @@ class ServeServer:
         self._conns: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._lock = threading.Lock()
         # (done_t, total_ms) per completed request — the QPS/percentile source
         self._latencies: deque[tuple[float, float]] = deque(maxlen=4096)
         self.completed = 0  # responses + sheds + errors actually answered
+        # terminal answers by string request id: a reconnecting client that
+        # replays an id gets the cached frame, never a second execution
+        self._dedupe: OrderedDict[str, tuple[int, bytes]] = OrderedDict()
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -116,6 +136,26 @@ class ServeServer:
             sock_dir = tempfile.mkdtemp(prefix="sheepserve-")
             return wire.format_address("unix", os.path.join(sock_dir, "serve.sock"))
         return bind
+
+    def drain(self) -> None:
+        """Graceful shutdown half 1: stop ACCEPTING work (new requests are
+        shed with reason="draining" + a retry hint) while every queued
+        request finishes — the batcher's zero-drop close. `close()` then
+        tears the sockets down."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        self._event(
+            "serve.draining",
+            queue_depth=float(self.batcher.queue_depth()),
+            completed=self.completed,
+        )
+        self.batcher.close()  # blocks until the queue is served
+        self._event("serve.drained", completed=self.completed)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
 
     def close(self) -> None:
         self._stop.set()
@@ -183,6 +223,18 @@ class ServeServer:
                     req = json.loads(payload.decode()) if payload else {}
                     reply = self.store.reload(req.get("path"))
                     wire.send_json(conn, wire.RELOAD, reply)
+                elif kind == HEALTH:
+                    wire.send_json(
+                        conn,
+                        HEALTH,
+                        {
+                            "ready": not self._draining.is_set(),
+                            "draining": self._draining.is_set(),
+                            "version": self.store.version,
+                            "queue_depth": self.batcher.queue_depth(),
+                            "completed": self.completed,
+                        },
+                    )
                 elif kind == wire.REQUEST:
                     self._handle_request(conn, payload)
                 else:
@@ -190,8 +242,15 @@ class ServeServer:
                         conn, wire.ERROR,
                         {"error": f"unexpected frame kind {kind}", "kind": "protocol"},
                     )
-        except (wire.FrameError, ConnectionError, OSError, ValueError):
-            pass
+        except (wire.FrameError, ConnectionError, OSError, ValueError) as err:
+            # the failure killed only THIS connection — every other client
+            # keeps being served — but it must leave a receipt (SL012:
+            # swallowed handlers hide exactly the chaos-CI signals)
+            if not self._stop.is_set():
+                self._event(
+                    "serve.conn_error",
+                    error=f"{type(err).__name__}: {err}",
+                )
         finally:
             try:
                 conn.close()
@@ -202,6 +261,25 @@ class ServeServer:
         t0 = time.monotonic()
         meta, obs = unpack_request(payload)
         rid = meta.get("id")
+        if isinstance(rid, str):
+            with self._lock:
+                cached = self._dedupe.get(rid)
+            if cached is not None:
+                # replayed id after a reconnect: repeat the answer, not the
+                # work (the id was already executed and answered once)
+                wire.send_frame(conn, cached[0], cached[1])
+                return
+        if self._draining.is_set():
+            wire.send_json(
+                conn, wire.SHED,
+                {
+                    "id": rid,
+                    "retry_after_ms": round(self.batcher.retry_after_ms(), 1),
+                    "reason": "draining",
+                },
+            )
+            self._finish(t0)
+            return
         limit = self.policy.max_rows_per_request
         try:
             if limit is not None:
@@ -217,6 +295,8 @@ class ServeServer:
             )
             result = pending.wait(timeout=60.0)
         except RequestShed as shed:
+            # sheds are NOT cached for dedupe: "not executed, retry later"
+            # must stay retryable under the same id
             wire.send_json(
                 conn, wire.SHED,
                 {
@@ -228,15 +308,20 @@ class ServeServer:
             self._finish(t0)
             return
         except OversizedRequest as err:
-            wire.send_json(
-                conn, wire.ERROR,
-                {"id": rid, "error": str(err), "kind": "oversized"},
+            self._answer(
+                conn, rid, wire.ERROR,
+                json.dumps(
+                    {"id": rid, "error": str(err), "kind": "oversized"}
+                ).encode(),
             )
             self._finish(t0)
             return
         except ServeError as err:
-            wire.send_json(
-                conn, wire.ERROR, {"id": rid, "error": str(err), "kind": "failed"}
+            self._answer(
+                conn, rid, wire.ERROR,
+                json.dumps(
+                    {"id": rid, "error": str(err), "kind": "failed"}
+                ).encode(),
             )
             self._finish(t0)
             return
@@ -247,8 +332,20 @@ class ServeServer:
             "rows": pending.rows,
             "queue_ms": round(pending.queue_ms, 3),
         }
-        wire.send_frame(conn, wire.RESPONSE, pack_request(out_meta, result))
+        self._answer(conn, rid, wire.RESPONSE, pack_request(out_meta, result))
         self._finish(t0)
+
+    def _answer(
+        self, conn: socket.socket, rid, kind: int, payload: bytes
+    ) -> None:
+        """Send a TERMINAL answer (RESPONSE/ERROR), remembering it for
+        string (idempotent) ids so a replay never re-executes."""
+        if isinstance(rid, str):
+            with self._lock:
+                self._dedupe[rid] = (kind, payload)
+                while len(self._dedupe) > DEDUPE_CAP:
+                    self._dedupe.popitem(last=False)
+        wire.send_frame(conn, kind, payload)
 
     def _finish(self, t0: float) -> None:
         now = time.monotonic()
@@ -264,6 +361,7 @@ class ServeServer:
             lats = sorted(ms for _, ms in self._latencies)
             recent = sum(1 for t, _ in self._latencies if now - t <= 10.0)
         out = {
+            "Serve/draining": float(self._draining.is_set()),
             "Serve/qps": recent / 10.0,
             "Serve/latency_p50_ms": _percentile(lats, 0.50),
             "Serve/latency_p99_ms": _percentile(lats, 0.99),

@@ -1,0 +1,209 @@
+"""Flock recovery paths over real sockets (ISSUE 16): FrameError
+isolation (a poisoned connection dies alone), heartbeat-staleness
+eviction, and the crash-resume sidecar (snapshot -> restore -> rehost at
+the same address with zero committed rows lost)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import AsyncReplayBuffer
+from sheeprl_tpu.flock import wire
+from sheeprl_tpu.flock.service import ReplayService
+
+from .test_service import _chunk, _FakeActor, _Recorder, _wait_events
+
+
+@pytest.mark.timeout(60)
+def test_frame_error_kills_only_that_connection():
+    """Garbage magic on actor 0's connection: only actor 0 dies — the
+    service emits flock.conn_error and keeps serving actor 1."""
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        a0 = _FakeActor(addr, 0)
+        a1 = _FakeActor(addr, 1)
+        # poison the stream: bad magic, then half a header (mid-frame EOF)
+        a0.sock.sendall(b"XXXX" + b"\x00" * 12)
+        a0.sock.close()
+        _wait_events(rec, "flock.conn_error")
+        _wait_events(rec, "flock.actor_disconnected")
+        # the OTHER actor's connection is untouched
+        reply = a1.push(_chunk(2.0), rows=4)
+        assert reply["rows_total"] == 4
+        assert svc.actors_alive() == 1
+        a1.bye()
+    err = dict(rec.events)["flock.conn_error"]
+    assert err["actor_id"] == 0 and "FrameError" in err["error"]
+
+
+@pytest.mark.timeout(60)
+def test_oversize_frame_kills_only_that_connection():
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        a0 = _FakeActor(addr, 0)
+        a1 = _FakeActor(addr, 1)
+        # a length field past MAX_FRAME_BYTES must not allocate the moon
+        a0.sock.sendall(
+            wire._HEADER.pack(
+                wire.MAGIC, wire.PUSH, 0, 0, wire.MAX_FRAME_BYTES + 1
+            )
+        )
+        _wait_events(rec, "flock.conn_error")
+        assert a1.push(_chunk(1.0), rows=4)["rows_total"] == 4
+        a0.sock.close()
+        a1.bye()
+
+
+@pytest.mark.timeout(60)
+def test_heartbeat_staleness_evicts_but_keeps_shard(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_FLOCK_HEARTBEAT_TIMEOUT_S", "0.5")
+    rec = _Recorder()
+    evicted = []
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        svc.on_evict = evicted.append
+        addr = svc.start()
+        a = _FakeActor(addr, 0)
+        a.push(_chunk(3.0), rows=4)
+        # go silent: no heartbeat, no push — past the 0.5 s timeout the
+        # monitor frees the connection but KEEPS the shard
+        _wait_events(rec, "flock.actor_stale", timeout=10.0)
+        assert evicted == [0]
+        _wait_events(rec, "flock.actor_disconnected")
+        assert svc.rows_total() == 4
+        assert svc.next_chunk(timeout=1.0) is not None  # shard kept
+        # rejoin under the same id still works (generation bumps)
+        b = _FakeActor(addr, 0)
+        assert b.welcome["generation"] == 1
+        b.bye()
+    stale = dict(rec.events)["flock.actor_stale"]
+    assert stale["actor_id"] == 0 and stale["timeout_s"] == 0.5
+
+
+@pytest.mark.timeout(60)
+def test_heartbeat_timeout_zero_disables_monitor(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_TPU_FLOCK_HEARTBEAT_TIMEOUT_S", "0")
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        a = _FakeActor(addr, 0)
+        time.sleep(0.8)  # far past any would-be timeout
+        assert svc.actors_alive() == 1
+        assert "flock.actor_stale" not in rec.names()
+        a.bye()
+
+
+@pytest.mark.timeout(60)
+def test_sidecar_roundtrip_chunks_mode(tmp_path):
+    """SIGKILL-shaped crash: snapshot, rebuild a FRESH service from the
+    sidecar, rehost at the same address, and verify zero committed rows
+    lost, monotonic weight versions, and actor rejoin."""
+    rec = _Recorder()
+    ckpt = str(tmp_path / "ckpt_3")
+    svc = ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=64, telem=rec,
+    )
+    addr = svc.start()
+    svc.publish([np.arange(4, dtype=np.float32)])  # version 1
+    a0 = _FakeActor(addr, 0)
+    a0.push(_chunk(1.0), rows=4, env_steps=4, weight_version=1)
+    a0.push(_chunk(2.0), rows=4, env_steps=8, weight_version=1)
+    path = svc.save_sidecar(ckpt)
+    assert os.path.exists(path)
+    a0.sock.close()
+    svc.close()  # the crash (the real one never even closes)
+
+    rec2 = _Recorder()
+    svc2 = ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=64, telem=rec2,
+    )
+    assert svc2.restore_sidecar(ckpt)
+    addr2 = svc2.start()
+    try:
+        assert addr2 == addr  # rehosted at the pre-crash address
+        assert svc2.rows_total() == 8  # zero committed rows lost
+        assert "flock.resumed" in rec2.names()
+        # publish AFTER restore bumps PAST the restored version: monotonic
+        assert svc2.publish([np.arange(4, dtype=np.float32)]) == 2
+        chunk = svc2.next_chunk(timeout=1.0)
+        np.testing.assert_array_equal(
+            chunk["obs"], _chunk(1.0)["obs"]
+        )
+        # a surviving actor re-dials the SAME address and re-HELLOs
+        b = _FakeActor(addr2, 0)
+        assert b.welcome["generation"] == 1  # ever_connected survived
+        assert "flock.actor_rejoined" in rec2.names()
+        b.bye()
+    finally:
+        svc2.close()
+
+
+@pytest.mark.timeout(60)
+def test_sidecar_roundtrip_buffer_mode(tmp_path):
+    def make_shard(cap):
+        return AsyncReplayBuffer(
+            cap, 2, storage="host", sequential=True,
+            obs_keys=("obs",), seed=7,
+        )
+
+    ckpt = str(tmp_path / "ckpt_9")
+    svc = ReplayService(
+        algo="dreamer_v3", n_actors=1, mode="buffer", capacity_rows=32,
+        make_shard=make_shard, telem=_Recorder(),
+    )
+    addr = svc.start()
+    a = _FakeActor(addr, 0)
+    tree = {
+        "obs": np.random.default_rng(0).standard_normal(
+            (8, 2, 3)
+        ).astype(np.float32),
+        "rewards": np.zeros((8, 2, 1), np.float32),
+    }
+    a.push(tree, rows=8, env_steps=16, weight_version=0)
+    before = svc.shard(0).to_bytes()
+    svc.save_sidecar(ckpt)
+    a.sock.close()
+    svc.close()
+
+    svc2 = ReplayService(
+        algo="dreamer_v3", n_actors=1, mode="buffer", capacity_rows=32,
+        make_shard=make_shard, telem=_Recorder(),
+    )
+    assert svc2.restore_sidecar(ckpt)
+    svc2.start()
+    try:
+        # bit-exact shard restore: ring contents + sampler PRNG state
+        assert svc2.shard(0).to_bytes() == before
+        assert svc2.rows_total() == 8
+    finally:
+        svc2.close()
+
+
+@pytest.mark.timeout(60)
+def test_sidecar_mismatch_raises(tmp_path):
+    ckpt = str(tmp_path / "ckpt_1")
+    svc = ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=64,
+        telem=_Recorder(),
+    )
+    svc.start()
+    svc.save_sidecar(ckpt)
+    svc.close()
+    other = ReplayService(
+        algo="ppo", n_actors=3, mode="chunks", capacity_rows=64,
+        telem=_Recorder(),
+    )
+    with pytest.raises(ValueError, match="n_actors"):
+        other.restore_sidecar(ckpt)
+    assert not other.restore_sidecar(str(tmp_path / "no_such_ckpt"))

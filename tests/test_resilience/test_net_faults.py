@@ -1,0 +1,178 @@
+"""Network/peer fault sites injected inside the FLK1 framing layer
+(ISSUE 16 tentpole): parse, per-frame counting, each site's blast radius,
+and — critically — that the injection layer is INERT with no clauses
+armed (the frame path stays byte-identical)."""
+
+import socket
+import time
+
+import pytest
+
+from sheeprl_tpu.flock import wire
+from sheeprl_tpu.resilience import inject
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan(monkeypatch):
+    monkeypatch.delenv(inject.ENV_VAR, raising=False)
+    monkeypatch.delenv(inject.SEED_VAR, raising=False)
+    inject.reset_plan()
+    wire._partition_until = 0.0
+    yield
+    inject.reset_plan()
+    wire._partition_until = 0.0
+
+
+def _arm(monkeypatch, text):
+    monkeypatch.setenv(inject.ENV_VAR, text)
+    inject.reset_plan()
+    return inject.get_plan()
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+KIND = wire.HEARTBEAT
+
+
+def test_new_sites_parse_and_describe():
+    plan = inject.FaultPlan.parse(
+        "net.drop@3,net.delay@2:250,net.corrupt@1,net.partition@4:1.5,"
+        "peer.crash@7"
+    )
+    sites = {s.site for s in plan.specs}
+    assert sites == {
+        "net.drop", "net.delay", "net.corrupt", "net.partition", "peer.crash"
+    }
+    assert {s.site: s.param for s in plan.specs}["net.delay"] == 250.0
+    for site in wire.NET_SITES + ("peer.crash",):
+        assert site in inject.FAULT_SITES
+
+
+def test_unarmed_layer_is_inert():
+    a, b = _pair()
+    try:
+        for i in range(5):
+            wire.send_frame(a, KIND, b"x" * (i + 1))
+        for i in range(5):
+            kind, payload = wire.recv_frame(b)
+            assert kind == KIND and payload == b"x" * (i + 1)
+        # no counters advanced, nothing fired, no partition window opened
+        assert inject.counters() == {}
+        assert wire.partition_remaining() == 0.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_drop_loses_exactly_frame_k(monkeypatch):
+    _arm(monkeypatch, "net.drop@2")
+    a, b = _pair()
+    try:
+        for tag in (b"one", b"two", b"three"):
+            wire.send_frame(a, KIND, tag)
+        a.close()
+        got = []
+        while True:
+            frame = wire.recv_frame(b)
+            if frame is None:
+                break
+            got.append(frame[1])
+        assert got == [b"one", b"three"]  # frame 2 silently gone
+        assert inject.counters().get("Fault/net.drop") == 1.0
+        assert inject.counters().get("Fault/injected") == 1.0
+    finally:
+        b.close()
+
+
+def test_net_delay_sleeps_param_ms(monkeypatch):
+    _arm(monkeypatch, "net.delay@1:200")
+    a, b = _pair()
+    try:
+        t0 = time.monotonic()
+        wire.send_frame(a, KIND, b"slow")
+        assert time.monotonic() - t0 >= 0.15
+        kind, payload = wire.recv_frame(b)
+        assert kind == KIND and payload == b"slow"  # delayed, not lost
+        # subsequent sends are back to full speed (exactly-once)
+        t0 = time.monotonic()
+        wire.send_frame(a, KIND, b"fast")
+        assert time.monotonic() - t0 < 0.1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_corrupt_garbles_magic_receiver_raises(monkeypatch):
+    _arm(monkeypatch, "net.corrupt@1")
+    a, b = _pair()
+    try:
+        wire.send_frame(a, KIND, b"payload")
+        with pytest.raises(wire.FrameError, match="bad frame magic"):
+            wire.recv_frame(b)
+        # the receiver kills that one connection (the stream is desynced
+        # past the garbled header); the SENDER's socket stays healthy —
+        # its next send does not raise
+        wire.send_frame(a, KIND, b"after")
+        assert inject.counters().get("Fault/net.corrupt") == 1.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_partition_kills_connection_and_blocks_reconnect(monkeypatch):
+    _arm(monkeypatch, "net.partition@1:0.4")
+    a, b = _pair()
+    try:
+        with pytest.raises(ConnectionError):
+            wire.send_frame(a, KIND, b"never lands")
+        assert wire.recv_frame(b) is None  # both directions dead
+        assert wire.partition_remaining() > 0.0
+        # reconnects are refused for the whole window...
+        with pytest.raises(ConnectionRefusedError, match="net.partition"):
+            wire.connect("tcp:127.0.0.1:1", timeout=0.1)
+        time.sleep(0.5)
+        # ...then the gate opens (the dial itself may still fail, but for
+        # the real reason, not the injected one)
+        assert wire.partition_remaining() == 0.0
+        assert inject.counters().get("Fault/net.partition") == 1.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_sites_share_one_per_send_counter(monkeypatch):
+    """Every armed net site counts the SAME frame sends: drop@1 and
+    corrupt@2 hit the first and second frames of this process."""
+    _arm(monkeypatch, "net.drop@1,net.corrupt@2")
+    a, b = _pair()
+    try:
+        wire.send_frame(a, KIND, b"first")   # dropped
+        wire.send_frame(a, KIND, b"second")  # corrupted
+        with pytest.raises(wire.FrameError):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_peer_crash_fires_at_declared_loop_step(monkeypatch):
+    # fire the plan directly — NEVER through guard.tick in-process (the
+    # site's action is SIGKILL)
+    plan = _arm(monkeypatch, "peer.crash@5")
+    assert plan.fire_at("peer.crash", 4) is None
+    spec = plan.fire_at("peer.crash", 5)
+    assert spec is not None and spec.site == "peer.crash"
+    assert plan.fire_at("peer.crash", 5) is None  # exactly-once
+    assert inject.counters().get("Fault/injected") == 1.0
+
+
+def test_partition_window_seeded_range_is_deterministic(monkeypatch):
+    monkeypatch.setenv(inject.SEED_VAR, "11")
+    p1 = inject.FaultPlan.parse("net.partition@10-50:2", seed=11)
+    p2 = inject.FaultPlan.parse("net.partition@10-50:2", seed=11)
+    assert p1.specs[0].step == p2.specs[0].step

@@ -1,0 +1,284 @@
+"""Serve-tier failure modes (ISSUE 16): typed ConnectionLost,
+retry/backoff with idempotent request ids (reconnect resends the SAME id,
+the server dedupes), SHED retry_after honoring, HEALTH probes, graceful
+drain, and FrameError isolation on live sockets."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.flock import wire
+from sheeprl_tpu.serve import (
+    ConnectionLost,
+    MicroBatcher,
+    ParamsStore,
+    RequestShed,
+    ServeClient,
+    ServeServer,
+)
+from sheeprl_tpu.serve.errors import ServeError
+from sheeprl_tpu.serve.server import HEALTH, pack_request, unpack_request
+from sheeprl_tpu.serve.policies import SACServePolicy
+
+from .test_server import _make_actor, _obs, OBS_DIM
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, name, **data):
+        self.events.append((name, data))
+
+    def names(self):
+        return [n for n, _ in self.events]
+
+
+@pytest.fixture(scope="module")
+def sac():
+    return SACServePolicy(OBS_DIM, 1), _make_actor(0)
+
+
+def _serving(policy, params, telem=None, deadline_ms=2000.0):
+    store = ParamsStore(lambda path: params, params, source=None)
+
+    def dispatch(stacked, pendings, rung):
+        version, live = store.current()
+        return policy.run(policy.step, live, version, stacked, pendings, rung), version
+
+    batcher = MicroBatcher(
+        dispatch, [1, 2, 4], window_ms=1.0, default_deadline_ms=deadline_ms
+    )
+    server = ServeServer(policy, store, batcher, telem=telem)
+    server.start()
+    return server
+
+
+class _ScriptedServer:
+    """A wire-speaking fake that scripts one behavior per connection —
+    the knob the real server can't offer: dying mid-request on cue."""
+
+    def __init__(self, scripts):
+        self.scripts = list(scripts)
+        self.seen_ids = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.address = f"tcp:127.0.0.1:{self._srv.getsockname()[1]}"
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        for script in self.scripts:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                frame = wire.recv_frame(conn)
+                assert frame is not None and frame[0] == wire.HELLO
+                wire.send_json(conn, wire.WELCOME, {"proto": 1, "algo": "fake"})
+                frame = wire.recv_frame(conn)
+                if frame is None:
+                    continue
+                meta, obs = unpack_request(frame[1])
+                self.seen_ids.append(meta["id"])
+                if script == "hangup":
+                    conn.close()
+                elif script == "shed":
+                    wire.send_json(
+                        conn, wire.SHED,
+                        {"id": meta["id"], "retry_after_ms": 50.0,
+                         "reason": "deadline"},
+                    )
+                    # same connection: the retried request after the hint
+                    frame = wire.recv_frame(conn)
+                    meta, obs = unpack_request(frame[1])
+                    self.seen_ids.append(meta["id"])
+                    self._respond(conn, meta, obs)
+                else:  # "serve"
+                    self._respond(conn, meta, obs)
+            except (OSError, wire.FrameError):
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _respond(conn, meta, obs):
+        out = {"actions": np.zeros_like(obs["obs"])}
+        out_meta = {"id": meta["id"], "version": 1, "rung": 1,
+                    "rows": 1, "queue_ms": 0.0}
+        wire.send_frame(conn, wire.RESPONSE, pack_request(out_meta, out))
+
+    def close(self):
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def test_connection_lost_is_typed_and_default_not_retried():
+    assert issubclass(ConnectionLost, ServeError)
+    srv = _ScriptedServer(["hangup"])
+    try:
+        client = ServeClient(srv.address, timeout=5.0)
+        # default retries=0: the dead socket surfaces immediately, typed
+        with pytest.raises(ConnectionLost):
+            client.request(_obs(1))
+        client.close()
+    finally:
+        srv.close()
+
+
+@pytest.mark.timeout(60)
+def test_reconnect_resends_the_same_request_id():
+    srv = _ScriptedServer(["hangup", "serve"])
+    try:
+        with ServeClient(srv.address, timeout=5.0, backoff_s=0.01) as client:
+            result, meta = client.request(_obs(1), retries=2)
+            assert result["actions"].shape == (1, OBS_DIM)
+        # both attempts carried the SAME idempotent id — the server-side
+        # dedupe contract depends on it
+        assert len(srv.seen_ids) == 2
+        assert srv.seen_ids[0] == srv.seen_ids[1] == meta["id"]
+    finally:
+        srv.close()
+
+
+@pytest.mark.timeout(60)
+def test_shed_retry_honors_retry_after_hint():
+    srv = _ScriptedServer(["shed"])
+    try:
+        with ServeClient(srv.address, timeout=5.0) as client:
+            t0 = time.monotonic()
+            result, _meta = client.request(_obs(1), retries=1)
+            elapsed = time.monotonic() - t0
+        assert result["actions"].shape == (1, OBS_DIM)
+        assert elapsed >= 0.04  # slept the server's 50 ms hint
+        assert srv.seen_ids[0] == srv.seen_ids[1]
+    finally:
+        srv.close()
+
+
+@pytest.mark.timeout(120)
+def test_idempotent_string_ids_dedupe_on_the_real_server(sac):
+    """Replaying an already-answered string id returns the cached frame
+    byte-for-byte and never re-executes; int ids (the legacy protocol)
+    are never deduped."""
+    policy, params = sac
+    server = _serving(policy, params)
+    try:
+        sock = wire.connect(server.address, timeout=10.0)
+        wire.send_json(sock, wire.HELLO, {"proto": 1})
+        wire.recv_json(sock, wire.WELCOME)
+        payload = pack_request({"id": "abc-1"}, _obs(1))
+        wire.send_frame(sock, wire.REQUEST, payload)
+        kind1, reply1 = wire.recv_frame(sock)
+        # the frame goes out BEFORE the completion counter bumps — settle
+        deadline = time.monotonic() + 5.0
+        while server.completed < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        executed = server.completed
+        assert executed == 1
+        wire.send_frame(sock, wire.REQUEST, payload)  # replay the SAME id
+        kind2, reply2 = wire.recv_frame(sock)
+        assert kind1 == kind2 == wire.RESPONSE
+        assert reply1 == reply2  # cached frame, bit-exact
+        assert server.completed == executed  # no second execution
+        # int ids: full re-execution, replies independent
+        legacy = pack_request({"id": 7}, _obs(1))
+        wire.send_frame(sock, wire.REQUEST, legacy)
+        wire.recv_frame(sock)
+        wire.send_frame(sock, wire.REQUEST, legacy)
+        wire.recv_frame(sock)
+        deadline = time.monotonic() + 5.0
+        while server.completed < executed + 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.completed == executed + 2
+        sock.close()
+    finally:
+        server.close()
+
+
+@pytest.mark.timeout(120)
+def test_health_probe_and_drain_shed(sac):
+    policy, params = sac
+    rec = _Recorder()
+    server = _serving(policy, params, telem=rec)
+    try:
+        assert HEALTH == 16  # pinned on the shared FLK1 registry
+        with ServeClient(server.address, timeout=10.0) as client:
+            health = client.health()
+            assert health["ready"] and not health["draining"]
+            assert health["completed"] == 0
+            server.drain()
+            assert server.draining
+            health = client.health()
+            assert health["draining"] and not health["ready"]
+            # new work is shed with the draining reason + a retry hint
+            with pytest.raises(RequestShed) as exc:
+                client.request(_obs(1))
+            assert exc.value.reason == "draining"
+            assert exc.value.retry_after_ms >= 0.0
+        assert "serve.draining" in rec.names()
+        assert "serve.drained" in rec.names()
+    finally:
+        server.close()
+
+
+@pytest.mark.timeout(120)
+def test_frame_error_kills_only_that_client(sac):
+    """Garbage magic from client A: A's connection dies with a
+    serve.conn_error receipt; client B is served as if nothing happened."""
+    policy, params = sac
+    rec = _Recorder()
+    server = _serving(policy, params, telem=rec)
+    try:
+        rogue = wire.connect(server.address, timeout=10.0)
+        wire.send_json(rogue, wire.HELLO, {"proto": 1})
+        wire.recv_json(rogue, wire.WELCOME)
+        with ServeClient(server.address, timeout=10.0) as client:
+            rogue.sendall(b"XXXX" + b"\x00" * 12)  # bad magic + half header
+            deadline = time.monotonic() + 5.0
+            while "serve.conn_error" not in rec.names():
+                assert time.monotonic() < deadline, rec.names()
+                time.sleep(0.01)
+            result, meta = client.request(_obs(1))
+            assert result["actions"].shape == (1, 1)
+        err = dict(rec.events)["serve.conn_error"]
+        assert "FrameError" in err["error"]
+        rogue.close()
+    finally:
+        server.close()
+
+
+@pytest.mark.timeout(120)
+def test_oversize_frame_kills_only_that_client(sac):
+    policy, params = sac
+    rec = _Recorder()
+    server = _serving(policy, params, telem=rec)
+    try:
+        rogue = wire.connect(server.address, timeout=10.0)
+        wire.send_json(rogue, wire.HELLO, {"proto": 1})
+        wire.recv_json(rogue, wire.WELCOME)
+        rogue.sendall(
+            wire._HEADER.pack(
+                wire.MAGIC, wire.REQUEST, 0, 0, wire.MAX_FRAME_BYTES + 1
+            )
+        )
+        with ServeClient(server.address, timeout=10.0) as client:
+            deadline = time.monotonic() + 5.0
+            while "serve.conn_error" not in rec.names():
+                assert time.monotonic() < deadline, rec.names()
+                time.sleep(0.01)
+            result, _meta = client.request(_obs(1))
+            assert result["actions"].shape == (1, 1)
+        rogue.close()
+    finally:
+        server.close()

@@ -121,6 +121,9 @@ def summarize(events: list[dict]) -> dict:
         "serve_reloads": [],        # serve.reload timeline (ok/version/seconds)
         "serve_ladder": [],         # serve.ladder rung-sizing decisions
         "serve_gauges": {},         # last Serve/* gauge values
+        # ISSUE 16 distributed fault tolerance (sheepchaos)
+        "serve_events": [],         # serve.* hardening events (conn_error,
+                                    # draining/drained, client_close_error)
     }
     for ev in events:
         ts = ev.get("ts")
@@ -172,6 +175,8 @@ def summarize(events: list[dict]) -> dict:
             summary["serve_reloads"].append(ev)
         elif kind == "serve.ladder":
             summary["serve_ladder"].append(ev)
+        elif isinstance(kind, str) and kind.startswith("serve."):
+            summary["serve_events"].append(ev)
         elif kind == "log":
             summary["log_events"] += 1
             if ev.get("step") is not None:
@@ -456,6 +461,94 @@ def _fmt_row(cols, widths):
     return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths)).rstrip()
 
 
+# Distributed fault-tolerance lifecycle (ISSUE 16): which events mark a
+# failure being NOTICED vs SURVIVED, per tier. The timeline pairs each
+# recovery with the nearest preceding detection on the same scope (actor id
+# for flock, the whole server for serve) to print recovery latencies.
+_DETECT_EVENTS = {
+    "flock.conn_error": "flock",
+    "flock.actor_stale": "flock",
+    "flock.actor_disconnected": "flock",
+    "serve.conn_error": "serve",
+    "serve.client_close_error": "serve",
+    "serve.draining": "serve",
+}
+_RECOVER_EVENTS = {
+    "flock.actor_rejoined": "flock",
+    "flock.actor_adopted": "flock",
+    "flock.actor_respawned": "flock",
+    "flock.resumed": "flock",
+    "serve.drained": "serve",
+}
+
+
+def recovery_timeline(summary: dict) -> list[str]:
+    """Per-tier fault/recovery timeline: every injection, detection and
+    recovery event in one chronological view, recoveries annotated with
+    the latency since the matching detection."""
+    entries: list[tuple[float, str, str, str]] = []  # ts, tier, verb, detail
+
+    def _detail(ev, skip=("event", "ts", "step")):
+        return " ".join(
+            f"{k}={v}" for k, v in ev.items() if k not in skip and v is not None
+        )
+
+    for ev in summary["fault_injected"]:
+        site = str(ev.get("site", "?"))
+        tier = (
+            "net" if site.startswith("net.")
+            else "peer" if site.startswith("peer.")
+            else "train"
+        )
+        param = "" if ev.get("param") is None else f":{ev['param']:g}"
+        entries.append(
+            (ev.get("ts") or 0.0, tier, "INJECT",
+             f"{site}@{ev.get('step')}{param}")
+        )
+
+    pool = summary["flock_events"] + summary["serve_events"]
+    detections: list[dict] = []
+    for ev in sorted(pool, key=lambda e: e.get("ts") or 0.0):
+        kind = ev["event"]
+        ts = ev.get("ts") or 0.0
+        if kind in _DETECT_EVENTS:
+            detections.append(ev)
+            verb = {
+                "flock.actor_stale": "EVICT",
+                "serve.draining": "DRAIN",
+            }.get(kind, "DETECT")
+            entries.append(
+                (ts, _DETECT_EVENTS[kind], verb,
+                 f"{kind.split('.', 1)[1]} {_detail(ev)}")
+            )
+        elif kind in _RECOVER_EVENTS:
+            # latency: nearest preceding detection on the same scope
+            scope = ev.get("actor_id")
+            prior = [
+                d for d in detections
+                if (d.get("ts") or 0.0) <= ts
+                and (scope is None or d.get("actor_id") in (None, scope))
+                and _DETECT_EVENTS[d["event"]] == _RECOVER_EVENTS[kind]
+            ]
+            lat = (
+                f" (+{ts - (prior[-1].get('ts') or 0.0):.2f}s after "
+                f"{prior[-1]['event'].split('.', 1)[1]})"
+                if prior else ""
+            )
+            entries.append(
+                (ts, _RECOVER_EVENTS[kind], "RECOVER",
+                 f"{kind.split('.', 1)[1]} {_detail(ev)}{lat}")
+            )
+
+    if not entries:
+        return []
+    t0 = summary["first_ts"] or 0.0
+    lines = ["distributed recovery timeline (per tier):"]
+    for ts, tier, verb, detail in sorted(entries, key=lambda e: e[0]):
+        lines.append(f"t+{ts - t0:7.2f}s  [{tier:<5}] {verb:<7} {detail}")
+    return lines
+
+
 def render(summary: dict) -> str:
     """The human-readable report."""
     lines: list[str] = []
@@ -727,6 +820,10 @@ def render(summary: dict) -> str:
                 f"final_version={st.get('version')}"
             )
 
+    # distributed detections/recoveries (ISSUE 16) open the section too:
+    # a partition that only shows up as flock.conn_error + actor_rejoined
+    # still belongs in the fault/recovery story
+    timeline = recovery_timeline(summary)
     resil_any = (
         summary["fault_injected"]
         or summary["fault_recovered"]
@@ -735,6 +832,7 @@ def render(summary: dict) -> str:
         or summary["checkpoint_corrupt"]
         or summary["checkpoint_errors"]
         or summary["fault_gauges"]
+        or timeline
     )
     if resil_any:
         lines.append("")
@@ -791,6 +889,9 @@ def render(summary: dict) -> str:
                 for k, v in sorted(summary["fault_gauges"].items())
             )
             lines.append(f"Fault gauges: {gauges}")
+        if timeline:
+            lines.append("")
+            lines.extend(timeline)
 
     lines.append("")
     lines.append("== health ==")
@@ -1114,6 +1215,47 @@ def selftest() -> int:
     assert "stopped: completed=1200 final_version=2" in out4, out4
     assert len(summary4["serve_ladder"]) == 2
     assert [r["ok"] for r in summary4["serve_reloads"]] == [True, False]
+
+    # distributed recovery timeline (ISSUE 16): a chaos-shaped run — a net
+    # partition detected as a flock conn_error + disconnect and survived by
+    # a rejoin, a learner resume, and a serve drain — must render one
+    # chronological per-tier timeline with recovery latencies
+    d5 = tempfile.mkdtemp(prefix="telemetry_selftest_chaos_")
+    telem5 = Telemetry(d5, rank=0, algo="chaos")
+    telem5.event("start", algo="chaos", env_id="dummy", seed=0)
+    telem5.event("fault.injected", site="net.partition", step=30, param=1.0)
+    telem5.event(
+        "flock.conn_error", actor_id=0, role="data",
+        error="FrameError: bad magic b'XXXX'",
+    )
+    telem5.event("flock.actor_disconnected", actor_id=0, rows=96, env_steps=96)
+    telem5.event("flock.actor_rejoined", actor_id=0, generation=1, weight_version=3)
+    telem5.event("flock.resumed", rows_total=96, weight_version=3, n_actors=2)
+    telem5.event("serve.conn_error", peer="c1", error="FrameError: oversize")
+    telem5.event("serve.draining", pending=2)
+    telem5.event("serve.drained", completed=60)
+    telem5.close()
+    summary5 = summarize(load_events(d5))
+    assert len(summary5["serve_events"]) == 3, summary5["serve_events"]
+    tl = recovery_timeline(summary5)
+    assert tl and tl[0] == "distributed recovery timeline (per tier):", tl
+    body = "\n".join(tl)
+    assert "[net  ] INJECT  net.partition@30:1" in body, body
+    assert "[flock] DETECT  conn_error" in body and "FrameError" in body, body
+    assert "[flock] DETECT  actor_disconnected" in body, body
+    assert "[flock] RECOVER actor_rejoined" in body, body
+    assert "[flock] RECOVER resumed" in body, body
+    assert "[serve] DETECT  conn_error" in body, body
+    assert "[serve] DRAIN   draining" in body, body
+    assert "[serve] RECOVER drained" in body, body
+    # recoveries carry the latency back to their matching detection
+    assert "s after actor_disconnected)" in body or "s after conn_error)" in body, body
+    assert "s after draining)" in body, body
+    out5 = render(summary5)
+    assert "== resilience (faults / recovery) ==" in out5, out5
+    assert "distributed recovery timeline (per tier):" in out5, out5
+    # the flock selftest's membership churn alone must ALSO open the section
+    assert "distributed recovery timeline (per tier):" in out3, out3
 
     print("\nselftest OK", file=sys.stderr)
     return 0

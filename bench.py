@@ -1385,7 +1385,7 @@ def bench_dreamer_v3(tiny: bool = False, pipeline_mode: str = "ab") -> None:
 
 def _ppo_run(
     decoupled: bool, num_devices: int = -1, pixel: bool = False,
-    telemetry: bool = False,
+    telemetry: bool = False, trace: bool = False,
 ) -> float:
     """One PPO throughput run through the real rollout+update loop; returns
     env-steps/sec. `pixel=True` swaps CartPole's 4-float obs for the 64x64x3
@@ -1394,7 +1394,10 @@ def _ppo_run(
     is what makes the decoupled comparison meaningful. `telemetry` toggles
     the real Telemetry subsystem around the loop (the off arm runs the same
     disabled-instance calls the mains' SHEEPRL_TPU_TELEMETRY=0 path runs),
-    so `--telemetry ab` measures the instrumentation's honest overhead."""
+    so `--telemetry ab` measures the instrumentation's honest overhead.
+    `trace=True` (implies telemetry) additionally emits the sheepscope
+    per-update span set (drain/train/publish — the learner-side cadence
+    the flock mains emit), so the ab round also prices the trace plane."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1420,7 +1423,7 @@ def _ppo_run(
 
     telem = Telemetry(
         tempfile.mkdtemp(prefix="bench_telemetry_"), rank=0, algo="ppo_bench",
-        enabled=telemetry,
+        enabled=telemetry or trace,
     )
 
     args = PPOArgs(
@@ -1528,7 +1531,13 @@ def _ppo_run(
     n_updates = 4 if pixel else 8
     t0 = time.perf_counter()
     for u in range(n_updates):
+        # the flock learner's per-update span cadence (sheepscope):
+        # drain point -> train span -> publish point, 3 JSONL lines/update
+        drain_id = telem.tracer.point("drain", update=u) if trace else None
+        span = telem.tracer.begin("train", parent=drain_id, update=u) if trace else None
         carry = one_update(*carry)
+        if trace:
+            telem.tracer.point("publish", parent=telem.tracer.end(span), version=u)
         telem.interval({}, step=(u + 1) * args.rollout_steps * args.num_envs)
     import jax as _jax
 
@@ -1540,20 +1549,29 @@ def _ppo_run(
 
 
 def bench_ppo(telemetry: str = "off") -> None:
-    """`telemetry`: "off"/"on" run one arm; "ab" runs both and records the
-    instrumentation overhead honestly (ISSUE 2 satellite) — `value` stays
-    the instrumented number (the always-on path the mains actually run)."""
+    """`telemetry`: "off"/"on"/"trace" run one arm; "ab" runs all three and
+    records the instrumentation overhead honestly (ISSUE 2 satellite, trace
+    arm ISSUE 17) — `value` stays the instrumented number (the always-on
+    path the mains actually run)."""
     extras: dict = {"telemetry": telemetry}
     if telemetry == "ab":
         off_sps = _ppo_run(decoupled=False, telemetry=False)
         sps = _ppo_run(decoupled=False, telemetry=True)
+        trace_sps = _ppo_run(decoupled=False, telemetry=True, trace=True)
         extras.update(
             telemetry_off_sps=round(off_sps, 1),
             telemetry_on_sps=round(sps, 1),
             telemetry_overhead_pct=round(100.0 * (off_sps / max(sps, 1e-9) - 1.0), 2),
+            # the trace plane priced against the telemetry-on arm it rides
+            trace_on_sps=round(trace_sps, 1),
+            trace_overhead_pct=round(100.0 * (sps / max(trace_sps, 1e-9) - 1.0), 2),
         )
     else:
-        sps = _ppo_run(decoupled=False, telemetry=telemetry == "on")
+        sps = _ppo_run(
+            decoupled=False,
+            telemetry=telemetry in ("on", "trace"),
+            trace=telemetry == "trace",
+        )
     print(
         json.dumps(
             {
@@ -3323,10 +3341,16 @@ def bench_chaos() -> None:
     env.pop("SHEEPRL_TPU_FAULTS", None)
     env.pop("XLA_FLAGS", None)  # single-device children
 
-    def read_events(run_name):
+    def read_events(run_name, learner_only=False):
+        # merge every role shard (telemetry.jsonl + telemetry.<role>.jsonl,
+        # sheepscope ISSUE 17): the serve rounds' events now live in the
+        # server's telemetry.serve.jsonl shard. `learner_only` keeps the
+        # bare telemetry.jsonl's append-only order (scenario A slices it).
+        import glob as _glob
+
+        pattern = "telemetry.jsonl" if learner_only else "telemetry*.jsonl"
         events = []
-        jsonl = os.path.join(root, run_name, "telemetry.jsonl")
-        if os.path.exists(jsonl):
+        for jsonl in sorted(_glob.glob(os.path.join(root, run_name, pattern))):
             with open(jsonl) as fh:
                 for line in fh:
                     try:
@@ -3357,7 +3381,7 @@ def bench_chaos() -> None:
 
     t0 = time.perf_counter()
     crash = run_ppo(["--faults", "net.partition@30:1,peer.crash@12"])
-    ev1 = read_events("chaosA")
+    ev1 = read_events("chaosA", learner_only=True)
     crashed_ok = crash.returncode == -int(_signal.SIGKILL)
     # the partition's recovery receipt: actor 0 reconnected and re-HELLOed
     rejoined_pre = "flock.actor_rejoined" in names(ev1)
@@ -3368,7 +3392,7 @@ def bench_chaos() -> None:
     )
 
     resume = run_ppo(["--resume", "auto"])
-    ev2 = read_events("chaosA")[len(ev1):]  # the resumed segment only
+    ev2 = read_events("chaosA", learner_only=True)[len(ev1):]  # resumed segment
     resumed = [e for e in ev2 if e.get("event") == "flock.resumed"]
     rows_kept = resumed[0].get("rows_total", 0) if resumed else 0
     resumed_version = resumed[0].get("weight_version", -1) if resumed else -1
@@ -3830,9 +3854,10 @@ def main() -> None:
     )
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument(
-        "--telemetry", choices=["on", "off", "ab"], default="off",
+        "--telemetry", choices=["on", "off", "trace", "ab"], default="off",
         help="PPO bench only: run the loop with the telemetry subsystem "
-        "on/off, or 'ab' to measure both and record the overhead",
+        "on/off (or with sheepscope spans: 'trace'), or 'ab' to measure "
+        "all arms and record the overheads",
     )
     parser.add_argument(
         "--pipeline", choices=["on", "off", "ab"], default="ab",

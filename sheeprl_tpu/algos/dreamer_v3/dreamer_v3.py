@@ -540,6 +540,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="dreamer_v3")
+    if rank == 0:
+        from ...telemetry.trace import install_profile_signal
+
+        # sheepscope: SIGUSR2 opens a bounded on-demand profile window
+        install_profile_signal(log_dir)
     guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
@@ -1243,7 +1248,15 @@ def main(argv: Sequence[str] | None = None) -> None:
             player = make_player(state)
             if use_flock:
                 telem.mark("flock/publish")
-                service.publish(jax.tree_util.tree_leaves(player))
+                # sheepscope publish span: dv3's buffer mode has no per-chunk
+                # drain chain, so the publish span is the learner-side anchor
+                # actor pushes parent onto via the WEIGHTS meta
+                pub = telem.tracer.begin("publish")
+                version = service.publish(
+                    jax.tree_util.tree_leaves(player),
+                    span=None if pub is None else pub.id,
+                )
+                telem.tracer.end(pub, version=version)
             step_before_training = args.train_every // single_global_step
             if args.expl_decay:
                 expl_decay_steps += 1

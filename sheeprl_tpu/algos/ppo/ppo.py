@@ -257,6 +257,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     logger.log_hyperparams(args.as_dict())
     profiler = StepProfiler.from_args(args, log_dir, rank)
     telem = Telemetry.from_args(args, log_dir, rank, algo="ppo")
+    if rank == 0:
+        from ...telemetry.trace import install_profile_signal
+
+        # sheepscope: SIGUSR2 opens a bounded on-demand profile window
+        install_profile_signal(log_dir)
     guard = resilience.RunGuard.install(telem)
     sanitizer = Sanitizer.from_args(args, telem)
     telem.add_gauges(sanitizer.gauges)
@@ -596,6 +601,8 @@ def main(argv: Sequence[str] | None = None) -> None:
         # ---- rollout hot loop ------------------------------------------------
         telem.mark("rollout")
         chunk = None
+        drain_id = None
+        chunk_version = None
         if use_flock:
             # drain ONE rollout chunk from the replay service (round-robin
             # over actor shards, local memory — no socket on this path);
@@ -613,6 +620,20 @@ def main(argv: Sequence[str] | None = None) -> None:
                             "flock: every actor is dead and the respawn "
                             "budget is spent"
                         )
+            # sheepscope drain span: covers this update's wait on the queue,
+            # parented on the chunk's ingest span — the per-update drain-wait
+            # attribution by actor that sheeptrace's straggler report reads
+            prov = service.last_drain or {}
+            chunk_version = prov.get("weight_version")
+            drain_id = telem.tracer.point(
+                "drain",
+                parent=prov.get("span"),
+                t0=time.time() - float(prov.get("wait_s") or 0.0),
+                update=update,
+                actor=prov.get("actor"),
+                weight_version=chunk_version,
+                queued_ms=round(float(prov.get("queued_s") or 0.0) * 1e3, 3),
+            )
             global_step += args.rollout_steps * args.num_envs
         if use_jax_env:
             # the whole rollout is one device-resident scan; the only host
@@ -731,6 +752,11 @@ def main(argv: Sequence[str] | None = None) -> None:
             flat = shard_batch(flat, mesh)
         key, train_key = jax.random.split(key)
         telem.mark("train/dispatch")
+        train_span = (
+            telem.tracer.begin("train", parent=drain_id, update=update)
+            if use_flock
+            else None
+        )
         state, metrics = sanitizer.checked(
             "train", train_step,
             state, flat, train_key,
@@ -752,10 +778,25 @@ def main(argv: Sequence[str] | None = None) -> None:
                     )
                     key, _ = jax.random.split(key)
         if use_flock:
+            # per-row staleness attribution: how many versions behind the
+            # current weights the trained chunk was collected with
+            train_id = telem.tracer.end(
+                train_span,
+                staleness_versions=(
+                    None
+                    if chunk_version is None
+                    else max(0, service.weight_version - int(chunk_version))
+                ),
+            )
             # one device->host pull + one byte-pack per update; actors pull
             # the cached frame off their own hot path
             telem.mark("flock/publish")
-            service.publish(jax.tree_util.tree_leaves(state.agent))
+            pub = telem.tracer.begin("publish", parent=train_id)
+            version = service.publish(
+                jax.tree_util.tree_leaves(state.agent),
+                span=None if pub is None else pub.id,
+            )
+            telem.tracer.end(pub, version=version)
         for name, val in metrics.items():
             aggregator.update(name, val)
         profiler.tick()

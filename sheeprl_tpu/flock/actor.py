@@ -31,9 +31,14 @@ re-HELLOs (the service bumps the generation), and re-pushes the in-flight
 chunk, so no collected row is lost to a transient. Only an exhausted
 budget exits the process (rc 0: the learner is really gone).
 
-Actors are observability-quiet by design: no Telemetry instance (the
-learner's rank-0 JSONL is the single event stream; actor stats arrive
-there through PUSH/HEARTBEAT metadata → `Flock/actor*` gauges).
+Observability (ISSUE 17, sheepscope): each actor runs a real Telemetry
+instance writing its own `telemetry.actor{N}.jsonl` shard into the shared
+run directory, keyed by the run id the launcher exports. Collect/push
+spans carry trace context on the PUSH meta (ingested by the service into
+the learner's shard), HEARTBEATs piggyback monotonic + wall send stamps
+(sender-clock eviction ages and NTP-style clock-offset estimation), and
+SIGUSR2 opens a bounded on-demand `jax.profiler` window.
+`tools/sheeptrace.py` merges the shards into one timeline.
 """
 
 from __future__ import annotations
@@ -85,6 +90,9 @@ class WeightFetcher(threading.Thread):
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.version = -1
+        # sheepscope: publish span id riding the newest WEIGHTS meta — the
+        # collect span for data gathered under that version parents on it
+        self.last_span: str | None = None
         self._leaves: list[np.ndarray] | None = None
 
     def take(self):
@@ -131,6 +139,7 @@ class WeightFetcher(threading.Thread):
                     leaves = unpack_leaves(payload[4 + meta_len :])
                     with self._lock:
                         self.version = int(meta["version"])
+                        self.last_span = meta.get("span")
                         self._leaves = leaves
             except (OSError, wire.FrameError):
                 if sock is not None:
@@ -149,10 +158,15 @@ class WeightFetcher(threading.Thread):
 
 class _ServiceLink:
     """The actor's data connection: HELLO/WELCOME handshake, then strictly
-    sequential PUSH and HEARTBEAT request/replies from the step loop."""
+    sequential PUSH and HEARTBEAT request/replies from the step loop.
+    HEARTBEATs carry monotonic + wall send stamps; the service's
+    `server_wall_ts` reply feeds the optional `ClockSync` (sheepscope)."""
 
-    def __init__(self, addr: str, actor_id: int, timeout: float | None):
+    def __init__(
+        self, addr: str, actor_id: int, timeout: float | None, clock=None
+    ):
         self.sock = wire.connect(addr, timeout=timeout)
+        self._clock = clock
         wire.send_json(
             self.sock,
             wire.HELLO,
@@ -169,12 +183,24 @@ class _ServiceLink:
         self._hb_steps0 = 0
         self._hb_t0 = time.monotonic()
 
-    def push(self, ops, *, rows: int, env_steps: int, weight_version: int):
+    def push(
+        self,
+        ops,
+        *,
+        rows: int,
+        env_steps: int,
+        weight_version: int,
+        trace: dict | None = None,
+    ):
         wire.send_frame(
             self.sock,
             wire.PUSH,
             pack_push(
-                ops, rows=rows, env_steps=env_steps, weight_version=weight_version
+                ops,
+                rows=rows,
+                env_steps=env_steps,
+                weight_version=weight_version,
+                trace=trace,
             ),
         )
         reply = wire.recv_json(self.sock, wire.PUSH_OK)
@@ -189,6 +215,7 @@ class _ServiceLink:
         sps = (env_steps - self._hb_steps0) / dt
         self._hb_t0, self._hb_steps0 = now, env_steps
         self._last_hb = now
+        t0 = time.time()
         wire.send_json(
             self.sock,
             wire.HEARTBEAT,
@@ -197,10 +224,17 @@ class _ServiceLink:
                 "env_steps": env_steps,
                 "weight_version": weight_version,
                 "sps": sps,
+                # sender-clock stamps: mono feeds cross-host-safe eviction
+                # ages on the service, wall feeds the clock-offset estimate
+                "mono_ts": time.monotonic(),
+                "wall_ts": t0,
             },
         )
         reply = wire.recv_json(self.sock, wire.HEARTBEAT_OK)
         self.random_phase = bool(reply.get("random_phase"))
+        server_wall = reply.get("server_wall_ts")
+        if server_wall is not None and self._clock is not None:
+            self._clock.add(t0, float(server_wall), time.time())
 
     def close(self) -> None:
         try:
@@ -220,7 +254,7 @@ def _reconnect_budget() -> float:
 
 
 def _connect_with_backoff(
-    addr: str, actor_id: int, timeout: float | None
+    addr: str, actor_id: int, timeout: float | None, clock=None
 ) -> _ServiceLink:
     """Dial the service until it answers: capped exponential backoff
     (0.25 s doubling to 5 s) bounded by the total reconnect budget. An
@@ -232,7 +266,7 @@ def _connect_with_backoff(
     last: Exception | None = None
     while True:
         try:
-            return _ServiceLink(addr, actor_id, timeout)
+            return _ServiceLink(addr, actor_id, timeout, clock=clock)
         except (OSError, TimeoutError) as err:
             last = err
             left = deadline - time.monotonic()
@@ -254,11 +288,14 @@ class ResilientLink:
 
     _RETRIES = 3  # fresh backoff-bounded connection per attempt
 
-    def __init__(self, addr: str, actor_id: int, timeout: float | None):
+    def __init__(
+        self, addr: str, actor_id: int, timeout: float | None, clock=None
+    ):
         self._addr = addr
         self._actor_id = actor_id
         self._timeout = timeout
-        self._link = _connect_with_backoff(addr, actor_id, timeout)
+        self._clock = clock
+        self._link = _connect_with_backoff(addr, actor_id, timeout, clock=clock)
 
     @property
     def welcome(self) -> dict:
@@ -274,10 +311,18 @@ class ResilientLink:
         except OSError:
             pass
         self._link = _connect_with_backoff(
-            self._addr, self._actor_id, self._timeout
+            self._addr, self._actor_id, self._timeout, clock=self._clock
         )
 
-    def push(self, ops, *, rows: int, env_steps: int, weight_version: int):
+    def push(
+        self,
+        ops,
+        *,
+        rows: int,
+        env_steps: int,
+        weight_version: int,
+        trace: dict | None = None,
+    ):
         for attempt in range(self._RETRIES):
             try:
                 return self._link.push(
@@ -285,6 +330,7 @@ class ResilientLink:
                     rows=rows,
                     env_steps=env_steps,
                     weight_version=weight_version,
+                    trace=trace,
                 )
             except (OSError, TimeoutError):
                 if attempt == self._RETRIES - 1:
@@ -300,6 +346,30 @@ class ResilientLink:
 
     def close(self) -> None:
         self._link.close()
+
+
+def _observe(telem):
+    """-> (tracer, clock) for a runner. A missing Telemetry (direct
+    library calls, old tests) degrades to a disabled shard: every span
+    call no-ops, nothing is written."""
+    from ..telemetry.core import Telemetry
+    from ..telemetry.trace import ClockSync
+
+    if telem is None:
+        telem = Telemetry(None, enabled=False)
+    return telem.tracer, ClockSync(telem)
+
+
+def _push_trace(push_span, actor_id: int) -> dict | None:
+    """PUSH frame trace context. `mono_ts` rides along so in-flight pushes
+    advance the service's sender-clock liveness just like heartbeats."""
+    if push_span is None:
+        return None
+    return {
+        "span": push_span.id,
+        "actor": actor_id,
+        "mono_ts": time.monotonic(),
+    }
 
 
 def _transfer_timeout() -> float | None:
@@ -356,7 +426,7 @@ def _make_envs(args, actor_id: int, log_dir: str, *, mask_vel: bool = False):
 # ---------------------------------------------------------------------------
 
 
-def run_ppo(args, actor_id: int, addr: str, log_dir: str) -> None:
+def run_ppo(args, actor_id: int, addr: str, log_dir: str, telem=None) -> None:
     from ..algos.ppo.agent import (
         PPOAgent,
         buffer_actions,
@@ -390,10 +460,11 @@ def run_ppo(args, actor_id: int, addr: str, log_dir: str) -> None:
     )
     treedef = jax.tree_util.tree_structure(agent)
 
+    tracer, clock = _observe(telem)
     timeout = _transfer_timeout()
     fetcher = WeightFetcher(addr, actor_id, timeout)
     fetcher.start()
-    link = ResilientLink(addr, actor_id, timeout)
+    link = ResilientLink(addr, actor_id, timeout, clock=clock)
     version, leaves = _wait_initial_weights(fetcher)
     agent = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(x) for x in leaves])
 
@@ -404,6 +475,11 @@ def run_ppo(args, actor_id: int, addr: str, log_dir: str) -> None:
     step_counter = 0
     try:
         while True:
+            # collect span: one whole rollout chunk, parented on the publish
+            # span of the weights it acts with (the provenance chain's root)
+            collect = tracer.begin(
+                "collect", parent=fetcher.last_span, actor=actor_id
+            )
             chunk: dict[str, list] = {k: [] for k in obs_keys}
             for extra in ("actions", "logprobs", "values", "rewards", "dones"):
                 chunk[extra] = []
@@ -462,12 +538,18 @@ def run_ppo(args, actor_id: int, addr: str, log_dir: str) -> None:
             for extra in ("actions", "logprobs", "values", "rewards"):
                 chunk[extra].append(np.zeros_like(chunk[extra][0]))
             tree = {k: np.stack(v) for k, v in chunk.items()}
+            collect_id = tracer.end(
+                collect, rows=T, env_steps=env_steps, weight_version=version
+            )
+            push = tracer.begin("push", parent=collect_id, actor=actor_id)
             link.push(
                 [(tree, None)],
                 rows=T,
                 env_steps=env_steps,
                 weight_version=version,
+                trace=_push_trace(push, actor_id),
             )
+            tracer.end(push, rows=T, weight_version=version)
     finally:
         fetcher.stop()
         link.close()
@@ -479,7 +561,9 @@ def run_ppo(args, actor_id: int, addr: str, log_dir: str) -> None:
 # ---------------------------------------------------------------------------
 
 
-def run_dreamer_v3(args, actor_id: int, addr: str, log_dir: str) -> None:
+def run_dreamer_v3(
+    args, actor_id: int, addr: str, log_dir: str, telem=None
+) -> None:
     from ..algos.dreamer_v3.agent import PlayerDV3, build_models
     from ..algos.dreamer_v3.dreamer_v3 import _random_actions
     from ..algos.dreamer_v3.utils import make_device_preprocess
@@ -528,10 +612,11 @@ def run_dreamer_v3(args, actor_id: int, addr: str, log_dir: str) -> None:
 
     player_step = jax.jit(_player_step)
 
+    tracer, clock = _observe(telem)
     timeout = _transfer_timeout()
     fetcher = WeightFetcher(addr, actor_id, timeout)
     fetcher.start()
-    link = ResilientLink(addr, actor_id, timeout)
+    link = ResilientLink(addr, actor_id, timeout, clock=clock)
     version, leaves = _wait_initial_weights(fetcher)
     player = jax.tree_util.tree_unflatten(
         treedef, [jnp.asarray(x) for x in leaves]
@@ -629,12 +714,19 @@ def run_dreamer_v3(args, actor_id: int, addr: str, log_dir: str) -> None:
             env_steps += args.num_envs
 
             if rows_pending >= PUSH_EVERY_ROWS:
+                # dv3 buffers rows across steps, so the push span alone is
+                # the provenance unit (no per-chunk collect window exists)
+                push = tracer.begin(
+                    "push", parent=fetcher.last_span, actor=actor_id
+                )
                 link.push(
                     ops,
                     rows=rows_pending,
                     env_steps=env_steps,
                     weight_version=version,
+                    trace=_push_trace(push, actor_id),
                 )
+                tracer.end(push, rows=rows_pending, weight_version=version)
                 ops, rows_pending = [], 0
             link.maybe_heartbeat(env_steps, version)
     finally:
@@ -671,12 +763,24 @@ def main() -> int:
     else:
         print(f"flock actor: unsupported algo {algo!r}", file=sys.stderr)
         return 2
+    from ..telemetry.core import Telemetry
+    from ..telemetry.trace import install_profile_signal
+
+    # the sheepscope per-role shard: telemetry.actor{N}.jsonl in the SHARED
+    # run directory (SHEEPRL_TPU_FLOCK_LOG_DIR), run id inherited from the
+    # launcher's environment
+    telem = Telemetry.from_args(
+        args, log_dir, 0, algo=algo, role=f"actor{actor_id}"
+    )
+    install_profile_signal(log_dir)
     try:
-        runner(args, actor_id, addr, log_dir)
+        runner(args, actor_id, addr, log_dir, telem=telem)
     except (ConnectionError, wire.FrameError, TimeoutError):
         # the learner finished (service closed) or went away: a clean exit,
         # not a failure — the launcher treats rc 0 as "no respawn needed"
         return 0
+    finally:
+        telem.close()
     return 0
 
 

@@ -193,6 +193,8 @@ class ActorFleet:
     # -- internals ------------------------------------------------------------
 
     def _spawn(self, actor_id: int, *, first: bool) -> None:
+        from ..telemetry.trace import RUN_ENV, ensure_run_id
+
         env = dict(os.environ)
         env.update(
             SHEEPRL_TPU_FLOCK_ADDR=self.address,
@@ -201,10 +203,11 @@ class ActorFleet:
             SHEEPRL_TPU_FLOCK_ARGS=self._args_json,
             SHEEPRL_TPU_FLOCK_LOG_DIR=self.log_dir,
             JAX_PLATFORMS="cpu",
-            # actors are telemetry-quiet: the learner's JSONL is the single
-            # event stream of the run
-            SHEEPRL_TPU_TELEMETRY="0",
         )
+        # sheepscope (ISSUE 17): each actor writes its own
+        # telemetry.actor{N}.jsonl shard into the shared run dir, keyed by
+        # the learner's run id so sheeptrace merges them onto one timeline
+        env[RUN_ENV] = ensure_run_id()
         # one actor process needs no forced multi-device cpu topology
         env.pop("XLA_FLAGS", None)
         # the sigkill clause rides ONLY on actor 0's FIRST incarnation: a

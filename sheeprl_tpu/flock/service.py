@@ -70,10 +70,19 @@ HEARTBEAT_TIMEOUT_VAR = "SHEEPRL_TPU_FLOCK_HEARTBEAT_TIMEOUT_S"
 DEFAULT_HEARTBEAT_TIMEOUT_S = 30.0
 
 
-def pack_push(ops, *, rows: int, env_steps: int, weight_version: int) -> bytes:
+def pack_push(
+    ops,
+    *,
+    rows: int,
+    env_steps: int,
+    weight_version: int,
+    trace: dict | None = None,
+) -> bytes:
     """PUSH payload: u32 n_ops, then per op u32 meta_len | meta_json |
     u64 blob_len | pack_tree blob. Frame-level stats ride in op 0's meta.
-    `ops` is a list of (host_tree, indices|None)."""
+    `ops` is a list of (host_tree, indices|None). `trace` is the optional
+    sheepscope context {span, actor, mono_ts} — absent entirely when
+    tracing is off, so old receivers never see the key."""
     from ..data.wire import pack_tree
 
     parts = [_U32.pack(len(ops))]
@@ -87,6 +96,8 @@ def pack_push(ops, *, rows: int, env_steps: int, weight_version: int) -> bytes:
                 env_steps=int(env_steps),
                 weight_version=int(weight_version),
             )
+            if trace:
+                meta["trace"] = trace
         blob = pack_tree(tree)
         mb = json.dumps(meta).encode()
         parts += [_U32.pack(len(mb)), mb, _U64.pack(len(blob)), blob]
@@ -94,7 +105,8 @@ def pack_push(ops, *, rows: int, env_steps: int, weight_version: int) -> bytes:
 
 
 def unpack_push(payload: bytes):
-    """-> (ops, frame_meta) where ops = [(tree, indices|None), ...]."""
+    """-> (ops, frame_meta) where ops = [(tree, indices|None), ...].
+    frame_meta carries a "trace" key only when the sender included one."""
     from ..data.wire import unpack_tree
 
     (n_ops,) = _U32.unpack_from(payload, 0)
@@ -114,6 +126,8 @@ def unpack_push(payload: bytes):
             frame_meta = {
                 k: meta.get(k) for k in ("rows", "env_steps", "weight_version")
             }
+            if meta.get("trace"):
+                frame_meta["trace"] = meta["trace"]
         ops.append((tree, meta.get("indices")))
     return ops, frame_meta
 
@@ -130,6 +144,12 @@ class _ActorState:
         "weight_version",
         "sps",
         "rows",
+        # sender-monotonic liveness (ISSUE 17 satellite): baselines pairing
+        # the actor's OWN monotonic clock with ours, so staleness ages stop
+        # comparing clocks across hosts
+        "sender_mono0",
+        "recv_mono0",
+        "last_sender_mono",
     )
 
     def __init__(self, actor_id: int):
@@ -143,6 +163,36 @@ class _ActorState:
         self.weight_version = -1
         self.sps = 0.0
         self.rows = 0
+        self.sender_mono0 = None
+        self.recv_mono0 = None
+        self.last_sender_mono = None
+
+    def note_sender_mono(self, mono_ts) -> None:
+        """Record a frame's sender-side monotonic stamp. First stamp per
+        connection generation (or a regression — the actor restarted and
+        its monotonic clock reset) re-baselines the pair."""
+        if mono_ts is None:
+            return
+        mono = float(mono_ts)
+        if self.sender_mono0 is None or (
+            self.last_sender_mono is not None and mono < self.last_sender_mono
+        ):
+            self.sender_mono0 = mono
+            self.recv_mono0 = time.monotonic()
+        self.last_sender_mono = mono
+
+    def heartbeat_age(self, now: float) -> float:
+        """Seconds since this actor last SENT anything, measured on the
+        sender's monotonic clock when it provides stamps (cross-host safe:
+        elapsed receiver time minus elapsed sender time = time the sender
+        has been silent). Old peers without stamps fall back to the
+        receiver-clock age."""
+        if self.last_sender_mono is not None and self.sender_mono0 is not None:
+            age = (now - self.recv_mono0) - (
+                self.last_sender_mono - self.sender_mono0
+            )
+            return max(age, 0.0)
+        return now - self.last_heartbeat
 
 
 class ReplayService:
@@ -208,6 +258,11 @@ class ReplayService:
         self.heartbeat_timeout_s = float(
             os.environ.get(HEARTBEAT_TIMEOUT_VAR, DEFAULT_HEARTBEAT_TIMEOUT_S)
         )
+        # sheepscope: provenance of the chunk the last `next_chunk()` call
+        # returned ({actor, span, weight_version, wait_s, queued_s} or None)
+        # — the learner's drain span parents on it without the return type
+        # of next_chunk changing
+        self.last_drain: dict[str, Any] | None = None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -315,7 +370,24 @@ class ReplayService:
         role = "data"
         try:
             frame = wire.recv_frame(conn)
-            if frame is None or frame[0] != wire.HELLO:
+            if frame is None:
+                return
+            if frame[0] == wire.PROFILE:
+                # sheepscope on-demand profiling: a bare PROFILE connection
+                # (no HELLO) opens a bounded jax.profiler window in THIS
+                # process and replies with the artifact path
+                from ..telemetry.trace import handle_profile_frame
+
+                log_dir = getattr(self._telem, "log_dir", None)
+                wire.send_json(
+                    conn,
+                    wire.PROFILE,
+                    handle_profile_frame(
+                        json.loads(frame[1].decode() or "{}"), log_dir
+                    ),
+                )
+                return
+            if frame[0] != wire.HELLO:
                 return
             hello = json.loads(frame[1].decode())
             actor_id = int(hello["actor_id"])
@@ -409,6 +481,11 @@ class ReplayService:
             st.ever_connected = True
             st.pid = int(hello.get("pid", -1))
             st.last_heartbeat = time.monotonic()
+            # a (re)joining actor is a fresh process as far as its monotonic
+            # clock is concerned: drop the old baselines
+            st.sender_mono0 = None
+            st.recv_mono0 = None
+            st.last_sender_mono = None
             self._membership.notify_all()
         if rejoin:
             self._event(
@@ -438,7 +515,9 @@ class ReplayService:
         recorded but never acted on — a wedged actor (e.g. partitioned
         mid-push) held its connection slot forever. Past the timeout the
         connection is freed (the shard is KEPT for rejoin) and ActorFleet's
-        `on_evict` hook applies the normal respawn budget."""
+        `on_evict` hook applies the normal respawn budget. The age is the
+        sender-monotonic one (`_ActorState.heartbeat_age`) whenever the
+        actor stamps its frames — wall clocks never enter the decision."""
         poll = max(0.1, min(self.heartbeat_timeout_s / 4.0, 1.0))
         while not self._stop.wait(poll):
             now = time.monotonic()
@@ -447,7 +526,7 @@ class ReplayService:
                 for aid, st in self._actors.items():
                     if not st.connected or not st.last_heartbeat:
                         continue
-                    age = now - st.last_heartbeat
+                    age = st.heartbeat_age(now)
                     if age > self.heartbeat_timeout_s:
                         stale.append((aid, age))
             for aid, age in stale:
@@ -478,12 +557,30 @@ class ReplayService:
     def _handle_push(self, conn, actor_id: int, payload: bytes) -> None:
         ops, meta = unpack_push(payload)
         rows = int(meta.get("rows") or 0)
+        trace = meta.get("trace") or {}
+        # ingest span: the learner-side receipt of this PUSH, parented on
+        # the actor's push span so sheeptrace can stitch across processes
+        ingest_span = None
+        if self._telem is not None and trace.get("span"):
+            ingest_span = self._telem.tracer.point(
+                "ingest",
+                parent=trace.get("span"),
+                actor=actor_id,
+                rows=rows,
+                weight_version=meta.get("weight_version"),
+            )
         if self.mode == "buffer":
             shard = self._shards[actor_id]
             with self._shard_locks[actor_id]:
                 for tree, indices in ops:
                     shard.add(tree, indices=indices)
         else:
+            prov = {
+                "actor": actor_id,
+                "span": ingest_span,
+                "weight_version": meta.get("weight_version"),
+                "t_queued": time.monotonic(),
+            }
             with self._lock:
                 q = self._chunks[actor_id]
                 cap = self._chunk_cap.get(actor_id)
@@ -494,7 +591,7 @@ class ReplayService:
                     q.popleft()
                     self._chunks_dropped += 1
                 for tree, _ in ops:
-                    q.append(tree)
+                    q.append((tree, prov))
                 self._chunk_ready.notify_all()
         with self._lock:
             st = self._actors[actor_id]
@@ -504,6 +601,7 @@ class ReplayService:
                 meta.get("weight_version", st.weight_version)
             )
             st.last_heartbeat = time.monotonic()
+            st.note_sender_mono(trace.get("mono_ts"))
             self._rows_total += rows
             reply = {
                 "rows_total": self._rows_total,
@@ -520,18 +618,24 @@ class ReplayService:
             st.env_steps = int(hb.get("env_steps", st.env_steps))
             st.weight_version = int(hb.get("weight_version", st.weight_version))
             st.sps = float(hb.get("sps", st.sps))
+            st.note_sender_mono(hb.get("mono_ts"))
             reply = {
                 "random_phase": self._random_phase,
                 "weight_version": self._weight_version,
+                # clock-offset piggyback (sheepscope): our wall clock at
+                # reply time — the actor's ClockSync does the NTP math
+                "server_wall_ts": time.time(),
             }
         wire.send_json(conn, wire.HEARTBEAT_OK, reply)
 
     # -- learner side ---------------------------------------------------------
 
-    def publish(self, leaves) -> int:
+    def publish(self, leaves, span: str | None = None) -> int:
         """Snapshot a new weight version from flattened model leaves. The
         device->host pull and the byte packing happen ONCE here; every
-        actor pull then reuses the cached frame."""
+        actor pull then reuses the cached frame. `span` is the learner's
+        publish span id — it rides the WEIGHTS meta so the actor's next
+        collect span can parent on the version it acts with."""
         from ..data.wire import pack_leaves
 
         host_leaves = [np.asarray(leaf) for leaf in leaves]
@@ -539,7 +643,10 @@ class ReplayService:
         with self._lock:
             self._weight_version += 1
             version = self._weight_version
-            meta = json.dumps({"version": version}).encode()
+            wmeta: dict[str, Any] = {"version": version}
+            if span:
+                wmeta["span"] = span
+            meta = json.dumps(wmeta).encode()
             self._weight_payload = _U32.pack(len(meta)) + meta + blob
             self._publish_ts[version] = time.monotonic()
             # keep the timestamp map bounded
@@ -572,8 +679,12 @@ class ReplayService:
 
     def next_chunk(self, timeout: float | None = None):
         """Chunks mode: pop the next rollout chunk, round-robin across
-        actors so one fast actor cannot starve the rest. None on timeout."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        actors so one fast actor cannot starve the rest. None on timeout.
+        Sets `self.last_drain` to the popped chunk's sheepscope provenance
+        (actor, ingest span, weight version, this call's wait and the
+        chunk's queue dwell) — the learner reads it right after the call."""
+        t_enter = time.monotonic()
+        deadline = None if timeout is None else t_enter + timeout
         with self._chunk_ready:
             while True:
                 ids = sorted(self._chunks)
@@ -581,11 +692,24 @@ class ReplayService:
                     aid = ids[(self._drain_order + k) % len(ids)]
                     if self._chunks[aid]:
                         self._drain_order = (ids.index(aid) + 1) % len(ids)
-                        return self._chunks[aid].popleft()
+                        tree, prov = self._chunks[aid].popleft()
+                        now = time.monotonic()
+                        self.last_drain = {
+                            "actor": prov.get("actor", aid),
+                            "span": prov.get("span"),
+                            "weight_version": prov.get("weight_version"),
+                            "wait_s": round(now - t_enter, 6),
+                            "queued_s": round(
+                                now - prov.get("t_queued", now), 6
+                            ),
+                        }
+                        return tree
                 if self._stop.is_set():
+                    self.last_drain = None
                     return None
                 left = None if deadline is None else deadline - time.monotonic()
                 if left is not None and left <= 0:
+                    self.last_drain = None
                     return None
                 self._chunk_ready.wait(timeout=0.5 if left is None else min(left, 0.5))
 
@@ -679,7 +803,10 @@ class ReplayService:
                 else:
                     chunks = list(self._chunks[aid])
                     parts = [_U32.pack(len(chunks))]
-                    for tree in chunks:
+                    # provenance is NOT persisted: its span ids refer to the
+                    # crashed run's shards, and its t_queued to a dead
+                    # monotonic clock — restored chunks restart clean
+                    for tree, _prov in chunks:
                         blob = pack_tree(tree)
                         parts += [_U64.pack(len(blob)), blob]
                     blobs.append(b"".join(parts))
@@ -766,7 +893,7 @@ class ReplayService:
                     for _ in range(n_chunks):
                         (blen,) = _U64.unpack_from(blob, pos)
                         pos += 8
-                        q.append(unpack_tree(blob[pos : pos + blen]))
+                        q.append((unpack_tree(blob[pos : pos + blen]), {}))
                         pos += blen
                     self._chunks[aid] = q
             self._restored = True
@@ -811,7 +938,7 @@ class ReplayService:
                 out[f"{prefix}/staleness_s"] = float(staleness)
                 out[f"{prefix}/shard_fill"] = float(fill)
                 out[f"{prefix}/heartbeat_age_s"] = (
-                    float(now - st.last_heartbeat) if st.last_heartbeat else -1.0
+                    float(st.heartbeat_age(now)) if st.last_heartbeat else -1.0
                 )
                 out[f"{prefix}/connected"] = float(st.connected)
                 out[f"{prefix}/generation"] = float(st.generation)

@@ -16,22 +16,40 @@ Kinds (actor -> service unless noted):
                 | u64 blob_len | pack_tree blob.
                 op meta: {indices: [..]|null}; frame-level trailing JSON
                 rides in the first op's meta: {rows, env_steps,
-                weight_version}
+                weight_version, trace?} — `trace` is the optional
+                sheepscope context {span, actor, mono_ts} (ISSUE 17)
     PUSH_OK     (service) JSON {rows_total, random_phase, weight_version}
-    HEARTBEAT   JSON {actor_id, env_steps, weight_version, sps}
-    HEARTBEAT_OK(service) JSON {random_phase, weight_version}
+    HEARTBEAT   JSON {actor_id, env_steps, weight_version, sps,
+                      mono_ts?, wall_ts?} — monotonic + wall send stamps
+                      (mono feeds cross-host-safe eviction ages, wall
+                      feeds the NTP-style clock-offset estimate)
+    HEARTBEAT_OK(service) JSON {random_phase, weight_version,
+                                server_wall_ts?}
     GET_WEIGHTS JSON {have_version}
-    WEIGHTS     (service) u32 meta_len | {version} | pack_leaves blob
+    WEIGHTS     (service) u32 meta_len | {version, span?} | pack_leaves
+                blob — span = the publish span id, parenting the actor's
+                next collect span
     WEIGHTS_UNCHANGED (service) JSON {version}
     BYE         JSON {actor_id}
     ERROR       (either) JSON {error}
+    PROFILE     (either direction) JSON {seconds?, dir?}; reply PROFILE
+                JSON {ok, dir?, seconds?, error?, pid} — bounded
+                on-demand jax.profiler window (sheepscope)
+
+All sheepscope additions are OPTIONAL JSON keys or appended kinds: a peer
+that predates them ignores unknown keys and never sends kind 17, so old
+and new processes interoperate frame-for-frame.
 
 Serving kinds (client -> server unless noted; sheeprl_tpu/serve/):
 
     REQUEST     u32 meta_len | meta_json | pack_tree obs blob.
-                meta: {id, deadline_ms, session, reset}
+                meta: {id, deadline_ms, session, reset, span?} — span =
+                the client-side sheepscope span id, parenting the
+                server's request span
     RESPONSE    (server) u32 meta_len | meta_json | pack_tree action blob.
-                meta: {id, version, rung, rows, queue_ms}
+                meta: {id, version, rung, rows, queue_ms, span?} — span =
+                the server's request span id, echoed for client-side
+                correlation
     SHED        (server) JSON {id, retry_after_ms, reason} — deadline-aware
                 load shedding, NOT an error: retry after the hint
     RELOAD      JSON {path}; server replies RELOAD JSON
@@ -40,7 +58,7 @@ Serving kinds (client -> server unless noted; sheeprl_tpu/serve/):
 Frame kinds form an EXTENSIBLE registry: subsystems claim values through
 `register_kind` (u8, append-only — committed values are pinned by
 tests/test_flock/test_wire.py and must never be renumbered; 1-11 belong
-to flock, 12-15 to serve, 16+ are free).
+to flock, 12-16 to serve, 17 to sheepscope profiling, 18+ are free).
 
 Transport addresses serialize as `tcp:HOST:PORT` or `unix:PATH` — one
 string, environment-variable friendly for actor subprocesses.
@@ -61,6 +79,7 @@ import threading
 import time
 
 __all__ = [
+    "CORRUPT_MAGIC",
     "MAGIC",
     "MAX_FRAME_BYTES",
     "FrameError",
@@ -76,6 +95,9 @@ __all__ = [
 ]
 
 MAGIC = b"FLK1"
+# what `net.corrupt` overwrites the magic with: same length as MAGIC, can
+# never collide with a valid header, and greps memorably in packet dumps
+CORRUPT_MAGIC = b"XXXX"
 _HEADER = struct.Struct("<4sBBHQ")
 # a pushed chunk is rollout-sized, weights are model-sized; 1 GiB is far
 # above both and guards against a corrupt length field allocating the moon
@@ -126,6 +148,15 @@ REQUEST = register_kind(12, "request")
 RESPONSE = register_kind(13, "response")
 SHED = register_kind(14, "shed")
 RELOAD = register_kind(15, "reload")
+
+# 16 = "health" is claimed by sheeprl_tpu/serve/server.py at import time.
+
+# sheepscope (ISSUE 17): open a bounded jax.profiler.trace window on any
+# live process. JSON {seconds?, dir?}; the peer replies PROFILE JSON
+# {ok, dir?, seconds?, error?, pid}. Registered HERE (not in telemetry/)
+# because the registry is the wire module's and telemetry must stay
+# importable without the flock package.
+PROFILE = register_kind(17, "profile")
 
 
 class FrameError(ConnectionError):
@@ -178,7 +209,7 @@ def _inject_send(sock: socket.socket, data: bytes) -> bytes | None:
         elif spec.site == "net.corrupt":
             # garbled magic: the RECEIVER raises FrameError and kills that
             # one connection; the sender's socket stays healthy
-            return b"XXXX" + data[4:]
+            return CORRUPT_MAGIC + data[len(MAGIC):]
         elif spec.site == "net.partition":
             with _partition_gate:
                 _partition_until = time.monotonic() + (
@@ -225,7 +256,7 @@ def recv_frame(sock: socket.socket) -> tuple[int, bytes] | None:
         return None
     magic, kind, _flags, _rsvd, length = _HEADER.unpack(header)
     if magic != MAGIC:
-        raise FrameError(f"bad frame magic {magic!r}")
+        raise FrameError(f"bad frame magic {magic!r} (expected {MAGIC!r})")
     if length > MAX_FRAME_BYTES:
         raise FrameError(f"frame length {length} exceeds cap")
     payload = _recv_exact(sock, length) if length else b""

@@ -46,6 +46,7 @@ class PendingRequest:
     __slots__ = (
         "obs", "meta", "rows", "enqueue_t", "deadline_t",
         "done", "result", "error", "rung", "version", "queue_ms",
+        "pad_ms", "dispatch_ms", "slice_ms",
     )
 
     def __init__(self, obs, meta, rows, enqueue_t, deadline_t):
@@ -60,6 +61,11 @@ class PendingRequest:
         self.rung = 0
         self.version = 0
         self.queue_ms = 0.0
+        # sheepscope decomposition: where this request's latency went inside
+        # the batch it rode (pad/dispatch/slice are batch-wide costs)
+        self.pad_ms = 0.0
+        self.dispatch_ms = 0.0
+        self.slice_ms = 0.0
 
     def wait(self, timeout: float | None = None) -> dict[str, np.ndarray]:
         """Block until served; raises the typed error on shed/failure."""
@@ -222,8 +228,10 @@ class MicroBatcher:
         if not batch:
             return len(expired)
         rung = next(r for r in self.rungs if r >= rows)
+        t_pad = self._clock()
         stacked = _stack_pad([p.obs for p in batch], rows, rung)
         t0 = self._clock()
+        pad_ms = (t0 - t_pad) * 1000.0
         try:
             out, version = self._dispatch(stacked, batch, rung)
         except Exception as err:
@@ -235,14 +243,22 @@ class MicroBatcher:
             for p in batch:
                 p._complete(error=failure)
             return len(expired) + len(batch)
-        dispatch_ms = (self._clock() - t0) * 1000.0
+        t_slice = self._clock()
+        dispatch_ms = (t_slice - t0) * 1000.0
         off = 0
+        slices = []
         for p in batch:
+            slices.append({k: v[off : off + p.rows] for k, v in out.items()})
+            off += p.rows
+        slice_ms = (self._clock() - t_slice) * 1000.0
+        for p, result in zip(batch, slices):
             p.rung = rung
             p.version = version
             p.queue_ms = (t0 - p.enqueue_t) * 1000.0
-            p._complete(result={k: v[off : off + p.rows] for k, v in out.items()})
-            off += p.rows
+            p.pad_ms = pad_ms
+            p.dispatch_ms = dispatch_ms
+            p.slice_ms = slice_ms
+            p._complete(result=result)
         with self._cond:
             self.served += len(batch)
             self.rows_served += rows

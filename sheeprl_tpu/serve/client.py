@@ -95,6 +95,13 @@ class ServeClient:
         request id is resent, so a retry can never double-execute."""
         budget = self._retries if retries is None else int(retries)
         meta: dict[str, Any] = {"id": f"{self._nonce}-{next(self._ids)}"}
+        # sheepscope: a client-side span id rides the REQUEST meta; the
+        # server's request span parents on it and echoes its own span id
+        # back in the RESPONSE meta. Old servers ignore the key.
+        from ..telemetry import trace as tracelib
+
+        if tracelib.trace_enabled():
+            meta["span"] = tracelib.new_span_id()
         if deadline_ms is not None:
             meta["deadline_ms"] = deadline_ms
         if session is not None:

@@ -71,7 +71,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     logger, log_dir, run_name = create_logger(args, "serve", process_index=0)
     logger.log_hyperparams(args.as_dict())
-    telem = Telemetry.from_args(args, log_dir, 0, algo="serve")
+    telem = Telemetry.from_args(args, log_dir, 0, algo="serve", role="serve")
+    from ..telemetry.trace import install_profile_signal
+
+    install_profile_signal(log_dir)
     plan = CompilePlan.from_args(args, telem)
     telem.add_gauges(plan.gauges)
 

@@ -89,6 +89,9 @@ class ServeServer:
         self.batcher = batcher
         self._bind = bind
         self._telem = telem
+        # sheepscope span emitter (None when telem is absent or a bare
+        # stub): request spans + span-tagged connection failures
+        self._tracer = getattr(telem, "tracer", None)
         self.address: str | None = None
         self._listener: socket.socket | None = None
         self._unix_path: str | None = None
@@ -207,9 +210,18 @@ class ServeServer:
         }
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        # the connection's last request id + span: a FrameError or failed
+        # close is attributed to the request it interrupted, so sheeptrace
+        # can tie a dropped connection back into the span chain
+        last = {"rid": None, "span": None}
         try:
             frame = wire.recv_frame(conn)
-            if frame is None or frame[0] != wire.HELLO:
+            if frame is None:
+                return
+            if frame[0] == wire.PROFILE:
+                self._answer_profile(conn, frame[1])
+                return
+            if frame[0] != wire.HELLO:
                 return
             wire.send_json(conn, wire.WELCOME, self._hello_payload())
             while not self._stop.is_set():
@@ -223,6 +235,8 @@ class ServeServer:
                     req = json.loads(payload.decode()) if payload else {}
                     reply = self.store.reload(req.get("path"))
                     wire.send_json(conn, wire.RELOAD, reply)
+                elif kind == wire.PROFILE:
+                    self._answer_profile(conn, payload)
                 elif kind == HEALTH:
                     wire.send_json(
                         conn,
@@ -236,7 +250,7 @@ class ServeServer:
                         },
                     )
                 elif kind == wire.REQUEST:
-                    self._handle_request(conn, payload)
+                    self._handle_request(conn, payload, last)
                 else:
                     wire.send_json(
                         conn, wire.ERROR,
@@ -250,17 +264,51 @@ class ServeServer:
                 self._event(
                     "serve.conn_error",
                     error=f"{type(err).__name__}: {err}",
+                    request_id=last["rid"],
+                    span=last["span"],
                 )
         finally:
             try:
                 conn.close()
-            except OSError:
-                pass
+            except OSError as err:
+                # a failed close drops the client without a FrameError —
+                # tag it with the request it abandoned (ISSUE 17 satellite)
+                self._event(
+                    "serve.close_error",
+                    error=f"{type(err).__name__}: {err}",
+                    request_id=last["rid"],
+                    span=last["span"],
+                )
 
-    def _handle_request(self, conn: socket.socket, payload: bytes) -> None:
+    def _answer_profile(self, conn: socket.socket, payload: bytes) -> None:
+        """sheepscope on-demand profiling: open a bounded jax.profiler
+        window in the serving process and reply with the artifact path."""
+        from ..telemetry.trace import handle_profile_frame
+
+        req = json.loads(payload.decode()) if payload else {}
+        wire.send_json(
+            conn,
+            wire.PROFILE,
+            handle_profile_frame(req, getattr(self._telem, "log_dir", None)),
+        )
+
+    def _handle_request(
+        self, conn: socket.socket, payload: bytes, last: dict | None = None
+    ) -> None:
         t0 = time.monotonic()
         meta, obs = unpack_request(payload)
         rid = meta.get("id")
+        # request span: parented on the client-side span riding the REQUEST
+        # meta; its id is echoed in the RESPONSE meta and tagged onto any
+        # connection failure this request suffers
+        span = None
+        if self._tracer is not None:
+            span = self._tracer.begin(
+                "request", parent=meta.get("span"), id=rid
+            )
+        if last is not None:
+            last["rid"] = rid
+            last["span"] = span.id if span is not None else meta.get("span")
         if isinstance(rid, str):
             with self._lock:
                 cached = self._dedupe.get(rid)
@@ -268,6 +316,8 @@ class ServeServer:
                 # replayed id after a reconnect: repeat the answer, not the
                 # work (the id was already executed and answered once)
                 wire.send_frame(conn, cached[0], cached[1])
+                if self._tracer is not None:
+                    self._tracer.end(span, outcome="replay")
                 return
         if self._draining.is_set():
             wire.send_json(
@@ -279,6 +329,8 @@ class ServeServer:
                 },
             )
             self._finish(t0)
+            if self._tracer is not None:
+                self._tracer.end(span, outcome="shed", reason="draining")
             return
         limit = self.policy.max_rows_per_request
         try:
@@ -306,6 +358,8 @@ class ServeServer:
                 },
             )
             self._finish(t0)
+            if self._tracer is not None:
+                self._tracer.end(span, outcome="shed", reason=shed.reason)
             return
         except OversizedRequest as err:
             self._answer(
@@ -315,6 +369,8 @@ class ServeServer:
                 ).encode(),
             )
             self._finish(t0)
+            if self._tracer is not None:
+                self._tracer.end(span, outcome="error", kind="oversized")
             return
         except ServeError as err:
             self._answer(
@@ -324,6 +380,8 @@ class ServeServer:
                 ).encode(),
             )
             self._finish(t0)
+            if self._tracer is not None:
+                self._tracer.end(span, outcome="error", kind="failed")
             return
         out_meta = {
             "id": rid,
@@ -332,8 +390,26 @@ class ServeServer:
             "rows": pending.rows,
             "queue_ms": round(pending.queue_ms, 3),
         }
+        if span is not None:
+            out_meta["span"] = span.id
+        t_send = time.monotonic()
         self._answer(conn, rid, wire.RESPONSE, pack_request(out_meta, result))
         self._finish(t0)
+        if self._tracer is not None:
+            # the serve request decomposition sheeptrace reports on:
+            # queue-wait / pad / dispatch / slice / send
+            self._tracer.end(
+                span,
+                outcome="served",
+                version=pending.version,
+                rung=pending.rung,
+                rows=pending.rows,
+                queue_ms=round(pending.queue_ms, 3),
+                pad_ms=round(pending.pad_ms, 3),
+                dispatch_ms=round(pending.dispatch_ms, 3),
+                slice_ms=round(pending.slice_ms, 3),
+                send_ms=round((time.monotonic() - t_send) * 1000.0, 3),
+            )
 
     def _answer(
         self, conn: socket.socket, rid, kind: int, payload: bytes

@@ -8,14 +8,36 @@ from .compile_tracker import CompileTracker, monitoring_supported
 from .core import Telemetry, active_telemetry, device_memory_gauges, emit
 from .events import JsonlEventLog
 from .phase import PhaseTimers
+from .trace import (
+    ClockSync,
+    ProfileWindow,
+    Span,
+    Tracer,
+    ensure_run_id,
+    handle_profile_frame,
+    install_profile_signal,
+    new_span_id,
+    profile_window,
+    trace_enabled,
+)
 
 __all__ = [
+    "ClockSync",
     "CompileTracker",
     "JsonlEventLog",
     "PhaseTimers",
+    "ProfileWindow",
+    "Span",
     "Telemetry",
+    "Tracer",
     "active_telemetry",
     "device_memory_gauges",
     "emit",
+    "ensure_run_id",
+    "handle_profile_frame",
+    "install_profile_signal",
     "monitoring_supported",
+    "new_span_id",
+    "profile_window",
+    "trace_enabled",
 ]

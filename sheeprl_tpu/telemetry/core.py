@@ -119,10 +119,18 @@ class Telemetry:
         algo: str = "",
         enabled: bool = True,
         heartbeat_s: float = 30.0,
+        role: str = "",
+        run_id: str | None = None,
     ):
         self.enabled = enabled
         self.rank = rank
         self.algo = algo
+        # sheepscope role shard (ISSUE 17): non-learner roles write
+        # telemetry.<role>.jsonl next to the learner's telemetry.jsonl so
+        # tools/sheeptrace.py can merge all of a run's shards by run id
+        self.role = role or "learner"
+        self.run_id = run_id
+        self.log_dir = log_dir
         self.heartbeat_s = heartbeat_s
         self.timers = PhaseTimers()
         self._gauge_sources: list[Callable[[], dict[str, float]]] = []
@@ -132,9 +140,15 @@ class Telemetry:
         self._last_nan_warn = 0.0
         self._closed = not enabled
         self._compiles = CompileTracker()
+        self._tracer = None
         write_jsonl = enabled and rank == 0 and log_dir is not None
+        filename = (
+            self.FILENAME
+            if self.role == "learner"
+            else f"telemetry.{self.role}.jsonl"
+        )
         self._log = JsonlEventLog(
-            os.path.join(log_dir, self.FILENAME) if write_jsonl else None
+            os.path.join(log_dir, filename) if write_jsonl else None
         )
         if enabled:
             self._compiles.attach()
@@ -142,18 +156,35 @@ class Telemetry:
             atexit.register(self._atexit)
             _active.append(self)
 
+    @property
+    def tracer(self):
+        """This shard's span emitter (lazy — trace.py is pure stdlib but
+        there is no reason to build a Tracer nobody asks for)."""
+        if self._tracer is None:
+            from .trace import Tracer
+
+            self._tracer = Tracer(self)
+        return self._tracer
+
     # ---- construction policy ---------------------------------------------
     @classmethod
     def from_args(
-        cls, args: Any, log_dir: str, rank: int = 0, algo: str = ""
+        cls, args: Any, log_dir: str, rank: int = 0, algo: str = "", role: str = ""
     ) -> "Telemetry":
         """The mains' shared construction helper: always-on unless
         SHEEPRL_TPU_TELEMETRY=0, JSONL/heartbeat on process 0 only, and a
         `start` lifecycle event carrying the run identity. Checkpoint and
         profile-window lifecycle events arrive via the module-level `emit`
-        (save_checkpoint / StepProfiler publish them directly)."""
+        (save_checkpoint / StepProfiler publish them directly). `role`
+        selects the sheepscope shard filename (actor{N}/serve) and stamps
+        the shared run id into the `start` event."""
+        from .trace import ensure_run_id
+
         enabled = os.environ.get("SHEEPRL_TPU_TELEMETRY", "1") != "0"
-        telem = cls(log_dir, rank=rank, algo=algo, enabled=enabled)
+        telem = cls(
+            log_dir, rank=rank, algo=algo, enabled=enabled,
+            role=role, run_id=ensure_run_id() if enabled else None,
+        )
         if enabled:
             try:
                 import jax
@@ -173,6 +204,8 @@ class Telemetry:
                 local_devices=n_local,
                 rank=rank,
                 log_dir=log_dir,
+                role=telem.role,
+                run=telem.run_id,
                 compile_tracking=telem._compiles.supported,
             )
         return telem
@@ -191,7 +224,8 @@ class Telemetry:
         topology's queue-depth/staleness gauges)."""
         self._gauge_sources.append(source)
 
-    def event(self, name: str, **data: Any) -> None:
+    def event(self, name: str, /, **data: Any) -> None:
+        # positional-only: span events carry their own `name` payload key
         self._log.emit(name, **data)
 
     # ---- the per-logging-interval merge ----------------------------------

@@ -11,8 +11,13 @@ Schema (stable, consumed by the report tool and tests):
 
 Writes are a single `write()` of one line + flush: atomic enough for a
 line-oriented append-only file on POSIX, and a crash mid-run loses at most
-the event being written. Non-rank-0 processes construct the writer disabled
-(path=None) — same rank-0-only policy as TensorBoardLogger.
+the event being written. High-rate trace events (`span`, `trace.clock` —
+sheepscope emits a few per learner update) are the one exception: they
+flush lazily (at most every 0.25s, and on the next lifecycle event or
+close), so a hard kill loses at most a quarter-second of spans — a tail
+`tools/sheeptrace.py` already tolerates. Non-rank-0 processes construct
+the writer disabled (path=None) — same rank-0-only policy as
+TensorBoardLogger.
 """
 
 from __future__ import annotations
@@ -45,10 +50,16 @@ def _jsonable(value: Any):
         return repr(value)
 
 
+# events that may flush lazily (see module docstring)
+_LAZY_FLUSH_EVENTS = frozenset({"span", "trace.clock"})
+_LAZY_FLUSH_S = 0.25
+
+
 class JsonlEventLog:
     def __init__(self, path: str | None):
         self.path = path
         self._fh = None
+        self._last_flush = 0.0
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a", encoding="utf-8")
@@ -61,10 +72,24 @@ class JsonlEventLog:
         if self._fh is None:
             return
         record = {"ts": round(time.time(), 3), "event": event}
-        record.update({k: _jsonable(v) for k, v in data.items()})
+        record.update(data)
         try:
-            self._fh.write(json.dumps(record) + "\n")
+            try:
+                # fast path: span-rate payloads are plain ints/floats/strs;
+                # allow_nan=False turns a bare NaN/Infinity token into the
+                # ValueError that routes it through _jsonable below
+                line = json.dumps(record, allow_nan=False)
+            except (TypeError, ValueError):
+                record = {"ts": record["ts"], "event": event}
+                record.update({k: _jsonable(v) for k, v in data.items()})
+                line = json.dumps(record)
+            self._fh.write(line + "\n")
+            if event in _LAZY_FLUSH_EVENTS:
+                now = time.monotonic()
+                if now - self._last_flush < _LAZY_FLUSH_S:
+                    return
             self._fh.flush()
+            self._last_flush = time.monotonic()
         except (OSError, ValueError):
             # a full disk or a closed fd must never kill the training loop
             pass

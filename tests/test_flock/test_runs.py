@@ -73,6 +73,35 @@ def test_ppo_flock_two_actors_dry_run(tmp_path):
     # both actor log files exist (spawned subprocess receipts)
     logs = sorted(os.listdir(tmp_path / "flock2" / "flock"))
     assert logs == ["actor0.log", "actor1.log"]
+    # sheepscope (ISSUE 17): each actor wrote its own telemetry shard into
+    # the shared run dir, keyed by the learner's run id
+    run_dir = tmp_path / "flock2"
+    shards = sorted(p for p in os.listdir(run_dir) if p.startswith("telemetry"))
+    assert "telemetry.actor0.jsonl" in shards, shards
+    assert "telemetry.actor1.jsonl" in shards, shards
+    import json as _json
+    import sys
+
+    run_ids = set()
+    for shard in shards:
+        for line in (run_dir / shard).read_text().splitlines():
+            ev = _json.loads(line)
+            if ev.get("event") == "start":
+                run_ids.add(ev.get("run"))
+    assert len(run_ids) == 1 and None not in run_ids, run_ids
+    # the span chains cross the process boundary: sheeptrace reconstructs
+    # at least one complete collect->push->ingest->drain->train->publish
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import sheeptrace
+
+    summary = sheeptrace.summarize(sheeptrace.load_shards(str(run_dir)))
+    assert summary["complete"], (
+        summary["partial"],
+        [s.get("name") for s in summary["spans"]],
+    )
+    names = [s["name"] for s in summary["complete"][0]]
+    assert names == list(reversed(sheeptrace.CHAIN))
 
 
 @pytest.mark.slow

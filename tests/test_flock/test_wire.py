@@ -59,8 +59,12 @@ def test_bad_magic_and_oversize_length():
     a, b = _pair()
     try:
         a.sendall(b"NOPE" + bytes(12))
-        with pytest.raises(wire.FrameError, match="magic"):
+        with pytest.raises(wire.FrameError, match="magic") as exc:
             wire.recv_frame(b)
+        # the message names the offending bytes AND the expected magic —
+        # the difference between "corrupt frame" and "wrong port" in a log
+        assert "b'NOPE'" in str(exc.value)
+        assert repr(wire.MAGIC) in str(exc.value)
     finally:
         a.close()
         b.close()
@@ -195,6 +199,9 @@ PINNED_KINDS = {
     "response": 13,
     "shed": 14,
     "reload": 15,
+    # 16 = "health" is registered by serve/server.py at import time
+    # sheepscope (ISSUE 17)
+    "profile": 17,
 }
 
 
@@ -228,6 +235,27 @@ def test_serve_frames_travel_like_flock_frames():
         assert kind == wire.SHED
         wire.send_frame(a, wire.REQUEST, b"\x01\x02")
         assert wire.recv_frame(b) == (wire.REQUEST, b"\x01\x02")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_corrupt_magic_constant_is_not_magic():
+    """The fault injector's corruption pattern is a named constant, and it
+    must stay distinguishable from a real frame."""
+    assert wire.CORRUPT_MAGIC == b"XXXX"
+    assert len(wire.CORRUPT_MAGIC) == len(wire.MAGIC)
+    assert wire.CORRUPT_MAGIC != wire.MAGIC
+
+
+def test_profile_frame_roundtrip():
+    """The sheepscope PROFILE kind (17) travels like any JSON frame."""
+    a, b = _pair()
+    try:
+        wire.send_json(a, wire.PROFILE, {"seconds": 1.5})
+        assert wire.recv_json(b, wire.PROFILE) == {"seconds": 1.5}
+        wire.send_json(a, wire.PROFILE, {"ok": True, "dir": "/tmp/x"})
+        assert wire.recv_json(b, wire.PROFILE)["ok"] is True
     finally:
         a.close()
         b.close()

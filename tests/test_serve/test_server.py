@@ -40,7 +40,7 @@ def sac_policy():
 
 
 def _serving(policy, params, loaders=None, rungs=(1, 2, 4), window_ms=1.0,
-             deadline_ms=2000.0, bind="unix:auto"):
+             deadline_ms=2000.0, bind="unix:auto", telem=None):
     loaders = loaders or {}
 
     def loader(path):
@@ -55,7 +55,7 @@ def _serving(policy, params, loaders=None, rungs=(1, 2, 4), window_ms=1.0,
     batcher = MicroBatcher(
         dispatch, list(rungs), window_ms=window_ms, default_deadline_ms=deadline_ms
     )
-    server = ServeServer(policy, store, batcher, bind=bind)
+    server = ServeServer(policy, store, batcher, bind=bind, telem=telem)
     server.start()
     return server, store
 
@@ -212,6 +212,101 @@ def test_tcp_transport(sac_policy):
         with ServeClient(server.address) as client:
             res, meta = client.request(_obs(1))
             assert res["actions"].shape == (1, ACT_DIM)
+    finally:
+        server.close()
+
+
+class _SpanRecorder:
+    """Telemetry stand-in: thread-safe event capture + a live tracer."""
+
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def event(self, name, /, **data):
+        with self._lock:
+            self.events.append((name, data))
+
+    @property
+    def tracer(self):
+        from sheeprl_tpu.telemetry.trace import Tracer
+
+        return Tracer(self)
+
+    def of(self, name):
+        with self._lock:
+            return [d for n, d in self.events if n == name]
+
+
+@pytest.mark.timeout(120)
+def test_request_span_decomposition_and_echo(sac_policy):
+    """sheepscope (ISSUE 17): every served request gets a span parented on
+    the client's span id from the REQUEST meta, its own id echoed in the
+    RESPONSE meta, and the full queue/pad/dispatch/slice/send breakdown."""
+    policy, params, _ = sac_policy
+    rec = _SpanRecorder()
+    server, _store = _serving(policy, params, telem=rec)
+    try:
+        with ServeClient(server.address) as client:
+            res, meta = client.request(_obs(1))
+        assert "span" in meta, meta
+        spans = rec.of("span")
+        assert len(spans) == 1
+        span = spans[0]
+        assert span["name"] == "request" and span["outcome"] == "served"
+        assert span["span"] == meta["span"] and span["id"] == meta["id"]
+        # parented on the CLIENT's span id (a compact 8-hex id the client
+        # stamped into the REQUEST meta)
+        assert isinstance(span["parent"], str) and len(span["parent"]) == 8
+        for phase in ("queue_ms", "pad_ms", "dispatch_ms", "slice_ms", "send_ms"):
+            assert span[phase] >= 0.0, (phase, span)
+        assert span["version"] == 1 and span["rows"] == 1
+    finally:
+        server.close()
+
+
+@pytest.mark.timeout(120)
+def test_trace_off_leaves_wire_meta_clean(sac_policy, monkeypatch):
+    """Kill switch: no span keys ride the wire in either direction — the
+    exact frames an old peer would see."""
+    monkeypatch.setenv("SHEEPRL_TPU_TRACE", "0")
+    policy, params, _ = sac_policy
+    rec = _SpanRecorder()
+    server, _store = _serving(policy, params, telem=rec)
+    try:
+        with ServeClient(server.address) as client:
+            _res, meta = client.request(_obs(1))
+        assert "span" not in meta, meta
+        assert rec.of("span") == []
+    finally:
+        server.close()
+
+
+@pytest.mark.timeout(120)
+def test_conn_error_attributed_to_last_request(sac_policy):
+    """A connection that dies mid-stream is span-tagged: the conn_error
+    event names the request id + span it interrupted, so sheeptrace can
+    tie the drop back into the chain."""
+    import time as _time
+
+    policy, params, _ = sac_policy
+    rec = _SpanRecorder()
+    server, _store = _serving(policy, params, telem=rec)
+    try:
+        client = ServeClient(server.address)
+        _res, meta = client.request(_obs(1))
+        # corrupt bytes on the live connection: the handler's FrameError
+        client._sock.sendall(b"XXXX" + bytes(12))
+        client._sock.close()
+        deadline = _time.monotonic() + 20.0
+        while not rec.of("serve.conn_error") and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        errors = rec.of("serve.conn_error")
+        assert errors, rec.events
+        assert errors[0]["request_id"] == meta["id"]
+        assert errors[0]["span"] == meta["span"]
     finally:
         server.close()
 

@@ -158,6 +158,29 @@ def test_selftest_entrypoint_passes():
     assert mod.main(["--selftest"]) == 0
 
 
+def test_sheeptrace_selftest_entrypoint_passes():
+    """sheeptrace's selftest builds skewed multi-role shards through the
+    real Telemetry and asserts clock merge + chain reconstruction — wired
+    exactly like telemetry_report's."""
+    spec = importlib.util.spec_from_file_location(
+        "sheeptrace", os.path.join(REPO, "tools", "sheeptrace.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--selftest"]) == 0
+
+
+def test_report_reads_role_shard_when_learner_shard_absent(tmp_path):
+    telem = Telemetry(str(tmp_path), rank=0, algo="unit", role="actor0")
+    telem.event("start", algo="unit")
+    telem.interval({"Loss/x": 1.0}, step=3)
+    telem.close()
+    assert not (tmp_path / "telemetry.jsonl").exists()
+    mod = _load_report_module()
+    summary = mod.summarize(mod.load_events(str(tmp_path)))
+    assert summary["last_step"] == 3
+
+
 # ---------------------------------------------------------------------------
 # NaN watchdog
 # ---------------------------------------------------------------------------

@@ -1,0 +1,545 @@
+#!/usr/bin/env python
+"""Cross-process trace reconstruction from sheepscope telemetry shards.
+
+    python tools/sheeptrace.py <log_dir-or-shard.jsonl>
+    python tools/sheeptrace.py <log_dir> --assert-chain
+    python tools/sheeptrace.py --selftest
+
+A flock/serve run writes one telemetry shard per role into the shared run
+directory (sheeprl_tpu/telemetry/, ISSUE 17): `telemetry.jsonl` for the
+learner, `telemetry.actor{N}.jsonl` per flock actor, `telemetry.serve.jsonl`
+for the serving tier — all keyed by one run id. Span events inside each
+shard carry compact ids that also rode the FLK1 frame meta, so the chains
+cross process boundaries:
+
+    collect -> push -> ingest -> drain -> train -> publish -> (next collect)
+
+This tool merges the shards onto ONE timeline (actor wall clocks are
+corrected by the NTP-style offsets the heartbeat round-trips estimated,
+recorded as `trace.clock` events; the learner is the reference clock),
+reconstructs the span chains by walking parent links, and prints:
+
+  - a shard table: role, events, spans, best clock offset + its RTT bound;
+  - the end-to-end chains with a per-hop critical-path decomposition
+    (collect / push / wire+queue / train / publish) and per-row weight
+    staleness attribution;
+  - per-update drain-wait attribution by actor — which actor's chunks the
+    learner spent its drain budget waiting on (the straggler table);
+  - the serve request decomposition: queue-wait / pad / dispatch(compute) /
+    slice / send percentiles per outcome.
+
+Pure stdlib (no jax, no repo imports except the selftest's Telemetry), so
+it runs anywhere the JSONL shards can be copied to. `--assert-chain` exits
+non-zero unless at least one COMPLETE collect->...->publish chain is
+reconstructed — the CI trace-smoke gate. `--selftest` synthesizes skewed
+shards through the real Telemetry writer and asserts the merge undoes the
+skew and the chains survive — writer and this reader staying in sync.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# parent-walk order, publish-first; a COMPLETE chain reverses to
+# collect -> push -> ingest -> drain -> train -> publish
+CHAIN = ("publish", "train", "drain", "ingest", "push", "collect")
+
+SERVE_PHASES = ("queue_ms", "pad_ms", "dispatch_ms", "slice_ms", "send_ms")
+
+
+# ---------------------------------------------------------------------------
+# loading + clock merge
+# ---------------------------------------------------------------------------
+
+
+def _parse_jsonl(path: str) -> list[dict]:
+    """One shard's events; tolerates a truncated final line (crash)."""
+    events = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                break
+    return events
+
+
+def _shard_role(path: str) -> str:
+    """telemetry.jsonl -> learner; telemetry.<role>.jsonl -> role."""
+    name = os.path.basename(path)
+    parts = name.split(".")
+    return parts[1] if len(parts) == 3 else "learner"
+
+
+def load_shards(path: str) -> dict[str, list[dict]]:
+    """role -> event list, from a run dir (all telemetry*.jsonl in it) or a
+    single shard file."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "telemetry*.jsonl")))
+        if not files:
+            raise FileNotFoundError(
+                f"no telemetry*.jsonl shards under {path} — did the run "
+                "write telemetry? (SHEEPRL_TPU_TELEMETRY=0 disables)"
+            )
+    elif os.path.exists(path):
+        files = [path]
+    else:
+        raise FileNotFoundError(path)
+    return {_shard_role(f): _parse_jsonl(f) for f in files}
+
+
+def best_offset(events: list[dict]) -> tuple[float, float | None, int]:
+    """(offset_s, rtt_s, samples) from the shard's LAST trace.clock event —
+    ClockSync only re-emits on a minimum-RTT improvement, so the last one
+    is the best estimate. Shards without one (the learner itself, serve)
+    are their own reference: offset 0."""
+    offset, rtt, samples = 0.0, None, 0
+    for ev in events:
+        if ev.get("event") == "trace.clock":
+            offset = float(ev.get("offset_s") or 0.0)
+            rtt = ev.get("rtt_s")
+            samples = int(ev.get("samples") or 0)
+    return offset, rtt, samples
+
+
+def collect_spans(shards: dict[str, list[dict]]) -> list[dict]:
+    """Every span event across all shards, t0/t1 shifted onto the learner
+    clock by the shard's offset, tagged with its role."""
+    spans = []
+    for role, events in shards.items():
+        offset, _, _ = best_offset(events)
+        for ev in events:
+            if ev.get("event") != "span" or ev.get("span") is None:
+                continue
+            span = dict(ev)
+            span["role"] = role
+            for key in ("t0", "t1"):
+                if isinstance(span.get(key), (int, float)):
+                    span[key] = span[key] + offset
+            spans.append(span)
+    spans.sort(key=lambda s: s.get("t0") or 0.0)
+    return spans
+
+
+# ---------------------------------------------------------------------------
+# chain reconstruction
+# ---------------------------------------------------------------------------
+
+
+def walk_chain(pub: dict, index: dict[str, dict]) -> list[dict]:
+    """From a publish span, walk parent links down the expected chain.
+    Returns collect-first; complete iff len == len(CHAIN)."""
+    path = [pub]
+    cur = pub
+    for expected in CHAIN[1:]:
+        parent = index.get(cur.get("parent") or "")
+        if parent is None or parent.get("name") != expected:
+            break
+        path.append(parent)
+        cur = parent
+    path.reverse()
+    return path
+
+
+def reconstruct(spans: list[dict]) -> tuple[list[list[dict]], list[list[dict]]]:
+    """(complete, partial) chains, one per publish span."""
+    index = {s["span"]: s for s in spans}
+    complete, partial = [], []
+    for s in spans:
+        if s.get("name") != "publish":
+            continue
+        chain = walk_chain(s, index)
+        (complete if len(chain) == len(CHAIN) else partial).append(chain)
+    return complete, partial
+
+
+def summarize(shards: dict[str, list[dict]]) -> dict:
+    spans = collect_spans(shards)
+    complete, partial = reconstruct(spans)
+    by_name: dict[str, list[dict]] = {}
+    for s in spans:
+        by_name.setdefault(str(s.get("name")), []).append(s)
+    return {
+        "shards": {
+            role: {
+                "events": len(events),
+                "spans": sum(1 for e in events if e.get("event") == "span"),
+                "offset": best_offset(events),
+                "run": next(
+                    (e.get("run") for e in events if e.get("event") == "start"),
+                    None,
+                ),
+            }
+            for role, events in shards.items()
+        },
+        "spans": spans,
+        "by_name": by_name,
+        "complete": complete,
+        "partial": partial,
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_row(cols, widths):
+    return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths)).rstrip()
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
+
+def _chain_row(chain: list[dict]) -> dict:
+    """Critical-path decomposition of one COMPLETE chain (collect-first,
+    already on the merged clock)."""
+    named = {s["name"]: s for s in chain}
+    collect, push = named["collect"], named["push"]
+    ingest, drain = named["ingest"], named["drain"]
+    train, publish = named["train"], named["publish"]
+    ms = lambda s: float(s.get("dur_ms") or 0.0)  # noqa: E731
+    return {
+        "actor": drain.get("actor", collect.get("actor")),
+        "e2e_ms": round((publish["t1"] - collect["t0"]) * 1e3, 3),
+        "collect_ms": ms(collect),
+        "push_ms": ms(push),
+        # push send -> learner drain pickup: wire transit + shard residency;
+        # clock-corrected, so a wildly negative value means a bad offset
+        "wire_queue_ms": round((drain["t1"] - push["t0"]) * 1e3, 3),
+        "queued_ms": float(drain.get("queued_ms") or 0.0),
+        "train_ms": ms(train),
+        "publish_ms": ms(publish),
+        "staleness": train.get("staleness_versions"),
+        "version": publish.get("version"),
+        "ingest_id": ingest.get("span"),
+    }
+
+
+def render(summary: dict, max_chains: int = 8) -> str:
+    lines: list[str] = []
+    lines.append("== telemetry shards ==")
+    widths = (10, 8, 7, 12, 10, 8)
+    lines.append(
+        _fmt_row(("role", "events", "spans", "clock_offset", "rtt_ms", "run"), widths)
+    )
+    for role in sorted(summary["shards"]):
+        info = summary["shards"][role]
+        offset, rtt, samples = info["offset"]
+        lines.append(_fmt_row(
+            (
+                role,
+                info["events"],
+                info["spans"],
+                "reference" if samples == 0 and offset == 0.0 else f"{offset:+.3f}s",
+                "-" if rtt is None else f"{float(rtt) * 1e3:.1f}",
+                (info["run"] or "?")[:8],
+            ),
+            widths,
+        ))
+
+    complete, partial = summary["complete"], summary["partial"]
+    lines.append("")
+    lines.append("== span chains (collect -> push -> ingest -> drain -> train -> publish) ==")
+    lines.append(
+        f"complete={len(complete)} partial={len(partial)} "
+        f"spans_total={len(summary['spans'])}"
+    )
+    rows = [_chain_row(c) for c in complete]
+    if rows:
+        widths = (6, 9, 11, 9, 11, 10, 9, 11, 6)
+        lines.append(_fmt_row(
+            ("actor", "e2e_ms", "collect_ms", "push_ms", "wire+q_ms",
+             "queued_ms", "train_ms", "publish_ms", "stale"),
+            widths,
+        ))
+        for r in rows[:max_chains]:
+            lines.append(_fmt_row(
+                (
+                    r["actor"], f"{r['e2e_ms']:.1f}", f"{r['collect_ms']:.1f}",
+                    f"{r['push_ms']:.1f}", f"{r['wire_queue_ms']:.1f}",
+                    f"{r['queued_ms']:.1f}", f"{r['train_ms']:.1f}",
+                    f"{r['publish_ms']:.1f}",
+                    "-" if r["staleness"] is None else r["staleness"],
+                ),
+                widths,
+            ))
+        if len(rows) > max_chains:
+            lines.append(f"... {len(rows) - max_chains} more chain(s)")
+        # critical path: which hop dominates the mean end-to-end latency
+        hops = ("collect_ms", "push_ms", "wire_queue_ms", "train_ms", "publish_ms")
+        means = {h: sum(r[h] for r in rows) / len(rows) for h in hops}
+        top = max(means, key=means.get)
+        lines.append(
+            "critical path (mean): "
+            + " ".join(f"{h[:-3]}={v:.1f}ms" for h, v in means.items())
+            + f" <- dominated by {top[:-3]}"
+        )
+    elif partial:
+        # name the break point so a broken chain is debuggable from CI logs
+        longest = max(partial, key=len)
+        lines.append(
+            "NO complete chain; longest partial: "
+            + " -> ".join(str(s.get("name")) for s in longest)
+        )
+
+    # per-update drain-wait attribution: which actor the learner waited on
+    drains = summary["by_name"].get("drain", [])
+    if drains:
+        lines.append("")
+        lines.append("== drain-wait attribution (per actor) ==")
+        per: dict = {}
+        for d in drains:
+            per.setdefault(str(d.get("actor")), []).append(
+                (float(d.get("dur_ms") or 0.0), float(d.get("queued_ms") or 0.0))
+            )
+        widths = (8, 8, 12, 12, 12)
+        lines.append(_fmt_row(
+            ("actor", "drains", "wait_total", "wait_mean", "queued_mean"), widths
+        ))
+        for actor in sorted(per):
+            waits = [w for w, _ in per[actor]]
+            queued = [q for _, q in per[actor]]
+            lines.append(_fmt_row(
+                (
+                    actor, len(waits), f"{sum(waits):.1f}ms",
+                    f"{sum(waits) / len(waits):.1f}ms",
+                    f"{sum(queued) / len(queued):.1f}ms",
+                ),
+                widths,
+            ))
+        slowest = max(per, key=lambda a: sum(w for w, _ in per[a]))
+        lines.append(f"straggler: actor {slowest} (largest total drain wait)")
+
+    # per-row weight-version staleness attribution from the train spans
+    stale = [
+        int(s["staleness_versions"])
+        for s in summary["by_name"].get("train", [])
+        if s.get("staleness_versions") is not None
+    ]
+    if stale:
+        s = sorted(stale)
+        lines.append("")
+        lines.append("== trained-row weight staleness (versions behind at train time) ==")
+        lines.append(
+            f"updates={len(s)} min={s[0]} p50={s[len(s) // 2]} "
+            f"p90={_pct(s, 0.9)} max={s[-1]}"
+        )
+
+    # serve request decomposition
+    requests = summary["by_name"].get("request", [])
+    if requests:
+        lines.append("")
+        lines.append("== serve request decomposition ==")
+        outcomes: dict = {}
+        for r in requests:
+            outcomes[str(r.get("outcome"))] = outcomes.get(str(r.get("outcome")), 0) + 1
+        lines.append(
+            "outcomes: "
+            + " ".join(f"{k}={v}" for k, v in sorted(outcomes.items()))
+        )
+        served = [r for r in requests if r.get("outcome") == "served"]
+        if served:
+            widths = (12, 10, 10, 10)
+            lines.append(_fmt_row(("phase", "mean_ms", "p50_ms", "p99_ms"), widths))
+            for phase in SERVE_PHASES:
+                vals = sorted(
+                    float(r.get(phase) or 0.0) for r in served
+                )
+                lines.append(_fmt_row(
+                    (
+                        phase[:-3], f"{sum(vals) / len(vals):.3f}",
+                        f"{_pct(vals, 0.5):.3f}", f"{_pct(vals, 0.99):.3f}",
+                    ),
+                    widths,
+                ))
+            total = [
+                sum(float(r.get(p) or 0.0) for p in SERVE_PHASES) for r in served
+            ]
+            lines.append(
+                f"served={len(served)} mean_total={sum(total) / len(total):.3f}ms"
+            )
+    return "\n".join(lines)
+
+
+def report(path: str, max_chains: int = 8) -> dict:
+    """Load + merge + print; returns the summary (tests + CI use it)."""
+    summary = summarize(load_shards(path))
+    print(render(summary, max_chains=max_chains))
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+
+def selftest() -> int:
+    """Synthesize a skewed 3-shard run through the REAL Telemetry writer,
+    then assert the merge undoes the skew and the chain survives."""
+    import tempfile
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from sheeprl_tpu.telemetry import Telemetry
+
+    d = tempfile.mkdtemp(prefix="sheeptrace_selftest_")
+    run = "selftest-run"
+
+    # learner shard: the reference clock. One full update's learner-side
+    # spans, parented across the process boundary by the actor's span ids.
+    learner = Telemetry(d, rank=0, algo="selftest", role="learner", run_id=run)
+    learner.event("start", algo="selftest", role="learner", run=run)
+    learner.event(  # seed publish the actor's collect parents on
+        "span", name="publish", span="aaaa0001", parent=None,
+        t0=1000.00, t1=1000.01, dur_ms=10.0, version=1,
+    )
+    learner.event(
+        "span", name="ingest", span="cccc0001", parent="bbbb0002",
+        t0=1000.280, t1=1000.285, dur_ms=5.0, actor=0, rows=4, weight_version=1,
+    )
+    learner.event(
+        "span", name="drain", span="cccc0002", parent="cccc0001",
+        t0=1000.30, t1=1000.31, dur_ms=10.0, actor=0, queued_ms=20.0,
+        weight_version=1, update=1,
+    )
+    learner.event(
+        "span", name="train", span="cccc0003", parent="cccc0002",
+        t0=1000.31, t1=1000.50, dur_ms=190.0, staleness_versions=1, update=1,
+    )
+    learner.event(
+        "span", name="publish", span="cccc0004", parent="cccc0003",
+        t0=1000.50, t1=1000.51, dur_ms=10.0, version=2,
+    )
+    learner.close()
+
+    # actor shard: wall clock 5s AHEAD of the learner, so its recorded
+    # offset (server - local midpoint) is -5s and the raw span times are
+    # nonsense until merged.
+    actor = Telemetry(d, rank=0, algo="selftest", role="actor0", run_id=run)
+    actor.event("start", algo="selftest", role="actor0", run=run)
+    actor.event("trace.clock", offset_s=-5.0, rtt_s=0.004, samples=3)
+    actor.event(
+        "span", name="collect", span="bbbb0001", parent="aaaa0001",
+        t0=1005.05, t1=1005.25, dur_ms=200.0, actor=0, rows=4, weight_version=1,
+    )
+    actor.event(
+        "span", name="push", span="bbbb0002", parent="bbbb0001",
+        t0=1005.25, t1=1005.27, dur_ms=20.0, actor=0, rows=4,
+    )
+    actor.close()
+
+    # serve shard: two requests, one served with the full decomposition,
+    # one shed — parented on client span ids no shard contains (normal:
+    # clients are other processes entirely)
+    serve = Telemetry(d, rank=0, algo="serve", role="serve", run_id=run)
+    serve.event("start", algo="serve", role="serve", run=run)
+    serve.event(
+        "span", name="request", span="dddd0001", parent="eeee0001",
+        t0=1000.60, t1=1000.61, dur_ms=5.2, id="c1-1", outcome="served",
+        version=2, rung=4, rows=2, queue_ms=1.5, pad_ms=0.2,
+        dispatch_ms=3.0, slice_ms=0.1, send_ms=0.4,
+    )
+    serve.event(
+        "span", name="request", span="dddd0002", parent="eeee0002",
+        t0=1000.62, t1=1000.63, dur_ms=0.3, id="c1-2", outcome="shed",
+        reason="deadline",
+    )
+    serve.close()
+
+    shards = load_shards(d)
+    assert set(shards) == {"learner", "actor0", "serve"}, sorted(shards)
+    assert best_offset(shards["actor0"]) == (-5.0, 0.004, 3)
+    assert best_offset(shards["learner"]) == (0.0, None, 0)
+
+    summary = summarize(shards)
+    # the merge puts the actor's collect right after the seed publish
+    collect = summary["by_name"]["collect"][0]
+    assert abs(collect["t0"] - 1000.05) < 1e-6, collect["t0"]
+    # one complete cross-process chain, one partial (the seed publish)
+    assert len(summary["complete"]) == 1, summary["partial"]
+    assert len(summary["partial"]) == 1
+    names = [s["name"] for s in summary["complete"][0]]
+    assert names == list(reversed(CHAIN)), names
+    roles = [s["role"] for s in summary["complete"][0]]
+    assert roles[:2] == ["actor0", "actor0"] and roles[2:] == ["learner"] * 4
+
+    row = _chain_row(summary["complete"][0])
+    assert row["actor"] == 0 and row["staleness"] == 1 and row["version"] == 2
+    # e2e on the MERGED clock: collect t0 1000.05 -> publish t1 1000.51
+    assert abs(row["e2e_ms"] - 460.0) < 1e-6, row["e2e_ms"]
+    assert abs(row["wire_queue_ms"] - 60.0) < 1e-6, row["wire_queue_ms"]
+
+    out = render(summary)
+    assert "-5.000s" in out and "reference" in out, out
+    assert "complete=1 partial=1" in out, out
+    assert "straggler: actor 0" in out, out
+    assert "updates=1 min=1 p50=1" in out, out
+    assert "served=1 shed=1" in out, out
+    assert "dispatch" in out and "queue" in out, out
+    assert "critical path (mean):" in out, out
+
+    # --assert-chain: the gate passes here, fails on a chain-less dir
+    assert main([d, "--assert-chain"]) == 0
+    d2 = tempfile.mkdtemp(prefix="sheeptrace_selftest_broken_")
+    broken = Telemetry(d2, rank=0, algo="selftest", role="learner", run_id=run)
+    broken.event(
+        "span", name="publish", span="aaaa0001", parent="gone",
+        t0=1.0, t1=1.1, dur_ms=100.0, version=1,
+    )
+    broken.close()
+    assert main([d2, "--assert-chain"]) == 1
+
+    print("\nselftest OK", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "path", nargs="?", help="run log_dir or a single telemetry shard"
+    )
+    parser.add_argument(
+        "--assert-chain", action="store_true",
+        help="exit 1 unless >=1 complete collect->...->publish chain",
+    )
+    parser.add_argument(
+        "--max-chains", type=int, default=8,
+        help="chains printed in full (default 8)",
+    )
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="synthesize skewed shards and verify writer/reader agreement",
+    )
+    opts = parser.parse_args(argv)
+    if opts.selftest:
+        return selftest()
+    if not opts.path:
+        parser.error("path required (or --selftest)")
+    summary = report(opts.path, max_chains=opts.max_chains)
+    if opts.assert_chain and not summary["complete"]:
+        print(
+            "ASSERT-CHAIN FAILED: no complete "
+            "collect->push->ingest->drain->train->publish chain",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closed the pipe; not an error
+        os._exit(0)

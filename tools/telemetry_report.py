@@ -51,9 +51,19 @@ import sys
 
 def load_events(path: str) -> list[dict]:
     """Parse a telemetry.jsonl (or a log_dir containing one). Tolerates a
-    truncated final line (crash mid-write)."""
+    truncated final line (crash mid-write). Non-learner processes write
+    role shards (`telemetry.<role>.jsonl`, sheepscope ISSUE 17) — a dir
+    holding only those (e.g. a serve run) falls back to the first shard;
+    merging ALL shards onto one timeline is tools/sheeptrace.py's job."""
     if os.path.isdir(path):
-        path = os.path.join(path, "telemetry.jsonl")
+        candidate = os.path.join(path, "telemetry.jsonl")
+        if not os.path.exists(candidate):
+            import glob as _glob
+
+            shards = sorted(_glob.glob(os.path.join(path, "telemetry.*.jsonl")))
+            if shards:
+                candidate = shards[0]
+        path = candidate
     if not os.path.exists(path):
         raise FileNotFoundError(
             f"{path} not found — did the run write telemetry? "

@@ -3064,7 +3064,11 @@ def bench_serve() -> None:
                 done.set()
 
         threads = [
-            threading.Thread(target=worker, args=(t,)) for t in range(concurrency)
+            threading.Thread(
+                target=worker, args=(t,),
+                name=f"bench-serve-client-{t}", daemon=True,
+            )
+            for t in range(concurrency)
         ]
         t0 = time.perf_counter()
         for t in threads:
@@ -3337,6 +3341,10 @@ def bench_chaos() -> None:
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
         SHEEPRL_TPU_TELEMETRY="1",
+        # sheepsync (ISSUE 18): chaos children run under the runtime thread
+        # sanitizer — lock-order violations under fault injection surface as
+        # sync.order_violation events in the shards read back below
+        SHEEPRL_TPU_SANITIZE_THREADS="1",
     )
     env.pop("SHEEPRL_TPU_FAULTS", None)
     env.pop("XLA_FLAGS", None)  # single-device children

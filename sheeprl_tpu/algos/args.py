@@ -178,6 +178,17 @@ class StandardArgs:
         "train step with checkify NaN/div checks (sanitizer.checkify "
         "events). Audit mode: adds overhead, never changes results",
     )
+    sanitize_threads: bool = Arg(
+        default=False,
+        help="runtime thread sanitizer (sheepsync's dynamic half, ISSUE "
+        "18): instrument threading.Lock/RLock/Condition, record per-thread "
+        "lock acquisition order, and assert it against the committed "
+        "lock-order ledger (analysis/budget/concurrency.json). Violations "
+        "become sync.order_violation telemetry events; Sync/* gauges "
+        "report acquisitions, contention, hold times and undeclared "
+        "edges. Equivalent to SHEEPRL_TPU_SANITIZE_THREADS=1. Audit "
+        "mode: adds overhead, never changes behavior",
+    )
 
     def __setattr__(self, name: str, value: Any) -> None:
         if name == "precision" and value not in ("float32", "bfloat16"):

@@ -32,18 +32,34 @@ Two halves of one invariant set (ISSUE 3):
     dropped donations, executable-embedded constants, per-shard peaks over
     budget), plus the `memory` ledger section behind the CI-gated HBM
     drift budget and the bf16 activation-byte receipt.
+  - `concurrency_check`: the host-side half (ISSUE 18,
+    `tools/sheepsync.py`) — an AST pass over the threaded runtime tiers
+    (flock/serve/telemetry/resilience/parallel/compile) builds the
+    per-module lock graph, thread inventory and FLK1 send/recv contexts,
+    and checks SY001-SY006 (lock-order cycles, blocking calls under a
+    held lock, unguarded shared writes, manual acquire without
+    try/finally, Condition.wait outside a predicate loop, protocol
+    sequencing), plus the `concurrency` ledger behind the CI-gated
+    lock-graph drift budget.
+  - `thread_sanitizer`: concurrency_check's runtime half — instrumented
+    Lock/RLock/Condition factories record per-thread acquisition order
+    and assert it against the committed lock-order DAG
+    (`--sanitize_threads` / SHEEPRL_TPU_SANITIZE_THREADS=1), emitting
+    `sync.order_violation` events and `Sync/*` gauges.
 """
 
-from . import jaxpr_check, memory_check, shard_check
+from . import concurrency_check, jaxpr_check, memory_check, shard_check, thread_sanitizer
 from .linter import lint_file, lint_paths, lint_source
 from .rules import RULES, Rule, Violation
 from .sanitizer import Sanitizer
 
 __all__ = [
     "RULES",
+    "concurrency_check",
     "jaxpr_check",
     "memory_check",
     "shard_check",
+    "thread_sanitizer",
     "Rule",
     "Violation",
     "Sanitizer",

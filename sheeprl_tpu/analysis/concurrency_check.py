@@ -1,0 +1,1470 @@
+"""sheepsync — static concurrency & wire-protocol analysis (ISSUE 18).
+
+The four older gates (sheeplint/sheepcheck/sheepshard/sheepmem) analyze
+jitted/XLA code; this module covers the other half of the runtime: the
+threaded host Python behind flock's replay service, serve's
+batcher/server/hot-reload slot, the tracer and the fault-recovery paths.
+One AST pass over `sheeprl_tpu/{flock,serve,telemetry,resilience,
+parallel,compile}` builds
+
+  - a **lock graph**: every Lock/RLock/Condition allocation gets a stable
+    identity (`flock.service.ReplayService._lock`; a dict-of-locks
+    comprehension collapses to `..._shard_locks[*]`; a Condition built on
+    a shared lock is acquired AS that lock), every `with` site is
+    attributed to its function, and nested acquisitions — including
+    through same-class / same-package calls made while a lock is held —
+    become directed edges `outer -> inner`;
+  - a **thread inventory**: every `threading.Thread`/`Timer` construction
+    (and Thread subclass) with target, name template, daemon flag and
+    best-effort join evidence;
+  - a **guard map**: for each class attribute written outside `__init__`
+    from more than one thread entry point, the lock (if any) that
+    dominates *every* write.
+
+and checks six rules over them:
+
+  SY001  lock-order cycle across the acquisition graph (potential
+         deadlock; both chains reported). Nested re-acquisition of a
+         plain (non-reentrant) Lock is a self-deadlock and also fires;
+         RLock/Condition self-nesting is reentrant and exempt, as are
+         `[*]` dict-lock pairs (index unknown statically).
+  SY002  blocking call under a held lock: socket send/recv/accept/
+         connect (incl. the `wire.*` frame helpers), `Thread.join`,
+         `Event.wait`, `time.sleep`, checkpoint-restore / `*loader*`
+         calls, `subprocess.*` — directly or through a call made with
+         the lock held. `Condition.wait` is exempt (it releases its
+         backing lock).
+  SY003  shared mutable attribute written from >= 2 thread entry points
+         (thread targets / Thread-subclass `run` / the public-API root)
+         without one common dominating lock.
+  SY004  manual `.acquire()` whose `.release()` is not in a `finally:`
+         of the same function (prefer `with`).
+  SY005  `Condition.wait` outside an enclosing loop that re-checks the
+         predicate (`wait_for` is exempt: the predicate is the argument).
+  SY006  FLK1 protocol sequencing, from the pinned `flock/wire.py`
+         registry: a freshly `wire.connect`-ed socket whose first send
+         is not HELLO/PROFILE, or a reply kind (WELCOME/PUSH_OK/
+         HEARTBEAT_OK/WEIGHTS/WEIGHTS_UNCHANGED/ERROR/RESPONSE/SHED)
+         sent from a function not reachable from a frame-receiving
+         handler.
+
+Findings are suppressed only through `SYNC_SUPPRESSIONS`, keyed
+`(relpath, qualname, rule)` with a mandatory justification — the same
+contract as sheepmem's `MEM_SUPPRESSIONS`.
+
+The committed ledger `analysis/budget/concurrency.json` (built by
+`tools/sheepsync.py --update-budget`) records the lock-graph fingerprint,
+per-role lock tables, thread inventory and guard maps; `--check-budget`
+fails CI on any new lock-order edge, any cycle, a newly unguarded shared
+write, or a new undeclared thread. The runtime half
+(`analysis/thread_sanitizer.py`) asserts the committed edge DAG against
+real per-thread acquisition order.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .linter import iter_python_files
+from .rules import Rule
+
+__all__ = [
+    "SY_RULES",
+    "SYNC_SUPPRESSIONS",
+    "DEFAULT_PACKAGES",
+    "Finding",
+    "ConcurrencyReport",
+    "analyze_paths",
+    "analyze_source",
+    "build_ledger",
+    "check_budget",
+    "default_paths",
+    "ledger_path",
+    "load_ledger",
+    "render_report",
+    "save_ledger",
+]
+
+ERROR = "error"
+
+_SY_RULES = [
+    Rule(
+        id="SY001",
+        name="lock-order-cycle",
+        severity=ERROR,
+        summary="Lock acquisition order forms a cycle (potential deadlock); "
+        "both acquisition chains are reported. Break the cycle or collapse "
+        "the locks. Nested re-acquisition of a plain Lock is the "
+        "single-lock case of the same deadlock.",
+        autofix=(
+            "impose one global acquisition order (document it in the ledger); or collapse the two locks into one; for the single-lock case use an RLock or move the call outside the with block"
+        ),
+    ),
+    Rule(
+        id="SY002",
+        name="blocking-under-lock",
+        severity=ERROR,
+        summary="Blocking call (socket I/O, Thread.join, Event.wait, "
+        "time.sleep, checkpoint restore, subprocess) while holding a lock: "
+        "every thread contending on the lock stalls behind the I/O.",
+        autofix=(
+            "copy the shared state out under the lock, release, then do the I/O on the local copy (the service/server send paths are the repo's reference idiom)"
+        ),
+    ),
+    Rule(
+        id="SY003",
+        name="unguarded-shared-write",
+        severity=ERROR,
+        summary="Attribute written from >= 2 thread entry points without one "
+        "common dominating lock: a data race the GIL schedules but does not "
+        "prevent.",
+        autofix=(
+            "take the owning object's lock around every write, or funnel all writes through one thread; then rerun --update-budget so the guard map records the invariant"
+        ),
+    ),
+    Rule(
+        id="SY004",
+        name="acquire-without-finally",
+        severity=ERROR,
+        summary="Manual .acquire() whose .release() is not in a finally of "
+        "the same function: an exception between them leaks the lock "
+        "forever. Use `with`.",
+        autofix=(
+            "replace acquire()/release() with `with lock:`; if the manual form is unavoidable, release in a finally block"
+        ),
+    ),
+    Rule(
+        id="SY005",
+        name="wait-without-predicate-loop",
+        severity=ERROR,
+        summary="Condition.wait outside a loop that re-checks the predicate: "
+        "spurious wakeups and timeout returns are indistinguishable from "
+        "the real signal.",
+        autofix=(
+            "wrap the wait in `while not <predicate>:` (or use Condition.wait_for, which loops internally)"
+        ),
+    ),
+    Rule(
+        id="SY006",
+        name="protocol-sequencing",
+        severity=ERROR,
+        summary="FLK1 frame sent out of protocol order: request before "
+        "HELLO/PROFILE on a fresh connection, or a reply kind sent outside "
+        "a request handler.",
+        autofix=(
+            "send HELLO (or PROFILE) first on every fresh wire.connect socket; emit reply kinds only from the conn-handler call path"
+        ),
+    ),
+]
+
+SY_RULES: dict[str, Rule] = {r.id: r for r in _SY_RULES}
+
+# (relpath, qualname, rule) -> mandatory justification. `*` matches any
+# qualname in the file. An unjustified suppression is a review error.
+SYNC_SUPPRESSIONS: dict[tuple[str, str, str], str] = {
+    ("sheeprl_tpu/serve/params.py", "ParamsStore.reload", "SY002"): (
+        "by design: _reload_lock serializes checkpoint restores and is "
+        "NEVER taken on the dispatch path — current() is a lock-free "
+        "tuple read, so a slow orbax restore stalls only a second reload "
+        "(PR 15 hot-reload contract)"
+    ),
+}
+
+# analyzed packages (relative to the sheeprl_tpu package root)
+DEFAULT_PACKAGES = (
+    "flock",
+    "serve",
+    "telemetry",
+    "resilience",
+    "parallel",
+    "compile",
+)
+
+_REPO = Path(__file__).resolve().parents[2]
+
+# -- wire-protocol classification (derived from the pinned registry) ----------
+
+_HANDSHAKE_OPEN = {"HELLO", "PROFILE"}
+_REPLY_KINDS = {
+    "WELCOME",
+    "PUSH_OK",
+    "HEARTBEAT_OK",
+    "WEIGHTS",
+    "WEIGHTS_UNCHANGED",
+    "ERROR",
+    "RESPONSE",
+    "SHED",
+}
+_SEND_FUNCS = {"send_frame", "send_json"}
+_RECV_FUNCS = {"recv_frame", "recv_json"}
+
+# -- blocking-call classification for SY002 -----------------------------------
+
+_BLOCKING_SOCKET = {
+    "sendall",
+    "recv",
+    "recv_into",
+    "accept",
+    "connect",
+    "send_frame",
+    "send_json",
+    "recv_frame",
+    "recv_json",
+}
+_BLOCKING_RESTORE = {"restore", "restore_checkpoint", "load_checkpoint"}
+
+
+@dataclass
+class Finding:
+    rule: Rule
+    path: str
+    line: int
+    qualname: str
+    message: str
+    suppressed: Optional[str] = None
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (
+            f"{self.path}:{self.line}: {self.rule.id} [{self.qualname}] "
+            f"{self.message}{tag}"
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule.id,
+            "path": self.path,
+            "line": self.line,
+            "qualname": self.qualname,
+            "message": self.message,
+            "suppressed": self.suppressed,
+        }
+
+
+@dataclass
+class LockDef:
+    ident: str
+    kind: str  # Lock | RLock | Condition
+    path: str
+    line: int
+    backing: Optional[str] = None  # Condition's shared backing lock identity
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    @property
+    def acq_ident(self) -> str:
+        """Identity acquisitions are recorded under: a Condition built on
+        a shared lock acquires THAT lock; otherwise itself."""
+        return self.backing or self.ident
+
+
+@dataclass
+class ThreadDef:
+    role: str
+    path: str
+    line: int
+    target: str
+    name: str  # literal, or template with `*` for interpolated parts
+    daemon: Optional[bool]
+    joined: bool = False
+    subclass: bool = False
+
+    def key(self) -> str:
+        return f"{self.path}::{self.name}::{self.target}"
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "target": self.target,
+            "name": self.name,
+            "daemon": self.daemon,
+            "joined": self.joined,
+            "subclass": self.subclass,
+        }
+
+
+@dataclass
+class _Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    chain: str  # human-readable acquisition chain
+
+
+@dataclass
+class _FuncInfo:
+    qualname: str  # "flock.service::ReplayService._handle_push"
+    path: str
+    cls: Optional[str]
+    # acq ident -> first acquisition line (with-statements only)
+    acquires: dict[str, int] = field(default_factory=dict)
+    edges: list[_Edge] = field(default_factory=list)
+    # every resolved call: (callee key, line, held idents at the call)
+    calls: list[tuple[str, int, tuple[str, ...]]] = field(default_factory=list)
+    # blocking leaf calls: (line, held idents at the call, description)
+    blocking: list[tuple[int, tuple[str, ...], str]] = field(default_factory=list)
+    receives: bool = False  # calls wire recv_frame / recv_json
+
+
+class _ModuleAnalysis:
+    """Single-file AST pass. Rule evaluation that needs the global picture
+    (SY001 cycles, SY002 interprocedural, SY003 roots, SY006 handler
+    reachability) happens in `ConcurrencyReport.link`."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        parts = Path(relpath).with_suffix("").parts
+        if parts and parts[0] == "sheeprl_tpu":
+            parts = parts[1:]
+        self.mod = ".".join(parts)  # "flock.service"
+        self.role = parts[0] if parts else relpath
+        self.tree = ast.parse(source)
+        self.aliases: dict[str, str] = {}
+        self.locks: dict[str, LockDef] = {}
+        self.threads: list[ThreadDef] = []
+        self.funcs: dict[str, _FuncInfo] = {}
+        # (class, attr) -> [(method name, line, held idents)]
+        self.attr_writes: dict[tuple[str, str], list] = {}
+        # class -> set of method names used as thread targets
+        self.thread_targets: dict[str, set[str]] = {}
+        self.class_methods: dict[str, set[str]] = {}
+        self.findings: list[Finding] = []
+        self._lock_valued_attrs: set[tuple[str, str]] = set()
+        self._thread_stores: set[str] = set()
+        self._thread_collections: set[str] = set()
+        self._annotate_parents()
+        self._collect_imports()
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _annotate_parents(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._sync_parent = node  # type: ignore[attr-defined]
+
+    def _collect_imports(self) -> None:
+        pkg = ("sheeprl_tpu." + self.mod).rsplit(".", 1)[0]
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg
+                    for _ in range(node.level - 1):
+                        base = base.rsplit(".", 1)[0]
+                    module = f"{base}.{node.module}" if node.module else base
+                else:
+                    module = node.module or ""
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{module}.{a.name}"
+
+    def _dotted(self, node: ast.AST) -> Optional[str]:
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(self.aliases.get(node.id, node.id))
+            return ".".join(reversed(parts))
+        return None
+
+    @staticmethod
+    def _leaf(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def _parents(self, node: ast.AST):
+        cur = getattr(node, "_sync_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_sync_parent", None)
+
+    def _enclosing_class(self, node: ast.AST) -> Optional[str]:
+        for p in self._parents(node):
+            if isinstance(p, ast.ClassDef):
+                return p.name
+        return None
+
+    @staticmethod
+    def _store_name(target: ast.AST) -> Optional[str]:
+        """`x` or `self.x` -> the bare name; anything else -> None."""
+        if isinstance(target, ast.Name):
+            return target.id
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            return target.attr
+        return None
+
+    def _finding(self, rule_id: str, node: ast.AST, qualname: str, message: str):
+        self.findings.append(
+            Finding(SY_RULES[rule_id], self.relpath, node.lineno, qualname, message)
+        )
+
+    # -- phase 1: definitions --------------------------------------------------
+
+    def _lock_ctor_kind(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            dotted = self._dotted(node.func)
+            if dotted in ("threading.Lock", "threading.RLock", "threading.Condition"):
+                return dotted.rsplit(".", 1)[1]
+        return None
+
+    def collect_defs(self) -> None:
+        pending: list[tuple[LockDef, ast.AST, Optional[str]]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                self._maybe_lock_def(node, pending)
+            elif isinstance(node, ast.Call):
+                self._maybe_thread_ctor(node)
+            elif isinstance(node, ast.ClassDef):
+                self.class_methods[node.name] = {
+                    n.name
+                    for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                if any(self._dotted(b) == "threading.Thread" for b in node.bases):
+                    self._thread_subclass(node)
+        for ld, expr, cls in pending:
+            ident = self._resolve_ident(expr, cls)
+            if ident and ident in self.locks:
+                ld.backing = self.locks[ident].acq_ident
+        self._collect_joins()
+
+    def _maybe_lock_def(self, node: ast.Assign, pending) -> None:
+        target = node.targets[0]
+        cls = self._enclosing_class(target)
+        ident = None
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and cls
+        ):
+            ident = f"{self.mod}.{cls}.{target.attr}"
+        elif isinstance(target, ast.Name) and cls is None:
+            if not any(
+                isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for p in self._parents(target)
+            ):
+                ident = f"{self.mod}.{target.id}"
+        if ident is None:
+            return
+        kind = self._lock_ctor_kind(node.value)
+        if kind:
+            ld = LockDef(ident, kind, self.relpath, node.value.lineno)
+            self.locks[ident] = ld
+            if cls:
+                self._lock_valued_attrs.add((cls, target.attr))
+            if kind == "Condition" and node.value.args:  # type: ignore[union-attr]
+                pending.append((ld, node.value.args[0], cls))  # type: ignore[union-attr]
+        elif isinstance(node.value, ast.DictComp):
+            kind = self._lock_ctor_kind(node.value.value)
+            if kind:
+                self.locks[f"{ident}[*]"] = LockDef(
+                    f"{ident}[*]", kind, self.relpath, node.value.lineno
+                )
+                if cls:
+                    self._lock_valued_attrs.add((cls, target.attr))
+        elif self._dotted(getattr(node.value, "func", node.value)) in (
+            "threading.Event",
+            "threading.Semaphore",
+            "threading.BoundedSemaphore",
+            "threading.Barrier",
+        ):
+            if cls:
+                self._lock_valued_attrs.add((cls, target.attr))
+
+    def _maybe_thread_ctor(self, node: ast.Call) -> None:
+        dotted = self._dotted(node.func)
+        if dotted not in ("threading.Thread", "threading.Timer"):
+            return
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        target_expr = kw.get("target")
+        if target_expr is None and dotted == "threading.Timer" and len(node.args) >= 2:
+            target_expr = node.args[1]
+        target = self._dotted(target_expr) if target_expr is not None else None
+        name = self._name_template(kw.get("name"))
+        if dotted == "threading.Timer" and name == "?":
+            name = "timer"
+        daemon = None
+        if "daemon" in kw and isinstance(kw["daemon"], ast.Constant):
+            daemon = bool(kw["daemon"].value)
+        self.threads.append(
+            ThreadDef(
+                role=self.role,
+                path=self.relpath,
+                line=node.lineno,
+                target=target or "?",
+                name=name,
+                daemon=daemon,
+            )
+        )
+        cls = self._enclosing_class(node)
+        if target and target.startswith("self.") and cls:
+            self.thread_targets.setdefault(cls, set()).add(target[5:])
+        # remember where the thread object lands, for join matching
+        parent = getattr(node, "_sync_parent", None)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            stored = self._store_name(parent.targets[0])
+            if stored:
+                self._thread_stores.add(stored)
+
+    def _thread_subclass(self, node: ast.ClassDef) -> None:
+        name, daemon = "?", None
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "__init__"
+                and isinstance(sub.func.value, ast.Call)
+                and self._leaf(sub.func.value.func) == "super"
+            ):
+                kw = {k.arg: k.value for k in sub.keywords if k.arg}
+                name = self._name_template(kw.get("name"))
+                if "daemon" in kw and isinstance(kw["daemon"], ast.Constant):
+                    daemon = bool(kw["daemon"].value)
+        self.threads.append(
+            ThreadDef(
+                role=self.role,
+                path=self.relpath,
+                line=node.lineno,
+                target=f"{node.name}.run",
+                name=name,
+                daemon=daemon,
+                subclass=True,
+            )
+        )
+        self.thread_targets.setdefault(node.name, set()).add("run")
+
+    @staticmethod
+    def _name_template(node: Optional[ast.AST]) -> str:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.JoinedStr):
+            return "".join(
+                str(p.value) if isinstance(p, ast.Constant) else "*"
+                for p in node.values
+            )
+        return "?"
+
+    def _collect_joins(self) -> None:
+        join_receivers: set[str] = set()
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                recv = self._store_name(node.func.value)
+                if recv:
+                    join_receivers.add(recv)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"
+            ):
+                coll = self._store_name(node.func.value)
+                arg = self._store_name(node.args[0]) if node.args else None
+                if coll and arg in self._thread_stores:
+                    self._thread_collections.add(coll)
+        # `for t in self._threads: t.join()` joins the collection
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+                it = node.iter
+                if (
+                    isinstance(it, ast.Call)
+                    and self._leaf(it.func) == "list"
+                    and it.args
+                ):
+                    it = it.args[0]
+                src = self._store_name(it)
+                if src in self._thread_collections and node.target.id in join_receivers:
+                    join_receivers.add(src)
+        joined_stores = (self._thread_stores | self._thread_collections) & join_receivers
+        for td in self.threads:
+            if joined_stores:
+                td.joined = True
+
+    # -- lock-expression resolution --------------------------------------------
+
+    def _resolve_ident(self, node: ast.AST, cls: Optional[str]) -> Optional[str]:
+        """`self._lock` / module `_gate` / `self._locks[i]` -> lock ident."""
+        if isinstance(node, ast.Subscript):
+            base = self._raw_ident(node.value, cls)
+            if base and f"{base}[*]" in self.locks:
+                return f"{base}[*]"
+            return None
+        ident = self._raw_ident(node, cls)
+        return ident if ident in self.locks else None
+
+    def _raw_ident(self, node: ast.AST, cls: Optional[str]) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and cls
+        ):
+            return f"{self.mod}.{cls}.{node.attr}"
+        if isinstance(node, ast.Name):
+            return f"{self.mod}.{node.id}"
+        return None
+
+    def lock_kind(self, acq_ident: str) -> Optional[str]:
+        ld = self.locks.get(acq_ident)
+        return ld.kind if ld else None
+
+    # -- phase 2: function walks -----------------------------------------------
+
+    def analyze_functions(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(node)
+
+    def _qualname(self, func: ast.AST) -> str:
+        names = [func.name]  # type: ignore[attr-defined]
+        for p in self._parents(func):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.append(p.name)
+        return ".".join(reversed(names))
+
+    def _walk_function(self, func) -> None:
+        cls = self._enclosing_class(func)
+        qual = self._qualname(func)
+        info = _FuncInfo(
+            qualname=f"{self.mod}::{qual}", path=self.relpath, cls=cls
+        )
+        self.funcs[info.qualname] = info
+        held: list[str] = []
+        for stmt in func.body:
+            self._visit(stmt, func, cls, qual, info, held)
+        self._check_sy004(func, cls, qual)
+        self._check_sy005(func, cls, qual)
+        self._check_sy006_fresh(func, cls, qual)
+
+    def _visit(self, node, func, cls, qual, info: _FuncInfo, held) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # separate walk / deferred execution
+        if isinstance(node, ast.With):
+            acquired: list[str] = []
+            for item in node.items:
+                self._visit(item.context_expr, func, cls, qual, info, held)
+                ident = self._resolve_ident(item.context_expr, cls)
+                if ident is None:
+                    continue
+                acq = self.locks[ident].acq_ident
+                line = item.context_expr.lineno
+                info.acquires.setdefault(acq, line)
+                for outer in held:
+                    if outer == acq:
+                        if self.lock_kind(acq) == "Lock" and "[*]" not in acq:
+                            self._finding(
+                                "SY001",
+                                item.context_expr,
+                                qual,
+                                f"nested re-acquisition of non-reentrant "
+                                f"Lock `{acq}` self-deadlocks",
+                            )
+                        continue
+                    info.edges.append(
+                        _Edge(
+                            src=outer,
+                            dst=acq,
+                            path=self.relpath,
+                            line=line,
+                            chain=(
+                                f"{qual} holds {outer}, acquires {acq} at "
+                                f"{self.relpath}:{line}"
+                            ),
+                        )
+                    )
+                held.append(acq)
+                acquired.append(acq)
+            for stmt in node.body:
+                self._visit(stmt, func, cls, qual, info, held)
+            for _ in acquired:
+                held.pop()
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node, cls, qual, info, held)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            self._record_stores(node, cls, qual, info, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, func, cls, qual, info, held)
+
+    def _visit_call(self, node: ast.Call, cls, qual, info: _FuncInfo, held) -> None:
+        leaf = self._leaf(node.func)
+        dotted = self._dotted(node.func)
+        if leaf in _RECV_FUNCS:
+            info.receives = True
+        # resolved callee, for the interprocedural passes
+        callee = self._callee_key(node, cls)
+        if callee:
+            info.calls.append((callee, node.lineno, tuple(held)))
+        desc = self._blocking_desc(node, cls, leaf, dotted)
+        if desc:
+            # recorded even with no lock held: callers that DO hold one
+            # inherit this through the interprocedural closure
+            info.blocking.append((node.lineno, tuple(held), desc))
+
+    def _callee_key(self, node: ast.Call, cls) -> Optional[str]:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+            and cls
+            and f.attr in self.class_methods.get(cls, ())
+        ):
+            return f"{self.mod}::{cls}.{f.attr}"
+        if isinstance(f, ast.Name):
+            if f.id in self.class_methods:
+                return None  # class constructor: __init__ rarely matters here
+            dotted = self.aliases.get(f.id)
+            if dotted and dotted.startswith("sheeprl_tpu."):
+                mod, _, leaf = dotted.rpartition(".")
+                return f"{mod.removeprefix('sheeprl_tpu.')}::{leaf}"
+            return f"{self.mod}::{f.id}"
+        dotted = self._dotted(f)
+        if dotted and dotted.startswith("sheeprl_tpu."):
+            mod, _, leaf = dotted.rpartition(".")
+            return f"{mod.removeprefix('sheeprl_tpu.')}::{leaf}"
+        return None
+
+    def _blocking_desc(self, node: ast.Call, cls, leaf, dotted) -> Optional[str]:
+        if dotted == "time.sleep":
+            return "time.sleep"
+        if dotted and dotted.startswith("subprocess."):
+            return dotted
+        if leaf in _BLOCKING_SOCKET:
+            return f".{leaf}()" if leaf not in _SEND_FUNCS | _RECV_FUNCS else f"wire.{leaf}"
+        if leaf == "send" and isinstance(node.func, ast.Attribute):
+            recv = self._leaf(node.func.value) or ""
+            if "sock" in recv or "conn" in recv:
+                return ".send()"
+            return None
+        if leaf in _BLOCKING_RESTORE:
+            return f".{leaf}()"
+        if leaf and "loader" in leaf:
+            return f"{leaf}() (checkpoint loader)"
+        if leaf == "join":
+            return self._join_blocking(node)
+        if leaf == "wait" and isinstance(node.func, ast.Attribute):
+            ident = self._resolve_ident(node.func.value, cls)
+            if ident and self.locks[ident].kind == "Condition":
+                return None  # Condition.wait releases its backing lock
+            return ".wait() (Event/process)"
+        return None
+
+    def _join_blocking(self, node: ast.Call) -> Optional[str]:
+        """Thread.join vs str.join: flag no-arg joins, timeout kwargs and
+        single numeric/timeout-named args; skip `sep.join(iterable)`."""
+        if isinstance(node.func, ast.Attribute) and isinstance(
+            node.func.value, ast.Constant
+        ):
+            return None
+        if any(k.arg == "timeout" for k in node.keywords):
+            return "Thread.join"
+        if not node.args and not node.keywords:
+            return "Thread.join"
+        if len(node.args) == 1:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, (int, float)):
+                return "Thread.join"
+            if isinstance(a, ast.Name) and any(
+                h in a.id for h in ("timeout", "deadline", "left", "budget")
+            ):
+                return "Thread.join"
+        return None
+
+    def _record_stores(self, node, cls, qual, info: _FuncInfo, held) -> None:
+        if cls is None or qual.split(".")[-1] == "__init__":
+            return
+        targets = (
+            node.targets
+            if isinstance(node, ast.Assign)
+            else [node.target]
+            if isinstance(node, ast.AugAssign)
+            else node.targets
+        )
+        method = qual.split(".")[-1]
+        flat: list[ast.AST] = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                flat.extend(t.elts)
+            else:
+                flat.append(t)
+        for t in flat:
+            base = t
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                attr = base.attr
+                if (cls, attr) in self._lock_valued_attrs:
+                    continue
+                self.attr_writes.setdefault((cls, attr), []).append(
+                    (method, t.lineno, tuple(held))
+                )
+
+    # -- flat per-function rule passes ----------------------------------------
+
+    def _check_sy004(self, func, cls, qual) -> None:
+        for node in ast.walk(func):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                continue
+            ident = self._resolve_ident(node.func.value, cls)
+            if ident is None:
+                continue
+            recv = self._store_name(node.func.value)
+
+            def releases(try_node: ast.Try) -> bool:
+                for fin in try_node.finalbody:
+                    for sub in ast.walk(fin):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "release"
+                            and self._store_name(sub.func.value) == recv
+                        ):
+                            return True
+                return False
+
+            safe = False
+            prev = node
+            for p in self._parents(node):
+                if isinstance(p, ast.Try) and releases(p):
+                    safe = True
+                # the canonical idiom puts the acquire BEFORE the Try:
+                # `lock.acquire()` then `try: ... finally: lock.release()`
+                # as the next statement in the same block
+                for field in ("body", "orelse", "finalbody"):
+                    stmts = getattr(p, field, None) or []
+                    if prev in stmts:
+                        idx = stmts.index(prev)
+                        if (
+                            idx + 1 < len(stmts)
+                            and isinstance(stmts[idx + 1], ast.Try)
+                            and releases(stmts[idx + 1])
+                        ):
+                            safe = True
+                if p is func or safe:
+                    break
+                prev = p
+            if not safe:
+                self._finding(
+                    "SY004",
+                    node,
+                    qual,
+                    f"manual acquire of `{ident}` without a matching "
+                    f"release in a finally block (use `with`)",
+                )
+
+    def _check_sy005(self, func, cls, qual) -> None:
+        for node in ast.walk(func):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "wait"
+            ):
+                continue
+            ident = self._resolve_ident(node.func.value, cls)
+            if ident is None or self.locks[ident].kind != "Condition":
+                continue
+            in_loop = False
+            for p in self._parents(node):
+                if p is func:
+                    break
+                if isinstance(p, (ast.While, ast.For)):
+                    in_loop = True
+                    break
+            if not in_loop:
+                self._finding(
+                    "SY005",
+                    node,
+                    qual,
+                    f"`{ident}.wait()` outside a predicate re-checking loop "
+                    f"(spurious wakeup / timeout returns unhandled)",
+                )
+
+    # -- SY006: within-function fresh-socket handshake order -------------------
+
+    def _kind_const(self, node: ast.AST) -> Optional[str]:
+        """`wire.HELLO` / imported `HELLO` -> "HELLO" when it looks like a
+        frame-kind constant."""
+        leaf = self._leaf(node)
+        if leaf and leaf.isupper():
+            return leaf
+        return None
+
+    def _calls_in_order(self, func) -> Iterable[ast.Call]:
+        def rec(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return
+            if isinstance(node, ast.Call):
+                yield node
+            for child in ast.iter_child_nodes(node):
+                yield from rec(child)
+
+        for stmt in func.body:
+            yield from rec(stmt)
+
+    def sends_of(self, func) -> list[tuple[str, str, int]]:
+        """Ordered (sock name, KIND, line) sends inside `func`."""
+        out = []
+        for call in self._calls_in_order(func):
+            if self._leaf(call.func) in _SEND_FUNCS and len(call.args) >= 2:
+                sock = self._store_name(call.args[0]) or "?"
+                kind = self._kind_const(call.args[1])
+                if kind:
+                    out.append((sock, kind, call.lineno))
+        return out
+
+    def _check_sy006_fresh(self, func, cls, qual) -> None:
+        fresh: dict[str, int] = {}
+        sent_on: set[str] = set()
+        for call in self._calls_in_order(func):
+            dotted = self._dotted(call.func)
+            leaf = self._leaf(call.func)
+            if leaf == "connect" and dotted and (
+                dotted.endswith("wire.connect") or dotted == "connect"
+            ):
+                parent = getattr(call, "_sync_parent", None)
+                if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                    stored = self._store_name(parent.targets[0])
+                    if stored:
+                        fresh[stored] = call.lineno
+                continue
+            if leaf in _SEND_FUNCS and len(call.args) >= 2:
+                sock = self._store_name(call.args[0])
+                kind = self._kind_const(call.args[1])
+                if sock is None or kind is None:
+                    continue
+                if sock in fresh and sock not in sent_on:
+                    sent_on.add(sock)
+                    if kind not in _HANDSHAKE_OPEN:
+                        self._finding(
+                            "SY006",
+                            call,
+                            qual,
+                            f"first frame on fresh connection `{sock}` "
+                            f"(wire.connect at line {fresh[sock]}) is {kind}, "
+                            f"not HELLO/PROFILE",
+                        )
+
+
+# -- global linking ------------------------------------------------------------
+
+
+@dataclass
+class ConcurrencyReport:
+    modules: list[_ModuleAnalysis]
+    findings: list[Finding] = field(default_factory=list)
+    locks: dict[str, LockDef] = field(default_factory=dict)
+    threads: list[ThreadDef] = field(default_factory=list)
+    # (src, dst) -> representative chain text
+    edges: dict[tuple[str, str], str] = field(default_factory=dict)
+    cycles: list[tuple[str, str, str, str]] = field(default_factory=list)
+    # role -> {"Class.attr" -> guard ident | None} (shared attrs only)
+    guards: dict[str, dict[str, Optional[str]]] = field(default_factory=dict)
+
+    # -- linking ---------------------------------------------------------------
+
+    def link(self) -> None:
+        funcs: dict[str, _FuncInfo] = {}
+        for m in self.modules:
+            self.locks.update(m.locks)
+            self.threads.extend(m.threads)
+            self.findings.extend(m.findings)
+            funcs.update(m.funcs)
+        self._link_edges(funcs)
+        self._check_cycles()
+        self._check_blocking(funcs)
+        self._check_shared_writes(funcs)
+        self._check_reply_contexts(funcs)
+        self._apply_suppressions()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
+
+    def _lock_kind(self, acq: str) -> Optional[str]:
+        ld = self.locks.get(acq)
+        return ld.kind if ld else None
+
+    def _link_edges(self, funcs: dict[str, _FuncInfo]) -> None:
+        # transitive acquires: acq ident -> representative site, per function
+        closure: dict[str, dict[str, str]] = {
+            q: {a: f"{fi.path}:{line} in {q.split('::')[-1]}" for a, line in fi.acquires.items()}
+            for q, fi in funcs.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in funcs.items():
+                mine = closure[q]
+                for callee, _line, _held in fi.calls:
+                    for a, site in closure.get(callee, {}).items():
+                        if a not in mine:
+                            mine[a] = site
+                            changed = True
+        for q, fi in funcs.items():
+            for e in fi.edges:
+                self.edges.setdefault((e.src, e.dst), e.chain)
+            for callee, line, held in fi.calls:
+                if not held:
+                    continue
+                for acq, site in closure.get(callee, {}).items():
+                    for h in held:
+                        if h == acq:
+                            if self._lock_kind(acq) == "Lock" and "[*]" not in acq:
+                                self.findings.append(
+                                    Finding(
+                                        SY_RULES["SY001"],
+                                        fi.path,
+                                        line,
+                                        q.split("::")[-1],
+                                        f"holds non-reentrant Lock `{acq}` "
+                                        f"across call to {callee.split('::')[-1]} "
+                                        f"which re-acquires it ({site}): "
+                                        f"self-deadlock",
+                                    )
+                                )
+                            continue
+                        chain = (
+                            f"{q.split('::')[-1]} holds {h}, calls "
+                            f"{callee.split('::')[-1]} at {fi.path}:{line} "
+                            f"which acquires {acq} ({site})"
+                        )
+                        self.edges.setdefault((h, acq), chain)
+
+    def _check_cycles(self) -> None:
+        adj: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen, stack = set(), [src]
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(adj.get(n, ()))
+            return False
+
+        reported: set[frozenset] = set()
+        for (a, b), chain in sorted(self.edges.items()):
+            if reaches(b, a):
+                key = frozenset((a, b))
+                if key in reported:
+                    continue
+                reported.add(key)
+                back = self.edges.get((b, a), f"(via intermediate locks) {b} .. {a}")
+                self.cycles.append((a, b, chain, back))
+                path = chain.split(" at ")[-1].split(" ")[0]
+                self.findings.append(
+                    Finding(
+                        SY_RULES["SY001"],
+                        self.locks[a].path if a in self.locks else path,
+                        self.locks[a].line if a in self.locks else 0,
+                        "<lock-graph>",
+                        f"lock-order cycle between `{a}` and `{b}`: "
+                        f"[chain 1] {chain}; [chain 2] {back}",
+                    )
+                )
+
+    def _check_blocking(self, funcs: dict[str, _FuncInfo]) -> None:
+        for q, fi in funcs.items():
+            for line, held, desc in fi.blocking:
+                if not held:
+                    continue
+                self.findings.append(
+                    Finding(
+                        SY_RULES["SY002"],
+                        fi.path,
+                        line,
+                        q.split("::")[-1],
+                        f"blocking {desc} while holding {', '.join(held)}",
+                    )
+                )
+        # interprocedural: calls made while holding a lock, into functions
+        # whose closure contains blocking calls
+        blocking_any: dict[str, list[tuple[str, str]]] = {}
+        for q, fi in funcs.items():
+            items = [
+                (d, f"{fi.path}:{line}")
+                for line, d in [(l, d) for l, _h, d in fi.blocking]
+            ]
+            blocking_any[q] = items
+        full: dict[str, list[tuple[str, str]]] = {}
+
+        def collect(q: str, seen: set[str]) -> list[tuple[str, str]]:
+            if q in full:
+                return full[q]
+            if q in seen:
+                return []
+            seen.add(q)
+            out = list(blocking_any.get(q, ()))
+            for callee, _line, _held in funcs.get(q, _FuncInfo(q, "", None)).calls:
+                out.extend(collect(callee, seen))
+            full[q] = out[:4]
+            return full[q]
+
+        for q in list(funcs):
+            collect(q, set())
+        for q, fi in funcs.items():
+            for callee, line, held in fi.calls:
+                if not held or callee not in funcs:
+                    continue
+                for desc, site in full.get(callee, ()):
+                    self.findings.append(
+                        Finding(
+                            SY_RULES["SY002"],
+                            fi.path,
+                            line,
+                            q.split("::")[-1],
+                            f"call to {callee.split('::')[-1]} while holding "
+                            f"{', '.join(held)} reaches blocking {desc} "
+                            f"({site})",
+                        )
+                    )
+
+    def _check_shared_writes(self, funcs: dict[str, _FuncInfo]) -> None:
+        for m in self.modules:
+            # class-internal call graph: method -> same-class methods called
+            calls: dict[str, dict[str, set[str]]] = {}
+            for q, fi in m.funcs.items():
+                if fi.cls is None:
+                    continue
+                qual = q.split("::")[-1]
+                if "." not in qual:
+                    continue
+                cls, method = qual.rsplit(".", 1)
+                for callee, _l, _h in fi.calls:
+                    cq = callee.split("::")[-1]
+                    if cq.startswith(f"{cls}."):
+                        calls.setdefault(cls, {}).setdefault(method, set()).add(
+                            cq.rsplit(".", 1)[1]
+                        )
+            for cls in m.class_methods:
+                targets = m.thread_targets.get(cls, set())
+                roots: dict[str, set[str]] = {}
+                for t in targets:
+                    roots[f"thread:{t}"] = self._reach(calls.get(cls, {}), t)
+                api_entry = {
+                    meth
+                    for meth in m.class_methods[cls]
+                    if not meth.startswith("_") or meth in ("__enter__", "__exit__")
+                } - targets
+                api_reach: set[str] = set()
+                for meth in api_entry:
+                    api_reach |= self._reach(calls.get(cls, {}), meth)
+                if api_reach:
+                    roots["api"] = api_reach
+                if len(roots) < 2:
+                    continue
+                for (wcls, attr), writes in m.attr_writes.items():
+                    if wcls != cls:
+                        continue
+                    writer_roots = {
+                        rname
+                        for rname, reach in roots.items()
+                        for method, _line, _held in writes
+                        if method in reach
+                    }
+                    if len(writer_roots) < 2:
+                        continue
+                    common = None
+                    for _method, _line, held in writes:
+                        s = set(held)
+                        common = s if common is None else (common & s)
+                    guard = sorted(common)[0] if common else None
+                    role = m.role
+                    self.guards.setdefault(role, {})[f"{cls}.{attr}"] = guard
+                    if guard is None:
+                        wsites = ", ".join(
+                            f"{meth}:{line}" for meth, line, _h in writes[:4]
+                        )
+                        self.findings.append(
+                            Finding(
+                                SY_RULES["SY003"],
+                                m.relpath,
+                                writes[0][1],
+                                f"{cls}.{attr}",
+                                f"written from {len(writer_roots)} thread "
+                                f"entry points ({', '.join(sorted(writer_roots))}) "
+                                f"with no common guard; writes at {wsites}",
+                            )
+                        )
+
+    @staticmethod
+    def _reach(graph: dict[str, set[str]], start: str) -> set[str]:
+        seen, stack = set(), [start]
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return seen
+
+    def _check_reply_contexts(self, funcs: dict[str, _FuncInfo]) -> None:
+        handlers = {q for q, fi in funcs.items() if fi.receives}
+        changed = True
+        while changed:
+            changed = False
+            for q in list(handlers):
+                for callee, _l, _h in funcs.get(q, _FuncInfo(q, "", None)).calls:
+                    if callee in funcs and callee not in handlers:
+                        handlers.add(callee)
+                        changed = True
+        for m in self.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                qual = m._qualname(node)
+                q = f"{m.mod}::{qual}"
+                if q in handlers:
+                    continue
+                for sock, kind, line in m.sends_of(node):
+                    if kind in _REPLY_KINDS:
+                        m_find = Finding(
+                            SY_RULES["SY006"],
+                            m.relpath,
+                            line,
+                            qual,
+                            f"reply kind {kind} sent outside a request "
+                            f"handler (no recv_frame/recv_json on the call "
+                            f"path into {qual})",
+                        )
+                        self.findings.append(m_find)
+
+    def _apply_suppressions(self) -> None:
+        for f in self.findings:
+            just = SYNC_SUPPRESSIONS.get(
+                (f.path, f.qualname, f.rule.id)
+            ) or SYNC_SUPPRESSIONS.get((f.path, "*", f.rule.id))
+            if just:
+                f.suppressed = just
+
+    # -- views -----------------------------------------------------------------
+
+    @property
+    def active_findings(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed_findings(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+
+# -- entry points --------------------------------------------------------------
+
+
+def default_paths() -> list[str]:
+    return [str(_REPO / "sheeprl_tpu" / pkg) for pkg in DEFAULT_PACKAGES]
+
+
+def analyze_paths(paths: Optional[Iterable[str]] = None) -> ConcurrencyReport:
+    modules = []
+    for path in iter_python_files(paths or default_paths()):
+        p = Path(path).resolve()
+        try:
+            rel = str(p.relative_to(_REPO))
+        except ValueError:
+            rel = str(p)
+        with open(p, encoding="utf-8") as fh:
+            src = fh.read()
+        m = _ModuleAnalysis(str(p), rel, src)
+        m.collect_defs()
+        m.analyze_functions()
+        modules.append(m)
+    report = ConcurrencyReport(modules=modules)
+    report.link()
+    return report
+
+
+def analyze_source(source: str, relpath: str = "fixture.py") -> ConcurrencyReport:
+    """Single-source entry for tests/fixtures."""
+    m = _ModuleAnalysis(relpath, relpath, source)
+    m.collect_defs()
+    m.analyze_functions()
+    report = ConcurrencyReport(modules=[m])
+    report.link()
+    return report
+
+
+# -- ledger --------------------------------------------------------------------
+
+
+def ledger_path() -> Path:
+    return _REPO / "analysis" / "budget" / "concurrency.json"
+
+
+def build_ledger(report: ConcurrencyReport) -> dict:
+    roles: dict[str, dict] = {}
+    for m in report.modules:
+        role = roles.setdefault(
+            m.role, {"locks": {}, "threads": [], "guards": {}}
+        )
+        for ident, ld in sorted(m.locks.items()):
+            role["locks"][ident] = {
+                "kind": ld.kind,
+                "site": ld.site,
+                "backing": ld.backing,
+            }
+        for td in m.threads:
+            role["threads"].append(td.as_dict())
+    for role, guards in report.guards.items():
+        roles.setdefault(role, {"locks": {}, "threads": [], "guards": {}})[
+            "guards"
+        ] = dict(sorted(guards.items()))
+    for role in roles.values():
+        role["threads"].sort(key=lambda t: (t["path"], t["line"]))
+    edges = sorted([list(e) for e in report.edges])
+    lock_sites = {
+        ld.site: ld.ident for ld in sorted(report.locks.values(), key=lambda l: l.site)
+    }
+    canonical = json.dumps(
+        {
+            "edges": edges,
+            "guards": {r: roles[r]["guards"] for r in sorted(roles)},
+            "threads": sorted(
+                td.key() for m in report.modules for td in m.threads
+            ),
+        },
+        sort_keys=True,
+    )
+    fingerprint = hashlib.sha256(canonical.encode()).hexdigest()[:16]
+    return {
+        "concurrency": {
+            "version": 1,
+            "fingerprint": fingerprint,
+            "lock_order": {
+                "edges": edges,
+                "chains": {f"{a} -> {b}": c for (a, b), c in sorted(report.edges.items())},
+                "cycles": [list(c[:2]) for c in report.cycles],
+            },
+            "lock_sites": lock_sites,
+            "roles": {r: roles[r] for r in sorted(roles)},
+        }
+    }
+
+
+def save_ledger(ledger: dict, path: Optional[Path] = None) -> Path:
+    path = path or ledger_path()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(ledger, indent=2, sort_keys=True) + "\n")
+    return path
+
+
+def load_ledger(path: Optional[Path] = None) -> Optional[dict]:
+    path = path or ledger_path()
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def check_budget(current: dict, committed: Optional[dict]) -> list[str]:
+    """Drift gate: regressions of `current` vs the committed ledger.
+    Returns human-readable regression lines (empty = pass)."""
+    if committed is None:
+        return ["no committed ledger at analysis/budget/concurrency.json — run tools/sheepsync.py --update-budget"]
+    cur = current["concurrency"]
+    old = committed.get("concurrency", {})
+    out: list[str] = []
+    old_edges = {tuple(e) for e in old.get("lock_order", {}).get("edges", [])}
+    chains = cur["lock_order"].get("chains", {})
+    for e in cur["lock_order"]["edges"]:
+        if tuple(e) not in old_edges:
+            chain = chains.get(f"{e[0]} -> {e[1]}", "")
+            out.append(
+                f"new lock-order edge {e[0]} -> {e[1]}"
+                + (f" [{chain}]" if chain else "")
+            )
+    for cyc in cur["lock_order"].get("cycles", []):
+        out.append(f"lock-order cycle {cyc[0]} <-> {cyc[1]}")
+    old_roles = old.get("roles", {})
+    for role, data in cur.get("roles", {}).items():
+        old_guards = old_roles.get(role, {}).get("guards", {})
+        for attr, guard in data.get("guards", {}).items():
+            if guard is None and old_guards.get(attr, "absent") is not None:
+                out.append(
+                    f"newly unguarded shared write: {role}:{attr} "
+                    f"(no common lock dominates every writer)"
+                )
+        old_threads = {
+            (t["path"], t["name"], t["target"])
+            for t in old_roles.get(role, {}).get("threads", [])
+        }
+        for t in data.get("threads", []):
+            if (t["path"], t["name"], t["target"]) not in old_threads:
+                out.append(
+                    f"new undeclared thread {t['name']!r} "
+                    f"(target {t['target']}) at {t['path']}:{t['line']}"
+                )
+    return out
+
+
+# -- rendering -----------------------------------------------------------------
+
+
+def render_report(report: ConcurrencyReport) -> str:
+    lines = ["sheepsync lock-order report", "=" * 60]
+    by_role: dict[str, list[LockDef]] = {}
+    for m in report.modules:
+        by_role.setdefault(m.role, []).extend(m.locks.values())
+    for role in sorted(by_role):
+        lines.append(f"\n[{role}] locks:")
+        for ld in sorted(by_role[role], key=lambda l: l.ident):
+            extra = f" on {ld.backing}" if ld.backing else ""
+            lines.append(f"  {ld.ident:55s} {ld.kind}{extra}  ({ld.site})")
+    lines.append("\nlock-order edges (outer -> inner):")
+    if not report.edges:
+        lines.append("  (none)")
+    for (a, b), chain in sorted(report.edges.items()):
+        lines.append(f"  {a} -> {b}")
+        lines.append(f"      {chain}")
+    if report.cycles:
+        lines.append("\nCYCLES:")
+        for a, b, c1, c2 in report.cycles:
+            lines.append(f"  {a} <-> {b}")
+            lines.append(f"      chain 1: {c1}")
+            lines.append(f"      chain 2: {c2}")
+    lines.append("\nthreads:")
+    for m in report.modules:
+        for td in m.threads:
+            j = "joined" if td.joined else "unjoined"
+            d = {True: "daemon", False: "non-daemon", None: "daemon?"}[td.daemon]
+            lines.append(
+                f"  {td.name:28s} target={td.target:40s} {d:11s} {j}  "
+                f"({td.path}:{td.line})"
+            )
+    lines.append("\nguard map (attributes written from >=2 thread roots):")
+    for role in sorted(report.guards):
+        for attr, guard in sorted(report.guards[role].items()):
+            lines.append(f"  [{role}] {attr:45s} -> {guard or 'UNGUARDED'}")
+    return "\n".join(lines)

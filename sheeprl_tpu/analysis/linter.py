@@ -1166,6 +1166,78 @@ def _check_sl013(a: _FileAnalysis) -> None:
     run(a.tree.body, set())
 
 
+def _check_sl014(a: _FileAnalysis) -> None:
+    """Anonymous threads (ISSUE 18): a direct `threading.Thread(...)` call
+    must pass BOTH `name=` (sheeptrace/sheepsync attribution is keyed by
+    thread name) and `daemon=` (the inherited flag makes shutdown behavior
+    an accident of the spawning thread). `threading.Timer(...)` takes no
+    daemon kwarg, so its stored handle needs a `.daemon =` assignment in
+    the same scope before `start()`. Thread *subclass* constructions are
+    exempt — the subclass' own __init__ (a `super().__init__(...)` call,
+    which `_dotted` cannot resolve anyway) makes the decision once."""
+    # scope -> names Timer handles are stored under / names with .daemon set
+    timer_stores: dict[ast.AST, list[tuple[ast.Call, str]]] = {}
+    daemon_sets: dict[ast.AST, set[str]] = {}
+
+    def scope_of(node: ast.AST) -> ast.AST:
+        for p in a._parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return p
+        return a.tree
+
+    def store_name(target: ast.expr) -> Optional[str]:
+        # `t = Timer(...)` and `self._timer = Timer(...)` both count
+        if isinstance(target, ast.Name):
+            return target.id
+        if isinstance(target, ast.Attribute):
+            return a._dotted(target)
+        return None
+
+    for n in ast.walk(a.tree):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            tgt = n.targets[0]
+            if (
+                isinstance(tgt, ast.Attribute)
+                and tgt.attr == "daemon"
+                and (base := a._dotted(tgt.value)) is not None
+            ):
+                daemon_sets.setdefault(scope_of(n), set()).add(base)
+            if isinstance(n.value, ast.Call):
+                d = a._dotted(n.value.func)
+                if d in ("threading.Timer", "Timer") and (
+                    nm := store_name(tgt)
+                ):
+                    timer_stores.setdefault(scope_of(n), []).append(
+                        (n.value, nm)
+                    )
+        if not isinstance(n, ast.Call):
+            continue
+        d = a._dotted(n.func)
+        if d in ("threading.Thread", "Thread"):
+            kwargs = {kw.arg for kw in n.keywords}
+            missing = [k for k in ("name", "daemon") if k not in kwargs]
+            if missing:
+                a.report(
+                    "SL014", n,
+                    "threading.Thread constructed without explicit "
+                    f"{' or '.join(f'`{m}=`' for m in missing)} — unnamed "
+                    "threads break sheeptrace/sheepsync attribution and an "
+                    "inherited daemon flag makes shutdown behavior an "
+                    "accident of the spawner",
+                )
+
+    for scope, stores in timer_stores.items():
+        have = daemon_sets.get(scope, set())
+        for call, nm in stores:
+            if nm not in have:
+                a.report(
+                    "SL014", call,
+                    f"threading.Timer stored as `{nm}` never gets a "
+                    "`.daemon =` decision in this scope — set "
+                    f"`{nm}.daemon = True` (or False) before start()",
+                )
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -1189,6 +1261,7 @@ def lint_source(
     _check_sl011(analysis)
     _check_sl012(analysis)
     _check_sl013(analysis)
+    _check_sl014(analysis)
     for ctx in analysis._top_level_contexts():
         _check_sl002(analysis, ctx)
         _check_sl003(analysis, ctx)

@@ -285,6 +285,28 @@ _RULES = [
             "device-buffer send gets a justified suppression"
         ),
     ),
+    Rule(
+        id="SL014",
+        name="anonymous-thread",
+        severity=WARNING,
+        summary=(
+            "threading.Thread constructed without an explicit `name=` or "
+            "without an explicit `daemon=` decision (or a threading.Timer "
+            "whose stored handle never gets a `.daemon =` assignment). An "
+            "unnamed thread breaks sheeptrace/sheepsync role attribution — "
+            "every telemetry event, lock acquisition and violation record "
+            "is keyed by thread name — and an implicit daemon flag "
+            "inherits from the spawner, so whether the thread can block "
+            "interpreter shutdown is an accident of call site (ISSUE 18: "
+            "the thread inventory in the concurrency ledger needs both)"
+        ),
+        autofix=(
+            "pass name='<role>-<purpose>' and an explicit daemon=True/"
+            "False to the constructor; for Timer (no daemon kwarg) set "
+            "`t.daemon = True` on the stored handle before start(); "
+            "Thread subclasses decide both in their own __init__"
+        ),
+    ),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

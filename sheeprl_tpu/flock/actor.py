@@ -102,8 +102,14 @@ class WeightFetcher(threading.Thread):
             leaves, self._leaves = self._leaves, None
             return (self.version, leaves) if leaves is not None else (None, None)
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: float = 5.0) -> None:
+        """Signal the poll loop and join it (bounded — the socket ops all
+        carry timeouts, so the loop observes the event within one poll
+        interval; sheepsync satellite: no unjoined thread survives the
+        actor's shutdown path)."""
         self._stop.set()
+        if self.is_alive():
+            self.join(timeout=join_timeout)
 
     def run(self) -> None:
         sock = None

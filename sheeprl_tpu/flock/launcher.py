@@ -95,6 +95,10 @@ class ActorFleet:
         self._adopted: dict[int, int] = {}  # actor_id -> orphan pid
         self._respawns: dict[int, int] = {i: 0 for i in range(self.n_actors)}
         self._logs: dict[int, object] = {}
+        # guards _procs/_adopted/_respawns/_logs: handle_eviction arrives on
+        # the ReplayService monitor thread while _monitor_loop mutates the
+        # same tables (sheepsync SY003). Never held across Popen/kill/wait.
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._monitor: threading.Thread | None = None
         os.makedirs(os.path.join(log_dir, "flock"), exist_ok=True)
@@ -117,36 +121,42 @@ class ActorFleet:
         """Track a surviving pre-crash actor process this fleet did not
         spawn, so `close()` still tears it down with the rest."""
         if pid > 0:
-            self._adopted[actor_id] = pid
+            with self._lock:
+                self._adopted[actor_id] = pid
             self._event("flock.actor_adopted", actor_id=actor_id, pid=pid)
 
     def handle_eviction(self, actor_id: int) -> None:
         """`ReplayService.on_evict` hook: a heartbeat-stale actor is
         treated like a death — kill the wedged process (the monitor loop
         then applies the normal respawn budget)."""
-        proc = self._procs.get(actor_id)
+        with self._lock:
+            proc = self._procs.get(actor_id)
         if proc is not None and proc.poll() is None:
             proc.kill()
             return
-        pid = self._adopted.pop(actor_id, None)
-        if pid is not None:
-            self._kill_pid(pid)
-            # an adopted orphan has no Popen handle for the monitor loop:
-            # respawn it here under the same budget
-            if self._respawns[actor_id] < self._max_respawns:
+        # an adopted orphan has no Popen handle for the monitor loop:
+        # respawn it here under the same budget. Budget bookkeeping under
+        # the lock; the kill and respawn on the local copies outside it.
+        with self._lock:
+            pid = self._adopted.pop(actor_id, None)
+            respawn = pid is not None and (
+                self._respawns[actor_id] < self._max_respawns
+            )
+            if respawn:
                 self._respawns[actor_id] += 1
-                self._spawn(actor_id, first=False)
-                self._event(
-                    "flock.actor_respawned",
-                    actor_id=actor_id,
-                    attempt=self._respawns[actor_id],
-                )
-            else:
-                self._event(
-                    "flock.actor_abandoned",
-                    actor_id=actor_id,
-                    respawns=self._respawns[actor_id],
-                )
+            attempt = self._respawns[actor_id]
+        if pid is None:
+            return
+        self._kill_pid(pid)
+        if respawn:
+            self._spawn(actor_id, first=False)
+            self._event(
+                "flock.actor_respawned", actor_id=actor_id, attempt=attempt
+            )
+        else:
+            self._event(
+                "flock.actor_abandoned", actor_id=actor_id, respawns=attempt
+            )
 
     @staticmethod
     def _kill_pid(pid: int) -> None:
@@ -165,20 +175,27 @@ class ActorFleet:
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=2.0)
-        for proc in self._procs.values():
+        # snapshot under the lock, tear down on the snapshot: the monitor
+        # thread is joined above, but handle_eviction can still arrive from
+        # the service's monitor thread until the service itself closes
+        with self._lock:
+            procs = list(self._procs.values())
+            adopted = list(self._adopted.values())
+            logs = list(self._logs.values())
+        for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
         deadline = time.monotonic() + 5.0
-        for proc in self._procs.values():
+        for proc in procs:
             left = max(deadline - time.monotonic(), 0.1)
             try:
                 proc.wait(timeout=left)
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5.0)
-        for pid in self._adopted.values():
+        for pid in adopted:
             self._kill_pid(pid)
-        for fh in self._logs.values():
+        for fh in logs:
             try:
                 fh.close()
             except OSError:
@@ -223,52 +240,63 @@ class ActorFleet:
             self.log_dir, "flock", f"actor{actor_id}.log"
         )
         fh = open(log_path, "ab")
-        old = self._logs.get(actor_id)
-        self._logs[actor_id] = fh
-        if old is not None:
-            try:
-                old.close()
-            except OSError:
-                pass
-        self._procs[actor_id] = subprocess.Popen(
+        proc = subprocess.Popen(
             [sys.executable, "-m", "sheeprl_tpu.flock.actor"],
             env=env,
             stdout=fh,
             stderr=subprocess.STDOUT,
             cwd=str(_REPO),
         )
+        with self._lock:
+            old = self._logs.get(actor_id)
+            self._logs[actor_id] = fh
+            self._procs[actor_id] = proc
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
 
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():
-            for actor_id, proc in list(self._procs.items()):
+            with self._lock:
+                snapshot = list(self._procs.items())
+            for actor_id, proc in snapshot:
                 rc = proc.poll()
                 if rc is None:
                     continue
                 self._event("flock.actor_died", actor_id=actor_id, rc=rc)
-                if rc == 0:
-                    # clean exit (service closed under it): nothing to heal
-                    del self._procs[actor_id]
-                    continue
-                if self._respawns[actor_id] >= self._max_respawns:
+                with self._lock:
+                    if rc == 0 or self._respawns[actor_id] >= self._max_respawns:
+                        # clean exit (service closed under it) or budget
+                        # exhausted: nothing to heal
+                        self._procs.pop(actor_id, None)
+                        respawn = False
+                    else:
+                        self._respawns[actor_id] += 1
+                        respawn = True
+                    attempt = self._respawns[actor_id]
+                if respawn:
+                    self._spawn(actor_id, first=False)
+                    self._event(
+                        "flock.actor_respawned",
+                        actor_id=actor_id,
+                        attempt=attempt,
+                    )
+                elif rc != 0:
                     self._event(
                         "flock.actor_abandoned",
                         actor_id=actor_id,
-                        respawns=self._respawns[actor_id],
+                        respawns=attempt,
                     )
-                    del self._procs[actor_id]
-                    continue
-                self._respawns[actor_id] += 1
-                self._spawn(actor_id, first=False)
-                self._event(
-                    "flock.actor_respawned",
-                    actor_id=actor_id,
-                    attempt=self._respawns[actor_id],
-                )
             self._stop.wait(_POLL_S)
 
     def alive(self) -> int:
-        n = sum(1 for p in self._procs.values() if p.poll() is None)
-        for pid in self._adopted.values():
+        with self._lock:
+            procs = list(self._procs.values())
+            adopted = list(self._adopted.values())
+        n = sum(1 for p in procs if p.poll() is None)
+        for pid in adopted:
             try:
                 os.kill(pid, 0)
             except OSError:

@@ -667,6 +667,8 @@ class ReplayService:
         want = self.n_actors if n is None else n
         deadline = time.monotonic() + timeout
         with self._membership:
+            # SY005: every wait below re-checks its predicate in the while
+            # head — a spurious or stale notify can never satisfy the wait
             while self.actors_alive() < want:
                 left = deadline - time.monotonic()
                 if left <= 0 or self._stop.is_set():

@@ -177,6 +177,8 @@ class MicroBatcher:
     def _loop(self) -> None:
         while True:
             with self._cond:
+                # SY005: both waits re-check their predicate in the while
+                # head — spurious wakeups and stale notifies are harmless
                 while not self._queue and not self._closed:
                     self._cond.wait(0.05)
                 if self._closed:

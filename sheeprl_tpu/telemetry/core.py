@@ -180,6 +180,16 @@ class Telemetry:
         the shared run id into the `start` event."""
         from .trace import ensure_run_id
 
+        # sheepsync (ISSUE 18): the runtime thread sanitizer is installed
+        # as early as possible so locks allocated by this process are
+        # instrumented; its Sync/* gauges ride every telemetry interval
+        from ..analysis import thread_sanitizer
+
+        if getattr(args, "sanitize_threads", False):
+            thread_sanitizer.install()
+        else:
+            thread_sanitizer.maybe_install_from_env()
+
         enabled = os.environ.get("SHEEPRL_TPU_TELEMETRY", "1") != "0"
         telem = cls(
             log_dir, rank=rank, algo=algo, enabled=enabled,
@@ -207,6 +217,16 @@ class Telemetry:
                 role=telem.role,
                 run=telem.run_id,
                 compile_tracking=telem._compiles.supported,
+            )
+        san = thread_sanitizer.installed()
+        if san is not None:
+            telem.add_gauges(thread_sanitizer.gauges)
+            # install() ran before this instance existed, so its start
+            # marker found no sink — re-emit through the live instance
+            telem.event(
+                "sync.sanitizer_start",
+                committed_edges=len(san.committed),
+                lock_sites=len(san.sites),
             )
         return telem
 

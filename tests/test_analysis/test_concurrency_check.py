@@ -1,0 +1,420 @@
+"""sheepsync static rules (ISSUE 18): per-rule known-bad fixtures vs clean
+controls, suppression honoring, the concurrency ledger round-trip, and the
+--check-budget drift gate (injected cycle / unguarded write / new thread).
+Pure AST — no jax import, mirrors test_jaxpr_check's fixture style."""
+
+from sheeprl_tpu.analysis import concurrency_check as cc
+
+FLOCK_FIXTURE = "sheeprl_tpu/flock/fixture.py"
+
+
+def _ids(report):
+    return [f.rule.id for f in report.active_findings]
+
+
+def _analyze(src):
+    return cc.analyze_source(src, relpath=FLOCK_FIXTURE)
+
+
+# ---------------------------------------------------------------------------
+# SY001: lock-order cycles
+# ---------------------------------------------------------------------------
+
+SY001_BAD = """
+import threading
+_A = threading.Lock()
+_B = threading.Lock()
+
+def f():
+    with _A:
+        with _B:
+            pass
+
+def g():
+    with _B:
+        with _A:
+            pass
+"""
+
+SY001_CLEAN = """
+import threading
+_A = threading.Lock()
+_B = threading.Lock()
+
+def f():
+    with _A:
+        with _B:
+            pass
+
+def g():
+    with _A:
+        with _B:
+            pass
+"""
+
+
+def test_sy001_cycle_detected_with_both_chains():
+    report = _analyze(SY001_BAD)
+    findings = [f for f in report.active_findings if f.rule.id == "SY001"]
+    assert findings, _ids(report)
+    msg = findings[0].message
+    assert "[chain 1]" in msg and "[chain 2]" in msg
+    assert report.cycles
+
+
+def test_sy001_consistent_order_is_clean():
+    report = _analyze(SY001_CLEAN)
+    assert "SY001" not in _ids(report)
+    assert ("flock.fixture._A", "flock.fixture._B") in report.edges
+
+
+def test_sy001_self_deadlock_through_helper():
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        with self._lock:
+            self._g()
+
+    def _g(self):
+        with self._lock:
+            pass
+"""
+    report = _analyze(src)
+    findings = [f for f in report.active_findings if f.rule.id == "SY001"]
+    assert findings and "self-deadlock" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# SY002: blocking call under a held lock
+# ---------------------------------------------------------------------------
+
+SY002_BAD = """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        with self._lock:
+            time.sleep(0.5)
+"""
+
+SY002_CLEAN = """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        time.sleep(0.5)
+        with self._lock:
+            pass
+"""
+
+
+def test_sy002_sleep_under_lock():
+    assert "SY002" in _ids(_analyze(SY002_BAD))
+
+
+def test_sy002_sleep_outside_lock_is_clean():
+    assert "SY002" not in _ids(_analyze(SY002_CLEAN))
+
+
+def test_sy002_interprocedural_reaches_blocking():
+    src = """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        with self._lock:
+            self._g()
+
+    def _g(self):
+        time.sleep(0.5)
+"""
+    report = _analyze(src)
+    findings = [f for f in report.active_findings if f.rule.id == "SY002"]
+    assert findings, _ids(report)
+    assert "reaches" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# SY003: unguarded shared writes
+# ---------------------------------------------------------------------------
+
+SY003_BAD = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._loop, name="c-loop", daemon=True)
+
+    def _loop(self):
+        self.count += 1
+
+    def bump(self):
+        self.count += 1
+"""
+
+SY003_CLEAN = SY003_BAD.replace(
+    "        self.count += 1",
+    "        with self._lock:\n            self.count += 1",
+)
+
+
+def test_sy003_unguarded_shared_write():
+    report = _analyze(SY003_BAD)
+    findings = [f for f in report.active_findings if f.rule.id == "SY003"]
+    assert findings, _ids(report)
+    assert "thread:_loop" in findings[0].message
+    assert report.guards["flock"]["C.count"] is None
+
+
+def test_sy003_guarded_write_is_clean_and_mapped():
+    report = _analyze(SY003_CLEAN)
+    assert "SY003" not in _ids(report)
+    assert report.guards["flock"]["C.count"] == "flock.fixture.C._lock"
+
+
+# ---------------------------------------------------------------------------
+# SY004: manual acquire without try/finally release
+# ---------------------------------------------------------------------------
+
+SY004_BAD = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        self._lock.acquire()
+        self.x = 1
+        self._lock.release()
+"""
+
+SY004_CLEAN = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        self._lock.acquire()
+        try:
+            self.x = 1
+        finally:
+            self._lock.release()
+"""
+
+
+def test_sy004_bare_acquire():
+    assert "SY004" in _ids(_analyze(SY004_BAD))
+
+
+def test_sy004_try_finally_is_clean():
+    assert "SY004" not in _ids(_analyze(SY004_CLEAN))
+
+
+# ---------------------------------------------------------------------------
+# SY005: Condition.wait outside a predicate loop
+# ---------------------------------------------------------------------------
+
+SY005_BAD = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.ready = False
+
+    def f(self):
+        with self._cond:
+            self._cond.wait(1.0)
+"""
+
+SY005_CLEAN = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.ready = False
+
+    def f(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(0.1)
+"""
+
+
+def test_sy005_wait_without_loop():
+    assert "SY005" in _ids(_analyze(SY005_BAD))
+
+
+def test_sy005_predicate_loop_is_clean():
+    assert "SY005" not in _ids(_analyze(SY005_CLEAN))
+
+
+# ---------------------------------------------------------------------------
+# SY006: FLK1 protocol sequencing
+# ---------------------------------------------------------------------------
+
+SY006_FRESH_BAD = """
+from sheeprl_tpu.flock import wire
+
+def push_first(addr):
+    sock = wire.connect(addr)
+    wire.send_json(sock, wire.PUSH, {})
+"""
+
+SY006_FRESH_CLEAN = """
+from sheeprl_tpu.flock import wire
+
+def hello_first(addr):
+    sock = wire.connect(addr)
+    wire.send_json(sock, wire.HELLO, {})
+    wire.send_json(sock, wire.PUSH, {})
+"""
+
+SY006_REPLY_BAD = """
+from sheeprl_tpu.flock import wire
+
+def rogue(sock):
+    wire.send_frame(sock, wire.WELCOME, b"")
+"""
+
+SY006_REPLY_CLEAN = """
+from sheeprl_tpu.flock import wire
+
+def handler(sock):
+    kind, payload = wire.recv_frame(sock)
+    wire.send_frame(sock, wire.WELCOME, b"")
+"""
+
+
+def test_sy006_fresh_socket_must_open_with_hello():
+    report = _analyze(SY006_FRESH_BAD)
+    findings = [f for f in report.active_findings if f.rule.id == "SY006"]
+    assert findings and "HELLO" in findings[0].message
+
+
+def test_sy006_hello_first_is_clean():
+    assert "SY006" not in _ids(_analyze(SY006_FRESH_CLEAN))
+
+
+def test_sy006_reply_kind_outside_handler():
+    report = _analyze(SY006_REPLY_BAD)
+    findings = [f for f in report.active_findings if f.rule.id == "SY006"]
+    assert findings and "WELCOME" in findings[0].message
+
+
+def test_sy006_reply_inside_handler_is_clean():
+    assert "SY006" not in _ids(_analyze(SY006_REPLY_CLEAN))
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_with_justification_downgrades_finding(monkeypatch):
+    monkeypatch.setitem(
+        cc.SYNC_SUPPRESSIONS,
+        (FLOCK_FIXTURE, "C.f", "SY002"),
+        "test: sleep under lock is the fixture's point",
+    )
+    report = _analyze(SY002_BAD)
+    assert "SY002" not in _ids(report)
+    sup = [f for f in report.suppressed_findings if f.rule.id == "SY002"]
+    assert sup and sup[0].suppressed.startswith("test:")
+
+
+# ---------------------------------------------------------------------------
+# Ledger + drift gate
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_roundtrip(tmp_path):
+    ledger = cc.build_ledger(_analyze(SY003_CLEAN))
+    path = cc.save_ledger(ledger, tmp_path / "concurrency.json")
+    loaded = cc.load_ledger(path)
+    assert loaded == ledger
+    assert loaded["concurrency"]["fingerprint"]
+    assert "flock" in loaded["concurrency"]["roles"]
+
+
+def test_check_budget_flags_injected_cycle():
+    committed = cc.build_ledger(_analyze(SY001_CLEAN))
+    current = cc.build_ledger(_analyze(SY001_BAD))
+    regs = cc.check_budget(current, committed)
+    assert any("new lock-order edge" in r for r in regs)
+    assert any("cycle" in r for r in regs)
+
+
+def test_check_budget_flags_newly_unguarded_write():
+    committed = cc.build_ledger(_analyze(SY003_CLEAN))
+    current = cc.build_ledger(_analyze(SY003_BAD))
+    regs = cc.check_budget(current, committed)
+    assert any("newly unguarded shared write" in r for r in regs)
+
+
+def test_check_budget_flags_new_undeclared_thread():
+    extra = SY003_CLEAN + """
+def spawn_extra():
+    t = threading.Thread(target=print, name="rogue", daemon=True)
+    t.start()
+"""
+    committed = cc.build_ledger(_analyze(SY003_CLEAN))
+    current = cc.build_ledger(_analyze(extra))
+    regs = cc.check_budget(current, committed)
+    assert any("new undeclared thread" in r for r in regs)
+
+
+def test_check_budget_identical_is_clean():
+    ledger = cc.build_ledger(_analyze(SY003_CLEAN))
+    assert cc.check_budget(ledger, ledger) == []
+
+
+def test_check_budget_requires_committed_ledger():
+    regs = cc.check_budget(cc.build_ledger(_analyze(SY001_CLEAN)), None)
+    assert regs and "--update-budget" in regs[0]
+
+
+# ---------------------------------------------------------------------------
+# The repo itself (ISSUE 18 acceptance: clean at HEAD, ledger current)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_sheepsync_clean_and_ledger_current():
+    report = cc.analyze_paths()
+    assert not report.active_findings, "\n" + "\n".join(
+        f.format() for f in report.active_findings
+    )
+    # every suppression that fired carries a justification
+    for f in report.suppressed_findings:
+        assert f.suppressed
+    regs = cc.check_budget(cc.build_ledger(report), cc.load_ledger())
+    assert not regs, "\n".join(regs)
+    # acceptance: the committed ledger covers flock+serve+telemetry
+    roles = cc.load_ledger()["concurrency"]["roles"]
+    for role in ("flock", "serve", "telemetry"):
+        assert role in roles

@@ -756,7 +756,7 @@ def test_sl013_suppression_with_justification():
 def test_rule_catalog_complete():
     assert rule_ids() == [
         "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
-        "SL008", "SL009", "SL010", "SL011", "SL012", "SL013",
+        "SL008", "SL009", "SL010", "SL011", "SL012", "SL013", "SL014",
     ]
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")

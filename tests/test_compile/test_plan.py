@@ -231,7 +231,7 @@ def test_wait_timeout_returns_false():
     e_fn = jax.jit(lambda x: x)
     plan.register("never", e_fn, example=lambda: (sds((2,), jnp.float32),))
     # start() NOT called: entries pending forever
-    t = threading.Thread(target=lambda: None)
+    t = threading.Thread(target=lambda: None, name="test-noop", daemon=True)
     t.start(); t.join()
     assert plan.wait(timeout=0.1) is False
     plan.close()

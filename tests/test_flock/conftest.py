@@ -1,0 +1,30 @@
+"""Run the flock suite under the sheepsync runtime thread sanitizer.
+
+Every Lock/RLock/Condition allocated while these tests run is
+instrumented: per-thread acquisition order is recorded and asserted
+against the committed lock-order ledger
+(`analysis/budget/concurrency.json`). Violations never raise — they are
+collected and printed at teardown so the suite stays deterministic —
+but the instrumentation itself exercising the full flock path IS the
+receipt that the static DAG matches the live system (ISSUE 18).
+
+CI additionally exports SHEEPRL_TPU_SANITIZE_THREADS=1 so the actor
+*subprocesses* spawned by these tests self-instrument too (the learner
+process' sanitizer cannot see their locks).
+"""
+
+import pytest
+
+from sheeprl_tpu.analysis import thread_sanitizer
+
+
+@pytest.fixture(scope="package", autouse=True)
+def _sheepsync_sanitizer():
+    san = thread_sanitizer.install()
+    yield san
+    summary = thread_sanitizer.uninstall()
+    if summary and summary["violations"]:
+        print(
+            "\n[sheepsync] lock-order violations observed during the flock "
+            f"suite: {summary['violations']}"
+        )

@@ -124,7 +124,7 @@ def test_connect_tcp_and_unix(tmp_path):
         got["frame"] = wire.recv_frame(conn)
         conn.close()
 
-    t = threading.Thread(target=_accept)
+    t = threading.Thread(target=_accept, name="test-accept-tcp", daemon=True)
     t.start()
     c = wire.connect(addr, timeout=5.0)
     wire.send_frame(c, wire.HELLO, b"hi")
@@ -138,7 +138,7 @@ def test_connect_tcp_and_unix(tmp_path):
     srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     srv.bind(path)
     srv.listen(1)
-    t = threading.Thread(target=_accept)
+    t = threading.Thread(target=_accept, name="test-accept-unix", daemon=True)
     t.start()
     c = wire.connect(wire.format_address("unix", path), timeout=5.0)
     wire.send_frame(c, wire.HELLO, b"hi")

@@ -1,0 +1,23 @@
+"""Run the serve suite under the sheepsync runtime thread sanitizer.
+
+Same contract as tests/test_flock/conftest.py: instrumented
+Lock/RLock/Condition wrappers assert per-thread acquisition order
+against the committed ledger while the batcher/server/hot-reload tests
+run; violations are collected (never raised) and printed at teardown.
+"""
+
+import pytest
+
+from sheeprl_tpu.analysis import thread_sanitizer
+
+
+@pytest.fixture(scope="package", autouse=True)
+def _sheepsync_sanitizer():
+    san = thread_sanitizer.install()
+    yield san
+    summary = thread_sanitizer.uninstall()
+    if summary and summary["violations"]:
+        print(
+            "\n[sheepsync] lock-order violations observed during the serve "
+            f"suite: {summary['violations']}"
+        )

@@ -193,7 +193,12 @@ def test_threaded_loop_serves_concurrent_submitters():
     def work(i):
         p = b.submit(_obs(1, fill=float(i)))
         results.append((i, p.wait(timeout=10.0)["actions"][0, 0]))
-    threads = [threading.Thread(target=work, args=(i,)) for i in range(16)]
+    threads = [
+        threading.Thread(
+            target=work, args=(i,), name=f"test-submit-{i}", daemon=True
+        )
+        for i in range(16)
+    ]
     for t in threads:
         t.start()
     for t in threads:

@@ -68,7 +68,9 @@ class _ScriptedServer:
         self._srv.bind(("127.0.0.1", 0))
         self._srv.listen(8)
         self.address = f"tcp:127.0.0.1:{self._srv.getsockname()[1]}"
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._loop, name="test-flaky-service", daemon=True
+        )
         self._thread.start()
 
     def _loop(self):

@@ -124,7 +124,10 @@ def test_hot_reload_zero_dropped_requests(sac_policy):
 
     try:
         threads = [
-            threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+            threading.Thread(
+                target=worker, args=(t,), name=f"test-client-{t}", daemon=True
+            )
+            for t in range(n_threads)
         ]
         for t in threads:
             t.start()

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""sheepsync — static concurrency & wire-protocol analysis CLI (ISSUE 18).
+
+The host-side sibling of sheeplint/sheepcheck/sheepshard/sheepmem: lock
+graphs, thread inventories and FLK1 protocol sequencing for the threaded
+runtime tiers (flock, serve, telemetry, resilience, parallel, compile).
+
+Usage:
+
+    python tools/sheepsync.py                  # sweep the six packages
+    python tools/sheepsync.py --report         # print the lock-order report
+    python tools/sheepsync.py --list-rules
+    python tools/sheepsync.py --update-budget  # write analysis/budget/concurrency.json
+    python tools/sheepsync.py --check-budget   # CI drift gate vs the ledger
+    python tools/sheepsync.py --json path/     # machine-readable findings
+
+Exit codes: 0 clean, 1 findings or budget regressions, 2 usage error.
+Pure AST + JSON: no jax import, safe for the no-accelerator CI lane.
+"""
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from sheeprl_tpu.analysis import concurrency_check as cc  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sheepsync", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: the six runtime packages)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--select", help="comma-separated rule ids (e.g. SY001,SY003)")
+    ap.add_argument("--json", action="store_true", help="findings as JSON lines")
+    ap.add_argument("--report", action="store_true", help="print the lock-order report")
+    ap.add_argument("--update-budget", action="store_true",
+                    help="rewrite the committed concurrency ledger")
+    ap.add_argument("--check-budget", action="store_true",
+                    help="fail on lock-graph drift vs the committed ledger")
+    ap.add_argument("--budget", help="ledger path override (default analysis/budget/concurrency.json)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in cc.SY_RULES.values():
+            print(f"{rule.id}  {rule.name:28s} [{rule.severity}]")
+            print(f"       {rule.summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {r.strip().upper() for r in args.select.split(",") if r.strip()}
+        unknown = select - set(cc.SY_RULES)
+        if unknown:
+            print(f"sheepsync: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"sheepsync: no such path: {p}", file=sys.stderr)
+            return 2
+
+    report = cc.analyze_paths(args.paths or None)
+    budget_path = Path(args.budget) if args.budget else cc.ledger_path()
+
+    if args.report:
+        print(cc.render_report(report))
+
+    if args.update_budget:
+        path = cc.save_ledger(cc.build_ledger(report), budget_path)
+        ledger = cc.load_ledger(path)
+        print(f"sheepsync: wrote {path} "
+              f"(fingerprint {ledger['concurrency']['fingerprint']}, "
+              f"{len(ledger['concurrency']['lock_order']['edges'])} edges)")
+
+    rc = 0
+    findings = report.findings
+    if select:
+        findings = [f for f in findings if f.rule.id in select]
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    if args.json:
+        for f in findings:
+            print(json.dumps(f.as_dict()))
+    else:
+        for f in active:
+            print(f.format())
+    if active:
+        print(f"sheepsync: {len(active)} finding(s) "
+              f"({len(suppressed)} suppressed)", file=sys.stderr)
+        rc = 1
+    elif not args.json and not args.report and not args.update_budget:
+        print(f"sheepsync: clean ({len(suppressed)} suppressed, "
+              f"{len(report.edges)} lock-order edges, "
+              f"{len(report.threads)} threads)")
+
+    if args.check_budget:
+        regressions = cc.check_budget(
+            cc.build_ledger(report), cc.load_ledger(budget_path)
+        )
+        if regressions:
+            print("sheepsync: concurrency budget regressions:", file=sys.stderr)
+            for r in regressions:
+                print(f"  - {r}", file=sys.stderr)
+            rc = 1
+        else:
+            print("sheepsync: budget OK (lock graph matches the committed ledger)")
+
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

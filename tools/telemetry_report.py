@@ -134,6 +134,10 @@ def summarize(events: list[dict]) -> dict:
         # ISSUE 16 distributed fault tolerance (sheepchaos)
         "serve_events": [],         # serve.* hardening events (conn_error,
                                     # draining/drained, client_close_error)
+        # ISSUE 18 sheepsync runtime thread sanitizer
+        "sync_events": [],          # sync.* events (order_violation,
+                                    # sanitizer_start/stop)
+        "sync_gauges": {},          # last Sync/* gauge values
     }
     for ev in events:
         ts = ev.get("ts")
@@ -187,6 +191,8 @@ def summarize(events: list[dict]) -> dict:
             summary["serve_ladder"].append(ev)
         elif isinstance(kind, str) and kind.startswith("serve."):
             summary["serve_events"].append(ev)
+        elif isinstance(kind, str) and kind.startswith("sync."):
+            summary["sync_events"].append(ev)
         elif kind == "log":
             summary["log_events"] += 1
             if ev.get("step") is not None:
@@ -222,6 +228,8 @@ def summarize(events: list[dict]) -> dict:
                     summary["fault_gauges"][k] = v
                 elif k.startswith("Serve/"):
                     summary["serve_gauges"][k] = v
+                elif k.startswith("Sync/"):
+                    summary["sync_gauges"][k] = v
                 elif k.startswith("Flock/"):
                     summary["flock_gauges"][k] = v
                     parts = k.split("/")
@@ -275,6 +283,12 @@ def load_memory_ledger(path: str | None = None) -> dict:
     """The committed sheepmem `memory` section (ISSUE 10)."""
     (memory,) = load_ledger_sections(("memory",), path)
     return memory
+
+
+def load_concurrency_ledger(path: str | None = None) -> dict:
+    """The committed sheepsync `concurrency` section (ISSUE 18)."""
+    (concurrency,) = load_ledger_sections(("concurrency",), path)
+    return concurrency
 
 
 def load_decision_cache(path: str | None = None) -> dict:
@@ -464,6 +478,109 @@ def render_memory_budget(
             f"vs static max peak {_fmt_wire(static_peak)} "
             f"({ratio:.1f}x — buffers + executables beyond any single jit)"
         )
+    return "\n".join(lines)
+
+
+def render_concurrency(conc: dict, summary: dict) -> str:
+    """The sheepsync concurrency section (ISSUE 18): the committed lock
+    graph, guard map and thread inventory from the ledger, merged with the
+    run's live `Sync/*` sanitizer gauges and any `sync.order_violation`
+    timeline. Either side may be empty — ledger-only (no sanitized run) and
+    run-only (ledger not committed yet) both render."""
+    lines = ["== sheepsync concurrency (lock graph / thread sanitizer) =="]
+    if conc:
+        lines.append(
+            f"ledger fingerprint {conc.get('fingerprint', '?')}  "
+            f"(analysis/budget/concurrency.json)"
+        )
+        roles = conc.get("roles", {})
+        for role in sorted(roles):
+            locks = roles[role].get("locks", {})
+            if not locks:
+                continue
+            lines.append(f"  [{role}] locks:")
+            for ident, ld in sorted(locks.items()):
+                backing = f" on {ld['backing']}" if ld.get("backing") else ""
+                lines.append(
+                    f"    {ident:52s} {ld.get('kind', '?'):9s}{backing} "
+                    f"({ld.get('site', '?')})"
+                )
+        edges = conc.get("lock_order", {}).get("edges", [])
+        chains = conc.get("lock_order", {}).get("chains", {})
+        lines.append("  lock-order edges (outer -> inner):")
+        if not edges:
+            lines.append("    (none)")
+        for a, b in edges:
+            lines.append(f"    {a} -> {b}")
+            chain = chains.get(f"{a} -> {b}")
+            if chain:
+                lines.append(f"        {chain}")
+        for cyc in conc.get("lock_order", {}).get("cycles", []):
+            lines.append(f"    CYCLE: {cyc[0]} <-> {cyc[1]}")
+        guarded = []
+        for role in sorted(roles):
+            for attr, guard in sorted(
+                (roles[role].get("guards") or {}).items()
+            ):
+                guarded.append(
+                    f"    {role}:{attr:40s} "
+                    + (guard if guard else "UNGUARDED")
+                )
+        if guarded:
+            lines.append("  shared-write guard map:")
+            lines.extend(guarded)
+        threads = [
+            (role, t)
+            for role in sorted(roles)
+            for t in roles[role].get("threads", [])
+        ]
+        if threads:
+            lines.append("  declared threads:")
+            for role, t in threads:
+                d = {True: "daemon", False: "non-daemon"}.get(
+                    t.get("daemon"), "daemon?"
+                )
+                j = "joined" if t.get("joined") else "unjoined"
+                lines.append(
+                    f"    [{role}] {t.get('name', '?'):26s} "
+                    f"target={t.get('target', '?'):34s} {d:11s} {j}"
+                )
+    gauges = summary.get("sync_gauges", {})
+    if gauges:
+        lines.append("  runtime sanitizer (last Sync/* gauges):")
+        acq = gauges.get("Sync/acquisitions", 0.0)
+        lines.append(
+            f"    acquisitions {acq:.0f}  contended "
+            f"{gauges.get('Sync/contended', 0.0):.0f}  "
+            f"hold max {gauges.get('Sync/hold_ms_max', 0.0):.1f}ms "
+            f"avg {gauges.get('Sync/hold_ms_avg', 0.0):.3f}ms  "
+            f"wait max {gauges.get('Sync/wait_ms_max', 0.0):.1f}ms"
+        )
+        lines.append(
+            f"    observed edges {gauges.get('Sync/observed_edges', 0.0):.0f} "
+            f"(undeclared {gauges.get('Sync/undeclared_edges', 0.0):.0f})  "
+            f"order violations "
+            f"{gauges.get('Sync/order_violations', 0.0):.0f}"
+        )
+    first_ts = summary.get("first_ts")
+    violations = [
+        ev
+        for ev in summary.get("sync_events", [])
+        if ev.get("event") == "sync.order_violation"
+    ]
+    if violations:
+        lines.append("  ORDER VIOLATIONS (runtime inversions of the DAG):")
+        for ev in violations:
+            rel = ""
+            if first_ts is not None and ev.get("ts") is not None:
+                rel = f"t+{ev['ts'] - first_ts:7.2f}s  "
+            lines.append(
+                f"    {rel}[{ev.get('thread', '?')}] acquired "
+                f"{ev.get('acquiring', '?')} while holding "
+                f"{ev.get('held', '?')}"
+            )
+    elif gauges or conc:
+        lines.append("  no lock-order violations recorded")
     return "\n".join(lines)
 
 
@@ -942,6 +1059,10 @@ def report(path: str) -> dict:
     if decisions:
         print()
         print(render_sheepopt_decisions(decisions))
+    conc = load_concurrency_ledger()
+    if conc or summary["sync_gauges"] or summary["sync_events"]:
+        print()
+        print(render_concurrency(conc, summary))
     return summary
 
 
@@ -1266,6 +1387,76 @@ def selftest() -> int:
     assert "distributed recovery timeline (per tier):" in out5, out5
     # the flock selftest's membership churn alone must ALSO open the section
     assert "distributed recovery timeline (per tier):" in out3, out3
+
+    # sheepsync concurrency section (ISSUE 18): writer (the runtime thread
+    # sanitizer's sync.* events + Sync/* gauges, and the sheepsync ledger
+    # schema) and this reader stay in sync
+    d6 = tempfile.mkdtemp(prefix="telemetry_selftest_sync_")
+    telem6 = Telemetry(d6, rank=0, algo="selftest")
+    telem6.event("start", algo="selftest", env_id="dummy", seed=0)
+    telem6.event("sync.sanitizer_start", committed_edges=2, known_sites=16, pid=1)
+    telem6.event(
+        "sync.order_violation",
+        acquiring="flock.service.ReplayService._lock",
+        held="flock.service.ReplayService._shard_locks[*]",
+        thread="flock-monitor",
+    )
+    telem6.interval(
+        {
+            "Sync/acquisitions": 420.0,
+            "Sync/contended": 3.0,
+            "Sync/order_violations": 1.0,
+            "Sync/undeclared_edges": 2.0,
+            "Sync/observed_edges": 5.0,
+            "Sync/hold_ms_avg": 0.021,
+            "Sync/hold_ms_max": 4.5,
+            "Sync/wait_ms_max": 1.25,
+        },
+        10,
+    )
+    telem6.close()
+    summary6 = summarize(load_events(d6))
+    assert len(summary6["sync_events"]) == 2, summary6["sync_events"]
+    assert summary6["sync_gauges"]["Sync/order_violations"] == 1.0
+    fake_conc = {
+        "fingerprint": "feedfacecafebeef",
+        "lock_order": {
+            "edges": [["A._lock", "A._shard[*]"]],
+            "chains": {"A._lock -> A._shard[*]": "f holds A._lock, acquires A._shard[*]"},
+            "cycles": [],
+        },
+        "roles": {
+            "flock": {
+                "locks": {
+                    "A._lock": {"kind": "RLock", "site": "a.py:1", "backing": None}
+                },
+                "threads": [
+                    {
+                        "role": "flock", "path": "a.py", "line": 9,
+                        "target": "A._loop", "name": "flock-monitor",
+                        "daemon": True, "joined": True,
+                    }
+                ],
+                "guards": {"A.count": "A._lock", "A.naked": None},
+            }
+        },
+    }
+    sync_section = render_concurrency(fake_conc, summary6)
+    assert "feedfacecafebeef" in sync_section, sync_section
+    assert "A._lock -> A._shard[*]" in sync_section, sync_section
+    assert "UNGUARDED" in sync_section and "A.count" in sync_section
+    assert "flock-monitor" in sync_section and "joined" in sync_section
+    assert "acquisitions 420" in sync_section, sync_section
+    assert "ORDER VIOLATIONS" in sync_section, sync_section
+    assert "while holding" in sync_section, sync_section
+    # ledger-only render (no sanitized run) stays valid + committed ledger
+    # loads wherever it exists
+    ledger_only = render_concurrency(fake_conc, {"sync_gauges": {}, "sync_events": []})
+    assert "no lock-order violations recorded" in ledger_only
+    conc = load_concurrency_ledger()
+    if conc:
+        assert conc.get("fingerprint") and "lock_order" in conc
+        assert "roles" in conc and "flock" in conc["roles"]
 
     print("\nselftest OK", file=sys.stderr)
     return 0

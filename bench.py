@@ -2799,9 +2799,19 @@ def bench_flock() -> None:
       4. dreamer_v3 `--flock 2` dry-run smoke: the buffer-mode shard path
          end to end, pass/fail + wall time.
 
+    ISSUE 19 scale-out receipts (round 13):
+
+      5. actor ladder (`SHEEPRL_TPU_FLOCK_BENCH_LADDER`, default 4,8,16):
+         aggregate actor steps/s and learner drain wait vs actor count,
+         relays engaged past 4 actors (R = N/8).
+      6. shm-vs-socket A/B: the same 2-actor colocated run with
+         `SHEEPRL_TPU_FLOCK_SHM=all` vs `off` — rate, drain wait, and the
+         `Flock/transport/*` frame split proving which path carried the
+         bytes.
+
     CPU receipts (mechanism, not raw speed: framing, drain scheduling and
     snapshot distribution are backend-independent); knobs via
-    SHEEPRL_TPU_FLOCK_BENCH_{STEPS,ROLLOUT}."""
+    SHEEPRL_TPU_FLOCK_BENCH_{STEPS,ROLLOUT,LADDER}."""
     import json as _json
     import os
     import subprocess
@@ -2819,8 +2829,11 @@ def bench_flock() -> None:
     env.pop("SHEEPRL_TPU_FAULTS", None)
     env.pop("XLA_FLAGS", None)  # single-device children
 
-    def run_ppo(run_name, n_actors):
+    def run_ppo(run_name, n_actors, relays=0, extra_env=None):
         t0 = time.perf_counter()
+        child = dict(env)
+        if extra_env:
+            child.update(extra_env)
         proc = subprocess.run(
             [
                 sys.executable, "-m", "sheeprl_tpu", "ppo",
@@ -2831,9 +2844,9 @@ def bench_flock() -> None:
                 "--cnn_features_dim", "16", "--mlp_features_dim", "8",
                 "--checkpoint_every", str(10 * steps), "--test_episodes", "0",
                 "--seed", "7", "--root_dir", root, "--run_name", run_name,
-                "--flock", str(n_actors),
+                "--flock", str(n_actors), "--relays", str(relays),
             ],
-            env=env, capture_output=True, text=True, timeout=900,
+            env=child, capture_output=True, text=True, timeout=900,
         )
         wall = time.perf_counter() - t0
         events = []
@@ -2916,6 +2929,54 @@ def bench_flock() -> None:
         }
         print(f"flock arm {n}: {arms[n]}", file=sys.stderr)
 
+    # -- ISSUE 19 scale-out receipts (round 13) ---------------------------
+    def transport_gauges(events):
+        out = {}
+        for ev in events:
+            if ev.get("event") != "log":
+                continue
+            for k, v in ev.get("metrics", {}).items():
+                if k.startswith("Flock/transport/") and isinstance(v, (int, float)):
+                    out[k.rsplit("/", 1)[1]] = v  # last sample wins
+        return out
+
+    def arm_summary(proc, wall, ev):
+        rate, total = actor_rate(ev)
+        return {
+            "rc": proc.returncode,
+            "wall_s": round(wall, 1),
+            "actor_env_steps_per_sec": round(rate, 1) if rate else None,
+            "actor_env_steps_total": total,
+            "drain_ms_per_update": round(drain_ms_per_update(ev), 3)
+            if drain_ms_per_update(ev) is not None else None,
+            "transport": transport_gauges(ev),
+        }
+
+    # actor ladder: relays kick in past 4 actors (a relay batches up to 8
+    # pushes per upstream frame, so R ~= N/8)
+    ladder_ns = [
+        int(x) for x in os.environ.get(
+            "SHEEPRL_TPU_FLOCK_BENCH_LADDER", "4,8,16"
+        ).split(",") if x.strip()
+    ]
+    ladder = {}
+    for n in ladder_ns:
+        r = max(1, n // 8) if n > 4 else 0
+        proc, wall, ev = run_ppo(f"ladder{n}", n, relays=r)
+        ladder[n] = dict(arm_summary(proc, wall, ev), relays=r)
+        print(f"flock ladder {n} (relays={r}): {ladder[n]}", file=sys.stderr)
+
+    # shm-vs-socket A/B: same 2-actor colocated run, only the transport
+    # differs — rate, drain wait and the Flock/transport/* split
+    shm_ab = {}
+    for label, extra in (
+        ("socket", {"SHEEPRL_TPU_FLOCK_SHM": "off"}),
+        ("shm", {"SHEEPRL_TPU_FLOCK_SHM": "all"}),
+    ):
+        proc, wall, ev = run_ppo(f"ab_{label}", 2, extra_env=extra)
+        shm_ab[label] = arm_summary(proc, wall, ev)
+        print(f"flock shm A/B {label}: {shm_ab[label]}", file=sys.stderr)
+
     # dreamer_v3 buffer-mode smoke: tiny dry-run, pass/fail + wall
     t0 = time.perf_counter()
     dv3 = subprocess.run(
@@ -2950,6 +3011,8 @@ def bench_flock() -> None:
         "flock_1": one,
         "flock_2": two,
         "actor_scaling_2_over_1": scaling,
+        "ladder": {str(n): v for n, v in ladder.items()},
+        "shm_ab": shm_ab,
         "dv3_flock2_smoke_ok": dv3.returncode == 0,
         "dv3_flock2_smoke_wall_s": dv3_wall,
         "total_steps": steps, "rollout_steps": rollout,

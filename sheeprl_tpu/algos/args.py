@@ -169,6 +169,19 @@ class StandardArgs:
         "learner restart. Supported by ppo and dreamer_v3 (host env "
         "backend)",
     )
+    relays: int = Arg(
+        default=0,
+        help="hierarchical actor aggregation (flock/relay.py, ISSUE 19): "
+        "0 (default) connects every flock actor directly to the learner's "
+        "replay service; R > 0 spawns R relay processes and assigns actor "
+        "i to relay (i mod R). Relays batch PUSH frames upstream (PUSH_BATCH), "
+        "forward heartbeats/HELLOs so learner-side membership and rejoin "
+        "receipts are unchanged, and serve weight pulls from a single "
+        "cached snapshot per version — the learner holds O(relays) "
+        "connections instead of O(actors). Requires --flock N; a killed "
+        "relay is respawned at the same address and its actors reconnect "
+        "through it",
+    )
     sanitize: bool = Arg(
         default=False,
         help="runtime transfer/donation sanitizer (sheeplint's dynamic "
@@ -217,6 +230,17 @@ class StandardArgs:
             if n <= 0:
                 raise ValueError(
                     f"flock must be 'off' or a positive actor count, got {value!r}"
+                )
+        if name == "relays":
+            try:
+                value = int(value)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"relays must be a non-negative integer, got {value!r}"
+                ) from None
+            if value < 0:
+                raise ValueError(
+                    f"relays must be a non-negative integer, got {value!r}"
                 )
         super().__setattr__(name, value)
         if name == "log_dir" and value:

@@ -738,7 +738,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         args.buffer_size // (args.num_envs * world) if not args.dry_run else 2
     )
     rb = None
-    service = fleet = None
+    service = fleet = flock_assembler = None
     if use_flock:
         from ... import flock as _flock
         from ...data.wire import tree_nbytes
@@ -812,8 +812,17 @@ def main(argv: Sequence[str] | None = None) -> None:
             service.close()
             raise RuntimeError("flock: no actor registered within 180 s")
         # the learner samples the service directly: local shard reads, no
-        # socket on the sample path (the prefetcher pairs with a live rb)
+        # socket on the sample path. Under --pipeline on the assembler
+        # pre-draws the next batch's shard slices on worker threads while
+        # the train step runs (flock/assemble.py — the SamplePrefetcher
+        # contract generalized across shards, same epoch guard + PRNG
+        # rewind, so assembly on/off stays bit-exact)
         sampler = service
+        if pipe.enabled:
+            flock_assembler = _flock.BatchAssembler(
+                service, max_staleness=pipe.max_staleness, stats=pipe.stats,
+            )
+            sampler = flock_assembler
     else:
         rb = AsyncReplayBuffer(
             max(buffer_size, args.per_rank_sequence_length),
@@ -1323,6 +1332,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     profiler.close()
     if envs is not None:
         envs.close()
+    if flock_assembler is not None:
+        flock_assembler.close()
     if fleet is not None:
         fleet.close()
     if service is not None:

@@ -167,6 +167,14 @@ SY_RULES: dict[str, Rule] = {r.id: r for r in _SY_RULES}
 # (relpath, qualname, rule) -> mandatory justification. `*` matches any
 # qualname in the file. An unjustified suppression is a review error.
 SYNC_SUPPRESSIONS: dict[tuple[str, str, str], str] = {
+    ("sheeprl_tpu/flock/relay.py", "Relay._up_request", "SY002"): (
+        "by design: _up_lock serializes the ONE multiplexed upstream "
+        "connection (strict request/reply framing — interleaved senders "
+        "would corrupt the stream). It is never taken on the downstream "
+        "accept path; a stalled upstream blocks only the forwarder and "
+        "heartbeat forwards, and downstream PUSHes are answered from the "
+        "cached aggregate PUSH_OK (ISSUE 19 relay contract)"
+    ),
     ("sheeprl_tpu/serve/params.py", "ParamsStore.reload", "SY002"): (
         "by design: _reload_lock serializes checkpoint restores and is "
         "NEVER taken on the dispatch path — current() is a lock-free "
@@ -189,7 +197,7 @@ _REPO = Path(__file__).resolve().parents[2]
 
 # -- wire-protocol classification (derived from the pinned registry) ----------
 
-_HANDSHAKE_OPEN = {"HELLO", "PROFILE"}
+_HANDSHAKE_OPEN = {"HELLO", "PROFILE", "RELAY_HELLO"}
 _REPLY_KINDS = {
     "WELCOME",
     "PUSH_OK",

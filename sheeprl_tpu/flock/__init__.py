@@ -16,14 +16,34 @@ Module map:
     sizing.py    per-actor shard capacities from the sheepmem ledger
     service.py   learner-side replay service + membership + gauges
     actor.py     actor process entry (`python -m sheeprl_tpu.flock.actor`)
-    launcher.py  actor subprocess lifecycle: spawn, monitor, respawn
+    launcher.py  actor/relay subprocess lifecycle: spawn, monitor, respawn
+    shm.py       zero-copy shared-memory ring transport for colocated actors
+    relay.py     hierarchical aggregation hop (`--relays R`, ISSUE 19)
+    assemble.py  in-network sample pre-assembly across shards (ISSUE 19)
 
 Wired behind `--flock {off,N}` in `ppo` and `dreamer_v3`; `--flock off`
 is bit-exact vs the in-process path (checkpoint-parity test-gated).
+Scale-out (ISSUE 19): `--relays R` inserts an aggregation tier,
+`SHEEPRL_TPU_FLOCK_SHM` moves colocated actors' bulk pushes onto
+shared-memory rings, and `--pipeline on` pre-assembles sample batches
+across shards — see howto/distributed_actors.md.
 """
 
+from .assemble import BatchAssembler
 from .launcher import ActorFleet, retarget_sigkill
+from .relay import Relay
 from .service import ReplayService
+from .shm import ShmReceiver, ShmRing, shm_enabled_for
 from .sizing import shard_capacity
 
-__all__ = ["ActorFleet", "ReplayService", "retarget_sigkill", "shard_capacity"]
+__all__ = [
+    "ActorFleet",
+    "BatchAssembler",
+    "Relay",
+    "ReplayService",
+    "ShmReceiver",
+    "ShmRing",
+    "retarget_sigkill",
+    "shard_capacity",
+    "shm_enabled_for",
+]

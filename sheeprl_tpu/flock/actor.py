@@ -63,6 +63,7 @@ import numpy as np
 from ..resilience import inject
 from . import wire
 from .service import PROTO_VERSION, pack_push
+from .shm import shm_enabled_for
 
 _U32 = struct.Struct("<I")
 
@@ -166,13 +167,29 @@ class _ServiceLink:
     """The actor's data connection: HELLO/WELCOME handshake, then strictly
     sequential PUSH and HEARTBEAT request/replies from the step loop.
     HEARTBEATs carry monotonic + wall send stamps; the service's
-    `server_wall_ts` reply feeds the optional `ClockSync` (sheepscope)."""
+    `server_wall_ts` reply feeds the optional `ClockSync` (sheepscope).
+
+    With `use_shm` (ISSUE 19) the first push lazily creates a
+    shared-memory ring sized off its payload (flock/shm.py), attaches it
+    over the socket (SHM_ATTACH), and routes every subsequent PUSH
+    payload through the ring — the socket keeps carrying heartbeats and
+    BYE. Any ring failure (attach refused, ring full past its bounded
+    wait, oversize frame, injected partition) falls back to the socket
+    path for that push; a partition disables shm for the link's lifetime
+    so the reconnect genuinely exercises the socket."""
 
     def __init__(
-        self, addr: str, actor_id: int, timeout: float | None, clock=None
+        self,
+        addr: str,
+        actor_id: int,
+        timeout: float | None,
+        clock=None,
+        use_shm: bool = False,
     ):
         self.sock = wire.connect(addr, timeout=timeout)
         self._clock = clock
+        self._use_shm = use_shm
+        self._ring = None
         wire.send_json(
             self.sock,
             wire.HELLO,
@@ -189,6 +206,59 @@ class _ServiceLink:
         self._hb_steps0 = 0
         self._hb_t0 = time.monotonic()
 
+    def _attach_ring(self, first_payload_len: int) -> None:
+        """Create + announce the ring; one shot — a refusal (old service,
+        attach error) permanently reverts this link to the socket."""
+        from .shm import ShmRing, ring_geometry
+
+        self._use_shm = False  # re-enabled only on an ok reply
+        slots, slot_bytes = ring_geometry(first_payload_len)
+        ring = ShmRing.create(slots=slots, slot_bytes=slot_bytes)
+        try:
+            wire.send_json(
+                self.sock,
+                wire.SHM_ATTACH,
+                {
+                    "actor_id": self.welcome["actor_id"],
+                    "name": ring.name,
+                    "slots": slots,
+                    "slot_bytes": slot_bytes,
+                },
+            )
+            reply = wire.recv_json(self.sock, wire.SHM_ATTACH)
+        except (OSError, wire.FrameError):
+            ring.close(unlink=True)
+            raise
+        if reply.get("ok"):
+            self._ring = ring
+            self._use_shm = True
+        else:
+            ring.close(unlink=True)
+
+    def _detach_ring(self) -> None:
+        if self._ring is not None:
+            # unlink only the NAME: the service's drain thread still holds
+            # a mapping and unlinks defensively on its own teardown
+            self._ring.close(unlink=True)
+            self._ring = None
+        self._use_shm = False
+
+    def _push_shm(self, payload: bytes) -> bool:
+        """Commit one PUSH payload to the ring; False -> use the socket
+        for this frame. Raises ConnectionResetError on injected partition
+        (after disabling shm for this link)."""
+        import zlib
+
+        crc = zlib.crc32(payload)
+        try:
+            data = wire.inject_shm_send(payload)
+        except ConnectionResetError:
+            self._detach_ring()
+            raise
+        if data is None:
+            return True  # injected net.drop: the frame is lost, by design
+        return self._ring.push(data, crc=crc)
+
     def push(
         self,
         ops,
@@ -198,17 +268,21 @@ class _ServiceLink:
         weight_version: int,
         trace: dict | None = None,
     ):
-        wire.send_frame(
-            self.sock,
-            wire.PUSH,
-            pack_push(
-                ops,
-                rows=rows,
-                env_steps=env_steps,
-                weight_version=weight_version,
-                trace=trace,
-            ),
+        payload = pack_push(
+            ops,
+            rows=rows,
+            env_steps=env_steps,
+            weight_version=weight_version,
+            trace=trace,
         )
+        if self._use_shm and self._ring is None:
+            self._attach_ring(len(payload))
+        if self._use_shm and self._ring is not None:
+            if self._push_shm(payload):
+                # no per-push reply on the ring path: random_phase and
+                # weight_version updates ride the 1 Hz heartbeats
+                return {"shm": True, "random_phase": self.random_phase}
+        wire.send_frame(self.sock, wire.PUSH, payload)
         reply = wire.recv_json(self.sock, wire.PUSH_OK)
         self.random_phase = bool(reply.get("random_phase"))
         return reply
@@ -249,6 +323,7 @@ class _ServiceLink:
             )
         except OSError:
             pass
+        self._detach_ring()
         try:
             self.sock.close()
         except OSError:
@@ -260,7 +335,11 @@ def _reconnect_budget() -> float:
 
 
 def _connect_with_backoff(
-    addr: str, actor_id: int, timeout: float | None, clock=None
+    addr: str,
+    actor_id: int,
+    timeout: float | None,
+    clock=None,
+    use_shm: bool = False,
 ) -> _ServiceLink:
     """Dial the service until it answers: capped exponential backoff
     (0.25 s doubling to 5 s) bounded by the total reconnect budget. An
@@ -272,7 +351,9 @@ def _connect_with_backoff(
     last: Exception | None = None
     while True:
         try:
-            return _ServiceLink(addr, actor_id, timeout, clock=clock)
+            return _ServiceLink(
+                addr, actor_id, timeout, clock=clock, use_shm=use_shm
+            )
         except (OSError, TimeoutError) as err:
             last = err
             left = deadline - time.monotonic()
@@ -295,13 +376,21 @@ class ResilientLink:
     _RETRIES = 3  # fresh backoff-bounded connection per attempt
 
     def __init__(
-        self, addr: str, actor_id: int, timeout: float | None, clock=None
+        self,
+        addr: str,
+        actor_id: int,
+        timeout: float | None,
+        clock=None,
+        use_shm: bool = False,
     ):
         self._addr = addr
         self._actor_id = actor_id
         self._timeout = timeout
         self._clock = clock
-        self._link = _connect_with_backoff(addr, actor_id, timeout, clock=clock)
+        self._use_shm = use_shm
+        self._link = _connect_with_backoff(
+            addr, actor_id, timeout, clock=clock, use_shm=use_shm
+        )
 
     @property
     def welcome(self) -> dict:
@@ -312,12 +401,18 @@ class ResilientLink:
         return self._link.random_phase
 
     def _reconnect(self) -> None:
+        # a link that disabled shm on itself (injected partition, refused
+        # attach) keeps it disabled across reconnects: the fallback must
+        # stay on the socket path it degraded to
+        self._use_shm = self._use_shm and self._link._use_shm
+        self._link._detach_ring()
         try:
             self._link.sock.close()
         except OSError:
             pass
         self._link = _connect_with_backoff(
-            self._addr, self._actor_id, self._timeout, clock=self._clock
+            self._addr, self._actor_id, self._timeout, clock=self._clock,
+            use_shm=self._use_shm,
         )
 
     def push(
@@ -470,7 +565,10 @@ def run_ppo(args, actor_id: int, addr: str, log_dir: str, telem=None) -> None:
     timeout = _transfer_timeout()
     fetcher = WeightFetcher(addr, actor_id, timeout)
     fetcher.start()
-    link = ResilientLink(addr, actor_id, timeout, clock=clock)
+    link = ResilientLink(
+        addr, actor_id, timeout, clock=clock,
+        use_shm=shm_enabled_for(actor_id),
+    )
     version, leaves = _wait_initial_weights(fetcher)
     agent = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(x) for x in leaves])
 
@@ -622,7 +720,10 @@ def run_dreamer_v3(
     timeout = _transfer_timeout()
     fetcher = WeightFetcher(addr, actor_id, timeout)
     fetcher.start()
-    link = ResilientLink(addr, actor_id, timeout, clock=clock)
+    link = ResilientLink(
+        addr, actor_id, timeout, clock=clock,
+        use_shm=shm_enabled_for(actor_id),
+    )
     version, leaves = _wait_initial_weights(fetcher)
     player = jax.tree_util.tree_unflatten(
         treedef, [jnp.asarray(x) for x in leaves]

@@ -1,0 +1,229 @@
+"""In-network sample pre-assembly across replay-service shards (ISSUE
+19, tentpole a).
+
+`ReplayService.sample` partitions a batch across per-actor shards,
+draws each slice under that shard's lock, and concatenates — all
+synchronously on the learner thread, right where the train step is
+waiting. `BatchAssembler` moves that work off the critical path: the
+moment a batch is SERVED, the next one is dispatched to per-shard
+worker threads (`flock-assemble-{aid}`) that draw their slices
+concurrently with the train step; the last finisher concatenates and
+parks the assembled batch in a depth-1 ready slot the next `sample()`
+call collects.
+
+This is the PR-3 `SamplePrefetcher` contract generalized from one
+buffer to a sharded service, and it keeps the SAME bit-exactness
+guarantee: a pre-assembled batch is served only if the service's total
+write `epoch` has not advanced past `max_staleness` since dispatch and
+the call signature matches; otherwise the batch is discarded and the
+FULL sample state — every shard's sampler PRNG plus the remainder-
+rotation counter `plan_partition` consumed — is rewound to the
+snapshot the dispatch took, so the fresh synchronous resample draws
+exactly what the unassembled path would have. Assembler on vs off
+trains on identical batches (tests/test_flock/test_assemble.py A/Bs
+this), exactly like `--pipeline on|off`.
+
+Dispatch pauses while writes land every serve-to-serve gap (strict
+staleness can never hit there) and re-arms in quiet gaps — the same
+`predict_quiet` heuristic as the prefetcher, sharing its
+`PipelineStats` counters so `Pipeline/sample_hit_rate` reports this
+path too.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..parallel.pipeline import PipelineStats
+
+__all__ = ["BatchAssembler"]
+
+_STOP = object()
+
+
+class _Assembly:
+    """One in-flight assembled batch: per-shard slices land from worker
+    threads; the last finisher concatenates (and tops up skipped shards)
+    so `wait()` returns a ready batch — or None when nothing could serve
+    (the caller's guard then rewinds and resamples synchronously)."""
+
+    def __init__(self, sig, epoch0: int, state0: dict, kw: dict):
+        self.sig = sig
+        self.epoch0 = epoch0
+        self.state0 = state0
+        self.kw = kw
+        self.batch: dict[str, np.ndarray] | None = None
+        self._parts: list[tuple[int, Any]] = []  # (actor_id, slice)
+        self._missing = 0
+        self._pending = 0
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+
+    def expect(self, n_parts: int) -> None:
+        self._pending = n_parts
+        if n_parts == 0:
+            self._done.set()
+
+    def deliver(self, service, aid: int, part, missing: int) -> None:
+        with self._lock:
+            if part is not None:
+                self._parts.append((aid, part))
+            self._missing += missing
+            self._pending -= 1
+            last = self._pending == 0
+        if last:
+            self._finish(service)
+            self._done.set()
+
+    def _finish(self, service) -> None:
+        if not self._parts:
+            return  # nothing served: the guard falls back synchronously
+        if self._missing:
+            # same top-up rule as ReplayService.sample: a warming-up shard's
+            # slice comes from one that CAN serve, keeping the batch shape
+            # (the train jit's aval) intact
+            aid = min(aid for aid, _ in self._parts)
+            try:
+                with service._shard_locks[aid]:
+                    self._parts.append(
+                        (aid, service._shards[aid].sample(self._missing, **self.kw))
+                    )
+            except (ValueError, RuntimeError):
+                return
+        self._parts.sort(key=lambda item: item[0])
+        axis = 2 if "sequence_length" in self.kw else 0
+        parts = [p for _, p in self._parts]
+        self.batch = {
+            k: np.concatenate([p[k] for p in parts], axis=axis)
+            for k in parts[0]
+        }
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class BatchAssembler:
+    """Pre-assembling wrapper around a buffer-mode `ReplayService`; the
+    learner calls `sample()` exactly as it would on the service."""
+
+    def __init__(
+        self,
+        service,
+        enabled: bool = True,
+        max_staleness: int = 0,
+        stats: PipelineStats | None = None,
+    ):
+        self.service = service
+        self.enabled = enabled and service.mode == "buffer"
+        self.max_staleness = max_staleness
+        self._stats = stats if stats is not None else PipelineStats()
+        self._inflight: _Assembly | None = None
+        self._last_epoch: int | None = None
+        self._workers: dict[int, tuple[Any, threading.Thread]] = {}
+        if self.enabled:
+            import queue
+
+            for aid in sorted(service._shards):
+                q: "queue.Queue" = queue.Queue()
+                t = threading.Thread(
+                    target=self._worker,
+                    args=(aid, q),
+                    name=f"flock-assemble-{aid}",
+                    daemon=True,
+                )
+                t.start()
+                self._workers[aid] = (q, t)
+
+    def __getattr__(self, name):  # delegate everything else to the service
+        return getattr(self.service, name)
+
+    # -- workers --------------------------------------------------------------
+
+    def _worker(self, aid: int, q) -> None:
+        service = self.service
+        while True:
+            task = q.get()
+            if task is _STOP:
+                return
+            assembly, n = task
+            part, missing = None, 0
+            try:
+                with service._shard_locks[aid]:
+                    part = service._shards[aid].sample(n, **assembly.kw)
+            except (ValueError, RuntimeError):
+                missing = n
+            assembly.deliver(service, aid, part, missing)
+
+    def _dispatch(self, batch_size: int, sig, kw: dict) -> None:
+        service = self.service
+        state0 = service.get_sample_state()
+        epoch0 = service.epoch
+        assembly = _Assembly(sig, epoch0, state0, kw)
+        parts = [
+            (aid, n)
+            for aid, n in service.plan_partition(batch_size)
+            if n > 0 and aid in self._workers
+        ]
+        assembly.expect(len(parts))
+        self._inflight = assembly
+        self._stats.sample_prefetches += 1
+        for aid, n in parts:
+            self._workers[aid][0].put((assembly, n))
+
+    # -- learner-facing -------------------------------------------------------
+
+    def sample(self, batch_size: int, **kw):
+        service = self.service
+        if not self.enabled:
+            return service.sample(batch_size, **kw)
+        sig = (batch_size, tuple(sorted(kw.items())))
+        batch = None
+        if self._inflight is not None:
+            assembly = self._inflight
+            self._inflight = None
+            # the wait ALSO quiesces the workers: no shard PRNG can mutate
+            # underneath the rewind/resample below
+            assembly.wait()
+            epoch = service.epoch
+            fresh = (
+                assembly.sig == sig
+                and assembly.batch is not None
+                and epoch - assembly.epoch0 <= self.max_staleness
+            )
+            if fresh:
+                self._stats.sample_hits += 1
+                batch = assembly.batch
+            else:
+                # consistency guard: writes landed since dispatch (or the
+                # signature changed, or nothing could serve) — discard and
+                # rewind every shard's PRNG plus the remainder rotation to
+                # the dispatch snapshot, so the fresh resample draws exactly
+                # what the unassembled path would have (bit-exact on/off)
+                self._stats.sample_misses += 1
+                service.set_sample_state(assembly.state0)
+        if batch is None:
+            batch = service.sample(batch_size, **kw)
+        epoch_now = service.epoch
+        predict_quiet = (
+            self.max_staleness > 0
+            or self._last_epoch is None
+            or epoch_now == self._last_epoch
+        )
+        self._last_epoch = epoch_now
+        if predict_quiet:
+            self._dispatch(batch_size, sig, kw)
+        return batch
+
+    def close(self) -> None:
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            inflight.wait(timeout=5.0)
+        for q, _ in self._workers.values():
+            q.put(_STOP)
+        for _, t in self._workers.values():
+            t.join(timeout=5.0)
+        self._workers.clear()
+        self.enabled = False

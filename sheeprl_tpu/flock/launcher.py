@@ -9,6 +9,17 @@ a bounded budget) with a fault-scrubbed environment, reconnects to the
 service under its same actor id, and resumes filling its shard — the
 learner never restarts, never even blocks.
 
+With `--relays R` (ISSUE 19) the fleet also owns R
+`python -m sheeprl_tpu.flock.relay` children, spawned BEFORE the actors:
+actor i gets relay i % R's bind address as its service address, so the
+learner holds O(R) data connections however many actors run. Relays are
+supervised by the same monitor loop under the same respawn budget; a
+respawned relay rebinds its predecessor's unix path, so its actors'
+`ResilientLink` reconnect backoff rides straight through the kill —
+elastic membership (kill/rejoin, generation bumps) is preserved across
+the extra hop because relays FORWARD control frames rather than
+answering them.
+
 `retarget_sigkill` implements the sheepfault contract for the flock
 topology: `sigkill@N` and `net.*` clauses in `--faults` are retargeted
 from the learner onto actor 0 (killing the learner tests nothing about
@@ -85,6 +96,9 @@ class ActorFleet:
     ):
         self.algo = algo
         self.n_actors = int(args.flock)
+        self.n_relays = min(
+            int(getattr(args, "relays", 0) or 0), self.n_actors
+        )
         self.address = address
         self.log_dir = log_dir
         self._args_json = json.dumps(args.as_dict())
@@ -95,6 +109,23 @@ class ActorFleet:
         self._adopted: dict[int, int] = {}  # actor_id -> orphan pid
         self._respawns: dict[int, int] = {i: 0 for i in range(self.n_actors)}
         self._logs: dict[int, object] = {}
+        self._relay_procs: dict[int, subprocess.Popen] = {}
+        self._relay_respawns: dict[int, int] = {
+            i: 0 for i in range(self.n_relays)
+        }
+        self._relay_logs: dict[int, object] = {}
+        # relay bind paths live in a short tempdir, not under log_dir: an
+        # AF_UNIX path caps at ~107 bytes and run dirs routinely blow that
+        self._relay_dir: str | None = None
+        self._relay_addrs: dict[int, str] = {}
+        if self.n_relays:
+            import tempfile
+
+            self._relay_dir = tempfile.mkdtemp(prefix="flock-r-")
+            self._relay_addrs = {
+                i: f"unix:{self._relay_dir}/r{i}.sock"
+                for i in range(self.n_relays)
+            }
         # guards _procs/_adopted/_respawns/_logs: handle_eviction arrives on
         # the ReplayService monitor thread while _monitor_loop mutates the
         # same tables (sheepsync SY003). Never held across Popen/kill/wait.
@@ -109,6 +140,8 @@ class ActorFleet:
         """Spawn every actor not in `skip`. On crash-resume the learner
         skips ids whose pre-crash processes survived the restart and are
         already reconnected — those are `adopt`ed instead of respawned."""
+        for relay_id in range(self.n_relays):
+            self._spawn_relay(relay_id)
         for actor_id in range(self.n_actors):
             if actor_id not in skip:
                 self._spawn(actor_id, first=True)
@@ -179,9 +212,11 @@ class ActorFleet:
         # thread is joined above, but handle_eviction can still arrive from
         # the service's monitor thread until the service itself closes
         with self._lock:
-            procs = list(self._procs.values())
+            procs = list(self._procs.values()) + list(
+                self._relay_procs.values()
+            )
             adopted = list(self._adopted.values())
-            logs = list(self._logs.values())
+            logs = list(self._logs.values()) + list(self._relay_logs.values())
         for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
@@ -200,6 +235,10 @@ class ActorFleet:
                 fh.close()
             except OSError:
                 pass
+        if self._relay_dir:
+            import shutil
+
+            shutil.rmtree(self._relay_dir, ignore_errors=True)
 
     def __enter__(self):
         return self
@@ -209,12 +248,58 @@ class ActorFleet:
 
     # -- internals ------------------------------------------------------------
 
+    def _actor_address(self, actor_id: int) -> str:
+        """The address actor `actor_id` dials: its relay's bind when the
+        topology has relays, the service itself otherwise."""
+        if self.n_relays:
+            return self._relay_addrs[actor_id % self.n_relays]
+        return self.address
+
+    def _spawn_relay(self, relay_id: int) -> None:
+        from ..telemetry.trace import RUN_ENV, ensure_run_id
+
+        env = dict(os.environ)
+        env.update(
+            SHEEPRL_TPU_FLOCK_UPSTREAM=self.address,
+            SHEEPRL_TPU_FLOCK_RELAY_ID=str(relay_id),
+            SHEEPRL_TPU_FLOCK_RELAY_BIND=self._relay_addrs[relay_id],
+            SHEEPRL_TPU_FLOCK_LOG_DIR=self.log_dir,
+            JAX_PLATFORMS="cpu",
+        )
+        env[RUN_ENV] = ensure_run_id()
+        env.pop("XLA_FLAGS", None)
+        # fault clauses ride on the learner or actor 0, never a relay: the
+        # relay chaos coverage injects in-process (tests) or kills the
+        # relay outright (CI smoke)
+        env.pop(inject.ENV_VAR, None)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (str(_REPO), os.environ.get("PYTHONPATH")) if p
+        )
+        log_path = os.path.join(self.log_dir, "flock", f"relay{relay_id}.log")
+        fh = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "sheeprl_tpu.flock.relay"],
+            env=env,
+            stdout=fh,
+            stderr=subprocess.STDOUT,
+            cwd=str(_REPO),
+        )
+        with self._lock:
+            old = self._relay_logs.get(relay_id)
+            self._relay_logs[relay_id] = fh
+            self._relay_procs[relay_id] = proc
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+
     def _spawn(self, actor_id: int, *, first: bool) -> None:
         from ..telemetry.trace import RUN_ENV, ensure_run_id
 
         env = dict(os.environ)
         env.update(
-            SHEEPRL_TPU_FLOCK_ADDR=self.address,
+            SHEEPRL_TPU_FLOCK_ADDR=self._actor_address(actor_id),
             SHEEPRL_TPU_FLOCK_ACTOR_ID=str(actor_id),
             SHEEPRL_TPU_FLOCK_ALGO=self.algo,
             SHEEPRL_TPU_FLOCK_ARGS=self._args_json,
@@ -289,7 +374,45 @@ class ActorFleet:
                         actor_id=actor_id,
                         respawns=attempt,
                     )
+            with self._lock:
+                relay_snapshot = list(self._relay_procs.items())
+            for relay_id, proc in relay_snapshot:
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                self._event("flock.relay_died", relay_id=relay_id, rc=rc)
+                with self._lock:
+                    if (
+                        rc == 0
+                        or self._relay_respawns[relay_id] >= self._max_respawns
+                    ):
+                        self._relay_procs.pop(relay_id, None)
+                        respawn = False
+                    else:
+                        self._relay_respawns[relay_id] += 1
+                        respawn = True
+                    attempt = self._relay_respawns[relay_id]
+                if respawn:
+                    # same bind path: the relay's actors reconnect through
+                    # their normal backoff, no address redistribution
+                    self._spawn_relay(relay_id)
+                    self._event(
+                        "flock.relay_respawned",
+                        relay_id=relay_id,
+                        attempt=attempt,
+                    )
+                elif rc != 0:
+                    self._event(
+                        "flock.relay_abandoned",
+                        relay_id=relay_id,
+                        respawns=attempt,
+                    )
             self._stop.wait(_POLL_S)
+
+    def relays_alive(self) -> int:
+        with self._lock:
+            procs = list(self._relay_procs.values())
+        return sum(1 for p in procs if p.poll() is None)
 
     def alive(self) -> int:
         with self._lock:

@@ -1,0 +1,658 @@
+"""Hierarchical actor aggregation: the relay tier (ISSUE 19, tentpole c).
+
+`python -m sheeprl_tpu.flock.relay` hosts one relay between a group of
+actors and the learner's replay service, so the learner holds O(relays)
+connections instead of O(actors) — the Sebulba scale-out shape
+(arXiv:2104.06272). Downstream, a relay speaks the EXACT service
+protocol actors already use (HELLO/WELCOME, PUSH/PUSH_OK,
+HEARTBEAT/HEARTBEAT_OK, GET_WEIGHTS, SHM_ATTACH, BYE) — `ActorFleet`
+just hands actors a relay address and zero actor code changes follow.
+Upstream, everything multiplexes over ONE connection:
+
+    RELAY_HELLO  opens it (reply WELCOME {shard_capacity,
+                 weight_version, random_phase})
+    PUSH_BATCH   batches buffered PUSH payloads, forwarded VERBATIM —
+                 shard bytes and sheepscope trace context survive the
+                 hop bit-for-bit; one aggregate PUSH_OK refreshes the
+                 relay's cached reply fields
+    RELAY_FWD    wraps actor control frames (HELLO/HEARTBEAT/BYE) so
+                 learner-side membership, generation bumps and
+                 `flock.actor_rejoined` receipts fire exactly as if the
+                 actor were directly connected
+
+Pushes are acknowledged downstream IMMEDIATELY from cached state and
+flushed upstream by a forwarder thread (`flock-relay-fwd`), so an
+actor's push latency is one local hop regardless of learner load.
+Weight pulls are served from a single cached snapshot per version: a
+poller thread (`flock-relay-weights`) keeps the newest WEIGHTS payload
+(raw frame bytes, reused verbatim for every downstream GET_WEIGHTS), so
+N actors cost the learner ONE weight transfer per published version.
+
+Elasticity: a dying upstream connection is redialed with the actor-side
+backoff budget, and every known member re-HELLOs through the fresh
+connection (the service had deregistered them with the dead relay — the
+re-registration bumps generations, exactly the rejoin path). A relay
+killed outright is respawned by `ActorFleet` at the SAME bind address,
+and its actors' `ResilientLink` reconnects ride through. Colocated
+actors may SHM_ATTACH to the relay: the ring drains into the relay's
+upstream batch queue through the same `flock/shm.py` receiver the
+service uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from ..telemetry import core as telemetry
+from . import wire
+from .service import PROTO_VERSION
+
+__all__ = ["Relay"]
+
+_U32 = __import__("struct").Struct("<I")
+
+BATCH_MAX = 8  # pushes per PUSH_BATCH frame
+FLUSH_S = 0.02  # max dwell of a buffered push before a forced flush
+QUEUE_CAP = 256  # buffered pushes across all members; oldest dropped past it
+WEIGHT_POLL_S = 0.25
+
+
+class Relay:
+    """One actor->learner aggregation hop; see the module docstring."""
+
+    def __init__(
+        self,
+        *,
+        upstream: str,
+        relay_id: int,
+        bind: str | None = None,
+        telem=None,
+    ):
+        self.upstream = upstream
+        self.relay_id = relay_id
+        self._requested_bind = bind
+        self._telem = telem
+        self.address = ""
+        self._listener: socket.socket | None = None
+        self._unix_path: str | None = None
+        self._own_sockdir = False
+        # guards members/cache/queue/counters. NEVER taken around upstream
+        # socket I/O — that is `_up_lock`'s job, and `_up_lock` is never
+        # acquired while `_lock` is held (sheepsync lock-order ledger).
+        self._lock = threading.Lock()
+        self._queue_ready = threading.Condition(self._lock)
+        self._queue: deque[tuple[int, bytes]] = deque()
+        self._dropped = 0
+        self._members: dict[int, dict] = {}  # actor_id -> last hello
+        self._cache: dict[str, Any] = {
+            "rows_total": 0,
+            "random_phase": False,
+            "weight_version": 0,
+        }
+        self._weight_payload: bytes | None = None
+        self._weight_version = -1
+        self._shm_rx: dict[int, Any] = {}
+        # serializes request/reply traffic on the one upstream connection
+        self._up_lock = threading.Lock()
+        self._up_sock: socket.socket | None = None
+        self._stop = threading.Event()
+        self.fatal = threading.Event()  # upstream unreachable past budget
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self._batches = 0
+        self._forwarded = 0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> str:
+        sock = self._dial_upstream()  # fail fast: no learner, no relay
+        with self._up_lock:  # every _up_sock write happens under _up_lock
+            self._up_sock = sock
+        if self._requested_bind:
+            parsed = wire.parse_address(self._requested_bind)
+            if parsed[0] == "tcp":
+                srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                srv.bind((parsed[1], parsed[2]))
+            else:
+                # a respawned relay rebinds its predecessor's path so the
+                # actors' reconnect backoff finds it (service rehost logic)
+                path = parsed[1]
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                self._unix_path = path
+                srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                srv.bind(path)
+            self.address = self._requested_bind
+        else:
+            sock_dir = tempfile.mkdtemp(prefix="flock-relay-")
+            self._own_sockdir = True
+            self._unix_path = os.path.join(sock_dir, "relay.sock")
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(self._unix_path)
+            self.address = wire.format_address("unix", self._unix_path)
+        srv.listen(64)
+        self._listener = srv
+        for target, name in (
+            (self._accept_loop, "flock-relay-accept"),
+            (self._forward_loop, "flock-relay-fwd"),
+            (self._weight_loop, "flock-relay-weights"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._event(
+            "flock.relay_started",
+            relay_id=self.relay_id,
+            address=self.address,
+            upstream=self.upstream,
+        )
+        return self.address
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._queue_ready.notify_all()
+            receivers = list(self._shm_rx.values())
+            self._shm_rx.clear()
+        for rx in receivers:
+            rx.stop(unlink=True)
+        for sock in [self._listener, self._up_sock, *self._conns]:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        if self._unix_path:
+            try:
+                os.unlink(self._unix_path)
+                if self._own_sockdir:
+                    os.rmdir(os.path.dirname(self._unix_path))
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- upstream -------------------------------------------------------------
+
+    def _dial_upstream(self) -> socket.socket:
+        """(Re)open the multiplexed upstream connection with the actor-side
+        backoff budget, then re-HELLO every known member through it — the
+        service deregistered them when the previous connection died, so the
+        re-registration is exactly the rejoin path. Returns the socket;
+        the CALLER stores it into `_up_sock` under `_up_lock` (start()
+        dials before taking the lock, `_up_request` already holds it)."""
+        from .actor import BACKOFF_BASE_S, BACKOFF_CAP_S, _reconnect_budget
+
+        budget = _reconnect_budget()
+        deadline = time.monotonic() + budget
+        delay = BACKOFF_BASE_S
+        while True:
+            try:
+                sock = wire.connect(self.upstream, timeout=30.0)
+                wire.send_json(
+                    sock,
+                    wire.RELAY_HELLO,
+                    {
+                        "relay_id": self.relay_id,
+                        "pid": os.getpid(),
+                        "proto": PROTO_VERSION,
+                    },
+                )
+                welcome = wire.recv_json(sock, wire.WELCOME)
+                with self._lock:
+                    self._cache["random_phase"] = bool(
+                        welcome.get("random_phase")
+                    )
+                    self._cache["weight_version"] = int(
+                        welcome.get("weight_version", 0)
+                    )
+                    members = dict(self._members)
+                for aid, hello in members.items():
+                    wire.send_frame(
+                        sock,
+                        wire.RELAY_FWD,
+                        wire.pack_relay_fwd(
+                            aid, wire.HELLO, json.dumps(hello).encode()
+                        ),
+                    )
+                    wire.recv_frame(sock)  # RELAY_FWD(WELCOME): drain it
+                return sock
+            except (OSError, TimeoutError, wire.FrameError) as err:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._stop.is_set():
+                    self.fatal.set()
+                    raise ConnectionError(
+                        f"flock upstream {self.upstream!r} unreachable after "
+                        f"{budget:.0f}s (last: {type(err).__name__}: {err})"
+                    ) from err
+                time.sleep(min(delay, left))
+                delay = min(delay * 2.0, BACKOFF_CAP_S)
+
+    def _up_request(
+        self, kind: int, payload: bytes, idempotent: bool = True
+    ) -> tuple[int, bytes]:
+        """One request/reply on the upstream connection; redials once on a
+        dead socket. Idempotent frames (HELLO/HEARTBEAT/BYE forwards — the
+        service coalesces re-registration) are replayed on the fresh
+        connection. Non-idempotent ones (PUSH_BATCH: rows would land twice)
+        are replayed ONLY if the failure happened before the send completed
+        — once the bytes may have reached the service, a retry is a
+        duplicate, so the caller gets the error instead."""
+        with self._up_lock:
+            for attempt in (0, 1):
+                sock = self._up_sock
+                sent = False
+                try:
+                    if sock is None:
+                        sock = self._dial_upstream()
+                        self._up_sock = sock
+                    wire.send_frame(sock, kind, payload)
+                    sent = True
+                    frame = wire.recv_frame(sock)
+                    if frame is None:
+                        raise ConnectionResetError("upstream closed")
+                    return frame
+                except (OSError, TimeoutError, wire.FrameError):
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    self._up_sock = None
+                    if attempt or self._stop.is_set():
+                        raise
+                    if sent and not idempotent:
+                        raise
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    # -- forwarder ------------------------------------------------------------
+
+    def _enqueue(self, actor_id: int, payload: bytes) -> None:
+        with self._lock:
+            if len(self._queue) >= QUEUE_CAP:
+                self._queue.popleft()
+                self._dropped += 1
+            self._queue.append((actor_id, payload))
+            self._queue_ready.notify_all()
+
+    def _forward_loop(self) -> None:
+        """Drain the push queue into PUSH_BATCH frames: up to BATCH_MAX
+        payloads per frame, flushed within FLUSH_S of the first buffered
+        push. The aggregate PUSH_OK refreshes the cached reply fields every
+        downstream PUSH is answered from."""
+        while not self._stop.is_set():
+            with self._queue_ready:
+                # SY005: predicate re-checked in the loop head
+                while not self._queue and not self._stop.is_set():
+                    self._queue_ready.wait(timeout=0.5)
+                if self._stop.is_set() and not self._queue:
+                    return
+                batch = []
+                while self._queue and len(batch) < BATCH_MAX:
+                    batch.append(self._queue.popleft())
+            if not batch and not self._queue:
+                continue
+            try:
+                kind, reply = self._up_request(
+                    wire.PUSH_BATCH, wire.pack_push_batch(batch),
+                    idempotent=False,
+                )
+            except (ConnectionError, TimeoutError, wire.FrameError):
+                if self.fatal.is_set():
+                    return
+                continue  # batch lost with the connection; actors re-push
+            if kind == wire.PUSH_OK:
+                ok = json.loads(reply.decode())
+                with self._lock:
+                    self._cache.update(
+                        rows_total=int(ok.get("rows_total", 0)),
+                        random_phase=bool(ok.get("random_phase")),
+                        weight_version=int(ok.get("weight_version", 0)),
+                    )
+                    self._batches += 1
+                    self._forwarded += len(batch)
+            # small dwell so near-simultaneous pushes share one batch
+            self._stop.wait(FLUSH_S)
+
+    # -- weight cache ---------------------------------------------------------
+
+    def _weight_loop(self) -> None:
+        """Dedicated upstream weights connection (HELLO actor_id=-1): keeps
+        ONE cached WEIGHTS payload — the newest version — reused verbatim
+        for every downstream GET_WEIGHTS."""
+        sock = None
+        while not self._stop.is_set():
+            try:
+                if sock is None:
+                    sock = wire.connect(self.upstream, timeout=30.0)
+                    wire.send_json(
+                        sock,
+                        wire.HELLO,
+                        {
+                            "actor_id": -1,
+                            "pid": os.getpid(),
+                            "role": "weights",
+                            "proto": PROTO_VERSION,
+                        },
+                    )
+                wire.send_json(
+                    sock,
+                    wire.GET_WEIGHTS,
+                    {"have_version": self._weight_version},
+                )
+                frame = wire.recv_frame(sock)
+                if frame is None:
+                    raise ConnectionResetError("upstream weights closed")
+                kind, payload = frame
+                if kind == wire.WEIGHTS:
+                    (meta_len,) = _U32.unpack_from(payload, 0)
+                    meta = json.loads(payload[4 : 4 + meta_len].decode())
+                    with self._lock:
+                        self._weight_version = int(meta["version"])
+                        self._weight_payload = payload
+            except (OSError, wire.FrameError):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+            self._stop.wait(WEIGHT_POLL_S)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- downstream -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve,
+                args=(conn,),
+                name="flock-relay-conn",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        actor_id = None
+        role = "data"
+        try:
+            frame = wire.recv_frame(conn)
+            if frame is None:
+                return
+            if frame[0] == wire.PROFILE:
+                from ..telemetry.trace import handle_profile_frame
+
+                log_dir = getattr(self._telem, "log_dir", None)
+                wire.send_json(
+                    conn,
+                    wire.PROFILE,
+                    handle_profile_frame(
+                        json.loads(frame[1].decode() or "{}"), log_dir
+                    ),
+                )
+                return
+            if frame[0] != wire.HELLO:
+                return
+            hello = json.loads(frame[1].decode())
+            actor_id = int(hello["actor_id"])
+            role = hello.get("role", "data")
+            if hello.get("proto") != PROTO_VERSION:
+                wire.send_json(
+                    conn, wire.ERROR, {"error": f"bad hello {hello!r}"}
+                )
+                return
+            if role == "weights":
+                self._serve_weights(conn)
+                return
+            # forward the HELLO: the learner registers the actor (and bumps
+            # its generation on rejoin) exactly as with a direct connection
+            kind, reply = self._up_request(
+                wire.RELAY_FWD,
+                wire.pack_relay_fwd(
+                    actor_id, wire.HELLO, json.dumps(hello).encode()
+                ),
+            )
+            if kind != wire.RELAY_FWD:
+                wire.send_json(
+                    conn, wire.ERROR, {"error": "relay upstream refused hello"}
+                )
+                return
+            _aid, inner_kind, inner = wire.unpack_relay_fwd(reply)
+            if inner_kind != wire.WELCOME:
+                wire.send_frame(conn, inner_kind, inner)
+                return
+            with self._lock:
+                self._members[actor_id] = hello
+            wire.send_frame(conn, wire.WELCOME, inner)
+            while not self._stop.is_set():
+                frame = wire.recv_frame(conn)
+                if frame is None:
+                    break
+                kind, payload = frame
+                if kind == wire.PUSH:
+                    self._enqueue(actor_id, payload)
+                    with self._lock:
+                        ok = dict(self._cache)
+                    wire.send_json(conn, wire.PUSH_OK, ok)
+                elif kind == wire.HEARTBEAT:
+                    self._handle_heartbeat(conn, actor_id, payload)
+                elif kind == wire.SHM_ATTACH:
+                    self._handle_shm_attach(
+                        conn, actor_id, json.loads(payload.decode())
+                    )
+                elif kind == wire.BYE:
+                    with self._lock:
+                        self._members.pop(actor_id, None)
+                    try:
+                        self._up_request(
+                            wire.RELAY_FWD,
+                            wire.pack_relay_fwd(actor_id, wire.BYE, payload),
+                        )
+                    except (ConnectionError, TimeoutError, wire.FrameError):
+                        pass
+                    break
+                else:
+                    wire.send_json(
+                        conn,
+                        wire.ERROR,
+                        {"error": f"unexpected {wire.KIND_NAMES.get(kind, kind)}"},
+                    )
+        except (wire.FrameError, OSError, ValueError, KeyError) as err:
+            if not self._stop.is_set():
+                self._event(
+                    "flock.relay_conn_error",
+                    relay_id=self.relay_id,
+                    actor_id=actor_id,
+                    role=role,
+                    error=f"{type(err).__name__}: {err}",
+                )
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if actor_id is not None and role == "data":
+                with self._lock:
+                    rx = self._shm_rx.pop(actor_id, None)
+                if rx is not None:
+                    rx.stop(unlink=True)
+
+    def _serve_weights(self, conn: socket.socket) -> None:
+        while not self._stop.is_set():
+            frame = wire.recv_frame(conn)
+            if frame is None:
+                return
+            kind, payload = frame
+            if kind != wire.GET_WEIGHTS:
+                wire.send_json(conn, wire.ERROR, {"error": "weights conn"})
+                return
+            have = json.loads(payload.decode()).get("have_version", -1)
+            with self._lock:
+                version = self._weight_version
+                blob = self._weight_payload
+            if blob is None or have == version:
+                wire.send_json(
+                    conn, wire.WEIGHTS_UNCHANGED, {"version": max(version, 0)}
+                )
+            else:
+                wire.send_frame(conn, wire.WEIGHTS, blob)
+
+    def _handle_heartbeat(self, conn, actor_id: int, payload: bytes) -> None:
+        """Forward the heartbeat synchronously (1 Hz per actor — cheap) so
+        learner-side staleness/eviction sees real liveness; when the
+        upstream is down mid-redial, answer from cache so the ACTOR's link
+        stays healthy while the relay heals."""
+        try:
+            kind, reply = self._up_request(
+                wire.RELAY_FWD,
+                wire.pack_relay_fwd(actor_id, wire.HEARTBEAT, payload),
+            )
+            if kind == wire.RELAY_FWD:
+                _aid, inner_kind, inner = wire.unpack_relay_fwd(reply)
+                if inner_kind == wire.HEARTBEAT_OK:
+                    ok = json.loads(inner.decode())
+                    with self._lock:
+                        self._cache.update(
+                            random_phase=bool(ok.get("random_phase")),
+                            weight_version=int(ok.get("weight_version", 0)),
+                        )
+                    wire.send_frame(conn, wire.HEARTBEAT_OK, inner)
+                    return
+        except (ConnectionError, TimeoutError, wire.FrameError):
+            if self.fatal.is_set():
+                raise
+        with self._lock:
+            ok = {
+                "random_phase": self._cache["random_phase"],
+                "weight_version": self._cache["weight_version"],
+            }
+        wire.send_json(conn, wire.HEARTBEAT_OK, ok)
+
+    def _handle_shm_attach(self, conn, actor_id: int, req: dict) -> None:
+        """A colocated actor's ring drains into the upstream batch queue —
+        same `flock/shm.py` receiver the service uses, same payload
+        contract, one more hop."""
+        from .shm import ShmReceiver, ShmRing
+
+        try:
+            ring = ShmRing.attach(str(req["name"]))
+        except (OSError, KeyError, ValueError) as err:
+            wire.send_json(
+                conn,
+                wire.SHM_ATTACH,
+                {"ok": False, "error": f"{type(err).__name__}: {err}"},
+            )
+            return
+
+        def on_corrupt(_payload, aid=actor_id):
+            self._event(
+                "flock.shm_corrupt", relay_id=self.relay_id, actor_id=aid
+            )
+
+        rx = ShmReceiver(
+            ring,
+            on_payload=lambda p, aid=actor_id: self._enqueue(aid, p),
+            on_corrupt=on_corrupt,
+            name=f"flock-relay-shm-{actor_id}",
+        )
+        with self._lock:
+            old = self._shm_rx.get(actor_id)
+            self._shm_rx[actor_id] = rx
+        if old is not None:
+            old.stop(unlink=True)
+        rx.start()
+        self._event(
+            "flock.shm_attached",
+            relay_id=self.relay_id,
+            actor_id=actor_id,
+            ring=ring.name,
+        )
+        wire.send_json(conn, wire.SHM_ATTACH, {"ok": True})
+
+    # -- observability --------------------------------------------------------
+
+    def gauges(self) -> dict[str, float]:
+        with self._lock:
+            return {
+                "Flock/relay/queue_depth": float(len(self._queue)),
+                "Flock/relay/batches": float(self._batches),
+                "Flock/relay/forwarded": float(self._forwarded),
+                "Flock/relay/dropped": float(self._dropped),
+                "Flock/relay/members": float(len(self._members)),
+                "Flock/relay/weight_version": float(self._weight_version),
+            }
+
+    def _event(self, name: str, **data) -> None:
+        if self._telem is not None:
+            self._telem.event(name, **data)
+        else:
+            telemetry.emit(name, **data)
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def main() -> int:
+    upstream = os.environ["SHEEPRL_TPU_FLOCK_UPSTREAM"]
+    relay_id = int(os.environ.get("SHEEPRL_TPU_FLOCK_RELAY_ID", "0"))
+    bind = os.environ.get("SHEEPRL_TPU_FLOCK_RELAY_BIND") or None
+    log_dir = os.environ.get("SHEEPRL_TPU_FLOCK_LOG_DIR") or None
+    from ..telemetry.core import Telemetry
+
+    telem = (
+        Telemetry(log_dir, role=f"relay{relay_id}") if log_dir else None
+    )
+    relay = Relay(
+        upstream=upstream, relay_id=relay_id, bind=bind, telem=telem
+    )
+    try:
+        relay.start()
+    except ConnectionError:
+        return 0  # no learner to relay for: clean exit, no respawn
+    if telem is not None:
+        telem.add_gauges(relay.gauges)
+    try:
+        # serve until the learner goes away for good (fatal) or SIGTERM
+        while not relay.fatal.wait(timeout=0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        relay.close()
+        if telem is not None:
+            telem.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

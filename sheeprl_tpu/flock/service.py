@@ -27,6 +27,18 @@ the same id bumps its generation and resumes filling the same shard —
 the `flock.actor_rejoined` event is the receipt the CI fault-smoke
 scenario asserts on.
 
+Scale-out (ISSUE 19): two transports besides the per-actor socket. A
+colocated actor can attach a shared-memory ring (SHM_ATTACH ->
+`flock/shm.py`); a per-ring `ShmReceiver` drain thread ingests the ring's
+PUSH payloads through the same `_ingest_push` the socket path uses, so
+shard contents are transport-independent byte for byte. A relay
+(`flock/relay.py`) multiplexes many actors over ONE upstream connection:
+RELAY_HELLO opens it, PUSH_BATCH carries batched PUSH payloads, and
+RELAY_FWD forwards actor control frames (HELLO/HEARTBEAT/BYE) verbatim —
+membership, generations and rejoin events behave exactly as if each
+actor were directly connected. `Flock/transport/*` gauges count frames
+and bytes per transport.
+
 Crash-resume (ISSUE 16): `save_sidecar` snapshots the service next to a
 learner checkpoint — shard contents via the buffers' own `to_bytes()`
 wire codecs, the per-actor generation/weight-version table, and the bound
@@ -232,6 +244,18 @@ class ReplayService:
         self._chunks: dict[int, deque] = {i: deque() for i in range(n_actors)}
         self._chunk_cap: dict[int, int] = {}
         self._drain_order = 0
+        # fair remainder rotation for sample() partitioning (ISSUE 19
+        # satellite): part of the sample state so the assembler's rewind
+        # and the crash-resume sidecar both preserve it
+        self._sample_rr = 0
+        # per-transport ingest counters behind the Flock/transport/* gauges
+        self._tx: dict[str, int] = {
+            "socket_frames": 0, "socket_bytes": 0,
+            "shm_frames": 0, "shm_bytes": 0, "shm_corrupt": 0,
+            "relay_frames": 0, "relay_bytes": 0, "relay_batches": 0,
+        }
+        # actor_id -> live ShmReceiver drain thread (ISSUE 19)
+        self._shm_rx: dict[int, Any] = {}
         self._weight_version = 0
         self._weight_payload: bytes | None = None
         self._publish_ts: dict[int, float] = {}
@@ -335,6 +359,11 @@ class ReplayService:
                     sock.close()
                 except OSError:
                     pass
+        with self._lock:
+            receivers = list(self._shm_rx.values())
+            self._shm_rx.clear()
+        for rx in receivers:
+            rx.stop(unlink=True)
         for t in self._threads:
             t.join(timeout=2.0)
         if self._unix_path:
@@ -387,12 +416,22 @@ class ReplayService:
                     ),
                 )
                 return
+            if frame[0] == wire.RELAY_HELLO:
+                # a relay's upstream multiplexed connection (ISSUE 19)
+                role = "relay"
+                self._serve_relay(conn, json.loads(frame[1].decode()))
+                return
             if frame[0] != wire.HELLO:
                 return
             hello = json.loads(frame[1].decode())
             actor_id = int(hello["actor_id"])
             role = hello.get("role", "data")
-            if actor_id not in self._actors or hello.get("proto") != PROTO_VERSION:
+            # actor_id -1 is a relay's weight-cache poller: it serves many
+            # actors, so it carries no single actor identity
+            known = actor_id in self._actors or (
+                role == "weights" and actor_id == -1
+            )
+            if not known or hello.get("proto") != PROTO_VERSION:
                 wire.send_json(
                     conn, wire.ERROR, {"error": f"bad hello {hello!r}"}
                 )
@@ -423,6 +462,10 @@ class ReplayService:
                     self._handle_push(conn, actor_id, payload)
                 elif kind == wire.HEARTBEAT:
                     self._handle_heartbeat(conn, actor_id, payload)
+                elif kind == wire.SHM_ATTACH:
+                    self._handle_shm_attach(
+                        conn, actor_id, json.loads(payload.decode())
+                    )
                 elif kind == wire.BYE:
                     break
                 else:
@@ -451,6 +494,19 @@ class ReplayService:
                 with self._lock:
                     if self._data_conns.get(actor_id) is conn:
                         del self._data_conns[actor_id]
+                # the ring rides the data connection's lifetime: a dead
+                # actor's receiver drains what was committed, detaches and
+                # unlinks (the creator may be SIGKILLed and unable to).
+                # A rejoined actor has already swapped in a NEW receiver —
+                # only stop the one this connection attached.
+                with self._lock:
+                    rx = self._shm_rx.get(actor_id)
+                    if rx is not None and rx.conn is conn:
+                        del self._shm_rx[actor_id]
+                    else:
+                        rx = None
+                if rx is not None:
+                    rx.stop(unlink=True)
                 self._deregister(actor_id)
 
     def _serve_weights(self, conn: socket.socket) -> None:
@@ -554,7 +610,64 @@ class ReplayService:
         if self.on_evict is not None:
             self.on_evict(actor_id)
 
+    def _handle_shm_attach(self, conn, actor_id: int, req: dict) -> None:
+        """SHM_ATTACH: the colocated actor created a ring (flock/shm.py);
+        attach it and start a drain thread feeding `_ingest_push` — from
+        here on this actor's PUSH payloads arrive through shared memory
+        and the socket carries only control frames. A re-attach (actor
+        rejoined with a fresh ring) replaces the old receiver."""
+        from .shm import ShmReceiver, ShmRing
+
+        try:
+            ring = ShmRing.attach(str(req["name"]))
+        except (OSError, KeyError, ValueError) as err:
+            wire.send_json(
+                conn,
+                wire.SHM_ATTACH,
+                {"ok": False, "error": f"{type(err).__name__}: {err}"},
+            )
+            return
+
+        def on_corrupt(_payload, aid=actor_id):
+            with self._lock:
+                self._tx["shm_corrupt"] += 1
+            self._event("flock.shm_corrupt", actor_id=aid)
+
+        rx = ShmReceiver(
+            ring,
+            on_payload=lambda p, aid=actor_id: self._ingest_push(
+                aid, p, transport="shm"
+            ),
+            on_corrupt=on_corrupt,
+            name=f"flock-shm-drain-{actor_id}",
+        )
+        rx.conn = conn  # ties the receiver to this connection's lifetime
+        with self._lock:
+            old = self._shm_rx.get(actor_id)
+            self._shm_rx[actor_id] = rx
+        if old is not None:
+            old.stop(unlink=True)
+        rx.start()
+        self._event(
+            "flock.shm_attached",
+            actor_id=actor_id,
+            ring=ring.name,
+            slots=ring.slots,
+            slot_bytes=ring.slot_bytes,
+        )
+        wire.send_json(conn, wire.SHM_ATTACH, {"ok": True})
+
     def _handle_push(self, conn, actor_id: int, payload: bytes) -> None:
+        reply = self._ingest_push(actor_id, payload, transport="socket")
+        wire.send_json(conn, wire.PUSH_OK, reply)
+
+    def _ingest_push(
+        self, actor_id: int, payload: bytes, transport: str = "socket"
+    ) -> dict:
+        """Apply one PUSH payload to the actor's shard, whatever transport
+        carried it (socket handler, shm drain thread, relay batch), and
+        return the PUSH_OK reply fields. Shard contents are byte-identical
+        across transports — the payload IS the contract."""
         ops, meta = unpack_push(payload)
         rows = int(meta.get("rows") or 0)
         trace = meta.get("trace") or {}
@@ -603,14 +716,20 @@ class ReplayService:
             st.last_heartbeat = time.monotonic()
             st.note_sender_mono(trace.get("mono_ts"))
             self._rows_total += rows
+            self._tx[f"{transport}_frames"] += 1
+            self._tx[f"{transport}_bytes"] += len(payload)
             reply = {
                 "rows_total": self._rows_total,
                 "random_phase": self._random_phase,
                 "weight_version": self._weight_version,
             }
-        wire.send_json(conn, wire.PUSH_OK, reply)
+        return reply
 
     def _handle_heartbeat(self, conn, actor_id: int, payload: bytes) -> None:
+        reply = self._ingest_heartbeat(actor_id, payload)
+        wire.send_json(conn, wire.HEARTBEAT_OK, reply)
+
+    def _ingest_heartbeat(self, actor_id: int, payload: bytes) -> dict:
         hb = json.loads(payload.decode())
         with self._lock:
             st = self._actors[actor_id]
@@ -626,7 +745,112 @@ class ReplayService:
                 # reply time — the actor's ClockSync does the NTP math
                 "server_wall_ts": time.time(),
             }
-        wire.send_json(conn, wire.HEARTBEAT_OK, reply)
+        return reply
+
+    def _serve_relay(self, conn: socket.socket, hello: dict) -> None:
+        """One relay's upstream connection (ISSUE 19): strict
+        request/reply. PUSH_BATCH applies every batched PUSH payload and
+        gets one aggregate PUSH_OK; RELAY_FWD-wrapped actor control frames
+        (HELLO/HEARTBEAT/BYE) are processed exactly as if the actor were
+        directly connected — registration, generation bumps and rejoin
+        events included — and the normal reply rides back RELAY_FWD-
+        wrapped. A dying relay connection deregisters every actor it
+        forwarded, mirroring per-actor socket teardown."""
+        relay_id = int(hello.get("relay_id", -1))
+        if hello.get("proto") != PROTO_VERSION:
+            wire.send_json(
+                conn, wire.ERROR, {"error": f"bad relay hello {hello!r}"}
+            )
+            return
+        members: set[int] = set()
+        with self._lock:
+            welcome = {
+                "relay_id": relay_id,
+                "shard_capacity": self.capacity_rows,
+                "weight_version": self._weight_version,
+                "random_phase": self._random_phase,
+            }
+        wire.send_json(conn, wire.WELCOME, welcome)
+        self._event("flock.relay_joined", relay_id=relay_id,
+                    pid=int(hello.get("pid", -1)))
+        try:
+            while not self._stop.is_set():
+                frame = wire.recv_frame(conn)
+                if frame is None:
+                    break
+                kind, payload = frame
+                if kind == wire.PUSH_BATCH:
+                    items = wire.unpack_push_batch(payload)
+                    reply: dict = {}
+                    for aid, push_payload in items:
+                        if aid in self._actors:
+                            reply = self._ingest_push(
+                                aid, push_payload, transport="relay"
+                            )
+                    with self._lock:
+                        self._tx["relay_batches"] += 1
+                    if not reply:
+                        with self._lock:
+                            reply = {
+                                "rows_total": self._rows_total,
+                                "random_phase": self._random_phase,
+                                "weight_version": self._weight_version,
+                            }
+                    wire.send_json(conn, wire.PUSH_OK, reply)
+                elif kind == wire.RELAY_FWD:
+                    aid, inner_kind, inner = wire.unpack_relay_fwd(payload)
+                    if aid not in self._actors:
+                        wire.send_json(
+                            conn, wire.ERROR, {"error": f"unknown actor {aid}"}
+                        )
+                        continue
+                    if inner_kind == wire.HELLO:
+                        inner_hello = json.loads(inner.decode())
+                        self._register(aid, inner_hello)
+                        members.add(aid)
+                        with self._lock:
+                            wmsg = {
+                                "actor_id": aid,
+                                "shard_capacity": self.capacity_rows,
+                                "weight_version": self._weight_version,
+                                "random_phase": self._random_phase,
+                                "generation": self._actors[aid].generation,
+                            }
+                        out = (wire.WELCOME, json.dumps(wmsg).encode())
+                    elif inner_kind == wire.HEARTBEAT:
+                        out = (
+                            wire.HEARTBEAT_OK,
+                            json.dumps(
+                                self._ingest_heartbeat(aid, inner)
+                            ).encode(),
+                        )
+                    elif inner_kind == wire.BYE:
+                        members.discard(aid)
+                        self._deregister(aid)
+                        out = (wire.BYE, b"")
+                    else:
+                        out = (
+                            wire.ERROR,
+                            json.dumps(
+                                {"error": f"unexpected fwd kind {inner_kind}"}
+                            ).encode(),
+                        )
+                    wire.send_frame(
+                        conn, wire.RELAY_FWD, wire.pack_relay_fwd(aid, *out)
+                    )
+                elif kind == wire.BYE:
+                    break
+                else:
+                    wire.send_json(
+                        conn,
+                        wire.ERROR,
+                        {"error": f"unexpected {wire.KIND_NAMES.get(kind, kind)}"},
+                    )
+        finally:
+            self._event("flock.relay_disconnected", relay_id=relay_id,
+                        actors=sorted(members))
+            for aid in members:
+                self._deregister(aid)
 
     # -- learner side ---------------------------------------------------------
 
@@ -715,17 +939,59 @@ class ReplayService:
                     return None
                 self._chunk_ready.wait(timeout=0.5 if left is None else min(left, 0.5))
 
+    def plan_partition(self, batch_size: int) -> list[tuple[int, int]]:
+        """-> [(actor_id, n), ...] splitting `batch_size` across shards.
+        The remainder rotates from `_sample_rr` instead of always landing
+        on the first live shards (ISSUE 19 satellite): deterministic, and
+        over many calls every shard draws the same count to within one.
+        ADVANCES the rotation — callers draw exactly once per plan; the
+        counter is part of the sample state, so the assembler's rewind and
+        the crash-resume sidecar both restore it."""
+        ready = sorted(self._shards)
+        k = len(ready)
+        counts = [batch_size // k] * k
+        rem = batch_size % k
+        for i in range(rem):
+            counts[(self._sample_rr + i) % k] += 1
+        if rem:
+            self._sample_rr = (self._sample_rr + rem) % k
+        return list(zip(ready, counts))
+
+    @property
+    def epoch(self) -> int:
+        """Total write epoch across shards (buffer mode): bumps on every
+        ingested op, whatever transport carried it. The assembler's
+        consistency guard (flock/assemble.py, same contract as the PR-3
+        SamplePrefetcher) compares snapshots of this."""
+        return sum(
+            int(getattr(shard, "epoch", 0)) for shard in self._shards.values()
+        )
+
+    def get_sample_state(self) -> dict:
+        """Snapshot everything `sample()` consumes besides shard contents:
+        the remainder rotation and each shard's sampler PRNG state."""
+        state: dict[str, Any] = {"rr": self._sample_rr, "shards": {}}
+        for aid, shard in self._shards.items():
+            if hasattr(shard, "get_sample_state"):
+                with self._shard_locks[aid]:
+                    state["shards"][aid] = shard.get_sample_state()
+        return state
+
+    def set_sample_state(self, state: dict) -> None:
+        self._sample_rr = int(state.get("rr", 0))
+        for aid, shard_state in state.get("shards", {}).items():
+            shard = self._shards.get(int(aid))
+            if shard is not None and hasattr(shard, "set_sample_state"):
+                with self._shard_locks[int(aid)]:
+                    shard.set_sample_state(shard_state)
+
     def sample(self, batch_size: int, **kw):
         """Buffer mode: partition the batch across shards that can serve it
         and concatenate — local calls only, no socket. Shards still warming
         up (or disconnected mid-fill) are skipped; the batch re-partitions
         over the rest."""
-        ready = sorted(self._shards)
-        counts = [batch_size // len(ready)] * len(ready)
-        for i in range(batch_size % len(ready)):
-            counts[i] += 1
         parts, served, missing = [], [], 0
-        for aid, n in zip(ready, counts):
+        for aid, n in self.plan_partition(batch_size):
             if n == 0:
                 continue
             with self._shard_locks[aid]:
@@ -738,7 +1004,7 @@ class ReplayService:
             # the partition may have skipped (n == 0) the only shard with
             # data — e.g. batch_size < n_actors early in the run. Any single
             # shard that can serve the WHOLE batch keeps training moving.
-            for aid in ready:
+            for aid in sorted(self._shards):
                 with self._shard_locks[aid]:
                     try:
                         return self._shards[aid].sample(batch_size, **kw)
@@ -822,6 +1088,7 @@ class ReplayService:
                 "rows_total": self._rows_total,
                 "chunks_dropped": self._chunks_dropped,
                 "random_phase": self._random_phase,
+                "sample_rr": self._sample_rr,
                 "chunk_cap": {str(k): v for k, v in self._chunk_cap.items()},
                 "actors": actors,
                 "blob_lens": [len(b) for b in blobs],
@@ -867,6 +1134,7 @@ class ReplayService:
             self._rows_total = int(meta["rows_total"])
             self._chunks_dropped = int(meta["chunks_dropped"])
             self._random_phase = bool(meta["random_phase"])
+            self._sample_rr = int(meta.get("sample_rr", 0))
             self._chunk_cap = {
                 int(k): int(v) for k, v in meta.get("chunk_cap", {}).items()
             }
@@ -912,6 +1180,9 @@ class ReplayService:
                 "Flock/rows_total": float(self._rows_total),
                 "Flock/chunks_dropped": float(self._chunks_dropped),
             }
+            for key, val in self._tx.items():
+                out[f"Flock/transport/{key}"] = float(val)
+            out["Flock/transport/shm_rings"] = float(len(self._shm_rx))
             for aid, st in self._actors.items():
                 if not st.ever_connected:
                     continue

@@ -1,0 +1,321 @@
+"""Zero-copy shared-memory ring transport for colocated flock actors
+(ISSUE 19, tentpole b).
+
+An actor that shares the learner's host does not need a socket for its
+bulk rollout traffic: it creates a `multiprocessing.shared_memory` ring,
+announces it over the ordinary FLK1 data connection (SHM_ATTACH), and
+from then on every PUSH payload — the exact `service.pack_push` bytes
+the socket would carry, `data/wire.py` width-class packing and all — is
+committed into a ring slot the service's drain thread ingests in place.
+The socket stays open for control frames only (heartbeats, BYE), and
+any failure on the ring path falls back to it transparently.
+
+Ring layout (one writer = the actor, one reader = the service):
+
+    header(48) = magic(4)=b"SFR1" | version(u32) | slots(u32) | pad(u32)
+                 | slot_bytes(u64) | produced(u64) | consumed(u64) | pad(u64)
+    slot[i](slot_bytes) = seq(u64) | length(u64) | crc32(u32) | pad(u32)
+                          | payload[length]
+
+Slot commits use a seqlock-style header: for absolute frame position
+`p`, slot `p % slots` is committed at `seq == 2*(p // slots) + 2`; the
+writer stores `seq-1` (odd: write in progress), the payload, then the
+even seq — a reader that observes the even target seq AND
+`produced > p` sees fully-committed bytes, and a torn write can never
+masquerade as a commit. `produced`/`consumed` are single-writer
+cursors: the producer advances `produced` after the slot commit, the
+consumer advances `consumed` after copying the payload out, and the
+producer blocks (bounded) while the ring is full. Payloads carry a
+CRC32; a mismatch (injected `net.corrupt`, or a writer that died
+mid-slot and was force-committed) skips the slot with a receipt instead
+of poisoning the shard.
+
+Fault injection: the producer runs every payload through
+`wire.inject_shm_send`, so the sheepfault `net.*` clauses fire on shm
+frames exactly like socket frames — `net.partition` detaches the ring
+and (via the opened partition window) forces the socket fallback to
+wait the window out.
+
+Sizing knobs (howto/distributed_actors.md):
+
+    SHEEPRL_TPU_FLOCK_SHM_SLOTS       ring depth in frames (default 8)
+    SHEEPRL_TPU_FLOCK_SHM_SLOT_BYTES  payload capacity per slot (default
+                                      sized off the first pushed frame;
+                                      oversize frames fall back to the
+                                      socket for that push)
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import zlib
+
+from multiprocessing import shared_memory
+
+__all__ = ["ShmRing", "ShmReceiver", "ring_geometry", "shm_enabled_for"]
+
+MAGIC = b"SFR1"
+VERSION = 1
+
+_HEADER = struct.Struct("<4sIIIQQQQ")  # magic, ver, slots, pad, slot_bytes, produced, consumed, pad
+_SLOT = struct.Struct("<QQII")  # seq, length, crc32, pad
+
+HEADER_BYTES = _HEADER.size
+SLOT_HEADER_BYTES = _SLOT.size
+
+DEFAULT_SLOTS = 8
+SLOTS_VAR = "SHEEPRL_TPU_FLOCK_SHM_SLOTS"
+SLOT_BYTES_VAR = "SHEEPRL_TPU_FLOCK_SHM_SLOT_BYTES"
+ENABLE_VAR = "SHEEPRL_TPU_FLOCK_SHM"
+
+_PRODUCED_OFF = 24
+_CONSUMED_OFF = 32
+
+
+def shm_enabled_for(actor_id: int) -> bool:
+    """Transport policy for one actor, from SHEEPRL_TPU_FLOCK_SHM:
+    unset/'0'/'off' -> socket (the pre-ISSUE-19 behavior, bit-exact);
+    '1'/'all'/'on' -> every actor attaches a ring; a comma list of ids
+    ('0,2,4') -> exactly those actors, the rest stay on the socket —
+    the mixed topology the CI flock smoke exercises."""
+    raw = (os.environ.get(ENABLE_VAR) or "").strip().lower()
+    if raw in ("", "0", "off", "no"):
+        return False
+    if raw in ("1", "all", "on", "yes"):
+        return True
+    try:
+        ids = {int(tok) for tok in raw.split(",") if tok.strip()}
+    except ValueError:
+        return False
+    return actor_id in ids
+
+
+def ring_geometry(first_payload_len: int) -> tuple[int, int]:
+    """-> (slots, slot_bytes) for a new ring, sized so the first pushed
+    frame fits with headroom (frames are rollout-chunk sized and stable
+    within a run; 2x covers episode-boundary reset ops riding along)."""
+    slots = max(2, int(os.environ.get(SLOTS_VAR, DEFAULT_SLOTS)))
+    override = os.environ.get(SLOT_BYTES_VAR)
+    if override:
+        payload_cap = max(1024, int(override))
+    else:
+        payload_cap = max(64 * 1024, 2 * first_payload_len)
+    return slots, SLOT_HEADER_BYTES + payload_cap
+
+
+def _untrack(shm) -> None:
+    """Detach `shm` from this process's resource tracker: the ring's
+    lifetime is owned explicitly (creator unlinks on close, the service
+    unlinks on behalf of a SIGKILLed creator) — the tracker double-
+    unlinking at interpreter exit only produces noise."""
+    try:  # pragma: no cover - tracker internals vary across 3.x
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    # sheeplint: disable=SL012 — best-effort unregister against a private
+    # stdlib API whose shape varies across 3.x; failure just means the
+    # tracker keeps its (harmless, noisy-at-exit) double-unlink entry
+    except Exception:
+        pass
+
+
+class ShmRing:
+    """SPSC seqlock ring over one `multiprocessing.shared_memory` block."""
+
+    def __init__(self, shm, *, created: bool):
+        self._shm = shm
+        self._created = created
+        buf = shm.buf
+        magic, ver, slots, _, slot_bytes, _, _, _ = _HEADER.unpack_from(buf, 0)
+        if magic != MAGIC or ver != VERSION:
+            raise ValueError(
+                f"bad shm ring header in {shm.name!r}: "
+                f"magic={magic!r} version={ver}"
+            )
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        self.payload_cap = slot_bytes - SLOT_HEADER_BYTES
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def create(cls, *, slots: int, slot_bytes: int) -> "ShmRing":
+        size = HEADER_BYTES + slots * slot_bytes
+        shm = shared_memory.SharedMemory(create=True, size=size)
+        _HEADER.pack_into(shm.buf, 0, MAGIC, VERSION, slots, 0, slot_bytes, 0, 0, 0)
+        # zero seq on every slot so position 0's target (2) is unambiguous
+        for i in range(slots):
+            _SLOT.pack_into(shm.buf, HEADER_BYTES + i * slot_bytes, 0, 0, 0, 0)
+        return cls(shm, created=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        shm = shared_memory.SharedMemory(name=name, create=False)
+        _untrack(shm)
+        return cls(shm, created=False)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    # -- cursors --------------------------------------------------------------
+
+    def _read_u64(self, off: int) -> int:
+        return struct.unpack_from("<Q", self._shm.buf, off)[0]
+
+    def _write_u64(self, off: int, value: int) -> None:
+        struct.pack_into("<Q", self._shm.buf, off, value)
+
+    @property
+    def produced(self) -> int:
+        return self._read_u64(_PRODUCED_OFF)
+
+    @property
+    def consumed(self) -> int:
+        return self._read_u64(_CONSUMED_OFF)
+
+    def backlog(self) -> int:
+        return max(0, self.produced - self.consumed)
+
+    # -- producer (actor side) ------------------------------------------------
+
+    def try_push(self, data: bytes, crc: int | None = None) -> bool:
+        """Commit one payload; False when the ring is full or the payload
+        exceeds the slot capacity (the caller falls back to the socket)."""
+        if len(data) > self.payload_cap:
+            return False
+        p = self.produced
+        if p - self.consumed >= self.slots:
+            return False
+        if crc is None:
+            crc = zlib.crc32(data)
+        off = HEADER_BYTES + (p % self.slots) * self.slot_bytes
+        target = 2 * (p // self.slots) + 2
+        buf = self._shm.buf
+        _SLOT.pack_into(buf, off, target - 1, len(data), crc & 0xFFFFFFFF, 0)
+        buf[off + SLOT_HEADER_BYTES : off + SLOT_HEADER_BYTES + len(data)] = data
+        _SLOT.pack_into(buf, off, target, len(data), crc & 0xFFFFFFFF, 0)
+        self._write_u64(_PRODUCED_OFF, p + 1)
+        return True
+
+    def push(self, data: bytes, crc: int | None = None, timeout: float = 5.0) -> bool:
+        """`try_push` with a bounded wait for ring space. False only on
+        timeout (reader wedged or gone) or an oversize payload."""
+        if len(data) > self.payload_cap:
+            return False
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.try_push(data, crc):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.001)
+
+    # -- consumer (service side) ----------------------------------------------
+
+    def try_pop(self) -> tuple[bytes, bool] | None:
+        """-> (payload, crc_ok) for the next committed frame, or None when
+        the ring is empty. Advances `consumed` either way a frame is
+        returned — a corrupt frame is consumed (and reported by the
+        caller), never re-read forever."""
+        c = self.consumed
+        if self.produced <= c:
+            return None
+        off = HEADER_BYTES + (c % self.slots) * self.slot_bytes
+        target = 2 * (c // self.slots) + 2
+        buf = self._shm.buf
+        seq, length, crc, _ = _SLOT.unpack_from(buf, off)
+        if seq != target:
+            # producer advanced `produced` but the slot commit is not
+            # visible yet (or was torn): treat as empty, the next poll sees it
+            return None
+        length = min(length, self.payload_cap)
+        data = bytes(buf[off + SLOT_HEADER_BYTES : off + SLOT_HEADER_BYTES + length])
+        seq2 = _SLOT.unpack_from(buf, off)[0]
+        if seq2 != target:
+            return None
+        self._write_u64(_CONSUMED_OFF, c + 1)
+        return data, (zlib.crc32(data) & 0xFFFFFFFF) == crc
+
+    def pop(self, timeout: float = 0.2) -> tuple[bytes, bool] | None:
+        deadline = time.monotonic() + timeout
+        while True:
+            item = self.try_pop()
+            if item is not None:
+                return item
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.001)
+
+    # -- teardown -------------------------------------------------------------
+
+    def close(self, unlink: bool | None = None) -> None:
+        """Detach; unlink defaults to creator-side (the attaching service
+        passes unlink=True when it is tearing down a dead actor's ring)."""
+        do_unlink = self._created if unlink is None else unlink
+        try:
+            self._shm.close()
+        except (OSError, BufferError):
+            pass
+        if do_unlink:
+            try:
+                self._shm.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+
+
+class ShmReceiver(threading.Thread):
+    """Service-side drain thread for one attached ring: pops committed
+    frames and hands the payload bytes to `on_payload` (the service's
+    `_ingest_push`, or a relay's upstream batch queue). CRC mismatches go
+    to `on_corrupt` instead and the slot is skipped. `stop()` drains
+    whatever is already committed before detaching, so an actor's last
+    pushes before a clean BYE are never lost."""
+
+    def __init__(
+        self,
+        ring: ShmRing,
+        *,
+        on_payload,
+        on_corrupt=None,
+        name: str = "flock-shm-drain",
+    ):
+        super().__init__(name=name, daemon=True)
+        self.ring = ring
+        self._on_payload = on_payload
+        self._on_corrupt = on_corrupt
+        self._stop_evt = threading.Event()
+        self.frames = 0
+        self.bytes = 0
+        self.corrupt = 0
+
+    def run(self) -> None:
+        while not self._stop_evt.is_set():
+            self._drain_once(timeout=0.1)
+        # final drain: consume everything committed before the stop
+        while self._drain_once(timeout=0.0):
+            pass
+
+    def _drain_once(self, timeout: float) -> bool:
+        item = self.ring.pop(timeout=timeout) if timeout else self.ring.try_pop()
+        if item is None:
+            return False
+        payload, crc_ok = item
+        if not crc_ok:
+            self.corrupt += 1
+            if self._on_corrupt is not None:
+                self._on_corrupt(payload)
+            return True
+        self.frames += 1
+        self.bytes += len(payload)
+        self._on_payload(payload)
+        return True
+
+    def stop(self, join_timeout: float = 5.0, unlink: bool = True) -> None:
+        self._stop_evt.set()
+        if self.is_alive():
+            self.join(timeout=join_timeout)
+        self.ring.close(unlink=unlink)

@@ -55,10 +55,31 @@ Serving kinds (client -> server unless noted; sheeprl_tpu/serve/):
     RELOAD      JSON {path}; server replies RELOAD JSON
                 {ok, version, error}
 
+Scale-out kinds (ISSUE 19, flock/shm.py + flock/relay.py):
+
+    SHM_ATTACH  JSON {actor_id, name, slots, slot_bytes} — the actor
+                created a shared-memory ring (flock/shm.py) and asks the
+                colocated service to drain it; reply SHM_ATTACH JSON
+                {ok, error?}. After an ok the data socket carries only
+                control frames (heartbeats, BYE) — PUSH payloads ride
+                the ring
+    RELAY_HELLO JSON {relay_id, pid, proto} — a relay (flock/relay.py)
+                opens its upstream connection; reply WELCOME JSON
+                {shard_capacity, weight_version, random_phase}
+    PUSH_BATCH  u32 n_items, then per item u32 actor_id | u64 len |
+                PUSH payload. One learner-side reply PUSH_OK JSON
+                {rows_total, random_phase, weight_version} covers the
+                whole batch
+    RELAY_FWD   u32 actor_id | u8 inner_kind | inner payload — a
+                downstream actor's control frame (HELLO/HEARTBEAT/BYE)
+                forwarded verbatim through the relay; the reply is a
+                RELAY_FWD wrapping the service's normal reply frame
+
 Frame kinds form an EXTENSIBLE registry: subsystems claim values through
 `register_kind` (u8, append-only — committed values are pinned by
 tests/test_flock/test_wire.py and must never be renumbered; 1-11 belong
-to flock, 12-16 to serve, 17 to sheepscope profiling, 18+ are free).
+to flock, 12-16 to serve, 17 to sheepscope profiling, 18-21 to the
+flock scale-out tier, 22+ are free).
 
 Transport addresses serialize as `tcp:HOST:PORT` or `unix:PATH` — one
 string, environment-variable friendly for actor subprocesses.
@@ -86,6 +107,12 @@ __all__ = [
     "KIND_NAMES",
     "connect",
     "format_address",
+    "inject_shm_send",
+    "open_partition_window",
+    "pack_push_batch",
+    "pack_relay_fwd",
+    "unpack_push_batch",
+    "unpack_relay_fwd",
     "parse_address",
     "recv_frame",
     "recv_json",
@@ -158,9 +185,72 @@ RELOAD = register_kind(15, "reload")
 # importable without the flock package.
 PROFILE = register_kind(17, "profile")
 
+# flock scale-out tier (ISSUE 19): shared-memory transport + relay
+# aggregation. Appended, nothing renumbered.
+SHM_ATTACH = register_kind(18, "shm_attach")
+RELAY_HELLO = register_kind(19, "relay_hello")
+PUSH_BATCH = register_kind(20, "push_batch")
+RELAY_FWD = register_kind(21, "relay_fwd")
+
 
 class FrameError(ConnectionError):
     """Malformed frame or protocol violation on a flock socket."""
+
+
+# ---------------------------------------------------------------------------
+# relay codecs (ISSUE 19): payload layouts for RELAY_FWD / PUSH_BATCH.
+# They live HERE — next to the kinds they encode — so flock/relay.py and
+# flock/service.py share one definition without importing each other.
+# ---------------------------------------------------------------------------
+
+_FWD_HEAD = struct.Struct("<IB")
+_U32S = struct.Struct("<I")
+_U64S = struct.Struct("<Q")
+
+
+def pack_relay_fwd(actor_id: int, inner_kind: int, payload: bytes = b"") -> bytes:
+    """RELAY_FWD payload: u32 actor_id | u8 inner_kind | inner payload."""
+    return _FWD_HEAD.pack(actor_id, inner_kind) + payload
+
+
+def unpack_relay_fwd(payload: bytes) -> tuple[int, int, bytes]:
+    actor_id, inner_kind = _FWD_HEAD.unpack_from(payload, 0)
+    return actor_id, inner_kind, payload[_FWD_HEAD.size :]
+
+
+def pack_push_batch(items) -> bytes:
+    """PUSH_BATCH payload: u32 n, then per item u32 actor_id | u64 len |
+    PUSH payload (the `service.pack_push` bytes, forwarded verbatim so
+    sheepscope trace context survives the relay hop bit-for-bit)."""
+    parts = [_U32S.pack(len(items))]
+    for actor_id, payload in items:
+        parts += [_U32S.pack(actor_id), _U64S.pack(len(payload)), payload]
+    return b"".join(parts)
+
+
+def unpack_push_batch(payload: bytes):
+    try:
+        (n,) = _U32S.unpack_from(payload, 0)
+        off = 4
+        items = []
+        for _ in range(n):
+            (actor_id,) = _U32S.unpack_from(payload, off)
+            (plen,) = _U64S.unpack_from(payload, off + 4)
+            off += 12
+            if off + plen > len(payload):
+                raise FrameError(
+                    f"push_batch item overruns payload "
+                    f"({off + plen} > {len(payload)})"
+                )
+            items.append((actor_id, payload[off : off + plen]))
+            off += plen
+    except struct.error as err:
+        raise FrameError(f"truncated push_batch payload: {err}") from err
+    if off != len(payload):
+        raise FrameError(
+            f"push_batch trailing bytes ({len(payload) - off} past item {n})"
+        )
+    return items
 
 
 # ---------------------------------------------------------------------------
@@ -184,24 +274,65 @@ def partition_remaining() -> float:
         return max(0.0, _partition_until - time.monotonic())
 
 
-def _inject_send(sock: socket.socket, data: bytes) -> bytes | None:
-    """Advance every net site's per-process frame counter and apply the
-    fired fault, if any. Returns the (possibly corrupted) bytes to send, or
-    None when the frame must be silently dropped. Inert without an armed
-    plan: one attribute read, no counters, no locks."""
-    global _partition_until
+def _fire_net_sites():
+    """Advance every net site's per-process frame counter and return the
+    specs that fired on this frame (usually none). Inert without an armed
+    plan: one attribute read, no counters, no locks. Shared by the socket
+    send path and the shm ring producer so `net.*` clauses fire no matter
+    which transport carries the frame."""
     from ..resilience import inject
 
     plan = inject.get_plan()
     if not plan.specs or not any(s.site in NET_SITES for s in plan.pending()):
-        return data
+        return ()
     fired = []
     for site in NET_SITES:
         spec = plan.fire_next(site)
         if spec is not None:
             fired.append(spec)
             inject.count(f"Fault/{site}")
-    for spec in fired:
+    return fired
+
+
+def open_partition_window(seconds: float | None) -> None:
+    """Open the process-local injected-partition window: `connect` refuses
+    until it elapses, so reconnect backoff genuinely waits it out."""
+    global _partition_until
+    with _partition_gate:
+        _partition_until = time.monotonic() + (
+            seconds or DEFAULT_PARTITION_S
+        )
+
+
+def inject_shm_send(data: bytes) -> bytes | None:
+    """`net.*` fault hook for the shared-memory ring producer
+    (flock/shm.py), mapping each socket fault onto its shm analogue:
+    delay sleeps before the slot write, drop returns None (the frame is
+    never committed), corrupt garbles the payload AFTER its checksum was
+    taken (the reader's CRC check skips the slot), and partition opens
+    the connect-refusing window and raises — the link tears down the
+    ring and falls back to the socket path, whose reconnect backoff then
+    waits the window out."""
+    for spec in _fire_net_sites():
+        if spec.site == "net.delay":
+            time.sleep((spec.param or DEFAULT_DELAY_MS) / 1000.0)
+        elif spec.site == "net.drop":
+            return None
+        elif spec.site == "net.corrupt":
+            data = CORRUPT_MAGIC + data[len(CORRUPT_MAGIC):]
+        elif spec.site == "net.partition":
+            open_partition_window(spec.param)
+            raise ConnectionResetError(
+                "injected net.partition: shm ring detached"
+            )
+    return data
+
+
+def _inject_send(sock: socket.socket, data: bytes) -> bytes | None:
+    """Apply any fired net fault to one socket frame. Returns the
+    (possibly corrupted) bytes to send, or None when the frame must be
+    silently dropped."""
+    for spec in _fire_net_sites():
         if spec.site == "net.delay":
             time.sleep((spec.param or DEFAULT_DELAY_MS) / 1000.0)
         elif spec.site == "net.drop":
@@ -211,10 +342,7 @@ def _inject_send(sock: socket.socket, data: bytes) -> bytes | None:
             # one connection; the sender's socket stays healthy
             return CORRUPT_MAGIC + data[len(MAGIC):]
         elif spec.site == "net.partition":
-            with _partition_gate:
-                _partition_until = time.monotonic() + (
-                    spec.param or DEFAULT_PARTITION_S
-                )
+            open_partition_window(spec.param)
             try:
                 sock.shutdown(socket.SHUT_RDWR)  # both directions dead
             except OSError:

@@ -1,0 +1,200 @@
+"""In-network sample pre-assembly (ISSUE 19, tentpole a): the PR-3
+`SamplePrefetcher` contract generalized across replay-service shards.
+The receipt that matters: assembler ON vs OFF trains on bit-identical
+batches — hits serve pre-drawn slices, misses rewind every shard's
+sampler PRNG plus the remainder rotation and resample synchronously."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.flock.assemble import BatchAssembler
+from sheeprl_tpu.flock.service import ReplayService
+from sheeprl_tpu.parallel.pipeline import PipelineStats
+
+from .test_service import _Recorder
+
+
+class _RngShard:
+    """Replay-shard stand-in with the full sampling contract: PRNG-driven
+    draws, `get/set_sample_state` for the rewind path, and a write `epoch`
+    for the consistency guard."""
+
+    def __init__(self, cap, seed=7):
+        self.cap = cap
+        self.rows = []
+        self.epoch = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, tree, indices=None):
+        self.rows.append(tree)
+        self.epoch += 1
+
+    def sample(self, n, **kw):
+        if not self.rows:
+            raise ValueError("empty shard")
+        draw = self._rng.integers(0, len(self.rows), size=n)
+        base = float(len(self.rows))
+        if "sequence_length" in kw:
+            seq = int(kw["sequence_length"])
+            out = np.tile(
+                np.asarray(draw, np.float32).reshape(1, 1, n, 1), (seq, 1, 1, 1)
+            )
+            return {"x": out + base}
+        return {"x": np.asarray(draw, np.float32).reshape(n, 1) + base}
+
+    def get_sample_state(self):
+        return self._rng.bit_generator.state
+
+    def set_sample_state(self, state):
+        self._rng.bit_generator.state = state
+
+    def to_bytes(self):
+        return b""
+
+    @classmethod
+    def from_bytes(cls, blob, **kw):
+        return cls(0)
+
+
+def _service(n_actors=3):
+    return ReplayService(
+        algo="dreamer_v3", n_actors=n_actors, mode="buffer",
+        capacity_rows=16, make_shard=_RngShard, telem=_Recorder(),
+    )
+
+
+def _fill(svc, rows_per_shard=4):
+    for aid in range(svc.n_actors):
+        for _ in range(rows_per_shard):
+            svc.shard(aid).add({"x": np.zeros((1, 1), np.float32)})
+
+
+def _same(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        assert a[k].shape == b[k].shape
+        assert a[k].tobytes() == b[k].tobytes()
+
+
+@pytest.mark.timeout(60)
+def test_quiet_draws_are_bit_exact_and_mostly_hits():
+    """No writes between serves: every prefetched batch passes the epoch
+    guard, and the served sequence is byte-identical to the unassembled
+    service driven by the same call script."""
+    with _service() as plain, _service() as svc:
+        _fill(plain)
+        _fill(svc)
+        stats = PipelineStats()
+        asm = BatchAssembler(svc, max_staleness=0, stats=stats)
+        try:
+            for _ in range(6):
+                _same(plain.sample(4), asm.sample(4))
+        finally:
+            asm.close()
+        # first call has nothing in flight; the rest serve pre-assembled
+        assert stats.sample_hits == 5
+        assert stats.sample_misses == 0
+        assert stats.sample_prefetches >= 6
+
+
+@pytest.mark.timeout(60)
+def test_write_between_serves_misses_rewinds_and_stays_bit_exact():
+    """A write landing in the serve-to-serve gap advances the epoch: the
+    prefetched batch is discarded and the PRNG + remainder-rotation rewind
+    makes the synchronous resample draw exactly the unassembled answer."""
+    with _service() as plain, _service() as svc:
+        _fill(plain)
+        _fill(svc)
+        stats = PipelineStats()
+        asm = BatchAssembler(svc, max_staleness=0, stats=stats)
+        try:
+            row = {"x": np.zeros((1, 1), np.float32)}
+            for i in range(5):
+                _same(plain.sample(4), asm.sample(4))
+                plain.shard(i % 3).add(row)
+                svc.shard(i % 3).add(row)
+        finally:
+            asm.close()
+        # every gap had a write: the first in-flight assembly misses, then
+        # `predict_quiet` pauses dispatch (strict staleness could never hit
+        # there) — later calls are plain synchronous samples, not misses
+        assert stats.sample_hits == 0
+        assert stats.sample_misses == 1
+        assert stats.sample_prefetches == 1
+
+
+@pytest.mark.timeout(60)
+def test_signature_change_discards_and_stays_bit_exact():
+    """Changing batch size or sample kwargs between calls invalidates the
+    in-flight assembly — the rewind keeps the A/B exact anyway."""
+    script = [
+        dict(batch_size=4),
+        dict(batch_size=6),
+        dict(batch_size=6),
+        dict(batch_size=4, sequence_length=3, n_samples=1),
+        dict(batch_size=4, sequence_length=3, n_samples=1),
+    ]
+    with _service() as plain, _service() as svc:
+        _fill(plain)
+        _fill(svc)
+        asm = BatchAssembler(svc, max_staleness=0)
+        try:
+            for kw in script:
+                kw = dict(kw)
+                bs = kw.pop("batch_size")
+                _same(plain.sample(bs, **kw), asm.sample(bs, **kw))
+        finally:
+            asm.close()
+
+
+@pytest.mark.timeout(60)
+def test_max_staleness_serves_through_writes():
+    """Bounded staleness (the PR-3 knob): with max_staleness >= the writes
+    per gap, prefetched batches keep serving instead of rewinding."""
+    with _service() as svc:
+        _fill(svc)
+        stats = PipelineStats()
+        asm = BatchAssembler(svc, max_staleness=1, stats=stats)
+        try:
+            asm.sample(4)
+            for i in range(4):
+                svc.shard(i % 3).add({"x": np.zeros((1, 1), np.float32)})
+                out = asm.sample(4)
+                assert out["x"].shape == (4, 1)
+        finally:
+            asm.close()
+        assert stats.sample_hits == 4
+        assert stats.sample_misses == 0
+
+
+@pytest.mark.timeout(60)
+def test_disabled_paths_delegate_to_the_service():
+    """chunks-mode services and `enabled=False` fall through untouched —
+    and attribute access proxies to the service either way."""
+    with _service() as svc:
+        _fill(svc)
+        asm = BatchAssembler(svc, enabled=False)
+        assert not asm.enabled
+        assert asm.sample(4)["x"].shape == (4, 1)
+        assert asm.rows_total() == svc.rows_total()  # __getattr__ delegation
+        asm.close()
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=8,
+        telem=_Recorder(),
+    ) as chunks_svc:
+        asm = BatchAssembler(chunks_svc)
+        assert not asm.enabled  # pre-assembly is a buffer-mode feature
+        asm.close()
+
+
+@pytest.mark.timeout(60)
+def test_close_quiesces_workers_and_disables():
+    with _service() as svc:
+        _fill(svc)
+        asm = BatchAssembler(svc)
+        asm.sample(4)  # leaves one assembly in flight
+        asm.close()
+        assert not asm.enabled
+        assert not asm._workers
+        # post-close sampling still works (synchronous path)
+        assert asm.sample(4)["x"].shape == (4, 1)

@@ -1,0 +1,263 @@
+"""Hierarchical actor aggregation (ISSUE 19 tentpole c): the relay hop
+must be invisible to actors (same protocol) and to the learner
+(membership, rejoin receipts and weight versions unchanged), while
+collapsing N actor connections into one batched upstream. Chaos: a
+partitioned or killed relay heals without losing the learner."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.flock import relay as relay_mod
+from sheeprl_tpu.flock import wire
+from sheeprl_tpu.flock.actor import ResilientLink, _ServiceLink
+from sheeprl_tpu.flock.relay import Relay
+from sheeprl_tpu.flock.service import PROTO_VERSION, ReplayService, pack_push
+from sheeprl_tpu.resilience import inject
+
+from .test_service import _FakeActor, _Recorder, _chunk, _wait_events
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan(monkeypatch):
+    monkeypatch.delenv(inject.ENV_VAR, raising=False)
+    monkeypatch.delenv(inject.SEED_VAR, raising=False)
+    inject.reset_plan()
+    wire._partition_until = 0.0
+    yield
+    inject.reset_plan()
+    wire._partition_until = 0.0
+
+
+def _arm(monkeypatch, text):
+    monkeypatch.setenv(inject.ENV_VAR, text)
+    inject.reset_plan()
+    return inject.get_plan()
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.01)
+
+
+def _service(rec, n_actors=4):
+    return ReplayService(
+        algo="ppo", n_actors=n_actors, mode="chunks", capacity_rows=64,
+        telem=rec,
+    )
+
+
+@pytest.mark.timeout(60)
+def test_relay_batches_pushes_and_forwards_membership(monkeypatch):
+    # widen the coalescing dwell so a loaded box can't spread 6 pushes
+    # into 6 singleton flushes — the batching assertion stays exact
+    monkeypatch.setattr(relay_mod, "FLUSH_S", 0.3)
+    rec = _Recorder()
+    with _service(rec) as svc:
+        addr = svc.start()
+        with Relay(upstream=addr, relay_id=0) as relay:
+            raddr = relay.start()
+            # actors speak the UNMODIFIED protocol to the relay
+            a0, a1 = _FakeActor(raddr, 0), _FakeActor(raddr, 1)
+            assert a0.welcome["shard_capacity"] == 64
+            _wait_events(rec, "flock.relay_joined")
+            _wait_events(rec, "flock.actor_joined", n=2)
+            for _ in range(3):
+                a0.push(_chunk(1.0), rows=4)
+                a1.push(_chunk(2.0), rows=4)
+            _wait(lambda: svc.rows_total() == 24, msg="forwarded rows")
+            # batched: 6 pushes crossed upstream in < 6 PUSH_BATCH frames
+            gauges = relay.gauges()
+            assert gauges["Flock/relay/forwarded"] == 6.0
+            assert gauges["Flock/relay/batches"] < 6.0
+            assert gauges["Flock/relay/members"] == 2.0
+            assert svc.gauges()["Flock/transport/relay_batches"] >= 1.0
+            # learner-side liveness comes from forwarded heartbeats
+            hb = a0.heartbeat(
+                actor_id=0, env_steps=12, weight_version=0, sps=1.0,
+                mono_ts=time.monotonic(), wall_ts=time.time(),
+            )
+            assert "random_phase" in hb
+            assert svc.actors_alive() == 2
+            a0.bye()
+            a1.bye()
+            _wait(lambda: svc.actors_alive() == 0, msg="BYE forwarding")
+
+
+@pytest.mark.timeout(60)
+def test_relay_weight_cache_serves_the_learners_exact_frame():
+    rec = _Recorder()
+    with _service(rec) as svc:
+        addr = svc.start()
+        svc.publish([np.arange(6, dtype=np.float32)])
+        with Relay(upstream=addr, relay_id=0) as relay:
+            raddr = relay.start()
+            ws = wire.connect(raddr, timeout=5.0)
+            try:
+                wire.send_json(ws, wire.HELLO, {
+                    "actor_id": 0, "pid": 1, "role": "weights",
+                    "proto": PROTO_VERSION,
+                })
+                got = None
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    wire.send_json(ws, wire.GET_WEIGHTS, {"have_version": -1})
+                    kind, payload = wire.recv_frame(ws)
+                    if kind == wire.WEIGHTS:
+                        got = payload
+                        break
+                    time.sleep(0.05)
+                # ONE cached snapshot per version, byte-identical to the
+                # learner's frame — N actors cost one upstream transfer
+                assert got == svc._weight_payload
+                wire.send_json(ws, wire.GET_WEIGHTS, {"have_version": 1})
+                kind, _ = wire.recv_frame(ws)
+                assert kind == wire.WEIGHTS_UNCHANGED
+            finally:
+                ws.close()
+
+
+@pytest.mark.timeout(60)
+def test_shm_attach_through_relay_reaches_the_learner():
+    """A colocated actor rides a shared-memory ring INTO its relay; the
+    payload then crosses upstream in a PUSH_BATCH — both scale-out
+    transports compose."""
+    rec = _Recorder()
+    with _service(rec) as svc:
+        addr = svc.start()
+        with Relay(upstream=addr, relay_id=0, telem=rec) as relay:
+            raddr = relay.start()
+            link = _ServiceLink(raddr, 0, timeout=5.0, use_shm=True)
+            reply = link.push(
+                [(_chunk(1.0), None)], rows=4, env_steps=4, weight_version=0
+            )
+            assert reply.get("shm") is True
+            _wait_events(rec, "flock.shm_attached")
+            _wait(lambda: svc.rows_total() == 4, msg="shm->relay->learner")
+            assert svc.gauges()["Flock/transport/relay_frames"] == 1.0
+            link.close()
+
+
+@pytest.mark.timeout(60)
+def test_net_partition_on_relay_upstream_heals_and_rehellos(monkeypatch):
+    """Chaos satellite: net.partition fired on the relay's upstream send.
+    The relay redials through the partition window, re-HELLOs its
+    members (the learner sees the rejoin), and the batch that hit the
+    partition is retried on the fresh connection — rows land."""
+    rec = _Recorder()
+    with _service(rec) as svc:
+        addr = svc.start()
+        with Relay(upstream=addr, relay_id=0) as relay:
+            raddr = relay.start()
+            a0 = _FakeActor(raddr, 0)
+            a0.push(_chunk(1.0), rows=4)
+            _wait(lambda: svc.rows_total() == 4, msg="pre-partition push")
+            # armed now: the next frame send is the forwarder's PUSH_BATCH
+            # (enqueued directly so no downstream reply races the counter)
+            _arm(monkeypatch, "net.partition@1:0.5")
+            payload = pack_push(
+                [(_chunk(2.0), None)], rows=4, env_steps=8, weight_version=0
+            )
+            relay._enqueue(0, payload)
+            _wait(
+                lambda: svc.rows_total() == 8,
+                timeout=20.0,
+                msg="post-partition batch retry",
+            )
+            assert inject.counters().get("Fault/net.partition") == 1.0
+            # the redial re-registered the member: learner-side rejoin
+            _wait_events(rec, "flock.actor_rejoined")
+            _wait_events(rec, "flock.relay_disconnected")
+            assert rec.names().count("flock.relay_joined") == 2
+            # the actor's own connection never noticed
+            assert a0.push(_chunk(3.0), rows=4)["rows_total"] >= 8
+            _wait(lambda: svc.rows_total() == 12, msg="post-heal push")
+            a0.bye()
+
+
+@pytest.mark.timeout(90)
+def test_relay_death_and_respawn_at_same_address_preserves_rejoin(tmp_path):
+    """The peer-crash shape on a relay: the process dies, a replacement
+    binds the SAME address (launcher contract), and the actors'
+    ResilientLink backoff carries their next push through the new hop —
+    learner keeps serving throughout, rejoin receipts fire."""
+    rec = _Recorder()
+    bind = f"unix:{tmp_path}/r0.sock"
+    with _service(rec) as svc:
+        addr = svc.start()
+        relay1 = Relay(upstream=addr, relay_id=0, bind=bind)
+        relay1.start()
+        link = ResilientLink(bind, 0, timeout=5.0)
+        link.push(
+            [(_chunk(1.0), None)], rows=4, env_steps=4, weight_version=0
+        )
+        _wait(lambda: svc.rows_total() == 4, msg="push via relay1")
+        relay1.close()  # the "SIGKILL": downstream conns die with it
+        # learner is UNHARMED: a directly-connected actor still lands
+        direct = _FakeActor(addr, 1)
+        assert direct.push(_chunk(9.0), rows=4)["rows_total"] == 8
+        # replacement binds the same path (what ActorFleet's respawn does)
+        relay2 = Relay(upstream=addr, relay_id=0, bind=bind)
+        relay2.start()
+        # the actor's next push reconnects through the new relay
+        link.push(
+            [(_chunk(2.0), None)], rows=4, env_steps=8, weight_version=0
+        )
+        _wait(lambda: svc.rows_total() == 12, msg="push via relay2")
+        _wait_events(rec, "flock.actor_rejoined")
+        link.close()
+        direct.bye()
+        relay2.close()
+
+
+def test_launcher_topology_maps_actors_to_relays(tmp_path):
+    """`--relays R`: actor i dials relay i % R; R is clamped to the actor
+    count; R=0 keeps the direct topology."""
+    from sheeprl_tpu.algos.args import StandardArgs
+    from sheeprl_tpu.flock.launcher import ActorFleet
+
+    args = StandardArgs(flock="4", relays=2)
+    fleet = ActorFleet(
+        algo="ppo", args=args, address="unix:/tmp/svc.sock",
+        log_dir=str(tmp_path / "run"),
+    )
+    assert fleet.n_relays == 2
+    assert fleet._actor_address(0) == fleet._relay_addrs[0]
+    assert fleet._actor_address(1) == fleet._relay_addrs[1]
+    assert fleet._actor_address(2) == fleet._relay_addrs[0]
+    assert fleet._actor_address(3) == fleet._relay_addrs[1]
+    # every bind is a unix path under the AF_UNIX length cap
+    for a in fleet._relay_addrs.values():
+        assert a.startswith("unix:") and len(a) - 5 < 100
+    fleet.close()
+
+    direct = ActorFleet(
+        algo="ppo", args=StandardArgs(flock="2"),
+        address="unix:/tmp/svc.sock", log_dir=str(tmp_path / "d"),
+    )
+    assert direct.n_relays == 0
+    assert direct._actor_address(1) == "unix:/tmp/svc.sock"
+    direct.close()
+
+    clamped = ActorFleet(
+        algo="ppo",
+        args=StandardArgs(flock="2", relays=8),
+        address="unix:/tmp/svc.sock", log_dir=str(tmp_path / "c"),
+    )
+    assert clamped.n_relays == 2  # never more relays than actors
+    clamped.close()
+
+
+def test_relays_arg_validation():
+    from sheeprl_tpu.algos.args import StandardArgs
+
+    with pytest.raises(ValueError, match="relays"):
+        StandardArgs(relays=-1)
+    with pytest.raises(ValueError, match="relays"):
+        StandardArgs(relays="two")
+    assert StandardArgs(relays="3").relays == 3

@@ -1,0 +1,282 @@
+"""Zero-copy shared-memory ring transport (ISSUE 19 tentpole b): ring
+seqlock semantics, the service-side attach/drain/teardown lifecycle, and
+the chaos contract — `net.*` sites fire on shm frames exactly like
+socket frames, and every ring failure falls back to the socket path
+without losing the learner."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.flock import shm as shm_mod
+from sheeprl_tpu.flock import wire
+from sheeprl_tpu.flock.actor import ResilientLink, _ServiceLink
+from sheeprl_tpu.flock.service import ReplayService
+from sheeprl_tpu.flock.shm import ShmReceiver, ShmRing, ring_geometry, shm_enabled_for
+from sheeprl_tpu.resilience import inject
+
+from .test_service import _Recorder, _chunk, _wait_events
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan(monkeypatch):
+    monkeypatch.delenv(inject.ENV_VAR, raising=False)
+    monkeypatch.delenv(inject.SEED_VAR, raising=False)
+    inject.reset_plan()
+    wire._partition_until = 0.0
+    yield
+    inject.reset_plan()
+    wire._partition_until = 0.0
+
+
+def _arm(monkeypatch, text):
+    monkeypatch.setenv(inject.ENV_VAR, text)
+    inject.reset_plan()
+    return inject.get_plan()
+
+
+def _wait(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# ring unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_roundtrip_wraparound_and_ordering():
+    ring = ShmRing.create(slots=4, slot_bytes=shm_mod.SLOT_HEADER_BYTES + 256)
+    try:
+        # three full revolutions: seqlock targets keep advancing, FIFO holds
+        for round_ in range(3):
+            for i in range(4):
+                assert ring.try_push(b"%d:%d" % (round_, i))
+            for i in range(4):
+                payload, crc_ok = ring.try_pop()
+                assert crc_ok and payload == b"%d:%d" % (round_, i)
+        assert ring.try_pop() is None  # empty again
+    finally:
+        ring.close()
+
+
+def test_ring_full_and_oversize_refuse():
+    ring = ShmRing.create(slots=2, slot_bytes=shm_mod.SLOT_HEADER_BYTES + 64)
+    try:
+        assert ring.try_push(b"a") and ring.try_push(b"b")
+        assert not ring.try_push(b"c")  # full: caller falls back to socket
+        assert not ring.push(b"c", timeout=0.05)  # bounded wait, then False
+        assert not ring.try_push(b"x" * 65)  # oversize payload
+        payload, _ = ring.try_pop()
+        assert payload == b"a"
+        assert ring.try_push(b"c")  # slot freed
+    finally:
+        ring.close()
+
+
+def test_ring_attach_sees_creator_frames_and_unlink_is_idempotent():
+    ring = ShmRing.create(slots=4, slot_bytes=shm_mod.SLOT_HEADER_BYTES + 64)
+    peer = ShmRing.attach(ring.name)
+    ring.try_push(b"hello")
+    payload, crc_ok = peer.try_pop()
+    assert crc_ok and payload == b"hello"
+    peer.close(unlink=True)
+    ring.close()  # creator unlink after peer unlink must not raise
+    with pytest.raises(FileNotFoundError):
+        ShmRing.attach(ring.name)
+
+
+def test_receiver_drains_commits_on_stop_and_skips_bad_crc():
+    ring = ShmRing.create(slots=8, slot_bytes=shm_mod.SLOT_HEADER_BYTES + 64)
+    got, bad = [], []
+    rx = ShmReceiver(ring, on_payload=got.append, on_corrupt=bad.append)
+    rx.start()
+    ring.push(b"good-1")
+    ring.push(b"garbled", crc=0xDEADBEEF)  # wrong checksum in the slot
+    ring.push(b"good-2")
+    _wait(lambda: len(got) == 2, msg="drain")
+    rx.stop(unlink=True)
+    assert got == [b"good-1", b"good-2"]
+    assert bad == [b"garbled"] and rx.corrupt == 1
+    with pytest.raises(FileNotFoundError):
+        ShmRing.attach(ring.name)  # stop() unlinked
+
+
+def test_ring_geometry_sizing_knobs(monkeypatch):
+    slots, slot_bytes = ring_geometry(100)
+    assert slots == shm_mod.DEFAULT_SLOTS
+    assert slot_bytes == shm_mod.SLOT_HEADER_BYTES + 64 * 1024  # floor
+    _, big = ring_geometry(1_000_000)
+    assert big == shm_mod.SLOT_HEADER_BYTES + 2_000_000  # 2x headroom
+    monkeypatch.setenv(shm_mod.SLOTS_VAR, "16")
+    monkeypatch.setenv(shm_mod.SLOT_BYTES_VAR, "4096")
+    slots, slot_bytes = ring_geometry(1_000_000)
+    assert (slots, slot_bytes) == (16, shm_mod.SLOT_HEADER_BYTES + 4096)
+
+
+def test_shm_enabled_for_policy(monkeypatch):
+    monkeypatch.delenv(shm_mod.ENABLE_VAR, raising=False)
+    assert not shm_enabled_for(0)
+    for off in ("0", "off", "no"):
+        monkeypatch.setenv(shm_mod.ENABLE_VAR, off)
+        assert not shm_enabled_for(0)
+    for on in ("1", "all", "on"):
+        monkeypatch.setenv(shm_mod.ENABLE_VAR, on)
+        assert shm_enabled_for(0) and shm_enabled_for(7)
+    monkeypatch.setenv(shm_mod.ENABLE_VAR, "0,2")  # mixed topology (CI smoke)
+    assert shm_enabled_for(0) and shm_enabled_for(2)
+    assert not shm_enabled_for(1) and not shm_enabled_for(3)
+
+
+# ---------------------------------------------------------------------------
+# service integration: attach, ingest, teardown
+# ---------------------------------------------------------------------------
+
+
+def _push(link, v=1.0, rows=4):
+    return link.push(
+        [(_chunk(v, rows=rows), None)], rows=rows, env_steps=rows, weight_version=0
+    )
+
+
+@pytest.mark.timeout(60)
+def test_shm_attach_ingests_pushes_and_counts_transport():
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        link = _ServiceLink(addr, 0, timeout=5.0, use_shm=True)
+        sock_link = _ServiceLink(addr, 1, timeout=5.0, use_shm=False)
+        # first push lazily creates + attaches the ring, then rides it
+        assert _push(link, 1.0).get("shm") is True
+        assert _push(link, 2.0).get("shm") is True
+        _wait_events(rec, "flock.shm_attached")
+        _wait(lambda: svc.rows_total() == 8, msg="shm ingest")
+        _push(sock_link, 3.0)
+        gauges = svc.gauges()
+        assert gauges["Flock/transport/shm_frames"] == 2.0
+        assert gauges["Flock/transport/socket_frames"] == 1.0
+        assert gauges["Flock/transport/shm_rings"] == 1.0
+        assert gauges["Flock/transport/shm_bytes"] > 0.0
+        ring_name = link._ring.name
+        link.close()  # clean BYE detaches AND unlinks
+        sock_link.close()
+        _wait_events(rec, "flock.actor_disconnected", n=2)
+        with pytest.raises(FileNotFoundError):
+            ShmRing.attach(ring_name)
+
+
+@pytest.mark.timeout(60)
+def test_shm_last_pushes_survive_clean_bye():
+    """Frames committed to the ring right before BYE are drained, not
+    dropped: the receiver's stop() consumes everything committed."""
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        link = _ServiceLink(addr, 0, timeout=5.0, use_shm=True)
+        for i in range(5):
+            assert _push(link, float(i)).get("shm") is True
+        link.close()
+        _wait(lambda: svc.rows_total() == 20, msg="final drain")
+
+
+@pytest.mark.timeout(60)
+def test_abrupt_shm_actor_death_unlinks_ring_and_learner_keeps_serving():
+    """The peer-crash shape on an shm actor: SIGKILL leaves a ring the
+    creator can never unlink — the service must reap it when the data
+    connection dies, keep serving other actors, and accept a fresh ring
+    from the respawned incarnation."""
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=2, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        link = _ServiceLink(addr, 0, timeout=5.0, use_shm=True)
+        peer = _ServiceLink(addr, 1, timeout=5.0, use_shm=False)
+        assert _push(link, 1.0).get("shm") is True
+        ring_name = link._ring.name
+        # crash: the socket dies with no BYE, the ring is left behind
+        link.sock.close()
+        _wait_events(rec, "flock.actor_disconnected")
+        _wait(
+            lambda: not os.path.exists(f"/dev/shm/{ring_name}"),
+            msg="service-side ring unlink",
+        )
+        # the learner keeps serving the surviving actor...
+        assert _push(peer, 2.0)["rows_total"] >= 4
+        # ...and the respawned actor re-attaches a FRESH ring (new name)
+        link._ring = None  # the old mapping died with the process
+        relink = _ServiceLink(addr, 0, timeout=5.0, use_shm=True)
+        assert _push(relink, 3.0).get("shm") is True
+        assert relink._ring.name != ring_name
+        _wait(lambda: svc.rows_total() == 12, msg="rejoined shm ingest")
+        _wait_events(rec, "flock.actor_rejoined")
+        relink.close()
+        peer.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: net.* sites firing on the shm transport
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(60)
+def test_net_corrupt_on_shm_frame_is_skipped_with_receipt(monkeypatch):
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        link = _ServiceLink(addr, 0, timeout=5.0, use_shm=True)
+        assert _push(link, 1.0).get("shm") is True
+        _wait(lambda: svc.rows_total() == 4, msg="clean ingest")
+        # armed AFTER the handshake: the next net frame is the shm push
+        _arm(monkeypatch, "net.corrupt@1")
+        assert _push(link, 2.0).get("shm") is True  # committed, but garbled
+        _wait_events(rec, "flock.shm_corrupt")
+        assert inject.counters().get("Fault/net.corrupt") == 1.0
+        # the corrupt frame was consumed (not re-read forever), the next
+        # clean push lands, and the learner never saw poisoned bytes
+        assert _push(link, 3.0).get("shm") is True
+        _wait(lambda: svc.rows_total() == 8, msg="post-corrupt ingest")
+        assert svc.gauges()["Flock/transport/shm_corrupt"] == 1.0
+        link.close()
+
+
+@pytest.mark.timeout(60)
+def test_net_partition_on_shm_falls_back_to_socket(monkeypatch):
+    """The chaos contract end to end: an injected partition on the ring
+    path detaches the ring, the reconnect waits the window out on the
+    SOCKET path, and the in-flight chunk is replayed — zero rows lost,
+    shm disabled for the link's lifetime (the degraded path is real)."""
+    rec = _Recorder()
+    with ReplayService(
+        algo="ppo", n_actors=1, mode="chunks", capacity_rows=64, telem=rec,
+    ) as svc:
+        addr = svc.start()
+        link = ResilientLink(addr, 0, timeout=5.0, use_shm=True)
+        assert _push(link, 1.0).get("shm") is True
+        ring_name = link._link._ring.name
+        _arm(monkeypatch, "net.partition@1:0.5")
+        t0 = time.monotonic()
+        reply = _push(link, 2.0)  # partition fires on the ring path
+        waited = time.monotonic() - t0
+        # the replayed push went over the SOCKET (per-push reply, no shm)
+        assert "shm" not in reply
+        assert reply["rows_total"] == 8  # nothing lost
+        assert waited >= 0.4  # the reconnect genuinely waited the window
+        assert inject.counters().get("Fault/net.partition") == 1.0
+        assert not link._use_shm  # sticky fallback
+        _wait_events(rec, "flock.actor_rejoined")
+        with pytest.raises(FileNotFoundError):
+            ShmRing.attach(ring_name)  # the partitioned ring was torn down
+        assert _push(link, 3.0)["rows_total"] == 12  # still on socket
+        link.close()

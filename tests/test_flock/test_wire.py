@@ -202,6 +202,11 @@ PINNED_KINDS = {
     # 16 = "health" is registered by serve/server.py at import time
     # sheepscope (ISSUE 17)
     "profile": 17,
+    # flock scale-out (ISSUE 19)
+    "shm_attach": 18,
+    "relay_hello": 19,
+    "push_batch": 20,
+    "relay_fwd": 21,
 }
 
 
@@ -259,3 +264,36 @@ def test_profile_frame_roundtrip():
     finally:
         a.close()
         b.close()
+
+
+# ---------------------------------------------------------------------------
+# relay codecs (ISSUE 19): PUSH payloads must survive the aggregation hop
+# verbatim — shard bytes and sheepscope trace context are bit-equal after
+# pack/unpack, whatever binary junk they contain
+# ---------------------------------------------------------------------------
+
+
+def test_relay_fwd_roundtrip():
+    inner = b"\x00\xffhello\x00" * 7
+    blob = wire.pack_relay_fwd(42, wire.HEARTBEAT, inner)
+    aid, kind, payload = wire.unpack_relay_fwd(blob)
+    assert (aid, kind) == (42, wire.HEARTBEAT)
+    assert payload == inner  # verbatim, not re-encoded
+
+
+def test_push_batch_roundtrip_preserves_payloads_verbatim():
+    items = [
+        (0, b""),
+        (3, bytes(range(256))),
+        (7, b"\x00" * 1024),
+    ]
+    blob = wire.pack_push_batch(items)
+    assert wire.unpack_push_batch(blob) == items
+
+
+def test_push_batch_rejects_truncation():
+    blob = wire.pack_push_batch([(1, b"abc"), (2, b"defg")])
+    with pytest.raises(wire.FrameError):
+        wire.unpack_push_batch(blob[:-1])
+    with pytest.raises(wire.FrameError):
+        wire.unpack_push_batch(blob + b"\x00")

@@ -3224,6 +3224,87 @@ def bench_serve() -> None:
         server.close()
     print(f"serve sac reload: {results['sac_reload']}", file=sys.stderr)
 
+    # --- SAC int8: the sheepquant arm (ISSUE 20) ---------------------------
+    # same policy, quantized params, same closed-loop operating points —
+    # QPS/p99 against the f32 phases above at the same window/deadline,
+    # with the per-rung quality receipt (measured divergence vs bound) and
+    # a tight-bound run demonstrating DISQUALIFIED rungs keep serving f32
+    import types as _types
+
+    from sheeprl_tpu.serve.quant import QuantState
+
+    qstate = QuantState(
+        policy,
+        _types.SimpleNamespace(quant_bound=0.05, seed=0, ckpt=None),
+        tempfile.mkdtemp(prefix="bench_serve_quant_"),
+    )
+    won = qstate.accept_rungs(1, params, RUNGS)
+    results["sac_int8_receipt"] = {
+        "bound": qstate.bound,
+        "int8_rungs": sorted(won),
+        "fused": bool(qstate._fused),
+        "per_rung": {
+            str(r): {
+                "winner": d.winner,
+                "divergence": d.candidate("int8").get("divergence"),
+                "within_bound": d.candidate("int8").get("within_bound"),
+            }
+            for r, d in sorted(qstate.decisions.items())
+        },
+    }
+    print(f"serve sac int8 receipt: {results['sac_int8_receipt']}", file=sys.stderr)
+    qparams = qstate.params_for(1, params)
+    step_int8 = qstate.step_for(qparams)
+    t0q = time.perf_counter()
+    for rung in RUNGS:
+        step_int8(qparams, np.zeros((rung, policy.obs_dim), np.float32))
+    results["sac_int8_warm_seconds"] = round(time.perf_counter() - t0q, 2)
+
+    def serving_int8(window_ms=1.0):
+        def dispatch(stacked, pendings, rung):
+            version, live = store.current()
+            qp = qstate.params_for(version, live)
+            return (
+                policy.run(step_int8, qp, version, stacked, pendings, rung),
+                version,
+            )
+
+        batcher = MicroBatcher(
+            dispatch, RUNGS, window_ms=window_ms, default_deadline_ms=0.0
+        )
+        server = ServeServer(policy, store, batcher)
+        server.start()
+        return server
+
+    for conc, per in ((1, 200), (8, 100)):
+        server = serving_int8()
+        try:
+            results[f"sac_int8_b{conc}"] = drive(server, conc, per, sac_obs)
+        finally:
+            server.close()
+        print(
+            f"serve sac int8 conc={conc}: {results[f'sac_int8_b{conc}']}",
+            file=sys.stderr,
+        )
+    tight = QuantState(
+        policy,
+        _types.SimpleNamespace(quant_bound=1e-9, seed=0, ckpt=None),
+        tempfile.mkdtemp(prefix="bench_serve_quant_tight_"),
+    )
+    twon = tight.accept_rungs(1, params, RUNGS)
+    results["sac_int8_tight_bound"] = {
+        "bound": 1e-9,
+        "int8_rungs": sorted(twon),
+        "all_disqualified": not twon and bool(tight.decisions) and all(
+            d.candidate("int8").get("within_bound") is False
+            for d in tight.decisions.values()
+        ),
+    }
+    print(
+        f"serve sac int8 tight bound: {results['sac_int8_tight_bound']}",
+        file=sys.stderr,
+    )
+
     # --- DV3: recurrent player, server-side sessions ------------------------
     policy, params, store = build(
         "dreamer_v3",

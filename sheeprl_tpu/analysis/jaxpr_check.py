@@ -520,6 +520,23 @@ def _count_bf16_upcasts(closed: Any) -> int:
     return count
 
 
+def _count_int8_ops(closed: Any) -> int:
+    """Number of eqns touching an int8 aval (invars or outvars) — the
+    per-jit quantization fingerprint. For an unquantized jit this is 0;
+    for a `--quant int8` serving rung it counts the quantize / int8
+    dot_general / dequantize chain, and the budget gate treats a SHRINK
+    as lost quantization coverage (a rung silently serving full-width
+    again) the same way the bf16 gate treats lost bfloat16."""
+    count = 0
+    for eqn in iter_eqns(closed):
+        for v in (*eqn.invars, *eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if getattr(getattr(aval, "dtype", None), "name", "") == "int8":
+                count += 1
+                break
+    return count
+
+
 def fingerprint_jaxpr(closed: Any, lowered: Any = None) -> dict:
     """The compile-cost fingerprint of one jit: what the budget ledger
     commits and the CI drift gate compares."""
@@ -546,6 +563,10 @@ def fingerprint_jaxpr(closed: Any, lowered: Any = None) -> dict:
         # boundary; the gate fails when a derived program exceeds this
         # count (a new SILENT upcast) — see check_budget
         "bf16_upcasts": _count_bf16_upcasts(closed),
+        # the committed quantization coverage of an int8 serving rung:
+        # check_budget fails a declared-int8 jit whose count shrinks (a
+        # dequantized layer serving full-width under the int8 flag)
+        "int8_ops": _count_int8_ops(closed),
         "donated": 0,
         "flops": None,
         "bytes_accessed": None,
@@ -671,6 +692,33 @@ def check_budget(ledger: dict, derived: dict) -> tuple[list[str], list[str]]:
                     notes.append(
                         f"{key}: bf16 upcasts shrank {ou} -> {nu} — refresh "
                         "the ledger"
+                    )
+        # quantization drift (ISSUE 20): a jit whose ledger entry declares
+        # int8 compute (the `@int8` serving twins) must keep it — losing
+        # int8 from the dtype set, or shrinking the int8-op count, means a
+        # quantized rung silently serves full-width math again under the
+        # int8 flag. Growth is a note: MORE quantized coverage is an
+        # improvement that wants a ledger refresh, not a block.
+        if "int8" in o.get("dtypes", []):
+            if "int8" not in n.get("dtypes", []):
+                failures.append(
+                    f"{key}: declared-int8 jit lost its int8 compute "
+                    "(quantized rung silently dequantized to full width)"
+                )
+            oi = o.get("int8_ops")
+            ni = n.get("int8_ops")
+            if oi is not None and ni is not None:
+                if int(ni) < int(oi):
+                    failures.append(
+                        f"{key}: int8 ops shrank {oi} -> {ni} — lost "
+                        "quantization coverage inside a declared-int8 jit "
+                        "(re-run the capture, then --update-budget if "
+                        "intended)"
+                    )
+                elif int(ni) > int(oi):
+                    notes.append(
+                        f"{key}: int8 ops grew {oi} -> {ni} — refresh the "
+                        "ledger"
                     )
         oc, nc = int(o.get("op_count", 0)), int(n.get("op_count", 0))
         if nc > oc * (1.0 + tol):
@@ -885,7 +933,20 @@ CAPTURE_VARIANTS: dict[str, tuple[str, list[str]]] = {
         "p2e_dv1",
         "p2e_dv2",
     )},
+    # the ISSUE 20 quantized twins: same serve mains under `--quant int8`
+    # (capture mode quantizes the checkpoint-free init and registers the
+    # int8 step for every rung — no timed acceptance), so the committed
+    # fingerprints carry the int8 dtype + `int8_ops` coverage count the
+    # int8 half of check_budget enforces, and sheepmem can pair each
+    # rung's argument bytes against its full-width twin
+    "serve@int8": ("serve", ["--quant", "int8"]),
 }
+# dreamer_v3@serve@int8 composes the DV3 player-ladder variant's argv with
+# the quant flag (the dict literal can't self-reference its own entries)
+CAPTURE_VARIANTS["dreamer_v3@serve@int8"] = (
+    CAPTURE_VARIANTS["dreamer_v3@serve"][0],
+    [*CAPTURE_VARIANTS["dreamer_v3@serve"][1], "--quant", "int8"],
+)
 
 
 def declares_bf16(fingerprint: dict) -> bool:
@@ -893,6 +954,13 @@ def declares_bf16(fingerprint: dict) -> bool:
     population: its upcast count is enforced, f32-only jits stay
     audit-only)."""
     return "bfloat16" in (fingerprint or {}).get("dtypes", [])
+
+
+def declares_int8(fingerprint: dict) -> bool:
+    """True when a ledger entry declares int8 compute (the `@int8` serving
+    twins: check_budget enforces their dtype set and int8-op count, and
+    sheepmem pairs their argument bytes against the full-width twin)."""
+    return "int8" in (fingerprint or {}).get("dtypes", [])
 
 
 def resolve_capture(spec: str) -> tuple[str, list[str]]:
@@ -1091,11 +1159,19 @@ def save_budget(
         if os.path.exists(spec_path):
             with open(spec_path, encoding="utf-8") as fh:
                 blob = json.load(fh)
+        changed = not os.path.exists(spec_path)
         for section in sections:
-            blob.pop(section, None)
-            if by_spec.get(spec, {}).get(section):
-                blob[section] = by_spec[spec][section]
-        if any(blob.get(section) for section in _LEDGER_SECTIONS):
+            had = blob.pop(section, None)
+            new_sec = by_spec.get(spec, {}).get(section)
+            if new_sec:
+                blob[section] = new_sec
+            changed = changed or new_sec != had
+        if not changed:
+            # untouched managed sections: leave the file byte-identical —
+            # a spec file carrying only a foreign section (e.g. sheepsync's
+            # `concurrency`) must survive a jits/memory sweep unrewritten
+            continue
+        if any(blob.get(section) for section in blob):
             _write_json(blob, spec_path)
         elif os.path.exists(spec_path):
             os.remove(spec_path)

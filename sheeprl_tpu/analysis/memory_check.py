@@ -377,6 +377,7 @@ def memory_fingerprint(compiled: Any, closed: Any, donated: list[bool]) -> dict:
         "activation_bytes": total_act,
         "wide_activation_bytes": wide_act,
         "declares_bf16": "bfloat16" in dtypes,
+        "declares_int8": "int8" in dtypes,
         "num_partitions": int(m.group(1)) if m else 1,
         "scan_buffers": parse_scan_buffers(text)[:_SCAN_BUFFERS_KEPT],
     }
@@ -668,6 +669,16 @@ def _bf16_twin(key: str) -> str | None:
     return f"{spec[: -len('@bf16')]}/{jit}"
 
 
+def _int8_twin(key: str) -> str | None:
+    """`X@int8/policy_b2` -> `X/policy_b2` (the quantized serving twin's
+    byte receipt pairs each rung against the same rung captured at the
+    checkpoint dtype)."""
+    spec, _, jit = key.partition("/")
+    if not spec.endswith("@int8"):
+        return None
+    return f"{spec[: -len('@int8')]}/{jit}"
+
+
 def _remat_twin(key: str) -> str | None:
     """`X@remat/train_step` -> `X@scan/train_step` (the remat receipt only
     gates the train step — the other jits of the twin captures are
@@ -756,6 +767,30 @@ def check_memory_budget(ledger: dict, derived: dict) -> tuple[list[str], list[st
             notes.append(
                 f"{key}: wide activation bytes {bw} vs f32 twin {fw} "
                 f"(-{(fw - bw) / max(fw, 1):.0%})"
+            )
+    # the int8 byte receipt (ISSUE 20): a declared-int8 serving rung must
+    # actually carry quantized weights — its argument bytes must be
+    # STRICTLY below the full-width twin's (int8 weights are 4x narrower
+    # than f32; a rung whose arguments match the twin is serving
+    # full-width params under the int8 flag)
+    for key in sorted(new):
+        twin = _int8_twin(key)
+        if twin is None or twin not in new:
+            continue
+        if not new[key].get("declares_int8"):
+            continue
+        qa = int(new[key].get("argument_bytes", 0))
+        fa = int(new[twin].get("argument_bytes", 0))
+        if qa >= fa:
+            failures.append(
+                f"{key}: argument bytes {qa} not below the full-width "
+                f"twin's {fa} ({twin}) — the int8 rung is not actually "
+                "carrying quantized weights"
+            )
+        else:
+            notes.append(
+                f"{key}: argument bytes {qa} vs full-width twin {fa} "
+                f"(-{(fa - qa) / max(fa, 1):.0%})"
             )
     # the remat byte receipt (ISSUE 11): the @remat twin's train step must
     # undercut its @scan twin's peak by at least `remat_peak_frac` — the

@@ -235,6 +235,12 @@ class CandidateReport:
     peak_bytes: int | None = None
     temp_bytes: int | None = None
     error: str | None = None
+    # bounded-divergence acceptance (ISSUE 20): when the ladder runs with a
+    # quality_metric, every candidate carries its measured divergence vs the
+    # baseline and whether it stayed within quality_bound. Bit-exact ladders
+    # leave both None — the receipt is bit-exactness, as before.
+    divergence: float | None = None
+    within_bound: bool | None = None
 
     def as_dict(self) -> dict[str, Any]:
         return {k: v for k, v in dataclasses.asdict(self).items() if k != "label"}
@@ -255,6 +261,10 @@ class Decision:
     source: str  # "measured" | "cache"
     key: str
     max_time_cost_frac: float | None = None
+    # the quality-receipt bound the ladder was accepted under (None for
+    # bit-exact ladders) — committed next to the winner so the cache entry
+    # IS the receipt
+    quality_bound: float | None = None
 
     def candidate(self, label: str) -> dict:
         return self.candidates.get(str(label), {})
@@ -296,6 +306,11 @@ class Decision:
             out["seconds_delta"] = sd
         if bd is not None:
             out["bytes_delta"] = bd
+        if self.quality_bound is not None:
+            out["quality_bound"] = self.quality_bound
+            div = self.candidate(self.winner).get("divergence")
+            if div is not None:
+                out["divergence"] = div
         return out
 
     @classmethod
@@ -311,6 +326,7 @@ class Decision:
             source="cache",
             key=str(d.get("key", "")),
             max_time_cost_frac=d.get("max_time_cost_frac"),
+            quality_bound=d.get("quality_bound"),
         )
 
 
@@ -373,6 +389,8 @@ def decide(
     store_path: str | None = None,
     force: bool = False,
     candidate_context: Callable[[Any], Any] | None = None,
+    quality_metric: Callable[[Any, Any], float] | None = None,
+    quality_bound: float | None = None,
 ) -> Decision:
     """Measure one candidate ladder and return (and persist) the decision.
 
@@ -395,6 +413,15 @@ def decide(
       - "bytes": smallest peak-bytes among surviving candidates whose exec
         time is within `max_time_cost_frac` of the baseline's (when set);
         a candidate must STRICTLY undercut the baseline's bytes to win.
+
+    Bounded-divergence acceptance (the quantization path): passing
+    `quality_metric` (a `(baseline_out, candidate_out) -> float` distance,
+    e.g. max action divergence over a held-out calibration set) together
+    with `quality_bound` relaxes the receipt — a non-bit-exact candidate
+    survives when its measured divergence stays <= `quality_bound`, and is
+    DISQUALIFIED past it exactly like a non-bit-exact remat rung. The
+    divergence and the bound persist in the cache record: the decision
+    entry IS the quality receipt.
     """
     import jax
 
@@ -402,6 +429,8 @@ def decide(
 
     if objective not in ("seconds", "bytes"):
         raise ValueError(f"unknown objective {objective!r}")
+    if (quality_metric is None) != (quality_bound is None):
+        raise ValueError("quality_metric and quality_bound come together")
     labels = [str(c) for c in candidates]
     if len(set(labels)) != len(labels):
         raise ValueError(f"duplicate candidate labels in {labels}")
@@ -457,10 +486,25 @@ def decide(
     for label in labels:
         if label not in outputs:
             reports[label].bit_exact = False
+            if quality_metric is not None:
+                reports[label].within_bound = False
             continue
         reports[label].bit_exact = (
             True if label == baseline else _bit_exact(outputs[baseline], outputs[label])
         )
+        if quality_metric is not None:
+            if label == baseline:
+                reports[label].divergence = 0.0
+                reports[label].within_bound = True
+            else:
+                try:
+                    div = float(quality_metric(outputs[baseline], outputs[label]))
+                except Exception as err:  # an unmeasurable receipt disqualifies
+                    reports[label].error = f"{type(err).__name__}: {err}"[:200]
+                    reports[label].within_bound = False
+                    continue
+                reports[label].divergence = div
+                reports[label].within_bound = div <= quality_bound
 
     winner = _pick_winner(
         labels, reports, objective, baseline, max_time_cost_frac
@@ -476,6 +520,7 @@ def decide(
         source="measured",
         key=key,
         max_time_cost_frac=max_time_cost_frac,
+        quality_bound=quality_bound,
     )
     _store(path, key, decision.as_dict())
     return decision
@@ -488,10 +533,14 @@ def _pick_winner(
     baseline: str,
     max_time_cost_frac: float | None,
 ) -> str:
+    # a candidate survives on either receipt: bit-exactness (the default)
+    # or a measured divergence within the quality bound (bounded
+    # acceptance); everything else is disqualified
     eligible = [
         lbl
         for lbl in labels
-        if reports[lbl].bit_exact and reports[lbl].exec_seconds is not None
+        if (reports[lbl].bit_exact or reports[lbl].within_bound)
+        and reports[lbl].exec_seconds is not None
     ]
     if objective == "seconds":
         return min(

@@ -48,6 +48,9 @@ __all__ = [
     "layernorm_gru_cell",
     "fused_rssm_step",
     "rssm_step_reference",
+    "fused_int8_trunk",
+    "int8_trunk_reference",
+    "fused_int8_trunk_supported",
     "two_hot_log_prob",
     "symlog",
     "symexp",
@@ -89,9 +92,9 @@ def _env_flag(name: str) -> bool | None:
 
 def use_pallas(kind: str | None = None) -> bool:
     """Master gate, optionally refined per kernel family via
-    SHEEPRL_TPU_PALLAS_<KIND> (KIND in GRU|RSSM|TWO_HOT|SYMLOG|CNN) — the
-    bench uses the per-kernel switches to attribute wins/losses and keep
-    only winners."""
+    SHEEPRL_TPU_PALLAS_<KIND> (KIND in GRU|RSSM|TWO_HOT|SYMLOG|CNN|
+    SAC_TRUNK) — the bench uses the per-kernel switches to attribute
+    wins/losses and keep only winners."""
     if _FORCED is not None:
         enabled = _FORCED
     else:
@@ -523,6 +526,111 @@ def fused_rssm_supported(act: str, *weights) -> bool:
     co-reside in VMEM with room for the row blocks."""
     if act not in _KERNEL_ACTS:
         return False
+    total = sum(int(w.size) * w.dtype.itemsize for w in weights)
+    return total <= _FUSED_VMEM_BUDGET_BYTES
+
+
+# =============================================================================
+# Fused int8 SAC trunk (ISSUE 20 tentpole c)
+# =============================================================================
+#
+# The quantized SAC serve trunk is three int8 matmuls with relu glue:
+#
+#   a0   = relu((q(x  / s0) @ W0q) * ws0 + b0)     # trunk layer 0
+#   a1   = relu((q(a0 / s1) @ W1q) * ws1 + b1)     # trunk layer 1
+#   mean =      (q(a1 / sm) @ Wmq) * wsm + bm      # fc_mean head
+#
+# (q = round-to-nearest symmetric int8, ops/quant.py). At serve rung shapes
+# ([B<=8] rows through 256-wide layers) every stage is far below the MXU's
+# efficient arithmetic intensity and XLA stages each dequantized f32
+# activation through HBM between layers — the same per-step overhead
+# diagnosis as the fused RSSM step above. This kernel keeps the whole trunk
+# in VMEM: int8 x int8 matmuls accumulate in int32 on the MXU's native
+# int8 path, dequant/requant between layers is VPU work on blocks that
+# never leave VMEM, and only the f32 `mean` leaves the kernel (the
+# tanh * action_scale + action_bias squash stays outside in the f32
+# island, exactly like sampling stays outside the RSSM kernel).
+#
+# Inference-only: no custom VJP — the serve tier never differentiates the
+# policy, and the quality receipt in compile/decisions.py is measured
+# against `int8_trunk_reference`, the plain-XLA twin sharing this math
+# function verbatim (integer matmuls + same-order f32 ops, so kernel vs
+# twin parity is exact, not approximate).
+
+
+def _int8_trunk_math(
+    x, s0, w0, ws0, b0, s1, w1, ws1, b1, sm, wm, wsm, bm
+):
+    """The shared trunk math, used verbatim by the Pallas kernel body and
+    the XLA reference twin. Layer boundaries are f32 islands; matmuls are
+    int8 x int8 with int32 accumulation (`ops.quant.int8_linear`)."""
+    from .quant import int8_linear
+
+    a0 = jax.nn.relu(int8_linear(x, s0, w0, ws0, b0))
+    a1 = jax.nn.relu(int8_linear(a0, s1, w1, ws1, b1))
+    return int8_linear(a1, sm, wm, wsm, bm)
+
+
+def _fused_int8_kernel(
+    x_ref, s0_ref, w0_ref, ws0_ref, b0_ref,
+    s1_ref, w1_ref, ws1_ref, b1_ref,
+    sm_ref, wm_ref, wsm_ref, bm_ref, out_ref,
+):
+    out_ref[:] = _int8_trunk_math(
+        x_ref[:], s0_ref[:], w0_ref[:], ws0_ref[:], b0_ref[:],
+        s1_ref[:], w1_ref[:], ws1_ref[:], b1_ref[:],
+        sm_ref[:], wm_ref[:], wsm_ref[:], bm_ref[:],
+    )
+
+
+def int8_trunk_reference(x, s0, w0, ws0, b0, s1, w1, ws1, b1, sm, wm, wsm, bm):
+    """Plain-XLA twin of the fused kernel: the numerics oracle for the
+    parity tests and the fallback when the kernel is gated off."""
+    return _int8_trunk_math(
+        x, s0, w0, ws0, b0, s1, w1, ws1, b1, sm, wm, wsm, bm
+    )
+
+
+_INT8_BLOCK_ROWS = 128  # int8 min tile is (32, 128); row blocks stay modest
+
+
+def fused_int8_trunk(x, s0, w0, ws0, b0, s1, w1, ws1, b1, sm, wm, wsm, bm):
+    """One fused quantized SAC trunk step: x [B, Dx] f32, per layer
+    (in_scale [Din] f32, w_q [Din, Dout] int8, w_scale [Dout] f32,
+    bias [Dout] f32) -> raw mean [B, A] f32 (pre-squash)."""
+    batch = x.shape[0]
+    out_dim = wm.shape[-1]
+    bn = min(_INT8_BLOCK_ROWS, batch)
+
+    def rows(a):
+        return pl.BlockSpec((bn, a.shape[-1]), lambda i: (i, 0), memory_space=_VMEM)
+
+    def whole(a):
+        if a.ndim == 1:
+            return pl.BlockSpec(a.shape, lambda i: (0,), memory_space=_VMEM)
+        return pl.BlockSpec(a.shape, lambda i: (0, 0), memory_space=_VMEM)
+
+    return pl.pallas_call(
+        _fused_int8_kernel,
+        grid=(_cdiv(batch, bn),),
+        out_shape=jax.ShapeDtypeStruct((batch, out_dim), jnp.float32),
+        in_specs=[
+            rows(x),
+            whole(s0), whole(w0), whole(ws0), whole(b0),
+            whole(s1), whole(w1), whole(ws1), whole(b1),
+            whole(sm), whole(wm), whole(wsm), whole(bm),
+        ],
+        out_specs=pl.BlockSpec(
+            (bn, out_dim), lambda i: (i, 0), memory_space=_VMEM
+        ),
+        interpret=_INTERPRET,
+    )(x, s0, w0, ws0, b0, s1, w1, ws1, b1, sm, wm, wsm, bm)
+
+
+def fused_int8_trunk_supported(*weights) -> bool:
+    """Trace-time dispatch guard (the fused_rssm_supported pattern): the
+    trunk's quantized weights + scales + biases must co-reside in VMEM
+    with room for the row blocks."""
     total = sum(int(w.size) * w.dtype.itemsize for w in weights)
     return total <= _FUSED_VMEM_BUDGET_BYTES
 

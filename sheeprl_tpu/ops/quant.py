@@ -1,0 +1,286 @@
+"""sheepquant: int8 symmetric quantization for policy inference.
+
+Scheme (W8A8, per-channel, round-to-nearest, f32 islands at head
+boundaries):
+
+  - activations get a per-input-channel scale ``in_scale[in]`` derived at
+    CALIBRATION time (running absmax over held-out replay states / 127);
+  - the activation scale is folded into the weight BEFORE weight
+    quantization, so runtime never rescales activations per channel::
+
+        w_eff[in, out] = w[in, out] * in_scale[in]
+        w_scale[out]   = absmax(w_eff[:, out]) / 127
+        w_q            = round(w_eff / w_scale)          # int8
+
+  - runtime: ``x_q = clip(round(x / in_scale))`` per channel, then
+    ``y = (x_q @ w_q).astype(f32) * w_scale + bias`` — the matmul runs
+    int8 x int8 with int32 accumulation (MXU-native on TPU), and every
+    layer boundary dequantizes back to f32, which is exactly the
+    "f32 accumulate/dequant at head boundaries" contract the quality
+    receipt in `compile/decisions.py` is measured against.
+
+Calibration is a plain eager pass over replay-buffer state batches: the
+model's `Linear` layers are shadowed by recording wrappers
+(`_CaptureLinear`), the forward runs un-jitted, and each wrapper keeps the
+per-input-channel absmax it saw. `quantize_linears` then swaps calibrated
+`Linear`s for `QuantLinear`s — the surrounding pytree (SACActor, PlayerDV3)
+keeps its class, so the serve policies' jitted `step` functions work
+unchanged on quantized params (a new treedef just means a new trace).
+
+Scales persist next to the checkpoint (`quant_scales.npz`) so a serve
+restart re-quantizes identically without replaying calibration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Iterable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.core import Module
+from ..nn.layers import Linear
+
+__all__ = [
+    "QuantLinear",
+    "absmax_scale",
+    "quantize",
+    "int8_linear",
+    "map_linears",
+    "calibrate",
+    "calibrate_from_buffer",
+    "quantize_linears",
+    "save_scales",
+    "load_scales",
+    "scales_path",
+]
+
+# scales are floored so a dead channel (all-zero activations) quantizes to
+# zeros instead of dividing by zero
+_SCALE_FLOOR = 1e-8
+_QMAX = 127.0
+
+
+def absmax_scale(x: jax.Array, axis: int | tuple[int, ...]) -> jax.Array:
+    """Per-channel symmetric scale: absmax over `axis` mapped to [-127, 127]."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis) / _QMAX
+    return jnp.maximum(s, _SCALE_FLOOR)
+
+
+def quantize(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """Round-to-nearest symmetric int8 quantization (scale broadcasts)."""
+    q = jnp.round(x.astype(jnp.float32) / scale)
+    return jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8)
+
+
+def int8_linear(
+    x: jax.Array,
+    in_scale: jax.Array,
+    w_q: jax.Array,
+    w_scale: jax.Array,
+    bias: jax.Array | None,
+) -> jax.Array:
+    """The one int8 matmul used by QuantLinear, the XLA reference twin, and
+    (re-expressed op-for-op) the fused Pallas kernel: quantize the
+    activation per input channel, contract int8 x int8 with int32
+    accumulation, dequantize to f32 at the output boundary."""
+    x_q = quantize(x, in_scale)
+    acc = jax.lax.dot_general(
+        x_q,
+        w_q,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = acc.astype(jnp.float32) * w_scale
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y
+
+
+class QuantLinear(Module):
+    """Drop-in int8 replacement for `nn.layers.Linear`.
+
+    `w_q` already has the calibration-time activation scale folded in
+    (see module docstring), so `__call__` only divides the input by
+    `in_scale` once and multiplies the int32 accumulator by `w_scale`.
+    Output is always float32 — the layer boundary is an f32 island.
+    """
+
+    w_q: jax.Array  # int8 [in_features, out_features], activation scale folded
+    w_scale: jax.Array  # f32 [out_features]
+    in_scale: jax.Array  # f32 [in_features]
+    bias: jax.Array | None  # f32 [out_features] | None
+
+    @classmethod
+    def from_linear(cls, linear: Linear, in_scale: jax.Array) -> "QuantLinear":
+        in_scale = jnp.asarray(in_scale, jnp.float32)
+        w32 = linear.weight.astype(jnp.float32)
+        w_eff = w32 * in_scale[:, None]
+        w_scale = absmax_scale(w_eff, axis=0)
+        w_q = quantize(w_eff, w_scale)
+        bias = None
+        if linear.bias is not None:
+            bias = linear.bias.astype(jnp.float32)
+        return cls(w_q=w_q, w_scale=w_scale, in_scale=in_scale, bias=bias)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return int8_linear(x, self.in_scale, self.w_q, self.w_scale, self.bias)
+
+    @property
+    def in_features(self) -> int:
+        return self.w_q.shape[0]
+
+    @property
+    def out_features(self) -> int:
+        return self.w_q.shape[1]
+
+
+# ---------------------------------------------------------------------------
+# structural traversal: find/replace Linear layers anywhere in a Module tree
+# ---------------------------------------------------------------------------
+
+
+def map_linears(obj: Any, fn: Callable[[str, Linear], Any], path: str = "") -> Any:
+    """Rebuild `obj` with every `Linear` at any depth replaced by
+    `fn(dotted_path, linear)`. Containers handled: Module dataclasses,
+    tuples, lists, dicts. Anything else (arrays, scalars, statics) passes
+    through untouched. Returning the linear itself from `fn` keeps it."""
+    if isinstance(obj, Linear):
+        return fn(path, obj)
+    if isinstance(obj, Module):
+        changes = {}
+        for f in dataclasses.fields(type(obj)):
+            old = getattr(obj, f.name)
+            sub = f"{path}.{f.name}" if path else f.name
+            new = map_linears(old, fn, sub)
+            if new is not old:
+                changes[f.name] = new
+        return obj.replace(**changes) if changes else obj
+    if isinstance(obj, tuple):
+        new = tuple(map_linears(v, fn, f"{path}.{i}") for i, v in enumerate(obj))
+        return new if any(a is not b for a, b in zip(new, obj)) else obj
+    if isinstance(obj, list):
+        new = [map_linears(v, fn, f"{path}.{i}") for i, v in enumerate(obj)]
+        return new if any(a is not b for a, b in zip(new, obj)) else obj
+    if isinstance(obj, dict):
+        new = {k: map_linears(v, fn, f"{path}.{k}") for k, v in obj.items()}
+        return new if any(new[k] is not obj[k] for k in obj) else obj
+    return obj
+
+
+def linear_paths(obj: Any) -> list[str]:
+    """Dotted paths of every Linear in the tree (calibration coverage)."""
+    found: list[str] = []
+
+    def record(path: str, lin: Linear) -> Linear:
+        found.append(path)
+        return lin
+
+    map_linears(obj, record)
+    return found
+
+
+# ---------------------------------------------------------------------------
+# calibration: eager absmax recording via shadow layers
+# ---------------------------------------------------------------------------
+
+
+class _CaptureLinear:
+    """Eager-only shadow of a Linear: records the per-input-channel absmax
+    of everything it is called on, then delegates. NOT a pytree — the
+    probed tree must never be flattened (calibration runs with jit
+    disabled, so it isn't)."""
+
+    def __init__(self, inner: Linear, path: str, record: dict[str, np.ndarray]):
+        self._inner = inner
+        self._path = path
+        self._record = record
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        amax = np.asarray(
+            jnp.max(jnp.abs(x.astype(jnp.float32)), axis=tuple(range(x.ndim - 1)))
+        )
+        prev = self._record.get(self._path)
+        self._record[self._path] = amax if prev is None else np.maximum(prev, amax)
+        return self._inner(x)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+def calibrate(
+    module: Any,
+    call: Callable[[Any, Any], Any],
+    batches: Iterable[Any],
+) -> dict[str, np.ndarray]:
+    """Run `call(probed_module, batch)` eagerly over `batches` with every
+    Linear shadowed by an absmax recorder; return {dotted_path: f32 scale
+    vector [in_features]} for every Linear the forward actually touched."""
+    record: dict[str, np.ndarray] = {}
+    probed = map_linears(module, lambda p, lin: _CaptureLinear(lin, p, record))
+    with jax.disable_jit():
+        for batch in batches:
+            call(probed, batch)
+    return {
+        path: np.maximum(amax, _SCALE_FLOOR * _QMAX).astype(np.float32) / _QMAX
+        for path, amax in record.items()
+    }
+
+
+def calibrate_from_buffer(
+    module: Any,
+    call: Callable[[Any, Any], Any],
+    buffer: Any,
+    *,
+    obs_key: str = "obs",
+    n_batches: int = 4,
+    batch_size: int = 64,
+) -> dict[str, np.ndarray]:
+    """Calibration over the existing replay-buffer sample path: draw
+    `n_batches` uniform state batches via `buffer.sample` and feed the
+    `obs_key` column through `calibrate`. Determinism follows the buffer's
+    own seeded RNG — a freshly seeded buffer yields identical scales."""
+    batches = []
+    for _ in range(n_batches):
+        sample = buffer.sample(batch_size)
+        batches.append(np.asarray(sample[obs_key], np.float32))
+    return calibrate(module, call, batches)
+
+
+def quantize_linears(module: Any, scales: Mapping[str, Any]) -> Any:
+    """Swap every calibrated Linear for its QuantLinear; Linears with no
+    recorded scale (never touched by the calibration forward) stay f32."""
+
+    def swap(path: str, lin: Linear) -> Any:
+        s = scales.get(path)
+        if s is None:
+            return lin
+        return QuantLinear.from_linear(lin, jnp.asarray(s, jnp.float32))
+
+    return map_linears(module, swap)
+
+
+# ---------------------------------------------------------------------------
+# scale persistence (next to the checkpoint)
+# ---------------------------------------------------------------------------
+
+
+def scales_path(ckpt_path: str) -> str:
+    """`quant_scales.npz` beside the checkpoint file/dir."""
+    base = ckpt_path.rstrip("/")
+    return os.path.join(os.path.dirname(base), "quant_scales.npz")
+
+
+def save_scales(path: str, scales: Mapping[str, np.ndarray]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **{k: np.asarray(v, np.float32) for k, v in scales.items()})
+
+
+def load_scales(path: str) -> dict[str, np.ndarray] | None:
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}

@@ -80,6 +80,23 @@ class ServeArgs(StandardArgs):
         "is omitted; ignored when a checkpoint (with its args.json) is "
         "given",
     )
+    quant: str = Arg(
+        default="off",
+        help="policy-inference quantization: 'int8' calibrates per-channel "
+        "scales (persisted as quant_scales.npz next to --ckpt), builds an "
+        "int8 variant of every ladder rung, and accepts each rung through "
+        "the measured-decision framework under the --quant_bound quality "
+        "receipt — a rung whose divergence exceeds the bound is "
+        "DISQUALIFIED and keeps serving f32. 'off' (default) serves the "
+        "checkpoint dtype unchanged",
+    )
+    quant_bound: float = Arg(
+        default=0.05,
+        help="max tolerated action divergence (max |delta| over the "
+        "held-out calibration set) for accepting an int8 rung; the "
+        "measured divergence is committed next to the winner in the "
+        "decision cache as the quality receipt",
+    )
     # serving wants the AOT executables by default: the whole point of the
     # ladder is fixed-shape compiled dispatch
     warm_compile: str = Arg(
@@ -96,4 +113,8 @@ class ServeArgs(StandardArgs):
             )
         if name == "max_batch" and int(value) < 1:
             raise ValueError(f"max_batch must be >= 1, got {value!r}")
+        if name == "quant" and value not in ("off", "int8"):
+            raise ValueError(f"quant must be 'off' or 'int8', got {value!r}")
+        if name == "quant_bound" and float(value) <= 0.0:
+            raise ValueError(f"quant_bound must be > 0, got {value!r}")
         super().__setattr__(name, value)

@@ -150,6 +150,24 @@ class MicroBatcher:
             self._cond.notify_all()
         return pending
 
+    def set_rungs(self, rungs: list[int]) -> None:
+        """Occupancy-driven re-tier (expansion only): the new rung set must
+        be a superset of the current one with the same maximum — shrinking
+        could strand queued requests sized for a vanished rung, and the
+        max-rung submit contract (`OversizedRequest`) must never move
+        under a live client."""
+        new = sorted(set(int(r) for r in rungs))
+        with self._cond:
+            if not set(self.rungs) <= set(new):
+                raise ValueError(
+                    f"re-tier may only add rungs: {self.rungs} -> {new}"
+                )
+            if new[-1] != self.max_rung:
+                raise ValueError(
+                    f"re-tier must keep the max rung {self.max_rung}, got {new}"
+                )
+            self.rungs = new
+
     # ---- dispatch side -----------------------------------------------------
     def start(self) -> None:
         if self._thread is not None:
@@ -296,6 +314,7 @@ class MicroBatcher:
                 "Serve/queue_depth": float(sum(p.rows for p in self._queue)),
                 "Serve/batch_occupancy": occ,
                 "Serve/last_dispatch_ms": self.last_dispatch_ms,
+                "Serve/rungs": float(len(self.rungs)),
             }
 
     def _event(self, name: str, **data: Any) -> None:

@@ -37,6 +37,7 @@ from ..compile.partition import (
 
 __all__ = [
     "RungDecision",
+    "derive_rung",
     "ledger_spec",
     "parse_rungs",
     "serve_mem_budget_bytes",
@@ -66,6 +67,21 @@ def parse_rungs(ladder: str, max_batch: int) -> list[int]:
             f"ladder rung {rungs[-1]} exceeds --max_batch {max_batch}"
         )
     return rungs
+
+
+def derive_rung(avg_rows: float, rungs: list[int], max_batch: int) -> int | None:
+    """Occupancy-driven rung derivation: the intermediate batch size live
+    telemetry says dispatches actually carry. Returns None when the
+    candidate is degenerate (<= 0), already a rung, over --max_batch, or
+    within 1 of the rung it would relieve (padding one row is cheaper than
+    holding another executable)."""
+    cand = int(round(avg_rows))
+    if cand <= 0 or cand in rungs or cand > max_batch:
+        return None
+    above = [r for r in rungs if r >= cand]
+    if not above or above[0] - cand < 2:
+        return None
+    return cand
 
 
 def ledger_spec(algo: str) -> str:
@@ -161,8 +177,16 @@ def _predict_peak(
             live_args = 0
         if live_args:
             # activations scale with the data; parameters cancel out of the
-            # ratio (same scaling argument as decide_batch_chunk's step 0)
-            ratio = max(live_args / max(int(mem["argument_bytes"]), 1), 1.0)
+            # ratio (same scaling argument as decide_batch_chunk's step 0).
+            # The >=1 floor guards against a ledger captured at a WIDER
+            # model than the live one — but only when the executables share
+            # their compute dtypes: a quantized (int8) live example against
+            # an f32 ledger entry legitimately predicts BELOW the entry,
+            # and flooring it would make every int8 rung inherit the f32
+            # prediction unchanged (the ISSUE 20 satellite fix).
+            ratio = live_args / max(int(mem["argument_bytes"]), 1)
+            if _dtypes_match(example, key):
+                ratio = max(ratio, 1.0)
             peak = int(int(mem["peak_bytes"]) * ratio)
             return peak, "ledger", f"ledger {key} x{ratio:.2f}"
     # no committed entry (an uncaptured algo/width): one trial compile,
@@ -186,3 +210,32 @@ def _predict_peak(
         return None, "error", record["error"]
     tag = "probe cache" if src == "cache" else "probe"
     return int(record.get("peak_bytes", 0)), "probe", tag
+
+
+def _dtypes_match(example: tuple, key: str) -> bool:
+    """True when the live example's leaf dtypes agree with the committed
+    jit ledger entry's input dtypes (or when either side is unreadable —
+    the conservative answer keeps the historical >=1 ratio floor)."""
+    entry = ledger_entry(key, "jits")
+    avals = entry.get("in_avals") if isinstance(entry, dict) else None
+    if not avals:
+        return True
+    ledger_dtypes = {str(a).split("[", 1)[0] for a in avals}
+    try:
+        from ..compile.plan import avals_of
+
+        live_dtypes = {
+            getattr(getattr(a, "dtype", None), "name", "")
+            for a in _leaves(avals_of(example))
+        } - {""}
+    except Exception:
+        return True
+    if not live_dtypes:
+        return True
+    return live_dtypes == ledger_dtypes
+
+
+def _leaves(tree: Any):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)

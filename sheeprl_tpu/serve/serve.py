@@ -81,6 +81,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     policy, params, loader = build_policy(args, log_dir)
     store = ParamsStore(loader, params, source=args.ckpt, telem=telem)
 
+    qstate = None
+    if args.quant == "int8":
+        from . import quant as quant_mod
+
+        qstate = quant_mod.QuantState(policy, args, log_dir, telem=telem)
+        telem.add_gauges(qstate.gauges)
+
     requested = ladder_mod.parse_rungs(args.ladder, args.max_batch)
     spec = ladder_mod.ledger_spec(args.algo)
     if plan.capture_only:
@@ -97,11 +104,39 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             telem.event("serve.ladder", **d.as_event())
         accepted = [d.rung for d in decisions if d.accepted]
 
+    int8_rungs: set = set()
+    if qstate is not None:
+        version0, live0 = store.current()
+        if plan.capture_only:
+            # capture sweep: fingerprint the int8 variant of EVERY rung —
+            # the @int8 budget twins must see quantized programs, and
+            # timed acceptance would defeat a compile-free capture
+            qstate.params_for(version0, live0)
+            if qstate.available:
+                int8_rungs = set(accepted)
+                qstate.int8_rungs = int8_rungs
+        else:
+            int8_rungs = qstate.accept_rungs(version0, live0, accepted)
+        if int8_rungs:
+            # rebuild the quantized twin in the reload thread, not on the
+            # first int8 dispatch after a swap
+            store.on_reload = qstate.params_for
+
+    def _example_of(rung: int):
+        if qstate is not None and rung in qstate.int8_rungs:
+            return policy.example(qstate.params_for(*store.current()), rung)
+        return policy.example(store.current()[1], rung)
+
+    def _step_of(rung: int):
+        if qstate is not None and rung in qstate.int8_rungs:
+            return qstate.step_for(qstate.params_for(*store.current()))
+        return policy.step
+
     runners = {
         rung: plan.register(
             f"policy_b{rung}",
-            policy.step,
-            example=(lambda r=rung: policy.example(store.current()[1], r)),
+            _step_of(rung),
+            example=(lambda r=rung: _example_of(r)),
         )
         for rung in accepted
     }
@@ -109,6 +144,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     def dispatch(stacked, pendings, rung):
         version, live = store.current()
+        if qstate is not None and rung in qstate.int8_rungs:
+            live = qstate.params_for(version, live)
         out = policy.run(runners[rung], live, version, stacked, pendings, rung)
         return out, version
 
@@ -140,6 +177,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         telem.event(
             "serve.start", address=address, algo=args.algo,
             rungs=accepted, version=store.version, ckpt=args.ckpt,
+            quant=args.quant, int8_rungs=sorted(int8_rungs),
         )
         telem.add_gauges(server.gauges)
         if args.reload_poll_s > 0 and args.ckpt:
@@ -152,10 +190,60 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         from ..resilience import inject
 
         telem.add_gauges(inject.gauges)
+
+        # occupancy-driven rung resize (ISSUE 20 tentpole d): when the live
+        # Serve/occupancy telemetry shows dispatches consistently padding up
+        # to a rung far above their actual rows, derive the intermediate
+        # batch size, size it through the SAME ledger-first decision cache
+        # as the startup ladder, and splice it into the batcher (expansion
+        # only — existing rungs and the max-rung contract never move). The
+        # new runner is the plain jitted step (registered-on-plan runners
+        # are frozen at plan.start(); the jit dispatch cache compiles the
+        # extra rung at its first use).
+        retier = {"added": 0, "seen": 0}
+
+        def _maybe_retier() -> None:
+            if retier["added"] >= 2:
+                return  # bounded: a resize per occupancy regime, not a churn
+            g = batcher.gauges()
+            dispatches = int(g["Serve/dispatches"])
+            if dispatches - retier["seen"] < 16:
+                return  # need a fresh occupancy window, not startup noise
+            retier["seen"] = dispatches
+            avg_rows = g["Serve/rows_served"] / max(dispatches, 1)
+            cand = ladder_mod.derive_rung(avg_rows, batcher.rungs, args.max_batch)
+            if cand is None:
+                return
+            sized = ladder_mod.size_ladder(
+                policy.step,
+                lambda r: policy.example(store.current()[1], r),
+                [min(batcher.rungs), cand], spec,
+                store_path=os.path.join(log_dir, "serve_ladder.json"),
+            )
+            d = next(s for s in sized if s.rung == cand)
+            retier["added"] += 1  # even a rejection consumes the attempt
+            telem.event(
+                "serve.retier", rung=cand, occupancy_rows=round(avg_rows, 2),
+                **d.as_event(),
+            )
+            if not d.accepted:
+                return
+            runners[cand] = _step_of(cand)
+            batcher.set_rungs([*batcher.rungs, cand])
+
         step = 0
         while not stop.is_set():
             stop.wait(0.5)
             step += 1
+            if step % 16 == 0:
+                # a broken resize probe must never take down a serving loop
+                try:
+                    _maybe_retier()
+                except Exception as err:
+                    telem.event(
+                        "serve.retier_error",
+                        error=f"{type(err).__name__}: {err}",
+                    )
             # the chaos harness's server-crash site: SIGKILL, no drain — the
             # recovery under test is the CLIENT's (typed ConnectionLost +
             # reconnect/resend under idempotent ids)

@@ -487,6 +487,86 @@ def test_bf16_gate_shrink_is_a_note_and_f32_jits_exempt():
     assert any("bf16 upcasts shrank" in n for n in notes)
 
 
+def _int8_ledger():
+    """One declared-int8 serving rung and its full-width twin (ISSUE 20)."""
+    return {
+        "version": 1,
+        "tolerance": {"op_count_frac": 0.25},
+        "jits": {
+            "serve@int8/policy_b2": {
+                "op_count": 80,
+                "dtypes": ["float32", "int32", "int8"],
+                "bf16_upcasts": 0,
+                "int8_ops": 8,
+                "donated": 0,
+                "primitives": {},
+            },
+            "serve/policy_b2": {
+                "op_count": 60,
+                "dtypes": ["float32"],
+                "bf16_upcasts": 0,
+                "int8_ops": 0,
+                "donated": 0,
+                "primitives": {},
+            },
+        },
+    }
+
+
+def test_int8_gate_clean_on_identical_budget():
+    ledger = _int8_ledger()
+    failures, notes = jc.check_budget(ledger, json.loads(json.dumps(ledger)))
+    assert failures == [] and notes == []
+
+
+def test_int8_gate_fails_on_lost_int8_compute():
+    ledger = _int8_ledger()
+    drifted = json.loads(json.dumps(ledger))
+    drifted["jits"]["serve@int8/policy_b2"]["dtypes"] = ["float32", "int32"]
+    drifted["jits"]["serve@int8/policy_b2"]["int8_ops"] = 0
+    failures, _ = jc.check_budget(ledger, drifted)
+    assert any("lost its int8 compute" in f for f in failures)
+
+
+def test_int8_gate_fails_on_shrunk_coverage_notes_growth():
+    ledger = _int8_ledger()
+    drifted = json.loads(json.dumps(ledger))
+    # a dequantized layer: int8 dtype survives but the op coverage shrank
+    drifted["jits"]["serve@int8/policy_b2"]["int8_ops"] = 5
+    failures, _ = jc.check_budget(ledger, drifted)
+    assert any("int8 ops shrank 8 -> 5" in f for f in failures)
+    grown = json.loads(json.dumps(ledger))
+    grown["jits"]["serve@int8/policy_b2"]["int8_ops"] = 11
+    failures, notes = jc.check_budget(ledger, grown)
+    assert failures == []
+    assert any("int8 ops grew" in n for n in notes)
+
+
+def test_int8_fingerprint_counts_quantized_eqns():
+    """fingerprint_jaxpr's int8_ops: zero on an f32 program, positive on
+    the quantized twin of the same math."""
+    import numpy as np
+
+    from sheeprl_tpu.ops import quant as q
+
+    w = np.random.default_rng(0).standard_normal((6, 4)).astype(np.float32)
+    s = jnp.ones((6,), jnp.float32) * 0.1
+    ws = q.absmax_scale(jnp.asarray(w) * s[:, None], axis=0)
+    wq = q.quantize(jnp.asarray(w) * s[:, None], ws)
+
+    f32 = jax.jit(lambda x: x @ w).trace(
+        jax.ShapeDtypeStruct((2, 6), jnp.float32)
+    ).jaxpr
+    int8 = jax.jit(lambda x: q.int8_linear(x, s, wq, ws, None)).trace(
+        jax.ShapeDtypeStruct((2, 6), jnp.float32)
+    ).jaxpr
+    fp32 = jc.fingerprint_jaxpr(f32)
+    fpq = jc.fingerprint_jaxpr(int8)
+    assert fp32["int8_ops"] == 0 and not jc.declares_int8(fp32)
+    assert fpq["int8_ops"] > 0 and jc.declares_int8(fpq)
+    assert "int8" in fpq["dtypes"]
+
+
 def test_declares_bf16_predicate():
     ledger = _bf16_ledger()
     assert jc.declares_bf16(ledger["jits"]["algo@bf16/train_step"])

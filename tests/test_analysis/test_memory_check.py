@@ -416,6 +416,38 @@ def test_memory_gate_bf16_twin_receipt():
     assert failures == []
 
 
+def test_memory_gate_int8_twin_receipt():
+    """The ISSUE-20 byte receipt: a declared-int8 serving rung must carry
+    strictly fewer argument bytes than its full-width twin."""
+    base = {
+        "peak_bytes": 1000, "aliases": [], "large_constants": [],
+        "num_partitions": 1,
+    }
+    good = {
+        "memory": {
+            "serve/policy_b2": {**base, "argument_bytes": 1432},
+            "serve@int8/policy_b2": {
+                **base, "argument_bytes": 744, "declares_int8": True,
+            },
+        }
+    }
+    failures, notes = mc.check_memory_budget(good, good)
+    assert failures == []
+    assert any("argument bytes 744 vs full-width twin 1432" in n for n in notes)
+
+    bad = json.loads(json.dumps(good))
+    bad["memory"]["serve@int8/policy_b2"]["argument_bytes"] = 1432
+    failures, _ = mc.check_memory_budget(bad, bad)
+    assert any("not below the full-width twin" in f for f in failures)
+
+    # an @int8 capture that fell back to f32 (calibration unavailable)
+    # never declares int8 and is exempt from the receipt
+    undeclared = json.loads(json.dumps(bad))
+    undeclared["memory"]["serve@int8/policy_b2"]["declares_int8"] = False
+    failures, _ = mc.check_memory_budget(undeclared, undeclared)
+    assert failures == []
+
+
 def test_real_bf16_twin_shows_lower_wide_activation_bytes():
     """The receipt on real programs: the same update traced under a
     bf16-compute policy must shrink its full-width intermediate bytes."""

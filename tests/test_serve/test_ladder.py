@@ -32,10 +32,14 @@ def test_serve_mem_budget_env_override(monkeypatch):
     assert lm.serve_mem_budget_bytes() == 64 * 2**20
 
 
-def _fake_ledger(peaks):
-    """ledger_entry stand-in: peak scales with the rung suffix."""
+def _fake_ledger(peaks, jits=None):
+    """ledger_entry stand-in: peak scales with the rung suffix. The
+    `jits` section feeds the dtype-floor guard (ISSUE 20) — absent by
+    default, which keeps the conservative >=1 ratio floor."""
 
     def entry(key, section="memory"):
+        if section == "jits":
+            return (jits or {}).get(key)
         assert section == "memory"
         rung = int(key.rsplit("_b", 1)[1])
         if rung not in peaks:
@@ -75,6 +79,39 @@ def test_size_ladder_scales_ledger_by_argument_ratio(monkeypatch):
     )
     assert dec[0].peak_bytes == 400
     assert "x4.00" in dec[0].reason
+
+
+def test_size_ladder_ratio_floor_only_when_dtypes_match(monkeypatch):
+    """The ISSUE 20 dtype-floor fix: a quantized (int8) live example
+    against an f32 ledger entry legitimately predicts BELOW the entry —
+    the >=1 ratio floor must only apply when the dtypes agree."""
+    jits = {"serve/policy_b1": {"in_avals": ["float32[1,100]"]}}
+    monkeypatch.setattr(lm, "ledger_entry", _fake_ledger({1: 400}, jits=jits))
+    # same dtype, half the argument bytes (ledger has 100): a narrower
+    # f32 model -> floored back to the ledger entry
+    dec = lm.size_ladder(
+        None, lambda r: (np.zeros((r, 12), np.float32),), [1], "serve",
+        mem_budget_bytes=10**9,
+    )
+    assert dec[0].peak_bytes == 400 and "x1.00" in dec[0].reason
+    # int8 live example, quarter the bytes: the prediction must NOT be
+    # floored back up to the f32 entry
+    dec = lm.size_ladder(
+        None, lambda r: (np.zeros((r, 25), np.int8),), [1], "serve",
+        mem_budget_bytes=10**9,
+    )
+    assert dec[0].peak_bytes == 100 and "x0.25" in dec[0].reason
+
+
+def test_derive_rung_occupancy_candidates():
+    """Occupancy-driven re-tier (ISSUE 20): degenerate, existing,
+    over-max, and too-close candidates are all rejected."""
+    assert lm.derive_rung(3.0, [1, 2, 8], 8) == 3
+    assert lm.derive_rung(5.2, [1, 2, 8], 8) == 5
+    assert lm.derive_rung(0.0, [1, 2, 8], 8) is None  # degenerate
+    assert lm.derive_rung(2.2, [1, 2, 8], 8) is None  # already a rung
+    assert lm.derive_rung(9.0, [1, 2, 8], 8) is None  # over --max_batch
+    assert lm.derive_rung(7.4, [1, 2, 8], 8) is None  # within 1 of rung 8
 
 
 def test_size_ladder_probe_fallback_uses_real_compile(monkeypatch, tmp_path):

@@ -1,0 +1,343 @@
+"""sheepquant (ISSUE 20): calibration determinism, quality-receipt
+acceptance, quantized pad-slice parity, hot-reload scale re-derivation,
+and fused-kernel parity in interpret mode."""
+
+import json
+import os
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu.compile.decisions as dec
+import sheeprl_tpu.ops.quant as q
+from sheeprl_tpu.algos.sac.agent import SACActor
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.ops import pallas_kernels as pk
+from sheeprl_tpu.serve.quant import QuantState, action_divergence
+
+OBS_DIM, ACT_DIM, HIDDEN = 6, 3, 8
+
+
+def _tiny_actor(seed=0):
+    return SACActor.init(
+        jax.random.PRNGKey(seed), OBS_DIM, ACT_DIM, hidden_size=HIDDEN
+    )
+
+
+def _actor_call(m, obs):
+    return m.get_greedy_actions(jnp.asarray(obs, jnp.float32))
+
+
+def _quantized(actor, seed=0):
+    rng = np.random.default_rng(seed)
+    batches = [rng.standard_normal((16, OBS_DIM)).astype(np.float32)
+               for _ in range(3)]
+    scales = q.calibrate(actor, _actor_call, batches)
+    return q.quantize_linears(actor, scales), scales
+
+
+def _seeded_buffer(seed=11):
+    buf = ReplayBuffer(32, n_envs=1, storage="host", obs_keys=("obs",), seed=seed)
+    data_rng = np.random.default_rng(99)  # buffer CONTENT is fixed
+    buf.add({"obs": data_rng.standard_normal((32, 1, OBS_DIM)).astype(np.float32)})
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+
+def test_calibration_deterministic_from_seeded_buffer():
+    """Two freshly seeded buffers with the same contents must yield
+    bit-identical scales through calibrate_from_buffer (the persisted
+    quant_scales.npz contract: a restart re-quantizes identically)."""
+    actor = _tiny_actor()
+    s1 = q.calibrate_from_buffer(
+        actor, _actor_call, _seeded_buffer(), obs_key="obs",
+        n_batches=2, batch_size=8,
+    )
+    s2 = q.calibrate_from_buffer(
+        actor, _actor_call, _seeded_buffer(), obs_key="obs",
+        n_batches=2, batch_size=8,
+    )
+    assert sorted(s1) == sorted(s2)
+    # the greedy forward touches every Linear in the actor
+    assert sorted(s1) == sorted(q.linear_paths(actor))
+    for k in s1:
+        np.testing.assert_array_equal(s1[k], s2[k])
+    # a differently seeded buffer draws different batches -> different scales
+    s3 = q.calibrate_from_buffer(
+        actor, _actor_call, _seeded_buffer(seed=12), obs_key="obs",
+        n_batches=2, batch_size=8,
+    )
+    assert any(not np.array_equal(s1[k], s3[k]) for k in s1)
+
+
+def test_quantized_actor_close_to_f32():
+    actor = _tiny_actor()
+    qactor, scales = _quantized(actor)
+    assert all(v.dtype == np.float32 for v in scales.values())
+    obs = np.random.default_rng(1).standard_normal((4, OBS_DIM)).astype(np.float32)
+    a32 = np.asarray(_actor_call(actor, obs))
+    a8 = np.asarray(_actor_call(qactor, obs))
+    assert action_divergence(a32, a8) < 0.05  # int8 stays near full width
+    assert action_divergence(a32, a8) > 0.0  # but is NOT bit-exact
+
+
+# ---------------------------------------------------------------------------
+# quality-receipt acceptance (compile/decisions.py extension)
+# ---------------------------------------------------------------------------
+
+
+def test_decide_quality_receipt_tight_bound_disqualifies(tmp_path):
+    store = str(tmp_path / "d.json")
+    example = (np.ones((4, 3), np.float32),)
+
+    def build(label):
+        if label == "approx":
+            return lambda x: x * 2.0 + 0.01
+        return lambda x: x * 2.0
+
+    d = dec.decide(
+        "toy", "mul@tight", ["base", "approx"], build, example,
+        objective="seconds", quality_metric=action_divergence,
+        quality_bound=1e-4, store_path=store,
+    )
+    # the approx candidate diverges by 0.01 > 1e-4: DISQUALIFIED, the
+    # baseline wins regardless of timing
+    assert d.winner == "base"
+    rep = d.candidate("approx")
+    assert rep["within_bound"] is False
+    assert rep["divergence"] == pytest.approx(0.01, rel=1e-3)
+    # the bound is committed next to the record (the sheepopt receipt)
+    with open(store) as fh:
+        blob = json.load(fh)
+    (rec,) = [r for r in blob.values() if r.get("name") == "mul@tight"]
+    assert rec["quality_bound"] == pytest.approx(1e-4)
+
+
+def test_decide_quality_receipt_loose_bound_accepts(tmp_path):
+    store = str(tmp_path / "d.json")
+    example = (np.ones((4, 3), np.float32),)
+
+    def build(label):
+        if label == "approx":
+            return lambda x: x * 2.0 + 0.01
+        return lambda x: x * 2.0
+
+    d = dec.decide(
+        "toy", "mul@loose", ["base", "approx"], build, example,
+        objective="seconds", quality_metric=action_divergence,
+        quality_bound=0.1, store_path=store,
+    )
+    rep = d.candidate("approx")
+    assert rep["within_bound"] is True  # eligible; winner is whoever timed faster
+    assert d.candidate("base")["within_bound"] is True
+    assert d.quality_bound == pytest.approx(0.1)
+
+
+def test_decide_quality_args_come_together(tmp_path):
+    with pytest.raises(ValueError, match="come together"):
+        dec.decide(
+            "toy", "bad", ["a"], lambda label: (lambda x: x),
+            (np.ones((2,), np.float32),),
+            quality_metric=action_divergence,
+            store_path=str(tmp_path / "d.json"),
+        )
+
+
+def _quant_state(tmp_path, actor, bound, ckpt=None, seed=3):
+    policy = types.SimpleNamespace(
+        algo="sac",
+        obs_dim=OBS_DIM,
+        step=jax.jit(lambda p, obs: p.get_greedy_actions(obs)),
+    )
+    args = types.SimpleNamespace(quant_bound=bound, seed=seed, ckpt=ckpt)
+    return QuantState(policy, args, str(tmp_path))
+
+
+def test_accept_rungs_tight_bound_keeps_f32(tmp_path):
+    """An impossibly tight bound DISQUALIFIES every int8 rung: the ladder
+    keeps serving f32 and the receipt says why."""
+    actor = _tiny_actor()
+    qs = _quant_state(tmp_path, actor, bound=1e-12)
+    won = qs.accept_rungs(1, actor, [1, 2])
+    assert won == set() and qs.int8_rungs == set()
+    assert qs.available
+    for rung in (1, 2):
+        d = qs.decisions[rung]
+        assert d.winner == "f32"
+        rep = d.candidate("int8")
+        assert rep["within_bound"] is False and rep["divergence"] > 1e-12
+    assert os.path.exists(qs.store_path)
+
+
+def test_accept_rungs_loose_bound_int8_eligible(tmp_path):
+    actor = _tiny_actor()
+    qs = _quant_state(tmp_path, actor, bound=10.0)
+    qs.accept_rungs(1, actor, [1])
+    rep = qs.decisions[1].candidate("int8")
+    assert rep["within_bound"] is True
+    assert 0.0 < rep["divergence"] <= 10.0
+    g = qs.gauges()
+    assert g["Serve/quant_enabled"] == 1.0
+    assert g["Serve/quant_bound"] == 10.0
+
+
+# ---------------------------------------------------------------------------
+# pad-slice parity of the quantized rung
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_pad_slice_parity():
+    """Zero-padding rows up to a rung and slicing back must be bit-exact
+    against the direct call — int8 per-row math never mixes rows (the
+    batcher's padding contract extends to quantized rungs)."""
+    qactor, _ = _quantized(_tiny_actor())
+    step = jax.jit(lambda p, obs: p.get_greedy_actions(obs))
+    obs = np.random.default_rng(5).standard_normal((3, OBS_DIM)).astype(np.float32)
+    padded = np.concatenate([obs, np.zeros((1, OBS_DIM), np.float32)], axis=0)
+    direct = np.asarray(step(qactor, jnp.asarray(obs)))
+    sliced = np.asarray(step(qactor, jnp.asarray(padded)))[:3]
+    np.testing.assert_array_equal(direct, sliced)
+
+
+# ---------------------------------------------------------------------------
+# hot reload re-derives scales
+# ---------------------------------------------------------------------------
+
+
+def test_hot_reload_rederives_scales(tmp_path):
+    actor_v1 = _tiny_actor(seed=0)
+    actor_v2 = _tiny_actor(seed=1)
+    qs = _quant_state(tmp_path, actor_v1, bound=0.05)
+    q1 = qs.params_for(1, actor_v1)
+    assert qs.params_for(1, actor_v1) is q1  # cached per version
+    assert qs.rederives == 0
+    q2 = qs.params_for(2, actor_v2)  # the hot-reload path
+    assert qs.rederives == 1 and q2 is not q1
+    # the new weights were re-calibrated, not served under stale scales
+    assert not np.array_equal(
+        np.asarray(q2.fc_mean.w_q), np.asarray(q1.fc_mean.w_q)
+    )
+    assert qs.gauges()["Serve/quant_rederives"] == 1.0
+
+
+def test_reload_hook_rederives_off_the_dispatch_path(tmp_path):
+    """The ParamsStore on_reload hook rebuilds the quantized twin in the
+    reload thread, so the first int8 dispatch after a swap finds the
+    cache already at the new version."""
+    from sheeprl_tpu.serve.params import ParamsStore
+
+    actor_v1 = _tiny_actor(seed=0)
+    actor_v2 = _tiny_actor(seed=1)
+    qs = _quant_state(tmp_path, actor_v1, bound=0.05)
+    qs.params_for(1, actor_v1)  # startup derivation
+
+    store = ParamsStore(lambda path: actor_v2, actor_v1, source="ckpt_1")
+    store.on_reload = qs.params_for
+    reply = store.reload()
+    assert reply["ok"] and reply["version"] == 2
+    assert qs.rederives == 1
+    # a dispatch at the new version is a pure cache hit — no second derive
+    assert qs.params_for(*store.current()) is qs._cache[1]
+    assert qs.rederives == 1
+
+
+def test_reload_hook_failure_keeps_the_swap(tmp_path):
+    """A broken derived-state hook must not fail the reload itself."""
+    from sheeprl_tpu.serve.params import ParamsStore
+
+    events = []
+
+    class _Telem:
+        def event(self, name, **data):
+            events.append((name, data))
+
+    store = ParamsStore(lambda path: {"w": 2}, {"w": 1}, source="c", telem=_Telem())
+
+    def boom(version, params):
+        raise RuntimeError("hook exploded")
+
+    store.on_reload = boom
+    reply = store.reload()
+    assert reply["ok"] and reply["version"] == 2
+    assert store.current() == (2, {"w": 2})
+    hook_errs = [e for e in events if e[0] == "serve.reload_hook_error"]
+    assert hook_errs and "hook exploded" in hook_errs[0][1]["error"]
+
+
+def test_scales_persist_next_to_checkpoint(tmp_path):
+    ckpt = str(tmp_path / "ckpt_100")
+    os.makedirs(ckpt)
+    actor = _tiny_actor()
+    qs = _quant_state(tmp_path, actor, bound=0.05, ckpt=ckpt)
+    qs.params_for(1, actor)
+    path = q.scales_path(ckpt)
+    assert os.path.exists(path)
+    persisted = q.load_scales(path)
+    assert sorted(persisted) == sorted(q.linear_paths(actor))
+    # a fresh serve process re-quantizes from the persisted scales:
+    # identical quantized weights, no re-calibration drift
+    qs2 = _quant_state(tmp_path, actor, bound=0.05, ckpt=ckpt, seed=77)
+    qb = qs2.params_for(1, actor)
+    qa = qs.params_for(1, actor)
+    np.testing.assert_array_equal(
+        np.asarray(qa.fc_mean.w_q), np.asarray(qb.fc_mean.w_q)
+    )
+
+
+# ---------------------------------------------------------------------------
+# fused Pallas trunk
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def pallas_interpret():
+    pk.set_pallas(True, interpret=True)
+    yield
+    pk.set_pallas(None, interpret=False)
+
+
+def test_fused_int8_trunk_matches_reference(pallas_interpret):
+    rng = np.random.default_rng(7)
+
+    def lin(n_in, n_out):
+        w = rng.standard_normal((n_in, n_out)).astype(np.float32) * 0.3
+        s_in = jnp.asarray(np.abs(rng.standard_normal(n_in)) + 0.05, jnp.float32)
+        w_eff = jnp.asarray(w) * s_in[:, None]
+        ws = q.absmax_scale(w_eff, axis=0)
+        return (
+            s_in, q.quantize(w_eff, ws), ws,
+            jnp.asarray(rng.standard_normal(n_out), jnp.float32),
+        )
+
+    l0, l1, m = lin(OBS_DIM, HIDDEN), lin(HIDDEN, HIDDEN), lin(HIDDEN, ACT_DIM)
+    x = jnp.asarray(rng.standard_normal((5, OBS_DIM)), jnp.float32)
+    got = pk.fused_int8_trunk(x, *l0, *l1, *m)
+    want = pk.int8_trunk_reference(x, *l0, *l1, *m)
+    # the int8 chain is identical math; the dequant multiply-add may fuse
+    # differently (FMA) between the interpreter and XLA — f32 ulp noise
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    assert pk.fused_int8_trunk_supported(*l0, *l1, *m)
+
+
+def test_fused_sac_step_matches_generic_quant_path(pallas_interpret):
+    """The fused step and the generic QuantLinear path share int8_linear:
+    same quantized actor, same obs, same actions to f32 ulp noise."""
+    from sheeprl_tpu.serve.quant import _make_fused_sac_step, _sac_fused_ready
+
+    qactor, _ = _quantized(_tiny_actor())
+    policy = types.SimpleNamespace(algo="sac")
+    assert _sac_fused_ready(policy, qactor)
+    fused = _make_fused_sac_step()
+    obs = jnp.asarray(
+        np.random.default_rng(9).standard_normal((4, OBS_DIM)), jnp.float32
+    )
+    got = np.asarray(fused(qactor, obs))
+    want = np.asarray(qactor.get_greedy_actions(obs))
+    np.testing.assert_allclose(got, want, atol=1e-6)

@@ -288,20 +288,33 @@ def load_decisions(path: str) -> dict:
         return {}
 
 
-def render_decisions(cache: dict) -> list[str]:
+def render_decisions(cache: dict, family: str | None = None) -> list[str]:
     lines = []
     for key, rec in sorted(cache.items()):
         fam = rec.get("family", key.split("|", 1)[0])
+        if family and fam != family:
+            continue
         if "candidates" in rec:
             winner = rec.get("winner")
             base = rec.get("baseline")
             cands = rec.get("candidates", {})
             wr, br = cands.get(str(winner), {}), cands.get(str(base), {})
-            receipt = (
-                "bit-exact" if wr.get("bit_exact")
-                else "DISQUALIFIED" if wr.get("bit_exact") is False
-                else "unmeasured"
-            )
+            # the quality receipt (ISSUE 20): a non-bit-exact winner that
+            # was accepted under a quality bound prints its committed
+            # divergence next to the bound it satisfied
+            bound = rec.get("quality_bound")
+            if wr.get("bit_exact"):
+                receipt = "bit-exact"
+            elif bound is not None and wr.get("divergence") is not None:
+                receipt = (
+                    f"divergence {wr['divergence']:.3g} <= bound {bound:g}"
+                    if wr.get("within_bound")
+                    else f"divergence {wr['divergence']:.3g} > bound {bound:g}"
+                )
+            elif wr.get("bit_exact") is False:
+                receipt = "DISQUALIFIED"
+            else:
+                receipt = "unmeasured"
             delta = ""
             if wr.get("peak_bytes") is not None and br.get("peak_bytes"):
                 delta += f" bytes {wr['peak_bytes'] - br['peak_bytes']:+d}"
@@ -315,6 +328,19 @@ def render_decisions(cache: dict) -> list[str]:
                 f"{',' if delta else ''}{delta}) "
                 f"{'ACCEPTED' if rec.get('accepted') else 'baseline kept'}"
             )
+            if bound is not None:
+                for label in sorted(cands):
+                    cr = cands[label]
+                    if label == str(winner) or cr.get("within_bound") is not False:
+                        continue
+                    div = cr.get("divergence")
+                    lines.append(
+                        f"    DISQUALIFIED {label}: divergence "
+                        f"{div:.3g} > bound {bound:g}"
+                        if div is not None
+                        else f"    DISQUALIFIED {label}: "
+                        f"{cr.get('error') or 'quality metric failed'}"
+                    )
         elif "probe" in rec:
             lines.append(
                 f"[{fam}] {rec.get('name', '?')}: measured probe "
@@ -380,6 +406,11 @@ def main(argv: list[str] | None = None) -> int:
              "SHEEPRL_TPU_BUDGET_DIR honored)",
     )
     ap.add_argument(
+        "--family", default=None,
+        help="with --decisions: only print records of this knob family "
+             "(e.g. serve_quant, serve_ladder, remat)",
+    )
+    ap.add_argument(
         "--decision-cache", default=None,
         help="decision cache path (default: decisions.json next to the "
              "compile cache)",
@@ -396,12 +427,17 @@ def main(argv: list[str] | None = None) -> int:
 
     if ns.decisions:
         cache = load_decisions(decision_cache_path(ns.decision_cache))
+        if ns.family:
+            cache = {
+                k: r for k, r in cache.items()
+                if r.get("family", k.split("|", 1)[0]) == ns.family
+            }
         if ns.json:
             print(json.dumps(cache, indent=2, sort_keys=True))
         elif not cache:
             print("decision cache empty (no measured decisions yet)")
         else:
-            for line in render_decisions(cache):
+            for line in render_decisions(cache, family=ns.family):
                 print(line)
         return 0
 
